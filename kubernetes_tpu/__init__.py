@@ -1,0 +1,25 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A brand-new implementation of the capabilities of Kubernetes' kube-scheduler
+(reference: upstream k8s ~v1.20/1.21, ``pkg/scheduler/``), re-designed
+TPU-first: an authoritative host control path (watch-fed cluster cache,
+incremental snapshot, pluggable scheduling framework, three-tier pending
+queue, async binder) plus a JAX/XLA batch path that evaluates scheduling
+predicates and scores as dense pod-by-node tensors and solves assignment on
+device (serial-equivalent `lax.scan` commit, or sharded multi-chip solve via
+`shard_map` over a `jax.sharding.Mesh`).
+
+Layout (mirrors SURVEY.md section 2's component inventory):
+
+- ``api/``        object model + apimachinery subset (Quantity, label selectors)
+- ``apiserver/``  in-process state store with watches + Binding subresource
+- ``scheduler/``  cache, snapshot, queue, framework, plugins, core, loop
+- ``config/``     component config (profiles, plugin args), feature gates
+- ``ops/``        JAX predicate/score kernels + device snapshot encoding
+- ``parallel/``   mesh construction + sharded solver (multi-chip)
+- ``harness/``    scheduler_perf-style declarative benchmark harness
+- ``metrics/``    prometheus-style metrics registry
+- ``utils/``      tracing, clocks, backoff, parallel helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,42 @@
+"""Object model + apimachinery subset.
+
+The reference spreads this over ``staging/src/k8s.io/apimachinery`` (56.7k
+LoC) and ``staging/src/k8s.io/api`` (280k generated LoC); the scheduler only
+needs a focused slice: resource quantities, label selectors, object meta, and
+the Pod/Node families of types. See SURVEY.md section 2.6.
+"""
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+from kubernetes_tpu.api.labels import (
+    LabelSelector,
+    Requirement,
+    Selector,
+    parse_selector,
+)
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Service,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    Volume,
+    WeightedPodAffinityTerm,
+)

@@ -1,0 +1,157 @@
+"""Resource quantities.
+
+Behavioral equivalent of the reference's ``apimachinery/pkg/api/resource``
+Quantity (suffix grammar: decimal SI ``n u m "" k M G T P E``, binary
+``Ki Mi Gi Ti Pi Ei``, and scientific notation), reduced to what scheduling
+needs: parse, compare, add/sub, and the two canonical scalar views the
+scheduler's Resource vectors use (``milli_value`` for cpu,
+``value`` for memory/storage/counts).
+
+Unlike the reference (infinite-precision inf.Dec), we store an exact
+integer count of nano-units. Nano is the finest suffix the grammar admits,
+so every parseable quantity is exact; scheduling math in the reference
+happens on int64 MilliCPU/bytes anyway (``pkg/scheduler/framework/types.go``
+Resource), which this representation round-trips losslessly.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from functools import total_ordering
+
+_NANO = 10**9
+
+_SUFFIXES = {
+    "n": 1,                      # nano
+    "u": 10**3,                  # micro
+    "m": 10**6,                  # milli
+    "": _NANO,
+    "k": _NANO * 10**3,
+    "M": _NANO * 10**6,
+    "G": _NANO * 10**9,
+    "T": _NANO * 10**12,
+    "P": _NANO * 10**15,
+    "E": _NANO * 10**18,
+    "Ki": _NANO * 2**10,
+    "Mi": _NANO * 2**20,
+    "Gi": _NANO * 2**30,
+    "Ti": _NANO * 2**40,
+    "Pi": _NANO * 2**50,
+    "Ei": _NANO * 2**60,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)"
+    r"(?:(?P<suffix>[numkMGTPE]i?|Ki)|[eE](?P<exp>[+-]?\d+))?$"
+)
+
+
+@total_ordering
+class Quantity:
+    """An exact resource amount, stored as integer nano-units."""
+
+    __slots__ = ("nano",)
+
+    def __init__(self, nano: int = 0):
+        self.nano = int(nano)
+
+    # --- constructors -------------------------------------------------
+    @classmethod
+    def from_milli(cls, milli: int) -> "Quantity":
+        return cls(int(milli) * 10**6)
+
+    @classmethod
+    def from_value(cls, value: int) -> "Quantity":
+        return cls(int(value) * _NANO)
+
+    # --- views --------------------------------------------------------
+    def milli_value(self) -> int:
+        """Ceiling milli-units (reference Quantity.MilliValue rounds up)."""
+        return -((-self.nano) // 10**6)
+
+    def value(self) -> int:
+        """Ceiling whole units (reference Quantity.Value rounds up)."""
+        return -((-self.nano) // _NANO)
+
+    # --- arithmetic ---------------------------------------------------
+    def __add__(self, other: "Quantity") -> "Quantity":
+        return Quantity(self.nano + other.nano)
+
+    def __sub__(self, other: "Quantity") -> "Quantity":
+        return Quantity(self.nano - other.nano)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Quantity) and self.nano == other.nano
+
+    def __lt__(self, other: "Quantity") -> bool:
+        return self.nano < other.nano
+
+    def __hash__(self):
+        return hash(self.nano)
+
+    def __bool__(self):
+        return self.nano != 0
+
+    def __repr__(self):
+        return f"Quantity({self.to_string()!r})"
+
+    def to_string(self) -> str:
+        """Canonical-ish rendering: prefer whole units, then m, then n."""
+        if self.nano % _NANO == 0:
+            return str(self.nano // _NANO)
+        if self.nano % 10**6 == 0:
+            return f"{self.nano // 10**6}m"
+        return f"{self.nano}n"
+
+
+def parse_quantity(s) -> Quantity:
+    """Parse a quantity string (or int/float unit count) into a Quantity.
+
+    Accepts the reference grammar's common forms: "100m", "2", "1.5",
+    "64Mi", "2Gi", "1e3", "500". Raises ValueError on garbage.
+    """
+    if isinstance(s, Quantity):
+        return s
+    if isinstance(s, bool):
+        raise ValueError(f"cannot parse quantity from bool {s!r}")
+    if isinstance(s, int):
+        return Quantity.from_value(s)
+    if isinstance(s, float):
+        if not math.isfinite(s):
+            raise ValueError(f"cannot parse quantity from {s!r}")
+        return Quantity(round(s * _NANO))
+    m = _QUANTITY_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"invalid quantity {s!r}")
+    sign = -1 if m.group("sign") == "-" else 1
+    num = m.group("num")
+    if m.group("exp") is not None:
+        scale = _NANO
+        exp = int(m.group("exp"))
+    else:
+        suffix = m.group("suffix") or ""
+        if suffix not in _SUFFIXES:
+            raise ValueError(f"invalid quantity suffix in {s!r}")
+        scale = _SUFFIXES[suffix]
+        exp = 0
+    # exact decimal -> integer nano computation
+    if "." in num:
+        int_part, frac_part = num.split(".")
+        int_part = int_part or "0"
+        digits = int(int_part + frac_part)
+        denom = 10 ** len(frac_part)
+    else:
+        digits = int(num)
+        denom = 1
+    if exp >= 0:
+        numer = digits * scale * 10**exp
+    else:
+        denom *= 10**(-exp)
+        numer = digits * scale
+    if numer % denom != 0:
+        # sub-nano precision: round half away from zero like inf.Dec scaling
+        nano = (numer + denom // 2) // denom
+    else:
+        nano = numer // denom
+    return Quantity(sign * nano)

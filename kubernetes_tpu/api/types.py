@@ -1,0 +1,624 @@
+"""Typed object model (the scheduler-relevant slice of core/v1 + apps/v1).
+
+Equivalent surface to the reference's generated API types
+(``staging/src/k8s.io/api/core/v1/types.go``), hand-written as plain Python
+dataclasses with ``from_dict`` constructors accepting k8s-manifest-shaped
+dicts, so harness workload configs can be written in familiar YAML/JSON.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional
+
+from kubernetes_tpu.api.labels import LabelSelector, Requirement
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+
+# Well-known resource names (reference v1.ResourceName constants).
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+DEFAULT_MILLI_CPU_REQUEST = 100       # reference util defaults for
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # NonZero requests (schedutil)
+
+# Taint effects.
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# Pod phases.
+PENDING, RUNNING, SUCCEEDED, FAILED = "Pending", "Running", "Succeeded", "Failed"
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: str = ""
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    owner_references: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid") or new_uid(),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=list(d.get("ownerReferences") or []),
+        )
+
+
+def _parse_resource_list(d: Optional[Mapping]) -> Dict[str, Quantity]:
+    return {k: parse_quantity(v) for k, v in (d or {}).items()}
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ContainerPort":
+        return cls(
+            container_port=int(d.get("containerPort") or 0),
+            host_port=int(d.get("hostPort") or 0),
+            protocol=d.get("protocol") or "TCP",
+            host_ip=d.get("hostIP") or "",
+        )
+
+
+@dataclass
+class ResourceRequirements:
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+    limits: Dict[str, Quantity] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "ResourceRequirements":
+        d = d or {}
+        return cls(
+            requests=_parse_resource_list(d.get("requests")),
+            limits=_parse_resource_list(d.get("limits")),
+        )
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Container":
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            resources=ResourceRequirements.from_dict(d.get("resources")),
+            ports=[ContainerPort.from_dict(p) for p in (d.get("ports") or [])],
+        )
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str
+    values: List[str] = field(default_factory=list)
+
+    def to_requirement(self) -> Requirement:
+        return Requirement(self.key, self.operator, tuple(self.values))
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "NodeSelectorRequirement":
+        return cls(d["key"], d["operator"], list(d.get("values") or []))
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+    match_fields: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "NodeSelectorTerm":
+        return cls(
+            match_expressions=[
+                NodeSelectorRequirement.from_dict(e)
+                for e in (d.get("matchExpressions") or [])
+            ],
+            match_fields=[
+                NodeSelectorRequirement.from_dict(e)
+                for e in (d.get("matchFields") or [])
+            ],
+        )
+
+
+@dataclass
+class NodeSelector:
+    """ORed terms; each term's expressions/fields are ANDed."""
+
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["NodeSelector"]:
+        if d is None:
+            return None
+        return cls(
+            node_selector_terms=[
+                NodeSelectorTerm.from_dict(t)
+                for t in (d.get("nodeSelectorTerms") or [])
+            ]
+        )
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PreferredSchedulingTerm":
+        return cls(int(d["weight"]), NodeSelectorTerm.from_dict(d.get("preference") or {}))
+
+
+@dataclass
+class NodeAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[NodeSelector] = None
+    preferred_during_scheduling_ignored_during_execution: List[
+        PreferredSchedulingTerm
+    ] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["NodeAffinity"]:
+        if d is None:
+            return None
+        return cls(
+            required_during_scheduling_ignored_during_execution=NodeSelector.from_dict(
+                d.get("requiredDuringSchedulingIgnoredDuringExecution")
+            ),
+            preferred_during_scheduling_ignored_during_execution=[
+                PreferredSchedulingTerm.from_dict(t)
+                for t in (d.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+            ],
+        )
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: List[str] = field(default_factory=list)
+    topology_key: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodAffinityTerm":
+        return cls(
+            label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+            namespaces=list(d.get("namespaces") or []),
+            topology_key=d.get("topologyKey", ""),
+        )
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    pod_affinity_term: PodAffinityTerm
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "WeightedPodAffinityTerm":
+        return cls(int(d["weight"]), PodAffinityTerm.from_dict(d.get("podAffinityTerm") or {}))
+
+
+@dataclass
+class PodAffinity:
+    required_during_scheduling_ignored_during_execution: List[PodAffinityTerm] = field(
+        default_factory=list
+    )
+    preferred_during_scheduling_ignored_during_execution: List[
+        WeightedPodAffinityTerm
+    ] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["PodAffinity"]:
+        if d is None:
+            return None
+        return cls(
+            required_during_scheduling_ignored_during_execution=[
+                PodAffinityTerm.from_dict(t)
+                for t in (d.get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+            ],
+            preferred_during_scheduling_ignored_during_execution=[
+                WeightedPodAffinityTerm.from_dict(t)
+                for t in (d.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+            ],
+        )
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAffinity] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["Affinity"]:
+        if d is None:
+            return None
+        return cls(
+            node_affinity=NodeAffinity.from_dict(d.get("nodeAffinity")),
+            pod_affinity=PodAffinity.from_dict(d.get("podAffinity")),
+            pod_anti_affinity=PodAffinity.from_dict(d.get("podAntiAffinity")),
+        )
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: "Taint") -> bool:
+        """Reference v1helper.TolerationsTolerateTaint semantics."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        # operator Equal; empty key + Exists handled above. Empty key with
+        # Equal matches only empty taint key (covered by key check).
+        return self.value == taint.value
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Toleration":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", "Equal"),
+            value=d.get("value", ""),
+            effect=d.get("effect", ""),
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Taint":
+        return cls(d.get("key", ""), d.get("value", ""), d.get("effect", NO_SCHEDULE))
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "TopologySpreadConstraint":
+        return cls(
+            max_skew=int(d.get("maxSkew", 1)),
+            topology_key=d.get("topologyKey", ""),
+            when_unsatisfiable=d.get("whenUnsatisfiable", "DoNotSchedule"),
+            label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+        )
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # Exactly one source is typically set; we keep the ones scheduling cares about.
+    persistent_volume_claim: Optional[str] = None  # claimName
+    host_path: Optional[str] = None
+    ephemeral: bool = False
+    gce_persistent_disk: Optional[str] = None  # pdName
+    aws_elastic_block_store: Optional[str] = None  # volumeID
+    rbd: Optional[dict] = None
+    iscsi: Optional[dict] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Volume":
+        pvc = d.get("persistentVolumeClaim") or {}
+        gce = d.get("gcePersistentDisk") or {}
+        aws = d.get("awsElasticBlockStore") or {}
+        return cls(
+            name=d.get("name", ""),
+            persistent_volume_claim=pvc.get("claimName"),
+            host_path=(d.get("hostPath") or {}).get("path"),
+            ephemeral=bool(d.get("ephemeral")),
+            gce_persistent_disk=gce.get("pdName"),
+            aws_elastic_block_store=aws.get("volumeID"),
+            rbd=d.get("rbd"),
+            iscsi=d.get("iscsi"),
+        )
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Dict[str, Quantity] = field(default_factory=dict)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    scheduler_name: str = "default-scheduler"
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
+    volumes: List[Volume] = field(default_factory=list)
+    host_network: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodSpec":
+        return cls(
+            containers=[Container.from_dict(c) for c in (d.get("containers") or [])],
+            init_containers=[
+                Container.from_dict(c) for c in (d.get("initContainers") or [])
+            ],
+            overhead=_parse_resource_list(d.get("overhead")),
+            node_name=d.get("nodeName", ""),
+            node_selector=dict(d.get("nodeSelector") or {}),
+            affinity=Affinity.from_dict(d.get("affinity")),
+            tolerations=[Toleration.from_dict(t) for t in (d.get("tolerations") or [])],
+            scheduler_name=d.get("schedulerName") or "default-scheduler",
+            priority=d.get("priority"),
+            priority_class_name=d.get("priorityClassName", ""),
+            preemption_policy=d.get("preemptionPolicy") or "PreemptLowerPriority",
+            topology_spread_constraints=[
+                TopologySpreadConstraint.from_dict(t)
+                for t in (d.get("topologySpreadConstraints") or [])
+            ],
+            volumes=[Volume.from_dict(v) for v in (d.get("volumes") or [])],
+            host_network=bool(d.get("hostNetwork")),
+        )
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
+        d = d or {}
+        return cls(
+            phase=d.get("phase", PENDING),
+            nominated_node_name=d.get("nominatedNodeName", ""),
+        )
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def priority(self) -> int:
+        """Reference podutil.GetPodPriority: nil priority means 0."""
+        return self.spec.priority if self.spec.priority is not None else 0
+
+    def full_name(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Pod":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+            status=PodStatus.from_dict(d.get("status")),
+        )
+
+
+@dataclass
+class ContainerImage:
+    names: List[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    allocatable: Dict[str, Quantity] = field(default_factory=dict)
+    images: List[ContainerImage] = field(default_factory=list)
+    conditions: List[PodCondition] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "NodeStatus":
+        d = d or {}
+        capacity = _parse_resource_list(d.get("capacity"))
+        allocatable = _parse_resource_list(d.get("allocatable")) or dict(capacity)
+        return cls(
+            capacity=capacity,
+            allocatable=allocatable,
+            images=[
+                ContainerImage(list(i.get("names") or []), int(i.get("sizeBytes") or 0))
+                for i in (d.get("images") or [])
+            ],
+        )
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+    provider_id: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "NodeSpec":
+        d = d or {}
+        return cls(
+            unschedulable=bool(d.get("unschedulable")),
+            taints=[Taint.from_dict(t) for t in (d.get("taints") or [])],
+            provider_id=d.get("providerID", ""),
+        )
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Node":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NodeSpec.from_dict(d.get("spec")),
+            status=NodeStatus.from_dict(d.get("status")),
+        )
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: Optional[str] = None
+    access_modes: List[str] = field(default_factory=list)
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+    volume_name: str = ""
+    phase: str = "Pending"  # Pending | Bound | Lost
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    node_affinity: Optional[NodeSelector] = None
+    claim_ref: Optional[str] = None  # "namespace/name" of bound PVC
+    phase: str = "Available"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = "Immediate"  # or WaitForFirstConsumer
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class StatefulSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    label_selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def selector(self):
+        from kubernetes_tpu.api.labels import Selector
+
+        if self.label_selector is None:
+            return Selector.nothing()
+        return self.label_selector.to_selector()
+
+
+@dataclass
+class CSINodeDriver:
+    name: str
+    node_id: str = ""
+    allocatable_count: Optional[int] = None
+
+
+@dataclass
+class CSINode:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: List[CSINodeDriver] = field(default_factory=list)

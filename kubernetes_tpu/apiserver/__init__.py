@@ -1,0 +1,1 @@
+from kubernetes_tpu.apiserver.store import ClusterStore, Event, WatchHandle

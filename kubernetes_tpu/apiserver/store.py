@@ -1,0 +1,346 @@
+"""In-process cluster state store with watches and the Binding subresource.
+
+Plays the role the reference's apiserver+etcd+client-go stack plays for the
+scheduler: a typed object store with monotonic resource versions, watch
+event fan-out (the informer feed — reference
+``tools/cache/reflector.go:254`` ListAndWatch → DeltaFIFO → handlers), the
+pod **Binding** subresource (``pkg/registry/core/pod/storage/storage.go:159``
+— setting ``spec.nodeName`` transactionally), and the lister surface
+plugins consume. ``scheduler_perf`` semantics carry over: there are no
+kubelets; a bound pod is a finished pod (SURVEY.md section 3.5).
+
+Thread-safety: all mutations take the store lock; watch events are
+dispatched synchronously in order (the in-process equivalent of the
+watch-cache fan-out), so handler ordering matches event ordering.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import (
+    CSINode,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    ReplicaSet,
+    ReplicationController,
+    Service,
+    StatefulSet,
+    StorageClass,
+)
+
+ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
+
+
+@dataclass
+class Event:
+    type: str
+    kind: str
+    obj: Any
+    old_obj: Any = None
+
+
+class WatchHandle:
+    def __init__(self, store: "ClusterStore", fn: Callable[[Event], None]):
+        self._store = store
+        self.fn = fn
+
+    def stop(self) -> None:
+        self._store._remove_watch(self)
+
+
+class _Lease:
+    __slots__ = ("holder", "renew_time", "duration")
+
+    def __init__(self, holder: str, renew_time: float, duration: float):
+        self.holder = holder
+        self.renew_time = renew_time
+        self.duration = duration
+
+
+class ClusterStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._pods: Dict[str, Pod] = {}           # "ns/name" -> Pod
+        self._nodes: Dict[str, Node] = {}
+        self._services: Dict[str, Service] = {}
+        self._rcs: Dict[str, ReplicationController] = {}
+        self._rss: Dict[str, ReplicaSet] = {}
+        self._sss: Dict[str, StatefulSet] = {}
+        self._pvcs: Dict[str, PersistentVolumeClaim] = {}
+        self._pvs: Dict[str, PersistentVolume] = {}
+        self._storage_classes: Dict[str, StorageClass] = {}
+        self._csi_nodes: Dict[str, CSINode] = {}
+        self._pdbs: Dict[str, PodDisruptionBudget] = {}
+        self._leases: Dict[str, _Lease] = {}
+        self._watches: List[WatchHandle] = []
+        self._assumed_pvs: Dict[str, str] = {}  # pv name -> pvc key (Reserve)
+
+    # ------------------------------------------------------------------
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _dispatch(self, event: Event) -> None:
+        for w in list(self._watches):
+            w.fn(event)
+
+    def watch(self, fn: Callable[[Event], None]) -> WatchHandle:
+        with self._lock:
+            h = WatchHandle(self, fn)
+            self._watches.append(h)
+            return h
+
+    def _remove_watch(self, handle: WatchHandle) -> None:
+        with self._lock:
+            if handle in self._watches:
+                self._watches.remove(handle)
+
+    # ------------------------------------------------------------------
+    # pods
+    def create_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            key = pod.full_name()
+            if key in self._pods:
+                raise ValueError(f"pod {key} already exists")
+            pod.metadata.resource_version = self._next_rv()
+            self._pods[key] = pod
+            self._dispatch(Event(ADDED, "Pod", pod))
+            return pod
+
+    def update_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            key = pod.full_name()
+            old = self._pods.get(key)
+            if old is None:
+                raise KeyError(f"pod {key} not found")
+            pod.metadata.resource_version = self._next_rv()
+            self._pods[key] = pod
+            self._dispatch(Event(MODIFIED, "Pod", pod, old))
+            return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            old = self._pods.pop(key, None)
+            if old is not None:
+                self._dispatch(Event(DELETED, "Pod", old))
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        with self._lock:
+            return self._pods.get(f"{namespace}/{name}")
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        with self._lock:
+            if namespace is None:
+                return list(self._pods.values())
+            return [p for p in self._pods.values() if p.namespace == namespace]
+
+    def bind(self, namespace: str, name: str, uid: str, node_name: str) -> None:
+        """The Binding subresource (storage.go:159 BindingREST.Create →
+        setPodHostAndAnnotations): transactionally sets spec.nodeName on the
+        live object, failing on UID mismatch or an already-bound pod."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            pod = self._pods.get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            if uid and pod.uid != uid:
+                raise ValueError(f"pod {key} uid mismatch")
+            if pod.spec.node_name and pod.spec.node_name != node_name:
+                raise ValueError(f"pod {key} is already assigned to node "
+                                 f"{pod.spec.node_name!r}")
+            # build a fresh object so watchers' `old` stays unassigned
+            # (in-process stores have no serialization boundary to copy for us)
+            import copy
+
+            new_pod = copy.copy(pod)
+            new_pod.spec = copy.copy(pod.spec)
+            new_pod.spec.node_name = node_name
+            new_pod.metadata = copy.copy(pod.metadata)
+            new_pod.metadata.resource_version = self._next_rv()
+            self._pods[key] = new_pod
+            self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))
+
+    def patch_pod_condition(self, namespace: str, name: str, condition) -> None:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is None:
+                return
+            pod.status.conditions = [
+                c for c in pod.status.conditions if c.type != condition.type
+            ] + [condition]
+
+    def set_nominated_node_name(self, namespace: str, name: str, node: str) -> None:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is not None:
+                pod.status.nominated_node_name = node
+
+    def clear_nominated_node_name(self, namespace: str, name: str) -> None:
+        self.set_nominated_node_name(namespace, name, "")
+
+    # ------------------------------------------------------------------
+    # generic add/update/delete for the remaining kinds
+    def _upsert(self, table: Dict, kind: str, key: str, obj) -> None:
+        with self._lock:
+            old = table.get(key)
+            obj.metadata.resource_version = self._next_rv()
+            table[key] = obj
+            self._dispatch(Event(MODIFIED if old is not None else ADDED, kind, obj, old))
+
+    def _delete(self, table: Dict, kind: str, key: str) -> None:
+        with self._lock:
+            old = table.pop(key, None)
+            if old is not None:
+                self._dispatch(Event(DELETED, kind, old))
+
+    def add_node(self, node: Node) -> None:
+        self._upsert(self._nodes, "Node", node.name, node)
+
+    def update_node(self, node: Node) -> None:
+        self._upsert(self._nodes, "Node", node.name, node)
+
+    def delete_node(self, name: str) -> None:
+        self._delete(self._nodes, "Node", name)
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def list_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def add_service(self, svc: Service) -> None:
+        self._upsert(self._services, "Service", f"{svc.metadata.namespace}/{svc.name}", svc)
+
+    def list_services(self, namespace: str) -> List[Service]:
+        with self._lock:
+            return [
+                s for s in self._services.values()
+                if s.metadata.namespace == namespace
+            ]
+
+    def add_replication_controller(self, rc: ReplicationController) -> None:
+        self._upsert(self._rcs, "ReplicationController",
+                     f"{rc.metadata.namespace}/{rc.metadata.name}", rc)
+
+    def list_replication_controllers(self, namespace: str) -> List[ReplicationController]:
+        with self._lock:
+            return [
+                r for r in self._rcs.values() if r.metadata.namespace == namespace
+            ]
+
+    def add_replica_set(self, rs: ReplicaSet) -> None:
+        self._upsert(self._rss, "ReplicaSet",
+                     f"{rs.metadata.namespace}/{rs.metadata.name}", rs)
+
+    def list_replica_sets(self, namespace: str) -> List[ReplicaSet]:
+        with self._lock:
+            return [
+                r for r in self._rss.values() if r.metadata.namespace == namespace
+            ]
+
+    def add_stateful_set(self, ss: StatefulSet) -> None:
+        self._upsert(self._sss, "StatefulSet",
+                     f"{ss.metadata.namespace}/{ss.metadata.name}", ss)
+
+    def list_stateful_sets(self, namespace: str) -> List[StatefulSet]:
+        with self._lock:
+            return [
+                s for s in self._sss.values() if s.metadata.namespace == namespace
+            ]
+
+    def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        self._upsert(self._pvcs, "PersistentVolumeClaim",
+                     f"{pvc.namespace}/{pvc.name}", pvc)
+
+    def get_pvc(self, namespace: str, name: str) -> Optional[PersistentVolumeClaim]:
+        with self._lock:
+            return self._pvcs.get(f"{namespace}/{name}")
+
+    def add_pv(self, pv: PersistentVolume) -> None:
+        self._upsert(self._pvs, "PersistentVolume", pv.name, pv)
+
+    def get_pv(self, name: str) -> Optional[PersistentVolume]:
+        with self._lock:
+            return self._pvs.get(name)
+
+    def list_pvs(self) -> List[PersistentVolume]:
+        with self._lock:
+            return list(self._pvs.values())
+
+    def add_storage_class(self, sc: StorageClass) -> None:
+        self._upsert(self._storage_classes, "StorageClass", sc.name, sc)
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        with self._lock:
+            return self._storage_classes.get(name)
+
+    def add_csi_node(self, cn: CSINode) -> None:
+        self._upsert(self._csi_nodes, "CSINode", cn.metadata.name, cn)
+
+    def get_csi_node(self, name: str) -> Optional[CSINode]:
+        with self._lock:
+            return self._csi_nodes.get(name)
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> None:
+        self._upsert(self._pdbs, "PodDisruptionBudget",
+                     f"{pdb.namespace}/{pdb.name}", pdb)
+
+    def list_pdbs(self) -> List[PodDisruptionBudget]:
+        with self._lock:
+            return list(self._pdbs.values())
+
+    # ------------------------------------------------------------------
+    # volume binding support (SchedulerVolumeBinder assume/commit)
+    def assume_pv_bound(self, pv_name: str, pvc_key: str) -> None:
+        with self._lock:
+            self._assumed_pvs[pv_name] = pvc_key
+
+    def revert_assumed_pv(self, pv_name: str) -> None:
+        with self._lock:
+            self._assumed_pvs.pop(pv_name, None)
+
+    def bind_pv(self, pv_name: str, pvc_namespace: str, pvc_name: str) -> bool:
+        with self._lock:
+            pv = self._pvs.get(pv_name)
+            pvc = self._pvcs.get(f"{pvc_namespace}/{pvc_name}")
+            if pv is None or pvc is None:
+                return False
+            pv.claim_ref = f"{pvc_namespace}/{pvc_name}"
+            pv.phase = "Bound"
+            pvc.volume_name = pv_name
+            pvc.phase = "Bound"
+            self._assumed_pvs.pop(pv_name, None)
+            self._dispatch(Event(MODIFIED, "PersistentVolume", pv))
+            self._dispatch(Event(MODIFIED, "PersistentVolumeClaim", pvc))
+            return True
+
+    # ------------------------------------------------------------------
+    # Lease objects (leader election; reference client-go leaderelection)
+    def try_acquire_or_renew(
+        self, name: str, holder: str, now: float, duration: float
+    ) -> bool:
+        with self._lock:
+            lease = self._leases.get(name)
+            if (
+                lease is None
+                or lease.holder == holder
+                or now - lease.renew_time > lease.duration
+            ):
+                self._leases[name] = _Lease(holder, now, duration)
+                return True
+            return False
+
+    def lease_holder(self, name: str) -> Optional[str]:
+        with self._lock:
+            lease = self._leases.get(name)
+            return lease.holder if lease else None

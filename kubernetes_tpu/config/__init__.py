@@ -1,0 +1,10 @@
+from kubernetes_tpu.config.types import (
+    Extender,
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    PluginConfig,
+    PluginEntry,
+    Plugins,
+    PluginSet,
+)
+from kubernetes_tpu.config.feature_gates import FeatureGates, default_feature_gates

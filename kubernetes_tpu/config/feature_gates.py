@@ -1,0 +1,50 @@
+"""Feature gates (reference ``pkg/features/kube_features.go`` — 95 gates
+checked at use-sites). We carry the scheduler-relevant subset plus this
+framework's own gates, notably ``TPUBatchScheduler`` (the north-star flag
+that enables the device batch path with clean fallback)."""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+
+_DEFAULTS: Dict[str, bool] = {
+    # scheduler-relevant upstream gates (reference kube_features.go)
+    "PreferNominatedNode": False,
+    "DefaultPodTopologySpread": False,
+    "PodOverhead": True,
+    "BalanceAttachedNodeVolumes": False,
+    "VolumeCapacityPriority": False,
+    "NonPreemptingPriority": True,
+    # this framework's gates
+    "TPUBatchScheduler": False,
+    "TPUShardedSolver": False,
+}
+
+
+class FeatureGates:
+    def __init__(self, overrides: Mapping[str, bool] = ()):
+        self._gates = dict(_DEFAULTS)
+        self._gates.update(dict(overrides or {}))
+
+    def enabled(self, name: str) -> bool:
+        return self._gates.get(name, False)
+
+    def set(self, name: str, value: bool) -> None:
+        self._gates[name] = value
+
+    @classmethod
+    def from_string(cls, s: str) -> "FeatureGates":
+        """Parse ``--feature-gates=A=true,B=false`` syntax."""
+        overrides = {}
+        for part in (s or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            overrides[k.strip()] = v.strip().lower() in ("true", "1", "")
+        return cls(overrides)
+
+
+def default_feature_gates() -> FeatureGates:
+    return FeatureGates()

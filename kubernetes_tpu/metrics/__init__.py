@@ -1,0 +1,7 @@
+from kubernetes_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from kubernetes_tpu.metrics.scheduler_metrics import SchedulerMetrics

@@ -1,0 +1,171 @@
+"""Prometheus-style metrics primitives (the ``component-base/metrics`` +
+``legacyregistry`` equivalent): counters, gauges, histograms with label
+vectors, and text exposition in the Prometheus format for the /metrics
+endpoint."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LabelValues = Tuple[str, ...]
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def with_labels(self, *values: str):
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: Dict[LabelValues, float] = {}
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        with self._lock:
+            key = tuple(label_values)
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(label_values), 0.0)
+
+    def collect(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: Dict[LabelValues, float] = {}
+
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[tuple(label_values)] = value
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        with self._lock:
+            key = tuple(label_values)
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, *label_values: str) -> None:
+        self.inc(*label_values, amount=-1.0)
+
+    def get(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(label_values), 0.0)
+
+    def collect(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+DEFAULT_BUCKETS = (
+    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
+    10.0, 20.0, 50.0,
+)
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_text, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+
+    def observe(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            key = tuple(label_values)
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)
+                self._counts[key] = counts
+            counts[bisect.bisect_left(self.buckets, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, *label_values: str) -> int:
+        with self._lock:
+            return self._totals.get(tuple(label_values), 0)
+
+    def sum(self, *label_values: str) -> float:
+        with self._lock:
+            return self._sums.get(tuple(label_values), 0.0)
+
+    def quantile(self, q: float, *label_values: str) -> float:
+        """Bucket-interpolated quantile (what the perf harness scrapes)."""
+        with self._lock:
+            key = tuple(label_values)
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+        if not counts or total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                return self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+        return self.buckets[-1]
+
+    def collect(self):
+        with self._lock:
+            return [
+                (self.name, k, self._sums.get(k, 0.0), self._totals.get(k, 0))
+                for k in self._counts
+            ]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text exposition."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            if isinstance(m, Histogram):
+                for name, labels, total_sum, total in m.collect():
+                    label_str = _fmt_labels(m.label_names, labels)
+                    lines.append(f"{name}_sum{label_str} {total_sum}")
+                    lines.append(f"{name}_count{label_str} {total}")
+            else:
+                for name, labels, value in m.collect():
+                    lines.append(f"{name}{_fmt_labels(m.label_names, labels)} {value}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(names, values) -> str:
+    if not values:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"

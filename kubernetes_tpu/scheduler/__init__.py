@@ -1,0 +1,6 @@
+"""Scheduler stack: cache, snapshot, queue, framework, plugins, core loop.
+
+Re-implements the capability surface of the reference's ``pkg/scheduler``
+(see SURVEY.md sections 2.3/2.4 and 3.1-3.3) with a TPU batch path layered
+on top (``kubernetes_tpu.ops`` / ``kubernetes_tpu.parallel``).
+"""

@@ -1,0 +1,393 @@
+"""Assume-aware cluster cache with incremental snapshotting.
+
+Behavioral equivalent of the reference's ``pkg/scheduler/internal/cache/cache.go``:
+optimistically-bound ("assumed") pods with a TTL (30s default, cache.go:42),
+a doubly-linked list of NodeInfos ordered by most-recently-updated Generation
+so ``update_snapshot`` touches only the changed prefix (cache.go:203-287),
+and cluster-wide image-state aggregation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.scheduler.node_tree import NodeTree
+from kubernetes_tpu.scheduler.snapshot import Snapshot
+from kubernetes_tpu.scheduler.types import (
+    ImageStateSummary,
+    NodeInfo,
+    get_pod_key,
+    next_generation,
+)
+
+DEFAULT_ASSUME_TTL = 30.0
+CLEANUP_INTERVAL = 1.0
+
+
+class _PodState:
+    __slots__ = ("pod", "deadline", "binding_finished")
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self.deadline: Optional[float] = None
+        self.binding_finished = False
+
+
+class _NodeInfoListItem:
+    __slots__ = ("info", "next", "prev")
+
+    def __init__(self, info: NodeInfo):
+        self.info = info
+        self.next: Optional["_NodeInfoListItem"] = None
+        self.prev: Optional["_NodeInfoListItem"] = None
+
+
+class _ImageState:
+    """Cluster-wide per-image state. Exposed directly (shared, live) as the
+    NodeInfo image-state summary so num_nodes never goes stale as other
+    nodes gain/lose the image."""
+
+    __slots__ = ("size", "nodes")
+
+    def __init__(self, size: int):
+        self.size = size
+        self.nodes: Set[str] = set()
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.nodes)
+
+
+class SchedulerCache:
+    def __init__(self, ttl: float = DEFAULT_ASSUME_TTL, now=time.monotonic):
+        self._ttl = ttl
+        self._now = now
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, _NodeInfoListItem] = {}
+        self._head: Optional[_NodeInfoListItem] = None
+        self._node_tree = NodeTree()
+        self._assumed_pods: Set[str] = set()
+        self._pod_states: Dict[str, _PodState] = {}
+        self._image_states: Dict[str, _ImageState] = {}
+        self._stop = threading.Event()
+        self._cleanup_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # linked-list maintenance (cache.go moveNodeInfoToHead / removeNodeInfoFromList)
+    def _move_to_head(self, name: str) -> None:
+        item = self._nodes.get(name)
+        if item is None or item is self._head:
+            return
+        if item.prev is not None:
+            item.prev.next = item.next
+        if item.next is not None:
+            item.next.prev = item.prev
+        if self._head is not None:
+            self._head.prev = item
+        item.next = self._head
+        item.prev = None
+        self._head = item
+
+    def _remove_from_list(self, name: str) -> None:
+        item = self._nodes.get(name)
+        if item is None:
+            return
+        if item.prev is not None:
+            item.prev.next = item.next
+        if item.next is not None:
+            item.next.prev = item.prev
+        if item is self._head:
+            self._head = item.next
+        del self._nodes[name]
+
+    def _ensure_node(self, name: str) -> _NodeInfoListItem:
+        item = self._nodes.get(name)
+        if item is None:
+            item = _NodeInfoListItem(NodeInfo())
+            self._nodes[name] = item
+            if self._head is not None:
+                self._head.prev = item
+            item.next = self._head
+            self._head = item
+        return item
+
+    # ------------------------------------------------------------------
+    # pods
+    def assume_pod(self, pod: Pod) -> None:
+        key = get_pod_key(pod)
+        with self._lock:
+            if key in self._pod_states:
+                raise ValueError(f"pod {key} is in the cache, so can't be assumed")
+            self._add_pod_locked(pod)
+            self._pod_states[key] = _PodState(pod)
+            self._assumed_pods.add(key)
+
+    def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:
+        key = get_pod_key(pod)
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state is not None and key in self._assumed_pods:
+                state.binding_finished = True
+                state.deadline = (now if now is not None else self._now()) + self._ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        key = get_pod_key(pod)
+        with self._lock:
+            if key not in self._assumed_pods:
+                raise ValueError(f"pod {key} wasn't assumed, so can't be forgotten")
+            self._remove_pod_locked(self._pod_states[key].pod)
+            del self._pod_states[key]
+            self._assumed_pods.discard(key)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Informer-confirmed pod add (cache.go AddPod)."""
+        key = get_pod_key(pod)
+        with self._lock:
+            if key in self._assumed_pods:
+                state = self._pod_states[key]
+                if state.pod.spec.node_name != pod.spec.node_name:
+                    # scheduler result differs from api truth: relocate
+                    self._remove_pod_locked(state.pod)
+                    self._add_pod_locked(pod)
+                self._assumed_pods.discard(key)
+                self._pod_states[key] = _PodState(pod)
+            elif key in self._pod_states:
+                # duplicate add: treat as update
+                self._update_pod_locked(self._pod_states[key].pod, pod)
+                self._pod_states[key] = _PodState(pod)
+            else:
+                self._add_pod_locked(pod)
+                self._pod_states[key] = _PodState(pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        key = get_pod_key(old)
+        with self._lock:
+            if key in self._assumed_pods:
+                raise ValueError(f"assumed pod {key} shouldn't be updated")
+            self._update_pod_locked(old, new)
+            self._pod_states[key] = _PodState(new)
+
+    def remove_pod(self, pod: Pod) -> None:
+        key = get_pod_key(pod)
+        with self._lock:
+            state = self._pod_states.get(key)
+            if state is None:
+                return
+            self._remove_pod_locked(state.pod)
+            del self._pod_states[key]
+            self._assumed_pods.discard(key)
+
+    def get_pod(self, pod: Pod) -> Optional[Pod]:
+        with self._lock:
+            state = self._pod_states.get(get_pod_key(pod))
+            return state.pod if state else None
+
+    def is_assumed_pod(self, pod: Pod) -> bool:
+        with self._lock:
+            return get_pod_key(pod) in self._assumed_pods
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return sum(len(item.info.pods) for item in self._nodes.values())
+
+    def _add_pod_locked(self, pod: Pod) -> None:
+        item = self._ensure_node(pod.spec.node_name)
+        item.info.add_pod(pod)
+        self._move_to_head(pod.spec.node_name)
+
+    def _remove_pod_locked(self, pod: Pod) -> None:
+        item = self._nodes.get(pod.spec.node_name)
+        if item is not None:
+            item.info.remove_pod(pod)
+            if item.info.node is None and not item.info.pods:
+                self._remove_from_list(pod.spec.node_name)
+            else:
+                self._move_to_head(pod.spec.node_name)
+
+    def _update_pod_locked(self, old: Pod, new: Pod) -> None:
+        self._remove_pod_locked(old)
+        self._add_pod_locked(new)
+
+    # ------------------------------------------------------------------
+    # nodes
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            item = self._ensure_node(node.name)
+            self._remove_node_image_states(item.info.node)
+            item.info.set_node(node)
+            self._add_node_image_states(node, item.info)
+            self._node_tree.add_node(node)
+            self._move_to_head(node.name)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        with self._lock:
+            item = self._ensure_node(new.name)
+            self._remove_node_image_states(item.info.node)
+            item.info.set_node(new)
+            self._add_node_image_states(new, item.info)
+            self._node_tree.update_node(old, new)
+            self._move_to_head(new.name)
+
+    def remove_node(self, node: Node) -> None:
+        with self._lock:
+            item = self._nodes.get(node.name)
+            if item is None:
+                return
+            item.info.remove_node()
+            self._remove_node_image_states(node)
+            # keep the entry while pods remain (they'll be removed by events)
+            if not item.info.pods:
+                self._remove_from_list(node.name)
+            else:
+                self._move_to_head(node.name)
+            self._node_tree.remove_node(node)
+
+    def node_count(self) -> int:
+        with self._lock:
+            return self._node_tree.num_nodes
+
+    def _add_node_image_states(self, node: Node, ni: NodeInfo) -> None:
+        summaries: Dict[str, _ImageState] = {}
+        for img in node.status.images:
+            for name in img.names:
+                state = self._image_states.get(name)
+                if state is None:
+                    state = _ImageState(img.size_bytes)
+                    self._image_states[name] = state
+                state.size = img.size_bytes
+                state.nodes.add(node.name)
+                summaries[name] = state
+        ni.image_states = summaries
+
+    def _remove_node_image_states(self, node: Optional[Node]) -> None:
+        if node is None:
+            return
+        for img in node.status.images:
+            for name in img.names:
+                state = self._image_states.get(name)
+                if state is not None:
+                    state.nodes.discard(node.name)
+                    if not state.nodes:
+                        del self._image_states[name]
+
+    # ------------------------------------------------------------------
+    # snapshot
+    def update_snapshot(self, snapshot: Snapshot) -> None:
+        """Incremental O(changed-nodes) update (cache.go:203-287): walk the
+        generation-ordered list from the head, stop at the first item whose
+        generation the snapshot has already seen."""
+        with self._lock:
+            balanced_generation = 0
+            update_all_lists = False
+            updated_affinity = False
+
+            item = self._head
+            while item is not None and item.info.generation > snapshot.generation:
+                info = item.info
+                name = info.node.name if info.node is not None else None
+                if name is None:
+                    item = item.next
+                    continue
+                if balanced_generation == 0:
+                    # generation of the most recently updated node
+                    balanced_generation = info.generation
+                existing = snapshot.node_info_map.get(name)
+                if existing is None:
+                    update_all_lists = True
+                    snapshot.node_info_map[name] = info.clone()
+                else:
+                    if (
+                        bool(existing.pods_with_affinity)
+                        != bool(info.pods_with_affinity)
+                        or bool(existing.pods_with_required_anti_affinity)
+                        != bool(info.pods_with_required_anti_affinity)
+                    ):
+                        updated_affinity = True
+                    # copy IN PLACE: the snapshot's ordered lists hold the
+                    # same NodeInfo objects as the map
+                    existing.copy_from(info)
+                item = item.next
+
+            if balanced_generation:
+                snapshot.generation = balanced_generation
+            elif self._head is not None:
+                snapshot.generation = max(
+                    snapshot.generation, self._head.info.generation
+                )
+
+            # Reconcile deletions only when the snapshot can have shrunk
+            # (cache.go guards with len(snapshot map) > nodeTree.numNodes —
+            # a removal leaves the map larger than the live-node count, so
+            # the common no-deletion cycle stays O(changed prefix)).
+            if len(snapshot.node_info_map) > self._node_tree.num_nodes:
+                live = {
+                    n
+                    for n, it in self._nodes.items()
+                    if it.info.node is not None
+                }
+                for name in [n for n in snapshot.node_info_map if n not in live]:
+                    del snapshot.node_info_map[name]
+                update_all_lists = True
+
+            if update_all_lists or updated_affinity or len(
+                snapshot.node_info_list
+            ) != len(snapshot.node_info_map):
+                self._update_snapshot_lists(snapshot)
+
+    def _update_snapshot_lists(self, snapshot: Snapshot) -> None:
+        """Rebuild ordered lists in zone-interleaved node_tree order
+        (cache.go:289 updateNodeInfoSnapshotList)."""
+        order = self._node_tree.list()
+        snapshot.node_info_list = [
+            snapshot.node_info_map[n] for n in order if n in snapshot.node_info_map
+        ]
+        snapshot.have_pods_with_affinity_node_info_list = [
+            ni for ni in snapshot.node_info_list if ni.pods_with_affinity
+        ]
+        snapshot.have_pods_with_required_anti_affinity_node_info_list = [
+            ni for ni in snapshot.node_info_list if ni.pods_with_required_anti_affinity
+        ]
+
+    # ------------------------------------------------------------------
+    # dump (debugger support) and expiry
+    def dump(self):
+        with self._lock:
+            return {
+                "nodes": {
+                    n: item.info.clone() for n, item in self._nodes.items()
+                },
+                "assumed_pods": set(self._assumed_pods),
+            }
+
+    def run(self) -> None:
+        """Start the assumed-pod expiry goroutine-equivalent (cache.go:42)."""
+        if self._cleanup_thread is not None:
+            return
+        self._cleanup_thread = threading.Thread(
+            target=self._cleanup_loop, daemon=True, name="cache-expiry"
+        )
+        self._cleanup_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _cleanup_loop(self) -> None:
+        while not self._stop.wait(CLEANUP_INTERVAL):
+            self.cleanup_expired_assumed_pods()
+
+    def cleanup_expired_assumed_pods(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else self._now()
+        with self._lock:
+            for key in list(self._assumed_pods):
+                state = self._pod_states.get(key)
+                if state is None:
+                    self._assumed_pods.discard(key)
+                    continue
+                if state.binding_finished and state.deadline is not None and now >= state.deadline:
+                    # expire: the bind never became visible; undo the assume
+                    self._remove_pod_locked(state.pod)
+                    del self._pod_states[key]
+                    self._assumed_pods.discard(key)

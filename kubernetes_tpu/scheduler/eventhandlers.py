@@ -1,0 +1,138 @@
+"""Watch-event handlers: the state-ingestion path (reference
+``pkg/scheduler/eventhandlers.go:364-467 addAllEventHandlers``): unassigned
+pods feed the queue, assigned pods feed the cache (plus affinity wakeups),
+and node/PV/PVC/Service/StorageClass/CSINode events trigger targeted queue
+moves. Change-type detection for node updates mirrors
+``nodeSchedulingPropertiesChange`` (:469)."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Node, Pod
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, Event
+from kubernetes_tpu.scheduler import events as ev
+
+
+def assigned(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def schedulable(pod: Pod) -> bool:
+    """Mirrors the pod informer's field selector (scheduler.go:652-658):
+    terminal-phase pods are invisible to the scheduler."""
+    return pod.status.phase not in (SUCCEEDED, FAILED)
+
+
+class EventHandlers:
+    def __init__(self, scheduler):
+        self.sched = scheduler
+
+    def responsible_for(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name in self.sched.profiles
+
+    # ------------------------------------------------------------------
+    def handle(self, event: Event) -> None:
+        kind = event.kind
+        if kind == "Pod":
+            self._handle_pod(event)
+        elif kind == "Node":
+            self._handle_node(event)
+        elif kind == "Service":
+            self._move(event, {
+                ADDED: ev.SERVICE_ADD, MODIFIED: ev.SERVICE_UPDATE,
+                DELETED: ev.SERVICE_DELETE,
+            })
+        elif kind == "PersistentVolume":
+            self._move(event, {ADDED: ev.PV_ADD, MODIFIED: ev.PV_UPDATE})
+        elif kind == "PersistentVolumeClaim":
+            self._move(event, {ADDED: ev.PVC_ADD, MODIFIED: ev.PVC_UPDATE})
+        elif kind == "StorageClass":
+            self._move(event, {ADDED: ev.STORAGE_CLASS_ADD})
+        elif kind == "CSINode":
+            self._move(event, {ADDED: ev.CSI_NODE_ADD, MODIFIED: ev.CSI_NODE_UPDATE})
+
+    def _move(self, event: Event, mapping) -> None:
+        name = mapping.get(event.type)
+        if name:
+            self.sched.queue.move_all_to_active_or_backoff_queue(name)
+
+    # ------------------------------------------------------------------
+    def _handle_pod(self, event: Event) -> None:
+        sched = self.sched
+        pod: Pod = event.obj
+        old: Pod = event.old_obj
+
+        if event.type == ADDED:
+            if assigned(pod):
+                sched.cache.add_pod(pod)
+                sched.queue.assigned_pod_added(pod)
+            elif schedulable(pod) and self.responsible_for(pod):
+                sched.queue.add(pod)
+        elif event.type == MODIFIED:
+            if assigned(pod):
+                if old is not None and not assigned(old):
+                    # bind transition: confirm the assume, leave the queue
+                    sched.cache.add_pod(pod)
+                    sched.queue.delete(pod)
+                else:
+                    sched.cache.update_pod(old or pod, pod)
+                sched.queue.assigned_pod_updated(pod)
+            elif schedulable(pod) and self.responsible_for(pod):
+                if not self._skip_pod_update(old, pod):
+                    sched.queue.update(old, pod)
+        elif event.type == DELETED:
+            if assigned(pod):
+                sched.cache.remove_pod(pod)
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    ev.ASSIGNED_POD_DELETE
+                )
+            else:
+                sched.queue.delete(pod)
+                # a Permit-parked pod must be rejected so its assumed
+                # resources and gang slot are released (reference
+                # deletePodFromSchedulingQueue → fwk.RejectWaitingPod)
+                for fwk in sched.profiles.values():
+                    fwk.reject_waiting_pod(pod.uid)
+
+    def _skip_pod_update(self, old: Pod, new: Pod) -> bool:
+        """Reference skipPodUpdate: an update to an *assumed* pod that only
+        touches server-side fields must not churn the queue."""
+        if old is None:
+            return False
+        if not self.sched.cache.is_assumed_pod(new):
+            return False
+        return (
+            old.spec == new.spec
+            and old.metadata.labels == new.metadata.labels
+        )
+
+    # ------------------------------------------------------------------
+    def _handle_node(self, event: Event) -> None:
+        sched = self.sched
+        node: Node = event.obj
+        old: Node = event.old_obj
+        if event.type == ADDED:
+            sched.cache.add_node(node)
+            sched.queue.move_all_to_active_or_backoff_queue(ev.NODE_ADD)
+        elif event.type == MODIFIED:
+            sched.cache.update_node(old or node, node)
+            change = self._node_scheduling_properties_change(old, node)
+            if change:
+                sched.queue.move_all_to_active_or_backoff_queue(change)
+        elif event.type == DELETED:
+            sched.cache.remove_node(node)
+
+    @staticmethod
+    def _node_scheduling_properties_change(old: Node, new: Node):
+        """eventhandlers.go:469: only changes that could make pending pods
+        schedulable wake the queue."""
+        if old is None:
+            return ev.NODE_ADD
+        if old.spec.unschedulable != new.spec.unschedulable:
+            return ev.NODE_SPEC_UNSCHEDULABLE_CHANGE
+        if old.status.allocatable != new.status.allocatable:
+            return ev.NODE_ALLOCATABLE_CHANGE
+        if old.metadata.labels != new.metadata.labels:
+            return ev.NODE_LABEL_CHANGE
+        if old.spec.taints != new.spec.taints:
+            return ev.NODE_TAINT_CHANGE
+        return None

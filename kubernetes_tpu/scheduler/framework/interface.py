@@ -1,0 +1,225 @@
+"""Scheduling-framework plugin interfaces and status codes.
+
+Behavioral equivalent of the reference's
+``pkg/scheduler/framework/interface.go``: the 11 extension points
+(QueueSort, PreFilter(+extensions), Filter, PostFilter, PreScore,
+Score(+normalize), Reserve, Permit, PreBind, Bind, PostBind), the Status
+code lattice (:55-75) — notably the ``Unschedulable`` vs
+``UnschedulableAndUnresolvable`` distinction preemption relies on — and the
+score bounds (MaxNodeScore=100, :95).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.types import NodeInfo, QueuedPodInfo
+
+# Status codes (interface.go:55-75)
+SUCCESS = 0
+ERROR = 1
+UNSCHEDULABLE = 2
+UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+WAIT = 4
+SKIP = 5
+
+_CODE_NAMES = {
+    SUCCESS: "Success",
+    ERROR: "Error",
+    UNSCHEDULABLE: "Unschedulable",
+    UNSCHEDULABLE_AND_UNRESOLVABLE: "UnschedulableAndUnresolvable",
+    WAIT: "Wait",
+    SKIP: "Skip",
+}
+
+MAX_NODE_SCORE = 100  # interface.go:95
+MIN_NODE_SCORE = 0
+MAX_TOTAL_SCORE = (1 << 63) - 1
+
+
+class Status:
+    """Plugin result. ``None`` is treated as Success everywhere, matching
+    the reference's nil-*Status convention."""
+
+    __slots__ = ("code", "reasons", "failed_plugin")
+
+    def __init__(self, code: int = SUCCESS, *reasons: str, failed_plugin: str = ""):
+        self.code = code
+        self.reasons = list(reasons)
+        self.failed_plugin = failed_plugin
+
+    @staticmethod
+    def success() -> Optional["Status"]:
+        return None
+
+    @staticmethod
+    def is_ok(s: Optional["Status"]) -> bool:
+        return s is None or s.code == SUCCESS
+
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    def is_unschedulable(self) -> bool:
+        return self.code in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE)
+
+    def code_name(self) -> str:
+        return _CODE_NAMES.get(self.code, str(self.code))
+
+    def message(self) -> str:
+        return ", ".join(self.reasons)
+
+    def with_failed_plugin(self, name: str) -> "Status":
+        self.failed_plugin = name
+        return self
+
+    def as_error(self) -> Exception:
+        return RuntimeError(self.message() or self.code_name())
+
+    def __repr__(self):
+        return f"Status({self.code_name()}, {self.reasons!r})"
+
+    def __eq__(self, other):
+        if other is None:
+            return self.code == SUCCESS
+        return (
+            isinstance(other, Status)
+            and self.code == other.code
+            and self.reasons == other.reasons
+        )
+
+
+NodeToStatusMap = Dict[str, Status]
+
+
+@dataclass
+class NodeScore:
+    name: str
+    score: int
+
+
+@dataclass
+class PostFilterResult:
+    nominated_node_name: str = ""
+
+
+@dataclass
+class FitError(Exception):
+    """Raised when no node fits (reference core.FitError): carries the
+    per-node filter statuses preemption and diagnostics read."""
+
+    pod: Pod = None
+    num_all_nodes: int = 0
+    filtered_nodes_statuses: NodeToStatusMap = field(default_factory=dict)
+
+    def __str__(self):
+        reasons: Dict[str, int] = {}
+        for s in self.filtered_nodes_statuses.values():
+            for r in s.reasons:
+                reasons[r] = reasons.get(r, 0) + 1
+        parts = [f"{n} {m}" for m, n in sorted(reasons.items(), key=lambda kv: kv[0])]
+        return (
+            f"0/{self.num_all_nodes} nodes are available: {', '.join(parts)}."
+            if parts
+            else f"0/{self.num_all_nodes} nodes are available."
+        )
+
+
+class Plugin:
+    """Base plugin; subclasses override the extension points they implement.
+    ``NAME`` mirrors the reference's Name() identity used in config."""
+
+    NAME = "Plugin"
+
+    def name(self) -> str:
+        return self.NAME
+
+
+class QueueSortPlugin(Plugin):
+    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        raise NotImplementedError
+
+
+class PreFilterExtensions:
+    """Incremental PreFilter-state updates used when evaluating nominated
+    pods and preemption victims (interface.go PreFilterExtensions)."""
+
+    def add_pod(self, state, pod_to_schedule: Pod, pod_to_add: Pod,
+                node_info: NodeInfo) -> Optional[Status]:
+        return None
+
+    def remove_pod(self, state, pod_to_schedule: Pod, pod_to_remove: Pod,
+                   node_info: NodeInfo) -> Optional[Status]:
+        return None
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state, pod: Pod) -> Optional[Status]:
+        raise NotImplementedError
+
+    def pre_filter_extensions(self) -> Optional[PreFilterExtensions]:
+        return None
+
+
+class FilterPlugin(Plugin):
+    def filter(self, state, pod: Pod, node_info: NodeInfo) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state, pod: Pod,
+                    filtered_node_status_map: NodeToStatusMap):
+        """returns (PostFilterResult | None, Status)"""
+        raise NotImplementedError
+
+
+class PreScorePlugin(Plugin):
+    def pre_score(self, state, pod: Pod, nodes: List) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class ScoreExtensions:
+    def normalize_score(self, state, pod: Pod,
+                        scores: List[NodeScore]) -> Optional[Status]:
+        return None
+
+
+class ScorePlugin(Plugin):
+    def score(self, state, pod: Pod, node_name: str):
+        """returns (int score, Status)"""
+        raise NotImplementedError
+
+    def score_extensions(self) -> Optional[ScoreExtensions]:
+        return None
+
+
+class ReservePlugin(Plugin):
+    def reserve(self, state, pod: Pod, node_name: str) -> Optional[Status]:
+        return None
+
+    def unreserve(self, state, pod: Pod, node_name: str) -> None:
+        return None
+
+
+class PermitPlugin(Plugin):
+    def permit(self, state, pod: Pod, node_name: str):
+        """returns (Status, timeout_seconds). Status Wait parks the pod in
+        the waiting-pods map until Allow/Reject or timeout."""
+        raise NotImplementedError
+
+
+class PreBindPlugin(Plugin):
+    def pre_bind(self, state, pod: Pod, node_name: str) -> Optional[Status]:
+        raise NotImplementedError
+
+
+class BindPlugin(Plugin):
+    def bind(self, state, pod: Pod, node_name: str) -> Optional[Status]:
+        """Skip status delegates to the next bind plugin."""
+        raise NotImplementedError
+
+
+class PostBindPlugin(Plugin):
+    def post_bind(self, state, pod: Pod, node_name: str) -> None:
+        raise NotImplementedError

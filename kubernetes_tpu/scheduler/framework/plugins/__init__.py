@@ -1,0 +1,104 @@
+"""In-tree plugins (reference ``pkg/scheduler/framework/plugins/`` — the 22
+predicates/priorities inventoried in SURVEY.md section 2.4) plus this
+framework's own additions (coscheduling gang Permit plugin, TPU batch
+integration). ``new_in_tree_registry`` mirrors ``plugins/registry.go``; out-
+of-tree plugins merge via ``Registry.merge`` (the ``WithFrameworkOutOfTreeRegistry``
+mechanism the TPU plugin uses)."""
+
+from kubernetes_tpu.scheduler.framework.runtime import Registry
+
+
+def new_in_tree_registry() -> Registry:
+    from kubernetes_tpu.scheduler.framework.plugins import (
+        default_binder,
+        default_preemption,
+        image_locality,
+        interpod_affinity,
+        node_affinity,
+        node_label,
+        node_name,
+        node_ports,
+        node_prefer_avoid_pods,
+        node_resources,
+        node_unschedulable,
+        node_volume_limits,
+        pod_topology_spread,
+        queue_sort,
+        selector_spread,
+        service_affinity,
+        taint_toleration,
+        volume_binding,
+        volume_restrictions,
+        volume_zone,
+        coscheduling,
+    )
+
+    r = Registry()
+    r.register(queue_sort.PrioritySort.NAME, queue_sort.PrioritySort.factory)
+    r.register(node_resources.Fit.NAME, node_resources.Fit.factory)
+    r.register(
+        node_resources.BalancedAllocation.NAME,
+        node_resources.BalancedAllocation.factory,
+    )
+    r.register(
+        node_resources.LeastAllocated.NAME, node_resources.LeastAllocated.factory
+    )
+    r.register(node_resources.MostAllocated.NAME, node_resources.MostAllocated.factory)
+    r.register(
+        node_resources.RequestedToCapacityRatio.NAME,
+        node_resources.RequestedToCapacityRatio.factory,
+    )
+    r.register(node_name.NodeName.NAME, node_name.NodeName.factory)
+    r.register(node_ports.NodePorts.NAME, node_ports.NodePorts.factory)
+    r.register(
+        node_unschedulable.NodeUnschedulable.NAME,
+        node_unschedulable.NodeUnschedulable.factory,
+    )
+    r.register(node_affinity.NodeAffinity.NAME, node_affinity.NodeAffinity.factory)
+    r.register(node_label.NodeLabel.NAME, node_label.NodeLabel.factory)
+    r.register(
+        node_prefer_avoid_pods.NodePreferAvoidPods.NAME,
+        node_prefer_avoid_pods.NodePreferAvoidPods.factory,
+    )
+    r.register(
+        taint_toleration.TaintToleration.NAME, taint_toleration.TaintToleration.factory
+    )
+    r.register(
+        interpod_affinity.InterPodAffinity.NAME,
+        interpod_affinity.InterPodAffinity.factory,
+    )
+    r.register(
+        pod_topology_spread.PodTopologySpread.NAME,
+        pod_topology_spread.PodTopologySpread.factory,
+    )
+    r.register(
+        selector_spread.SelectorSpread.NAME, selector_spread.SelectorSpread.factory
+    )
+    r.register(
+        service_affinity.ServiceAffinity.NAME, service_affinity.ServiceAffinity.factory
+    )
+    r.register(image_locality.ImageLocality.NAME, image_locality.ImageLocality.factory)
+    r.register(volume_binding.VolumeBinding.NAME, volume_binding.VolumeBinding.factory)
+    r.register(
+        volume_restrictions.VolumeRestrictions.NAME,
+        volume_restrictions.VolumeRestrictions.factory,
+    )
+    r.register(volume_zone.VolumeZone.NAME, volume_zone.VolumeZone.factory)
+    r.register(node_volume_limits.CSILimits.NAME, node_volume_limits.CSILimits.factory)
+    r.register(
+        node_volume_limits.EBSLimits.NAME, node_volume_limits.EBSLimits.factory
+    )
+    r.register(
+        node_volume_limits.GCEPDLimits.NAME, node_volume_limits.GCEPDLimits.factory
+    )
+    r.register(
+        node_volume_limits.AzureDiskLimits.NAME,
+        node_volume_limits.AzureDiskLimits.factory,
+    )
+    r.register(
+        default_preemption.DefaultPreemption.NAME,
+        default_preemption.DefaultPreemption.factory,
+    )
+    r.register(default_binder.DefaultBinder.NAME, default_binder.DefaultBinder.factory)
+    r.register(coscheduling.Coscheduling.NAME, coscheduling.Coscheduling.factory)
+    return r

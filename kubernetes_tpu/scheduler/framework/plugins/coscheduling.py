@@ -1,0 +1,79 @@
+"""Coscheduling (gang scheduling) Permit plugin.
+
+The reference tree has no in-tree equivalent — gang scheduling is the
+Permit-phase pattern of the out-of-tree coscheduling plugin, enabled by the
+framework's ``RunPermitPlugins``/``WaitOnPermit`` machinery
+(``runtime/framework.go:960,1011``; see SURVEY.md section 6). Pods declare a
+gang via labels:
+
+    pod-group.scheduling.k8s.io/name: <group>
+    pod-group.scheduling.k8s.io/min-available: "<N>"
+
+A pod whose gang hasn't reached N scheduled-or-waiting members Waits at
+Permit; when the N-th member arrives, every waiting member is allowed.
+BASELINE config #5 exercises this together with spread + fit.
+"""
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.framework.interface import (
+    UNSCHEDULABLE,
+    WAIT,
+    PermitPlugin,
+    Status,
+)
+
+GROUP_NAME_LABEL = "pod-group.scheduling.k8s.io/name"
+MIN_AVAILABLE_LABEL = "pod-group.scheduling.k8s.io/min-available"
+DEFAULT_WAIT_SECONDS = 60.0
+
+
+def pod_group(pod: Pod) -> Tuple[str, int]:
+    name = pod.metadata.labels.get(GROUP_NAME_LABEL, "")
+    try:
+        min_available = int(pod.metadata.labels.get(MIN_AVAILABLE_LABEL, "0"))
+    except ValueError:
+        min_available = 0
+    return name, min_available
+
+
+class Coscheduling(PermitPlugin):
+    NAME = "Coscheduling"
+
+    @staticmethod
+    def factory(args, handle):
+        return Coscheduling(handle, args or {})
+
+    def __init__(self, handle=None, args=None):
+        self.handle = handle
+        self.wait_seconds = float((args or {}).get("permitWaitSeconds", DEFAULT_WAIT_SECONDS))
+        self._lock = threading.Lock()
+        self._permitted: Dict[str, int] = {}  # group -> pods at/past Permit
+
+    def permit(self, state, pod: Pod, node_name: str):
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return None, 0.0
+        with self._lock:
+            self._permitted[group] = self._permitted.get(group, 0) + 1
+            arrived = self._permitted[group]
+        if arrived >= min_available:
+            # release every gang member parked at Permit
+            def allow(wp):
+                g, _ = pod_group(wp.pod)
+                if g == group:
+                    wp.allow(self.NAME)
+
+            self.handle.iterate_waiting_pods(allow)
+            return None, 0.0
+        return Status(WAIT, f"waiting for gang {group}"), self.wait_seconds
+
+    def unreserve_group(self, pod: Pod) -> None:
+        """Called when a gang member fails downstream: undo its arrival."""
+        group, _ = pod_group(pod)
+        if group:
+            with self._lock:
+                if self._permitted.get(group, 0) > 0:
+                    self._permitted[group] -= 1

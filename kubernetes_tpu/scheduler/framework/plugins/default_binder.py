@@ -1,0 +1,26 @@
+"""DefaultBinder (reference ``plugins/defaultbinder/default_binder.go:50-61``):
+issues the Binding — the equivalent of POST pods/{name}/binding — through
+the client."""
+
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.framework.interface import BindPlugin, Status
+
+
+class DefaultBinder(BindPlugin):
+    NAME = "DefaultBinder"
+
+    @staticmethod
+    def factory(args, handle):
+        return DefaultBinder(handle)
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def bind(self, state, pod: Pod, node_name: str) -> Optional[Status]:
+        try:
+            self.handle.client.bind(pod.namespace, pod.name, pod.uid, node_name)
+        except Exception as e:  # surface as Error status like the reference
+            return Status(1, str(e))
+        return None

@@ -1,0 +1,73 @@
+"""Shared plugin helpers (reference ``plugins/helper/``): node-selector and
+node-affinity matching, and the default min-max score normalizer."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubernetes_tpu.api.types import Node, NodeSelector, NodeSelectorTerm, Pod
+from kubernetes_tpu.scheduler.framework.interface import MAX_NODE_SCORE, NodeScore
+
+
+def node_selector_term_matches(term: NodeSelectorTerm, node: Node) -> bool:
+    """A term with no expressions/fields matches nothing (reference
+    v1helper.MatchNodeSelectorTerms)."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    for req in term.match_expressions:
+        if not req.to_requirement().matches(node.metadata.labels):
+            return False
+    for req in term.match_fields:
+        # the only supported field is metadata.name
+        if req.key != "metadata.name":
+            return False
+        if not req.to_requirement().matches({"metadata.name": node.name}):
+            return False
+    return True
+
+
+def node_matches_node_selector(node: Node, selector: Optional[NodeSelector]) -> bool:
+    """ORed terms; nil selector matches everything, empty terms match nothing."""
+    if selector is None:
+        return True
+    return any(
+        node_selector_term_matches(t, node) for t in selector.node_selector_terms
+    )
+
+
+def pod_matches_node_selector_and_affinity(pod: Pod, node: Node) -> bool:
+    """Reference PodMatchesNodeSelectorAndAffinityTerms: both the simple
+    nodeSelector map and requiredDuringScheduling node affinity must hold."""
+    if pod.spec.node_selector:
+        for k, v in pod.spec.node_selector.items():
+            if node.metadata.labels.get(k) != v:
+                return False
+    aff = pod.spec.affinity
+    if (
+        aff is not None
+        and aff.node_affinity is not None
+        and aff.node_affinity.required_during_scheduling_ignored_during_execution
+        is not None
+    ):
+        terms = (
+            aff.node_affinity.required_during_scheduling_ignored_during_execution
+        )
+        if not node_matches_node_selector(node, terms):
+            return False
+    return True
+
+
+def default_normalize_score(
+    max_priority: int, reverse: bool, scores: List[NodeScore]
+) -> None:
+    """Scale raw scores into [0, max_priority] by the max; optionally
+    reverse (reference helper.DefaultNormalizeScore)."""
+    max_count = max((s.score for s in scores), default=0)
+    if max_count == 0:
+        if reverse:
+            for s in scores:
+                s.score = max_priority
+        return
+    for s in scores:
+        score = s.score * max_priority // max_count
+        s.score = max_priority - score if reverse else score

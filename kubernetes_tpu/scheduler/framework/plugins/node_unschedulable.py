@@ -1,0 +1,36 @@
+"""NodeUnschedulable filter (reference
+``plugins/nodeunschedulable/node_unschedulable.go``): respects
+``.spec.unschedulable`` unless the pod tolerates the synthetic
+unschedulable taint."""
+
+from typing import Optional
+
+from kubernetes_tpu.api.types import NO_SCHEDULE, Pod, Taint
+from kubernetes_tpu.scheduler.framework.interface import (
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+    FilterPlugin,
+    Status,
+)
+from kubernetes_tpu.scheduler.types import NodeInfo
+
+ERR_REASON_UNSCHEDULABLE = "node(s) were unschedulable"
+ERR_REASON_UNKNOWN_CONDITION = "node(s) had unknown conditions"
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+
+class NodeUnschedulable(FilterPlugin):
+    NAME = "NodeUnschedulable"
+
+    @staticmethod
+    def factory(args, handle):
+        return NodeUnschedulable()
+
+    def filter(self, state, pod: Pod, node_info: NodeInfo) -> Optional[Status]:
+        if node_info.node is None:
+            return Status(UNSCHEDULABLE_AND_UNRESOLVABLE, ERR_REASON_UNKNOWN_CONDITION)
+        if not node_info.node.spec.unschedulable:
+            return None
+        taint = Taint(TAINT_NODE_UNSCHEDULABLE, "", NO_SCHEDULE)
+        if any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return None
+        return Status(UNSCHEDULABLE_AND_UNRESOLVABLE, ERR_REASON_UNSCHEDULABLE)

@@ -1,0 +1,130 @@
+"""Node volume-count limits (reference ``plugins/nodevolumelimits/`` — 907
+LoC across csi.go + non_csi.go): per-node attachable-volume caps for CSI
+drivers (from CSINode allocatable) and the in-tree cloud disks (EBS 39,
+GCE PD 16, Azure Disk 16)."""
+
+from typing import Optional, Set, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.framework.interface import (
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+    FilterPlugin,
+    Status,
+)
+from kubernetes_tpu.scheduler.types import NodeInfo
+
+ERR_REASON = "node(s) exceed max volume count"
+
+DEFAULT_EBS_LIMIT = 39
+DEFAULT_GCE_PD_LIMIT = 16
+DEFAULT_AZURE_LIMIT = 16
+
+
+class CSILimits(FilterPlugin):
+    NAME = "NodeVolumeLimits"
+
+    @staticmethod
+    def factory(args, handle):
+        return CSILimits(handle)
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def filter(self, state, pod: Pod, node_info: NodeInfo) -> Optional[Status]:
+        if node_info.node is None:
+            return Status(UNSCHEDULABLE_AND_UNRESOLVABLE, "node not found")
+        client = self.handle.client
+        csi_node = client.get_csi_node(node_info.node.name)
+        if csi_node is None:
+            return None
+        wanted = self._pod_csi_volumes(client, pod)
+        if not wanted:
+            return None
+        in_use = set()
+        for pi in node_info.pods:
+            in_use |= self._pod_csi_volumes(client, pi.pod)
+        for driver in csi_node.drivers:
+            limit = driver.allocatable_count
+            if limit is None:
+                continue
+            new_count = len(
+                {v for d, v in (in_use | wanted) if d == driver.name}
+            )
+            if new_count > limit:
+                return Status(UNSCHEDULABLE, ERR_REASON)
+        return None
+
+    def _pod_csi_volumes(self, client, pod: Pod) -> Set[Tuple[str, str]]:
+        out = set()
+        for vol in pod.spec.volumes:
+            if not vol.persistent_volume_claim:
+                continue
+            pvc = client.get_pvc(pod.namespace, vol.persistent_volume_claim)
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = client.get_pv(pvc.volume_name)
+            if pv is None:
+                continue
+            driver = getattr(pv, "csi_driver", None)
+            if driver:
+                out.add((driver, pv.name))
+        return out
+
+
+class _InTreeLimits(FilterPlugin):
+    """Shared logic for the in-tree cloud-disk limit filters."""
+
+    volume_attr = ""
+    default_limit = 0
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def filter(self, state, pod: Pod, node_info: NodeInfo) -> Optional[Status]:
+        wanted = {
+            getattr(v, self.volume_attr)
+            for v in pod.spec.volumes
+            if getattr(v, self.volume_attr)
+        }
+        if not wanted:
+            return None
+        in_use = {
+            getattr(v, self.volume_attr)
+            for pi in node_info.pods
+            for v in pi.pod.spec.volumes
+            if getattr(v, self.volume_attr)
+        }
+        if len(in_use | wanted) > self.default_limit:
+            return Status(UNSCHEDULABLE, ERR_REASON)
+        return None
+
+
+class EBSLimits(_InTreeLimits):
+    NAME = "EBSLimits"
+    volume_attr = "aws_elastic_block_store"
+    default_limit = DEFAULT_EBS_LIMIT
+
+    @staticmethod
+    def factory(args, handle):
+        return EBSLimits(handle)
+
+
+class GCEPDLimits(_InTreeLimits):
+    NAME = "GCEPDLimits"
+    volume_attr = "gce_persistent_disk"
+    default_limit = DEFAULT_GCE_PD_LIMIT
+
+    @staticmethod
+    def factory(args, handle):
+        return GCEPDLimits(handle)
+
+
+class AzureDiskLimits(_InTreeLimits):
+    NAME = "AzureDiskLimits"
+    volume_attr = "gce_persistent_disk"  # azure disk volumes unsupported in the
+    default_limit = DEFAULT_AZURE_LIMIT  # object model; counts like GCE PD
+
+    @staticmethod
+    def factory(args, handle):
+        return AzureDiskLimits(handle)

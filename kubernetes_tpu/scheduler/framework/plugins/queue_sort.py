@@ -1,0 +1,20 @@
+"""PrioritySort QueueSort plugin (reference
+``plugins/queuesort/priority_sort.go:41-45``): higher ``.spec.priority``
+first, earlier queue timestamp as tiebreak."""
+
+from kubernetes_tpu.scheduler.framework.interface import QueueSortPlugin
+from kubernetes_tpu.scheduler.types import QueuedPodInfo
+
+
+class PrioritySort(QueueSortPlugin):
+    NAME = "PrioritySort"
+
+    @staticmethod
+    def factory(args, handle):
+        return PrioritySort()
+
+    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        pa, pb = a.pod.priority(), b.pod.priority()
+        if pa != pb:
+            return pa > pb
+        return a.timestamp < b.timestamp

@@ -1,0 +1,517 @@
+"""Framework runtime: plugin registry, the per-profile framework instance
+that executes each extension point, and the Permit waiting-pods map.
+
+Behavioral equivalent of the reference's
+``pkg/scheduler/framework/runtime/framework.go`` (frameworkImpl :67-96,
+NewFramework :238-355, RunScorePlugins' three passes :721-790,
+RunFilterPluginsWithNominatedPods' run-twice protocol :610-684,
+RunPermitPlugins/WaitOnPermit :960-1040) and ``waiting_pods_map.go``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.config.types import KubeSchedulerProfile, Plugins
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework import interface as fw
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, QueuedPodInfo
+from kubernetes_tpu.utils.parallelize import Parallelizer
+
+MAX_TIMEOUT = 15 * 60.0  # max permit wait (framework.go:47)
+
+
+class Registry(dict):
+    """name -> factory(args: dict, handle) -> Plugin (runtime/registry.go)."""
+
+    def register(self, name: str, factory) -> None:
+        if name in self:
+            raise ValueError(f"plugin {name} already registered")
+        self[name] = factory
+
+    def merge(self, other: "Registry") -> None:
+        for name, factory in other.items():
+            self.register(name, factory)
+
+
+class WaitingPod:
+    """A pod parked at Permit (waiting_pods_map.go:30)."""
+
+    def __init__(self, pod: Pod, plugin_timeouts: Dict[str, float]):
+        self.pod = pod
+        self._lock = threading.Lock()
+        self._pending = set(plugin_timeouts)
+        self._event = threading.Event()
+        self._status: Optional[fw.Status] = None
+        # the pod is rejected when the EARLIEST plugin timeout expires
+        # (waiting_pods_map.go: per-plugin timers, first to fire rejects)
+        self._deadline = time.monotonic() + (
+            min(plugin_timeouts.values()) if plugin_timeouts else 0.0
+        )
+
+    def pending_plugins(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pending)
+
+    def allow(self, plugin_name: str) -> None:
+        with self._lock:
+            self._pending.discard(plugin_name)
+            if self._pending and self._status is None:
+                return
+            if self._status is None:
+                self._status = fw.Status(fw.SUCCESS)
+        self._event.set()
+
+    def reject(self, plugin_name: str, msg: str = "") -> None:
+        with self._lock:
+            if self._status is None:
+                self._status = fw.Status(
+                    fw.UNSCHEDULABLE, msg or f"rejected by {plugin_name}",
+                    failed_plugin=plugin_name,
+                )
+        self._event.set()
+
+    def wait(self) -> fw.Status:
+        remaining = self._deadline - time.monotonic()
+        if not self._event.wait(timeout=max(0.0, remaining)):
+            return fw.Status(
+                fw.UNSCHEDULABLE,
+                f"pod {self.pod.full_name()} rejected: timed out waiting at Permit",
+            )
+        with self._lock:
+            return self._status or fw.Status(fw.SUCCESS)
+
+
+class Framework:
+    """One instance per scheduler profile. The framework itself is the
+    plugin Handle (the reference's frameworkImpl implements
+    framework.Handle): it delegates cluster-state access to a ``deps``
+    object providing ``snapshot()``, ``client``, ``pod_nominator``,
+    ``feature_gates``, and ``parallelizer``."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        profile: KubeSchedulerProfile,
+        default_plugins: Plugins,
+        deps=None,
+        metrics=None,
+    ):
+        self.profile_name = profile.scheduler_name
+        self.deps = deps
+        self.handle = self  # plugins receive the framework as their handle
+        self.metrics = metrics
+        plugins = (
+            profile.plugins.merge_defaults(default_plugins)
+            if profile.plugins is not None
+            else default_plugins
+        )
+        self._plugins = plugins
+
+        # instantiate each referenced plugin exactly once
+        instances: Dict[str, fw.Plugin] = {}
+        for point in (
+            "queue_sort", "pre_filter", "filter", "post_filter", "pre_score",
+            "score", "reserve", "permit", "pre_bind", "bind", "post_bind",
+        ):
+            for entry in plugins.get(point).enabled:
+                if entry.name in instances:
+                    continue
+                factory = registry.get(entry.name)
+                if factory is None:
+                    raise ValueError(f"plugin {entry.name!r} not in registry")
+                instances[entry.name] = factory(
+                    profile.get_plugin_args(entry.name), self
+                )
+        self._instances = instances
+
+        def plugin_list(point: str) -> List[fw.Plugin]:
+            return [instances[e.name] for e in plugins.get(point).enabled]
+
+        self.queue_sort_plugins: List[fw.QueueSortPlugin] = plugin_list("queue_sort")
+        self.pre_filter_plugins: List[fw.PreFilterPlugin] = plugin_list("pre_filter")
+        self.filter_plugins: List[fw.FilterPlugin] = plugin_list("filter")
+        self.post_filter_plugins: List[fw.PostFilterPlugin] = plugin_list("post_filter")
+        self.pre_score_plugins: List[fw.PreScorePlugin] = plugin_list("pre_score")
+        self.score_plugins: List[fw.ScorePlugin] = plugin_list("score")
+        self.reserve_plugins: List[fw.ReservePlugin] = plugin_list("reserve")
+        self.permit_plugins: List[fw.PermitPlugin] = plugin_list("permit")
+        self.pre_bind_plugins: List[fw.PreBindPlugin] = plugin_list("pre_bind")
+        self.bind_plugins: List[fw.BindPlugin] = plugin_list("bind")
+        self.post_bind_plugins: List[fw.PostBindPlugin] = plugin_list("post_bind")
+
+        self.score_plugin_weight = {
+            e.name: e.weight for e in plugins.get("score").enabled
+        }
+        for name, w in self.score_plugin_weight.items():
+            if w <= 0:
+                raise ValueError(f"score plugin {name} has non-positive weight")
+
+        self._waiting_pods: Dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
+        self.parallelizer: Parallelizer = getattr(
+            deps, "parallelizer", None
+        ) or Parallelizer()
+
+    # ------------------------------------------------------------------
+    # Handle surface (delegated to deps)
+    def snapshot(self):
+        return self.deps.snapshot()
+
+    @property
+    def client(self):
+        return self.deps.client
+
+    @property
+    def pod_nominator(self):
+        return getattr(self.deps, "pod_nominator", None)
+
+    @property
+    def feature_gates(self):
+        return getattr(self.deps, "feature_gates", None)
+
+    @property
+    def extenders(self):
+        return getattr(self.deps, "extenders", ())
+
+    # ------------------------------------------------------------------
+    def get_plugin(self, name: str) -> Optional[fw.Plugin]:
+        return self._instances.get(name)
+
+    def has_filter_plugins(self) -> bool:
+        return bool(self.filter_plugins)
+
+    def has_score_plugins(self) -> bool:
+        return bool(self.score_plugins)
+
+    def has_post_filter_plugins(self) -> bool:
+        return bool(self.post_filter_plugins)
+
+    def list_plugins(self) -> Dict[str, List[str]]:
+        return {
+            point: [e.name for e in self._plugins.get(point).enabled]
+            for point in (
+                "queue_sort", "pre_filter", "filter", "post_filter", "pre_score",
+                "score", "reserve", "permit", "pre_bind", "bind", "post_bind",
+            )
+        }
+
+    def _record(self, extension_point: str, status: Optional[fw.Status],
+                start: float) -> None:
+        if self.metrics is not None:
+            self.metrics.observe_extension_point(
+                extension_point,
+                "Success" if fw.Status.is_ok(status) else status.code_name(),
+                time.monotonic() - start,
+                profile=self.profile_name,
+            )
+
+    # ------------------------------------------------------------------
+    def queue_sort_less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        return self.queue_sort_plugins[0].less(a, b)
+
+    def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Optional[fw.Status]:
+        start = time.monotonic()
+        for p in self.pre_filter_plugins:
+            status = p.pre_filter(state, pod)
+            if not fw.Status.is_ok(status):
+                status.with_failed_plugin(p.name())
+                if status.is_unschedulable():
+                    self._record("PreFilter", status, start)
+                    return status
+                self._record("PreFilter", status, start)
+                return fw.Status(
+                    fw.ERROR,
+                    f"running PreFilter plugin {p.name()}: {status.message()}",
+                    failed_plugin=p.name(),
+                )
+        self._record("PreFilter", None, start)
+        return None
+
+    def run_pre_filter_extension_add_pod(
+        self, state: CycleState, pod: Pod, pod_to_add: Pod, node_info: NodeInfo
+    ) -> Optional[fw.Status]:
+        for p in self.pre_filter_plugins:
+            ext = p.pre_filter_extensions()
+            if ext is not None:
+                status = ext.add_pod(state, pod, pod_to_add, node_info)
+                if not fw.Status.is_ok(status):
+                    return status
+        return None
+
+    def run_pre_filter_extension_remove_pod(
+        self, state: CycleState, pod: Pod, pod_to_remove: Pod, node_info: NodeInfo
+    ) -> Optional[fw.Status]:
+        for p in self.pre_filter_plugins:
+            ext = p.pre_filter_extensions()
+            if ext is not None:
+                status = ext.remove_pod(state, pod, pod_to_remove, node_info)
+                if not fw.Status.is_ok(status):
+                    return status
+        return None
+
+    def run_filter_plugins(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo
+    ) -> Optional[fw.Status]:
+        for p in self.filter_plugins:
+            status = p.filter(state, pod, node_info)
+            if not fw.Status.is_ok(status):
+                if not status.is_unschedulable():
+                    status = fw.Status(
+                        fw.ERROR,
+                        f"running {p.name()} filter plugin: {status.message()}",
+                    )
+                status.with_failed_plugin(p.name())
+                return status
+        return None
+
+    def run_filter_plugins_with_nominated_pods(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo
+    ) -> Optional[fw.Status]:
+        """Run filters up to twice (framework.go:610-684): once with
+        higher-priority nominated pods added to the node, and — if that
+        passed and nominated pods existed — once without, because
+        anti-affinity-style filters can pass only when the nominated pods
+        are absent."""
+        nominator = getattr(self.handle, "pod_nominator", None)
+        for attempt in range(2):
+            state_to_use, info_to_use = state, node_info
+            if attempt == 0:
+                added, state_to_use, info_to_use = self._add_nominated_pods(
+                    state, pod, node_info, nominator
+                )
+                if not added:
+                    # no nominated pods: single pass suffices
+                    return self.run_filter_plugins(state, pod, node_info)
+            status = self.run_filter_plugins(state_to_use, pod, info_to_use)
+            if not fw.Status.is_ok(status):
+                return status
+        return None
+
+    def _add_nominated_pods(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo, nominator
+    ) -> Tuple[bool, CycleState, NodeInfo]:
+        if nominator is None or node_info.node is None:
+            return False, state, node_info
+        nominated = nominator.nominated_pods_for_node(node_info.node.name)
+        relevant = [
+            pi for pi in nominated
+            if pi.pod.uid != pod.uid and pi.pod.priority() >= pod.priority()
+        ]
+        if not relevant:
+            return False, state, node_info
+        node_out = node_info.clone()
+        state_out = state.clone()
+        for pi in relevant:
+            node_out.add_pod_info(pi)
+            self.run_pre_filter_extension_add_pod(state_out, pod, pi.pod, node_out)
+        return True, state_out, node_out
+
+    def run_post_filter_plugins(
+        self, state: CycleState, pod: Pod, statuses: fw.NodeToStatusMap
+    ) -> Tuple[Optional[fw.PostFilterResult], fw.Status]:
+        start = time.monotonic()
+        final = fw.Status(fw.UNSCHEDULABLE, "no candidates")
+        for p in self.post_filter_plugins:
+            result, status = p.post_filter(state, pod, statuses)
+            if fw.Status.is_ok(status):
+                self._record("PostFilter", status, start)
+                return result, status or fw.Status(fw.SUCCESS)
+            if not (status and status.is_unschedulable()):
+                self._record("PostFilter", status, start)
+                return None, fw.Status(
+                    fw.ERROR, f"running PostFilter plugin {p.name()}: {status.message()}"
+                )
+            final = status
+        self._record("PostFilter", final, start)
+        return None, final
+
+    def run_pre_score_plugins(
+        self, state: CycleState, pod: Pod, nodes: List
+    ) -> Optional[fw.Status]:
+        start = time.monotonic()
+        for p in self.pre_score_plugins:
+            status = p.pre_score(state, pod, nodes)
+            if not fw.Status.is_ok(status):
+                self._record("PreScore", status, start)
+                return fw.Status(
+                    fw.ERROR, f"running PreScore plugin {p.name()}: {status.message()}"
+                )
+        self._record("PreScore", None, start)
+        return None
+
+    def run_score_plugins(
+        self, state: CycleState, pod: Pod, node_names: List[str]
+    ) -> Tuple[Dict[str, List[fw.NodeScore]], Optional[fw.Status]]:
+        """Three passes (framework.go:734,754,772): score per node (parallel
+        over nodes), normalize per plugin, apply weights — returning
+        plugin -> [NodeScore] like the reference PluginToNodeScores."""
+        start = time.monotonic()
+        scores: Dict[str, List[fw.NodeScore]] = {
+            p.name(): [fw.NodeScore(n, 0) for n in node_names]
+            for p in self.score_plugins
+        }
+        errs: List[str] = []
+
+        def score_node(i: int) -> None:
+            for p in self.score_plugins:
+                s, status = p.score(state, pod, node_names[i])
+                if not fw.Status.is_ok(status):
+                    errs.append(f"{p.name()}: {status.message()}")
+                    return
+                scores[p.name()][i] = fw.NodeScore(node_names[i], s)
+
+        self.parallelizer.until(len(node_names), score_node)
+        if errs:
+            return scores, fw.Status(fw.ERROR, *errs)
+
+        for p in self.score_plugins:
+            ext = p.score_extensions()
+            if ext is not None:
+                status = ext.normalize_score(state, pod, scores[p.name()])
+                if not fw.Status.is_ok(status):
+                    return scores, fw.Status(
+                        fw.ERROR, f"normalizing {p.name()}: {status.message()}"
+                    )
+
+        for p in self.score_plugins:
+            weight = self.score_plugin_weight[p.name()]
+            for ns in scores[p.name()]:
+                if not (fw.MIN_NODE_SCORE <= ns.score <= fw.MAX_NODE_SCORE):
+                    return scores, fw.Status(
+                        fw.ERROR,
+                        f"plugin {p.name()} returns an invalid score {ns.score}",
+                    )
+                ns.score *= weight
+        self._record("Score", None, start)
+        return scores, None
+
+    def run_reserve_plugins_reserve(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Optional[fw.Status]:
+        start = time.monotonic()
+        for i, p in enumerate(self.reserve_plugins):
+            status = p.reserve(state, pod, node_name)
+            if not fw.Status.is_ok(status):
+                # roll back successful reservations in reverse order
+                for q in reversed(self.reserve_plugins[:i]):
+                    q.unreserve(state, pod, node_name)
+                self._record("Reserve", status, start)
+                return fw.Status(
+                    fw.ERROR, f"running Reserve plugin {p.name()}: {status.message()}"
+                )
+        self._record("Reserve", None, start)
+        return None
+
+    def run_reserve_plugins_unreserve(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> None:
+        for p in reversed(self.reserve_plugins):
+            p.unreserve(state, pod, node_name)
+
+    def run_permit_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Optional[fw.Status]:
+        start = time.monotonic()
+        plugin_timeouts: Dict[str, float] = {}
+        status_code = fw.SUCCESS
+        for p in self.permit_plugins:
+            status, timeout = p.permit(state, pod, node_name)
+            if not fw.Status.is_ok(status):
+                if status.is_unschedulable():
+                    self._record("Permit", status, start)
+                    return status.with_failed_plugin(p.name())
+                if status.code == fw.WAIT:
+                    plugin_timeouts[p.name()] = min(
+                        timeout if timeout and timeout > 0 else MAX_TIMEOUT,
+                        MAX_TIMEOUT,
+                    )
+                    status_code = fw.WAIT
+                else:
+                    self._record("Permit", status, start)
+                    return fw.Status(
+                        fw.ERROR,
+                        f"running Permit plugin {p.name()}: {status.message()}",
+                    )
+        if status_code == fw.WAIT:
+            wp = WaitingPod(pod, plugin_timeouts)
+            with self._waiting_lock:
+                self._waiting_pods[pod.uid] = wp
+            self._record("Permit", None, start)
+            return fw.Status(fw.WAIT, f"pod waiting at permit: {sorted(plugin_timeouts)}")
+        self._record("Permit", None, start)
+        return None
+
+    def wait_on_permit(self, pod: Pod) -> Optional[fw.Status]:
+        with self._waiting_lock:
+            wp = self._waiting_pods.get(pod.uid)
+        if wp is None:
+            return None
+        try:
+            status = wp.wait()
+        finally:
+            with self._waiting_lock:
+                self._waiting_pods.pop(pod.uid, None)
+        if not status.is_success():
+            return status
+        return None
+
+    def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
+        with self._waiting_lock:
+            return self._waiting_pods.get(uid)
+
+    def iterate_waiting_pods(self, fn: Callable[[WaitingPod], None]) -> None:
+        with self._waiting_lock:
+            pods = list(self._waiting_pods.values())
+        for wp in pods:
+            fn(wp)
+
+    def reject_waiting_pod(self, uid: str) -> bool:
+        wp = self.get_waiting_pod(uid)
+        if wp is None:
+            return False
+        wp.reject("", "removed from waiting")
+        return True
+
+    def run_pre_bind_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Optional[fw.Status]:
+        start = time.monotonic()
+        for p in self.pre_bind_plugins:
+            status = p.pre_bind(state, pod, node_name)
+            if not fw.Status.is_ok(status):
+                self._record("PreBind", status, start)
+                return fw.Status(
+                    fw.ERROR, f"running PreBind plugin {p.name()}: {status.message()}"
+                )
+        self._record("PreBind", None, start)
+        return None
+
+    def run_bind_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Optional[fw.Status]:
+        start = time.monotonic()
+        if not self.bind_plugins:
+            return fw.Status(fw.ERROR, "no bind plugin configured")
+        for p in self.bind_plugins:
+            status = p.bind(state, pod, node_name)
+            if status is not None and status.code == fw.SKIP:
+                continue
+            if not fw.Status.is_ok(status):
+                self._record("Bind", status, start)
+                return fw.Status(
+                    fw.ERROR, f"running Bind plugin {p.name()}: {status.message()}"
+                )
+            self._record("Bind", status, start)
+            return status
+        self._record("Bind", None, start)
+        return fw.Status(fw.ERROR, "all bind plugins skipped")
+
+    def run_post_bind_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> None:
+        for p in self.post_bind_plugins:
+            p.post_bind(state, pod, node_name)

@@ -1,0 +1,116 @@
+"""Keyed binary heap with arbitrary less-functions (reference
+``internal/heap/heap.go``): supports add/update/delete-by-key and peek/pop,
+with an optional gauge recorder (heap.go:243,248)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Heap:
+    def __init__(
+        self,
+        key_func: Callable[[Any], str],
+        less_func: Callable[[Any, Any], bool],
+        metric_recorder=None,
+    ):
+        self._key = key_func
+        self._less = less_func
+        self._items: List[Any] = []
+        self._index: Dict[str, int] = {}
+        self._metric = metric_recorder
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, obj: Any) -> bool:
+        return self._key(obj) in self._index
+
+    def has_key(self, key: str) -> bool:
+        return key in self._index
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        i = self._index.get(key)
+        return self._items[i] if i is not None else None
+
+    def list(self) -> List[Any]:
+        return list(self._items)
+
+    def add(self, obj: Any) -> None:
+        """Insert or update (reference heap.Add)."""
+        key = self._key(obj)
+        if key in self._index:
+            i = self._index[key]
+            self._items[i] = obj
+            self._sift_up(i)
+            self._sift_down(i)
+        else:
+            self._items.append(obj)
+            self._index[key] = len(self._items) - 1
+            self._sift_up(len(self._items) - 1)
+            if self._metric:
+                self._metric.inc()
+
+    # AddIfNotPresent semantics
+    def add_if_not_present(self, obj: Any) -> None:
+        if self._key(obj) not in self._index:
+            self.add(obj)
+
+    def update(self, obj: Any) -> None:
+        self.add(obj)
+
+    def delete(self, obj: Any) -> bool:
+        return self.delete_by_key(self._key(obj))
+
+    def delete_by_key(self, key: str) -> bool:
+        i = self._index.get(key)
+        if i is None:
+            return False
+        self._swap(i, len(self._items) - 1)
+        self._items.pop()
+        del self._index[key]
+        if i < len(self._items):
+            self._sift_up(i)
+            self._sift_down(i)
+        if self._metric:
+            self._metric.dec()
+        return True
+
+    def peek(self) -> Optional[Any]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Any:
+        if not self._items:
+            raise IndexError("pop from empty heap")
+        top = self._items[0]
+        self.delete_by_key(self._key(top))
+        return top
+
+    # --- internals ----------------------------------------------------
+    def _swap(self, i: int, j: int) -> None:
+        if i == j:
+            return
+        self._items[i], self._items[j] = self._items[j], self._items[i]
+        self._index[self._key(self._items[i])] = i
+        self._index[self._key(self._items[j])] = j
+
+    def _sift_up(self, i: int) -> None:
+        while i > 0:
+            parent = (i - 1) // 2
+            if self._less(self._items[i], self._items[parent]):
+                self._swap(i, parent)
+                i = parent
+            else:
+                break
+
+    def _sift_down(self, i: int) -> None:
+        n = len(self._items)
+        while True:
+            smallest = i
+            for child in (2 * i + 1, 2 * i + 2):
+                if child < n and self._less(self._items[child], self._items[smallest]):
+                    smallest = child
+            if smallest == i:
+                return
+            self._swap(i, smallest)
+            i = smallest

@@ -1,0 +1,84 @@
+"""Zone-aware node tree (reference ``internal/cache/node_tree.go:32-36``).
+
+Maintains zone → [node names] and produces a zone-interleaved ordering so a
+snapshot's node list spreads consecutive scheduling attempts across zones.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kubernetes_tpu.api.types import Node
+
+ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+REGION_LABELS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+def get_zone_key(node: Node) -> str:
+    region = zone = ""
+    for l in REGION_LABELS:
+        if l in node.metadata.labels:
+            region = node.metadata.labels[l]
+            break
+    for l in ZONE_LABELS:
+        if l in node.metadata.labels:
+            zone = node.metadata.labels[l]
+            break
+    if not region and not zone:
+        return ""
+    return f"{region}:\x00:{zone}"
+
+
+class NodeTree:
+    def __init__(self):
+        self._tree: Dict[str, List[str]] = {}
+        self._zones: List[str] = []
+        self.num_nodes = 0
+
+    def add_node(self, node: Node) -> None:
+        zone = get_zone_key(node)
+        if zone not in self._tree:
+            self._tree[zone] = []
+            self._zones.append(zone)
+        if node.name in self._tree[zone]:
+            return
+        self._tree[zone].append(node.name)
+        self.num_nodes += 1
+
+    def remove_node(self, node: Node) -> bool:
+        zone = get_zone_key(node)
+        names = self._tree.get(zone)
+        if names and node.name in names:
+            names.remove(node.name)
+            if not names:
+                del self._tree[zone]
+                self._zones.remove(zone)
+            self.num_nodes -= 1
+            return True
+        return False
+
+    def update_node(self, old: Node, new: Node) -> None:
+        if get_zone_key(old) == get_zone_key(new):
+            return
+        self.remove_node(old)
+        self.add_node(new)
+
+    def list(self) -> List[str]:
+        """Round-robin across zones (reference node_tree list ordering)."""
+        out: List[str] = []
+        idx = [0] * len(self._zones)
+        remaining = self.num_nodes
+        while remaining > 0:
+            for zi, zone in enumerate(self._zones):
+                names = self._tree.get(zone, ())
+                if idx[zi] < len(names):
+                    out.append(names[idx[zi]])
+                    idx[zi] += 1
+                    remaining -= 1
+        return out

@@ -1,0 +1,89 @@
+"""Algorithm providers (reference
+``pkg/scheduler/algorithmprovider/registry.go:71-150``): the default
+per-extension-point plugin wiring, plus the ClusterAutoscaler variant that
+swaps LeastAllocated for MostAllocated (:152-161) and feature-gate tweaks
+(:163 applyFeatureGates)."""
+
+from kubernetes_tpu.config.types import PluginEntry, Plugins, PluginSet
+
+
+def default_plugins(feature_gates=None) -> Plugins:
+    p = Plugins()
+    p.queue_sort = PluginSet(enabled=[PluginEntry("PrioritySort")])
+    p.pre_filter = PluginSet(
+        enabled=[
+            PluginEntry("NodeResourcesFit"),
+            PluginEntry("NodePorts"),
+            PluginEntry("PodTopologySpread"),
+            PluginEntry("InterPodAffinity"),
+            PluginEntry("VolumeBinding"),
+        ]
+    )
+    p.filter = PluginSet(
+        enabled=[
+            PluginEntry("NodeUnschedulable"),
+            PluginEntry("NodeName"),
+            PluginEntry("TaintToleration"),
+            PluginEntry("NodeAffinity"),
+            PluginEntry("NodePorts"),
+            PluginEntry("NodeResourcesFit"),
+            PluginEntry("VolumeRestrictions"),
+            PluginEntry("EBSLimits"),
+            PluginEntry("GCEPDLimits"),
+            PluginEntry("NodeVolumeLimits"),
+            PluginEntry("AzureDiskLimits"),
+            PluginEntry("VolumeBinding"),
+            PluginEntry("VolumeZone"),
+            PluginEntry("PodTopologySpread"),
+            PluginEntry("InterPodAffinity"),
+        ]
+    )
+    p.post_filter = PluginSet(enabled=[PluginEntry("DefaultPreemption")])
+    p.pre_score = PluginSet(
+        enabled=[
+            PluginEntry("InterPodAffinity"),
+            PluginEntry("PodTopologySpread"),
+            PluginEntry("TaintToleration"),
+        ]
+    )
+    p.score = PluginSet(
+        enabled=[
+            PluginEntry("NodeResourcesBalancedAllocation", 1),
+            PluginEntry("ImageLocality", 1),
+            PluginEntry("InterPodAffinity", 1),
+            PluginEntry("NodeResourcesLeastAllocated", 1),
+            PluginEntry("NodeAffinity", 1),
+            PluginEntry("NodePreferAvoidPods", 10000),
+            PluginEntry("PodTopologySpread", 2),
+            PluginEntry("TaintToleration", 1),
+        ]
+    )
+    p.reserve = PluginSet(enabled=[PluginEntry("VolumeBinding")])
+    p.pre_bind = PluginSet(enabled=[PluginEntry("VolumeBinding")])
+    p.bind = PluginSet(enabled=[PluginEntry("DefaultBinder")])
+
+    # legacy default spreading unless DefaultPodTopologySpread migrates it
+    if feature_gates is None or not feature_gates.enabled(
+        "DefaultPodTopologySpread"
+    ):
+        p.pre_score.enabled.append(PluginEntry("SelectorSpread"))
+        p.score.enabled.append(PluginEntry("SelectorSpread", 1))
+    return p
+
+
+def cluster_autoscaler_plugins(feature_gates=None) -> Plugins:
+    """Bin-packing variant (registry.go:152-161)."""
+    p = default_plugins(feature_gates)
+    p.score.enabled = [
+        PluginEntry("NodeResourcesMostAllocated", e.weight)
+        if e.name == "NodeResourcesLeastAllocated"
+        else e
+        for e in p.score.enabled
+    ]
+    return p
+
+
+PROVIDERS = {
+    "DefaultProvider": default_plugins,
+    "ClusterAutoscalerProvider": cluster_autoscaler_plugins,
+}

@@ -1,0 +1,359 @@
+"""Three-tier scheduling queue + pod nominator.
+
+Behavioral equivalent of the reference's
+``pkg/scheduler/internal/queue/scheduling_queue.go``: ``activeQ`` (heap
+ordered by the framework's QueueSort less-func), ``podBackoffQ`` (heap by
+backoff expiry; exponential 1s→10s), ``unschedulableQ`` (map), the
+``schedulingCycle``/``moveRequestCycle`` race-avoidance protocol
+(:297-329), event-driven ``move_all_to_active_or_backoff_queue`` (:512-533),
+periodic flushes (backoff 1s, unschedulable-leftover 30s period / 60s age),
+and an embedded PodNominator for preemption nominations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.heap import Heap
+from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo, get_pod_key
+from kubernetes_tpu.utils.clock import RealClock
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0   # scheduling_queue.go:57
+DEFAULT_POD_MAX_BACKOFF = 10.0      # scheduling_queue.go:59
+UNSCHEDULABLE_Q_TIME_INTERVAL = 60.0  # flush age threshold
+BACKOFF_FLUSH_INTERVAL = 1.0
+UNSCHEDULABLE_FLUSH_INTERVAL = 30.0
+
+
+class PodNominator:
+    """Tracks preemption nominations (reference framework/interface.go:587 +
+    queue nominator implementation)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nominated: Dict[str, List[PodInfo]] = {}  # nodeName -> pods
+        self._pod_to_node: Dict[str, str] = {}
+
+    def add_nominated_pod(self, pod: Pod, node_name: str = "") -> None:
+        with self._lock:
+            self._delete_locked(pod)
+            nn = node_name or pod.status.nominated_node_name
+            if not nn:
+                return
+            self._pod_to_node[get_pod_key(pod)] = nn
+            self._nominated.setdefault(nn, []).append(PodInfo(pod))
+
+    def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
+        with self._lock:
+            self._delete_locked(pod)
+
+    def update_nominated_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            # preserve the nomination across updates that drop the status field
+            nn = self._pod_to_node.get(get_pod_key(old), "")
+            self._delete_locked(old)
+            self.add_nominated_pod(new, new.status.nominated_node_name or nn)
+
+    def nominated_pods_for_node(self, node_name: str) -> List[PodInfo]:
+        with self._lock:
+            return list(self._nominated.get(node_name, ()))
+
+    def _delete_locked(self, pod: Pod) -> None:
+        key = get_pod_key(pod)
+        nn = self._pod_to_node.pop(key, None)
+        if nn is not None and nn in self._nominated:
+            self._nominated[nn] = [
+                pi for pi in self._nominated[nn] if get_pod_key(pi.pod) != key
+            ]
+            if not self._nominated[nn]:
+                del self._nominated[nn]
+
+
+def default_queue_sort_less(a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+    """PrioritySort less (priority_sort.go:41-45): higher priority first,
+    earlier enqueue-timestamp tiebreak."""
+    pa, pb = a.pod.priority(), b.pod.priority()
+    if pa != pb:
+        return pa > pb
+    return a.timestamp < b.timestamp
+
+
+class SchedulingQueue(PodNominator):
+    def __init__(
+        self,
+        less_func: Callable[[QueuedPodInfo, QueuedPodInfo], bool] = default_queue_sort_less,
+        clock=None,
+        pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        metrics=None,
+    ):
+        super().__init__()
+        self._clock = clock or RealClock()
+        self._qlock = threading.RLock()
+        self._cond = threading.Condition(self._qlock)
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+        self._metrics = metrics
+
+        def key(qpi: QueuedPodInfo) -> str:
+            return get_pod_key(qpi.pod)
+
+        self._active_q = Heap(key, less_func)
+        self._backoff_q = Heap(
+            key, lambda a, b: self._backoff_time(a) < self._backoff_time(b)
+        )
+        self._unschedulable_q: Dict[str, QueuedPodInfo] = {}
+        self.scheduling_cycle = 0
+        self._move_request_cycle = -1
+        self._closed = False
+        self._flush_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    def _backoff_time(self, qpi: QueuedPodInfo) -> float:
+        return qpi.timestamp + self._backoff_duration(qpi)
+
+    def _backoff_duration(self, qpi: QueuedPodInfo) -> float:
+        """initial * 2^attempts, capped (scheduling_queue.go
+        calculateBackoffDuration)."""
+        d = self._initial_backoff
+        for _ in range(1, qpi.attempts):
+            d *= 2
+            if d >= self._max_backoff:
+                return self._max_backoff
+        return min(d, self._max_backoff)
+
+    def _backoff_complete(self, qpi: QueuedPodInfo) -> bool:
+        return self._clock.now() >= self._backoff_time(qpi)
+
+    # ------------------------------------------------------------------
+    def add(self, pod: Pod) -> None:
+        with self._cond:
+            qpi = self._new_queued_pod_info(pod)
+            self._active_q.add(qpi)
+            key = get_pod_key(pod)
+            self._unschedulable_q.pop(key, None)
+            self._backoff_q.delete_by_key(key)
+            self.add_nominated_pod(pod)
+            if self._metrics:
+                self._metrics.pods_added("active", "PodAdd")
+            self._cond.notify_all()
+
+    def _new_queued_pod_info(self, pod: Pod) -> QueuedPodInfo:
+        # carry attempts across queue hops if known
+        key = get_pod_key(pod)
+        for source in (self._active_q.get_by_key(key), self._backoff_q.get_by_key(key),
+                       self._unschedulable_q.get(key)):
+            if source is not None:
+                source.pod_info = PodInfo(pod)
+                source.timestamp = self._clock.now()
+                return source
+        return QueuedPodInfo(pod, timestamp=self._clock.now())
+
+    def add_unschedulable_if_not_present(
+        self, qpi: QueuedPodInfo, pod_scheduling_cycle: int
+    ) -> None:
+        """Failed-cycle requeue (scheduling_queue.go:297-329): if a move
+        request arrived during this pod's scheduling cycle, the cluster may
+        already have changed — send it to backoff instead of unschedulable."""
+        with self._cond:
+            key = get_pod_key(qpi.pod)
+            if (
+                self._unschedulable_q.get(key) is not None
+                or self._active_q.has_key(key)
+                or self._backoff_q.has_key(key)
+            ):
+                raise ValueError(f"pod {key} already present in a queue")
+            qpi.timestamp = self._clock.now()
+            if self._move_request_cycle >= pod_scheduling_cycle:
+                self._backoff_q.add(qpi)
+                if self._metrics:
+                    self._metrics.pods_added("backoff", "ScheduleAttemptFailure")
+            else:
+                self._unschedulable_q[key] = qpi
+                if self._metrics:
+                    self._metrics.pods_added("unschedulable", "ScheduleAttemptFailure")
+            self.add_nominated_pod(qpi.pod)
+            self._cond.notify_all()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+        """Blocks until a pod is available (scheduling_queue.go:379-399)."""
+        with self._cond:
+            while len(self._active_q) == 0:
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+            qpi: QueuedPodInfo = self._active_q.pop()
+            qpi.attempts += 1
+            self.scheduling_cycle += 1
+            return qpi
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        with self._cond:
+            key = get_pod_key(new)
+            if old is not None:
+                for q in (self._active_q, self._backoff_q):
+                    existing = q.get_by_key(key)
+                    if existing is not None:
+                        existing.pod_info = PodInfo(new)
+                        q.update(existing)
+                        self.update_nominated_pod(old, new)
+                        return
+            existing = self._unschedulable_q.get(key)
+            if existing is not None:
+                self.update_nominated_pod(old or existing.pod, new)
+                if old is not None and _pod_updated_may_help(old, new):
+                    existing.pod_info = PodInfo(new)
+                    del self._unschedulable_q[key]
+                    if self._backoff_complete(existing):
+                        self._active_q.add(existing)
+                        self._cond.notify_all()
+                    else:
+                        self._backoff_q.add(existing)
+                else:
+                    existing.pod_info = PodInfo(new)
+                return
+            # not present anywhere: treat as new
+            self._active_q.add(self._new_queued_pod_info(new))
+            self.add_nominated_pod(new)
+            self._cond.notify_all()
+
+    def delete(self, pod: Pod) -> None:
+        with self._cond:
+            key = get_pod_key(pod)
+            self.delete_nominated_pod_if_exists(pod)
+            self._active_q.delete_by_key(key)
+            self._backoff_q.delete_by_key(key)
+            self._unschedulable_q.pop(key, None)
+
+    # ------------------------------------------------------------------
+    def move_all_to_active_or_backoff_queue(self, event: str) -> None:
+        with self._cond:
+            self._move_pods_locked(list(self._unschedulable_q.values()), event)
+
+    def assigned_pod_added(self, pod: Pod) -> None:
+        with self._cond:
+            self._move_pods_locked(
+                self._unschedulable_pods_with_matching_affinity(pod),
+                "AssignedPodAdd",
+            )
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        with self._cond:
+            self._move_pods_locked(
+                self._unschedulable_pods_with_matching_affinity(pod),
+                "AssignedPodUpdate",
+            )
+
+    def _unschedulable_pods_with_matching_affinity(self, pod: Pod) -> List[QueuedPodInfo]:
+        """Pods whose (anti-)affinity terms match the newly-assigned pod
+        (scheduling_queue.go:483 getUnschedulablePodsWithMatchingAffinityTerm)."""
+        out = []
+        for qpi in self._unschedulable_q.values():
+            pi = qpi.pod_info
+            terms = (
+                pi.required_affinity_terms
+                + pi.required_anti_affinity_terms
+                + [wt.term for wt in pi.preferred_affinity_terms]
+                + [wt.term for wt in pi.preferred_anti_affinity_terms]
+            )
+            if any(t.matches(pod) for t in terms):
+                out.append(qpi)
+        return out
+
+    def _move_pods_locked(self, pods: List[QueuedPodInfo], event: str) -> None:
+        for qpi in pods:
+            key = get_pod_key(qpi.pod)
+            if self._backoff_complete(qpi):
+                self._active_q.add(qpi)
+            else:
+                self._backoff_q.add(qpi)
+            self._unschedulable_q.pop(key, None)
+            if self._metrics:
+                self._metrics.pods_moved(event)
+        self._move_request_cycle = self.scheduling_cycle
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # periodic flushes
+    def flush_backoff_completed(self) -> None:
+        with self._cond:
+            moved = False
+            while len(self._backoff_q):
+                top: QueuedPodInfo = self._backoff_q.peek()
+                if not self._backoff_complete(top):
+                    break
+                self._backoff_q.pop()
+                self._active_q.add(top)
+                moved = True
+            if moved:
+                self._cond.notify_all()
+
+    def flush_unschedulable_left_over(self) -> None:
+        with self._cond:
+            now = self._clock.now()
+            stale = [
+                qpi
+                for qpi in self._unschedulable_q.values()
+                if now - qpi.timestamp >= UNSCHEDULABLE_Q_TIME_INTERVAL
+            ]
+            if stale:
+                self._move_pods_locked(stale, "UnschedulableTimeout")
+
+    def run(self) -> None:
+        """Start flush threads (scheduling_queue.go:241-244)."""
+        for interval, fn in (
+            (BACKOFF_FLUSH_INTERVAL, self.flush_backoff_completed),
+            (UNSCHEDULABLE_FLUSH_INTERVAL, self.flush_unschedulable_left_over),
+        ):
+            t = threading.Thread(
+                target=self._flush_loop, args=(interval, fn), daemon=True
+            )
+            t.start()
+            self._flush_threads.append(t)
+
+    def _flush_loop(self, interval: float, fn) -> None:
+        while not self._stop.wait(interval):
+            fn()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._stop.set()
+            self._cond.notify_all()
+
+    # introspection (tests + debugger)
+    def pending_pods(self) -> List[Pod]:
+        with self._qlock:
+            return (
+                [q.pod for q in self._active_q.list()]
+                + [q.pod for q in self._backoff_q.list()]
+                + [q.pod for q in self._unschedulable_q.values()]
+            )
+
+    def num_active(self) -> int:
+        with self._qlock:
+            return len(self._active_q)
+
+    def num_backoff(self) -> int:
+        with self._qlock:
+            return len(self._backoff_q)
+
+    def num_unschedulable(self) -> int:
+        with self._qlock:
+            return len(self._unschedulable_q)
+
+
+def _pod_updated_may_help(old: Pod, new: Pod) -> bool:
+    """Reference isPodUpdated: strip ResourceVersion/Status-y fields and
+    compare — we approximate by checking spec/label changes."""
+    return (
+        old.metadata.labels != new.metadata.labels
+        or old.spec.node_selector != new.spec.node_selector
+        or old.spec.tolerations != new.spec.tolerations
+        or old.spec.priority != new.spec.priority
+        or old.spec.affinity != new.spec.affinity
+    )

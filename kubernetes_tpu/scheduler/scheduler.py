@@ -1,0 +1,430 @@
+"""The scheduler: configurator + the scheduleOne loop.
+
+Behavioral equivalent of the reference's ``pkg/scheduler/scheduler.go``
+(Scheduler struct :61-88, Run :311-315, scheduleOne :427-600, assume :359,
+bind :381, skipPodSchedule :620) and ``factory.go`` (Configurator :90-184,
+MakeDefaultErrorFunc :316-362). One pod per cycle: Pop → Schedule → assume →
+Reserve → Permit → async binding cycle; failures re-queue through the
+error function with the moveRequestCycle protocol.
+
+The TPU batch path (``kubernetes_tpu.sidecar``) plugs in behind the
+``TPUBatchScheduler`` feature gate: when enabled the loop drains pod
+*batches* and delegates assignment to the device solver, falling back to
+this serial path whenever the sidecar declines a pod (clean fallback, like
+an ``IsIgnorable`` extender — SURVEY.md section 5).
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod, PodCondition
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.feature_gates import FeatureGates
+from kubernetes_tpu.config.types import KubeSchedulerConfiguration
+from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.core import GenericScheduler, ScheduleResult
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers, assigned
+from kubernetes_tpu.scheduler.extender import HTTPExtender
+from kubernetes_tpu.scheduler.framework import interface as fw
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework.plugins import new_in_tree_registry
+from kubernetes_tpu.scheduler.framework.runtime import Framework, Registry
+from kubernetes_tpu.scheduler.provider import PROVIDERS
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.scheduler.types import QueuedPodInfo
+from kubernetes_tpu.utils.clock import RealClock
+
+PLUGIN_METRICS_SAMPLE_PERCENT = 10  # scheduler.go:56
+
+
+class _Deps:
+    """The Handle dependency bundle shared by all profile frameworks."""
+
+    def __init__(self, scheduler: "Scheduler"):
+        self._scheduler = scheduler
+        self.parallelizer = None  # set by configurator
+
+    def snapshot(self):
+        return self._scheduler.algorithm.snapshot
+
+    @property
+    def client(self) -> ClusterStore:
+        return self._scheduler.client
+
+    @property
+    def pod_nominator(self):
+        return self._scheduler.queue
+
+    @property
+    def feature_gates(self) -> FeatureGates:
+        return self._scheduler.feature_gates
+
+    @property
+    def extenders(self):
+        return self._scheduler.algorithm.extenders
+
+
+class Scheduler:
+    def __init__(
+        self,
+        client: ClusterStore,
+        cache: SchedulerCache,
+        queue: SchedulingQueue,
+        profiles: Dict[str, Framework],
+        algorithm: GenericScheduler,
+        feature_gates: FeatureGates,
+        metrics: SchedulerMetrics,
+        clock=None,
+    ):
+        self.client = client
+        self.cache = cache
+        self.queue = queue
+        self.profiles = profiles
+        self.algorithm = algorithm
+        self.feature_gates = feature_gates
+        self.metrics = metrics
+        self.clock = clock or RealClock()
+        self._stop = threading.Event()
+        self._bind_pool = ThreadPoolExecutor(max_workers=64,
+                                             thread_name_prefix="binder")
+        self._inflight_bindings = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Condition(self._inflight_lock)
+        self.batch_scheduler = None  # set by kubernetes_tpu.sidecar when gated on
+        self._watch_handle = None
+        self.event_handlers = EventHandlers(self)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def create(
+        cls,
+        client: ClusterStore,
+        config: Optional[KubeSchedulerConfiguration] = None,
+        out_of_tree_registry: Optional[Registry] = None,
+        provider: str = "DefaultProvider",
+        feature_gates: Optional[FeatureGates] = None,
+        metrics: Optional[SchedulerMetrics] = None,
+        clock=None,
+    ) -> "Scheduler":
+        """The Configurator (factory.go:90-184 create/createFromProvider)."""
+        config = config or KubeSchedulerConfiguration()
+        errs = config.validate()
+        if errs:
+            raise ValueError("invalid scheduler configuration: " + "; ".join(errs))
+        feature_gates = feature_gates or FeatureGates(config.feature_gates)
+        metrics = metrics or SchedulerMetrics()
+        cache = SchedulerCache()
+        extenders = [HTTPExtender(e) for e in config.extenders]
+        algorithm = GenericScheduler(
+            cache,
+            extenders=extenders,
+            percentage_of_nodes_to_score=config.percentage_of_nodes_to_score,
+            feature_gates=feature_gates,
+        )
+
+        registry = new_in_tree_registry()
+        if out_of_tree_registry:
+            registry.merge(out_of_tree_registry)
+        default_plugins = PROVIDERS[provider](feature_gates)
+
+        # fully initialize the scheduler BEFORE running plugin factories:
+        # factories legitimately touch handle.client / pod_nominator
+        # (reference NewFramework receives a working handle). The queue is
+        # created first with the default less-func and rewired below —
+        # it is empty until start(), so the swap is safe.
+        queue = SchedulingQueue(
+            clock=clock,
+            pod_initial_backoff=config.pod_initial_backoff_seconds,
+            pod_max_backoff=config.pod_max_backoff_seconds,
+            metrics=metrics,
+        )
+        sched = cls(
+            client, cache, queue, {}, algorithm,
+            feature_gates, metrics, clock=clock,
+        )
+        deps = _Deps(sched)
+        from kubernetes_tpu.utils.parallelize import Parallelizer
+
+        deps.parallelizer = Parallelizer(config.parallelism)
+
+        for profile in config.profiles:
+            sched.profiles[profile.scheduler_name] = Framework(
+                registry, profile, default_plugins, deps=deps, metrics=metrics
+            )
+
+        # all profiles must share the queue-sort function (profile.go:52)
+        less_fns = {
+            tuple(p.list_plugins()["queue_sort"])
+            for p in sched.profiles.values()
+        }
+        if len(less_fns) != 1:
+            raise ValueError("all profiles must use the same QueueSort plugin")
+        any_profile = next(iter(sched.profiles.values()))
+        queue._active_q._less = any_profile.queue_sort_less
+        return sched
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Wire event handlers and start background machinery (the
+        informer-start + queue.Run portion of Run, scheduler.go:311)."""
+        if self._watch_handle is None:
+            self._watch_handle = self.client.watch(self.event_handlers.handle)
+        # replay current state (the initial List of ListAndWatch)
+        for node in self.client.list_nodes():
+            self.cache.add_node(node)
+        for pod in self.client.list_pods():
+            if assigned(pod):
+                self.cache.add_pod(pod)
+            elif self.event_handlers.responsible_for(pod):
+                self.queue.add(pod)
+        self.cache.run()
+        self.queue.run()
+
+    def run(self) -> threading.Thread:
+        """Run the scheduling loop in a thread; returns it."""
+        self.start()
+        t = threading.Thread(target=self._loop, daemon=True, name="scheduleOne")
+        t.start()
+        return t
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.schedule_one(pop_timeout=0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        self.cache.stop()
+        if self._watch_handle is not None:
+            self._watch_handle.stop()
+            self._watch_handle = None
+        self._bind_pool.shutdown(wait=False)
+
+    def wait_for_inflight_bindings(self, timeout: float = 30.0) -> bool:
+        with self._inflight_zero:
+            deadline = time.monotonic() + timeout
+            while self._inflight_bindings > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_zero.wait(remaining)
+            return True
+
+    # ------------------------------------------------------------------
+    def framework_for_pod(self, pod: Pod) -> Framework:
+        fwk = self.profiles.get(pod.spec.scheduler_name)
+        if fwk is None:
+            raise KeyError(
+                f"profile not found for scheduler name {pod.spec.scheduler_name!r}"
+            )
+        return fwk
+
+    def skip_pod_schedule(self, fwk: Framework, pod: Pod) -> bool:
+        """scheduler.go:620: deleting pods and already-assumed pods skip."""
+        if pod.metadata.deletion_timestamp is not None:
+            return True
+        if self.cache.is_assumed_pod(pod):
+            return True
+        if assigned(pod):
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    def schedule_one(self, pop_timeout: Optional[float] = None) -> bool:
+        """One scheduling cycle (scheduler.go:427). Returns False when the
+        queue yielded nothing."""
+        qpi = self.queue.pop(timeout=pop_timeout)
+        if qpi is None:
+            return False
+        pod = qpi.pod
+        try:
+            fwk = self.framework_for_pod(pod)
+        except KeyError:
+            return True
+        if self.skip_pod_schedule(fwk, pod):
+            return True
+
+        pod_scheduling_cycle = self.queue.scheduling_cycle
+        start = time.monotonic()
+        state = CycleState()
+        state.record_plugin_metrics = (
+            random.randrange(100) < PLUGIN_METRICS_SAMPLE_PERCENT
+        )
+
+        try:
+            result = self.algorithm.schedule(state, fwk, pod)
+        except fw.FitError as fit_err:
+            self._handle_fit_error(fwk, state, qpi, fit_err, pod_scheduling_cycle)
+            self.metrics.schedule_attempts.inc("unschedulable", fwk.profile_name)
+            return True
+        except Exception as err:  # noqa: BLE001 - mirrors the error func path
+            self._record_failure(fwk, qpi, err, "SchedulerError", "",
+                                 pod_scheduling_cycle)
+            self.metrics.schedule_attempts.inc("error", fwk.profile_name)
+            return True
+
+        self.metrics.scheduling_algorithm_duration.observe(time.monotonic() - start)
+
+        # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
+        assumed_pod = copy.copy(pod)
+        assumed_pod.spec = copy.copy(pod.spec)
+        assumed_pod.spec.node_name = result.suggested_host
+        try:
+            self.cache.assume_pod(assumed_pod)
+        except ValueError as err:
+            self._record_failure(fwk, qpi, err, "SchedulerError", "",
+                                 pod_scheduling_cycle)
+            return True
+        self.queue.delete_nominated_pod_if_exists(pod)
+
+        # Reserve
+        status = fwk.run_reserve_plugins_reserve(state, assumed_pod,
+                                                result.suggested_host)
+        if not fw.Status.is_ok(status):
+            self._forget_and_fail(fwk, state, qpi, assumed_pod, result,
+                                  status.as_error(), pod_scheduling_cycle)
+            return True
+
+        # Permit
+        status = fwk.run_permit_plugins(state, assumed_pod, result.suggested_host)
+        if status is not None and status.code not in (fw.SUCCESS, fw.WAIT):
+            self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
+                                        status.as_error(), pod_scheduling_cycle)
+            return True
+
+        # binding cycle runs async (scheduler.go:540): the loop continues
+        with self._inflight_lock:
+            self._inflight_bindings += 1
+        self.metrics.goroutines.inc("binding")
+        self._bind_pool.submit(
+            self._binding_cycle, fwk, state, qpi, assumed_pod, result,
+            pod_scheduling_cycle, start,
+        )
+        return True
+
+    # ------------------------------------------------------------------
+    def _binding_cycle(
+        self,
+        fwk: Framework,
+        state: CycleState,
+        qpi: QueuedPodInfo,
+        assumed_pod: Pod,
+        result: ScheduleResult,
+        cycle: int,
+        start: float,
+    ) -> None:
+        try:
+            status = fwk.wait_on_permit(assumed_pod)
+            if not fw.Status.is_ok(status):
+                self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
+                                            status.as_error(), cycle)
+                return
+            status = fwk.run_pre_bind_plugins(state, assumed_pod,
+                                              result.suggested_host)
+            if not fw.Status.is_ok(status):
+                self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
+                                            status.as_error(), cycle)
+                return
+            err = self._bind(fwk, state, assumed_pod, result.suggested_host)
+            if err is not None:
+                self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
+                                            err, cycle)
+                return
+            fwk.run_post_bind_plugins(state, assumed_pod, result.suggested_host)
+            elapsed = time.monotonic() - start
+            self.metrics.e2e_scheduling_duration.observe(elapsed, "scheduled")
+            self.metrics.schedule_attempts.inc("scheduled", fwk.profile_name)
+            self.metrics.pod_scheduling_attempts.observe(qpi.attempts)
+            self.metrics.pod_scheduling_duration.observe(
+                time.monotonic() - qpi.initial_attempt_timestamp,
+                str(qpi.attempts),
+            )
+        finally:
+            self.metrics.goroutines.dec("binding")
+            with self._inflight_zero:
+                self._inflight_bindings -= 1
+                if self._inflight_bindings == 0:
+                    self._inflight_zero.notify_all()
+
+    def _bind(self, fwk: Framework, state: CycleState, pod: Pod,
+              node_name: str) -> Optional[Exception]:
+        """scheduler.go:381: extender binders take precedence, then the
+        framework's bind plugins; FinishBinding starts the assumed TTL."""
+        try:
+            bound = False
+            for ext in self.algorithm.extenders:
+                if ext.is_binder() and ext.is_interested(pod):
+                    ext.bind(pod, node_name)
+                    bound = True
+                    break
+            if not bound:
+                status = fwk.run_bind_plugins(state, pod, node_name)
+                if not fw.Status.is_ok(status):
+                    return status.as_error()
+            self.cache.finish_binding(pod)
+            return None
+        except Exception as e:  # noqa: BLE001
+            return e
+
+    # ------------------------------------------------------------------
+    def _handle_fit_error(self, fwk: Framework, state: CycleState,
+                          qpi: QueuedPodInfo, fit_err: fw.FitError,
+                          cycle: int) -> None:
+        """PostFilter (preemption) then record + requeue (scheduler.go:465)."""
+        nominated_node = ""
+        if fwk.has_post_filter_plugins():
+            self.metrics.preemption_attempts.inc()
+            result, status = fwk.run_post_filter_plugins(
+                state, qpi.pod, fit_err.filtered_nodes_statuses
+            )
+            if fw.Status.is_ok(status) and result is not None:
+                nominated_node = result.nominated_node_name
+        self._record_failure(fwk, qpi, fit_err, "Unschedulable",
+                             nominated_node, cycle)
+
+    def _forget_and_fail(self, fwk, state, qpi, assumed_pod, result, err,
+                         cycle) -> None:
+        try:
+            self.cache.forget_pod(assumed_pod)
+        except ValueError:
+            pass
+        self._record_failure(fwk, qpi, err, "SchedulerError", "", cycle)
+
+    def _unreserve_forget_fail(self, fwk, state, qpi, assumed_pod, result,
+                               err, cycle) -> None:
+        fwk.run_reserve_plugins_unreserve(state, assumed_pod,
+                                          result.suggested_host)
+        gang = fwk.get_plugin("Coscheduling")
+        if gang is not None:
+            gang.unreserve_group(assumed_pod)
+        self._forget_and_fail(fwk, state, qpi, assumed_pod, result, err, cycle)
+
+    def _record_failure(self, fwk: Framework, qpi: QueuedPodInfo,
+                        err: Exception, reason: str, nominated_node: str,
+                        cycle: int) -> None:
+        """recordSchedulingFailure (scheduler.go:319) +
+        MakeDefaultErrorFunc (factory.go:316-362)."""
+        pod = qpi.pod
+        self.client.patch_pod_condition(
+            pod.namespace, pod.name,
+            PodCondition("PodScheduled", "False", reason, str(err)),
+        )
+        if nominated_node:
+            self.client.set_nominated_node_name(pod.namespace, pod.name,
+                                                nominated_node)
+            pod.status.nominated_node_name = nominated_node
+            self.queue.add_nominated_pod(pod, nominated_node)
+        # requeue only pods that still exist unassigned (factory.go:340)
+        current = self.client.get_pod(pod.namespace, pod.name)
+        if current is not None and not assigned(current):
+            try:
+                self.queue.add_unschedulable_if_not_present(qpi, cycle)
+            except ValueError:
+                pass

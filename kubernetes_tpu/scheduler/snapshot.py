@@ -1,0 +1,81 @@
+"""Immutable per-cycle cluster view (reference
+``internal/cache/snapshot.go:28-41``): node-info map, zone-interleaved node
+list, and affinity-specialized sublists, implementing the SharedLister
+surface plugins read (``framework/listers.go``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.scheduler.types import ImageStateSummary, NodeInfo
+
+
+class Snapshot:
+    def __init__(self):
+        self.node_info_map: Dict[str, NodeInfo] = {}
+        self.node_info_list: List[NodeInfo] = []
+        self.have_pods_with_affinity_node_info_list: List[NodeInfo] = []
+        self.have_pods_with_required_anti_affinity_node_info_list: List[NodeInfo] = []
+        self.generation: int = 0
+
+    # --- SharedLister / NodeInfoLister surface ------------------------
+    def list(self) -> List[NodeInfo]:
+        return self.node_info_list
+
+    def have_pods_with_affinity_list(self) -> List[NodeInfo]:
+        return self.have_pods_with_affinity_node_info_list
+
+    def have_pods_with_required_anti_affinity_list(self) -> List[NodeInfo]:
+        return self.have_pods_with_required_anti_affinity_node_info_list
+
+    def get(self, node_name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(node_name)
+
+    def num_nodes(self) -> int:
+        return len(self.node_info_list)
+
+    # --- pods view (reference snapshot podLister) ---------------------
+    def pods(self) -> List[Pod]:
+        return [pi.pod for ni in self.node_info_list for pi in ni.pods]
+
+
+def new_snapshot(pods: Iterable[Pod], nodes: Iterable[Node]) -> Snapshot:
+    """Test/algorithm constructor (reference snapshot.go:51 NewSnapshot):
+    builds a coherent snapshot directly from object lists, including
+    cluster-wide image states."""
+    s = Snapshot()
+    by_name: Dict[str, NodeInfo] = {}
+    for node in nodes:
+        ni = NodeInfo()
+        ni.set_node(node)
+        by_name[node.name] = ni
+    for pod in pods:
+        if pod.spec.node_name and pod.spec.node_name in by_name:
+            by_name[pod.spec.node_name].add_pod(pod)
+
+    # image states: size + how many nodes hold each image
+    image_nodes: Dict[str, set] = {}
+    image_size: Dict[str, int] = {}
+    for node in nodes:
+        for img in node.status.images:
+            for name in img.names:
+                image_nodes.setdefault(name, set()).add(node.name)
+                image_size[name] = img.size_bytes
+    for node in nodes:
+        ni = by_name[node.name]
+        for img in node.status.images:
+            for name in img.names:
+                ni.image_states[name] = ImageStateSummary(
+                    size=image_size[name], num_nodes=len(image_nodes[name])
+                )
+
+    s.node_info_map = by_name
+    s.node_info_list = list(by_name.values())
+    s.have_pods_with_affinity_node_info_list = [
+        ni for ni in s.node_info_list if ni.pods_with_affinity
+    ]
+    s.have_pods_with_required_anti_affinity_node_info_list = [
+        ni for ni in s.node_info_list if ni.pods_with_required_anti_affinity
+    ]
+    return s

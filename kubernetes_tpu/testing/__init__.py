@@ -1,0 +1,1 @@
+from kubernetes_tpu.testing.wrappers import MakeNode, MakePod, NodeWrapper, PodWrapper

@@ -1,0 +1,57 @@
+"""Chunked parallel fan-out (reference
+``internal/parallelize/parallelism.go:27,44-58``): 16 workers by default,
+chunk size ``max(1, min(sqrt(n), n/parallelism+1))``.
+
+On the host path this exists for capability parity and for IO-bound work
+(extender calls); the compute-bound per-node loops the reference fans out
+with this are replaced wholesale by the device batch path
+(``kubernetes_tpu.ops``), which is the point of the TPU build.
+"""
+
+from __future__ import annotations
+
+import math
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+DEFAULT_PARALLELISM = 16
+
+
+class Parallelizer:
+    def __init__(self, parallelism: int = DEFAULT_PARALLELISM):
+        self.parallelism = max(1, parallelism)
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=self.parallelism)
+        return self._pool
+
+    def chunk_size(self, n: int) -> int:
+        return max(1, min(int(math.sqrt(n)), n // self.parallelism + 1))
+
+    def until(self, n: int, fn: Callable[[int], None],
+              stop_check: Optional[Callable[[], bool]] = None) -> None:
+        """Run fn(i) for i in [0, n). Honors an optional early-cancel
+        predicate between chunks (the reference cancels via ctx when enough
+        feasible nodes are found)."""
+        if n <= 0:
+            return
+        if self.parallelism == 1 or n == 1:
+            for i in range(n):
+                if stop_check is not None and stop_check():
+                    return
+                fn(i)
+            return
+        chunk = self.chunk_size(n)
+        pool = self._ensure_pool()
+
+        def run_chunk(start: int) -> None:
+            for i in range(start, min(start + chunk, n)):
+                if stop_check is not None and stop_check():
+                    return
+                fn(i)
+
+        futures = [pool.submit(run_chunk, s) for s in range(0, n, chunk)]
+        for f in futures:
+            f.result()

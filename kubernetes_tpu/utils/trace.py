@@ -1,0 +1,41 @@
+"""Step-span tracing (re-implementation of the vendored
+``k8s.io/utils/trace`` used at ``generic_scheduler.go:98-104``): spans with
+steps, logged only when total duration exceeds a threshold."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.monotonic()
+        self.steps: List[Tuple[float, str]] = []
+        self._logged = False
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.monotonic(), msg))
+
+    def log_if_long(self, threshold: float) -> None:
+        total = time.monotonic() - self.start
+        if total < threshold:
+            return
+        self._logged = True
+        parts = [f'"{self.name}" {self.fields} total={total * 1000:.1f}ms']
+        prev = self.start
+        for ts, msg in self.steps:
+            parts.append(f"  step {msg}: +{(ts - prev) * 1000:.1f}ms")
+            prev = ts
+        logger.info("\n".join(parts))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

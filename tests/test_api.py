@@ -1,0 +1,175 @@
+"""API machinery tests: Quantity parsing and label-selector matching."""
+
+import pytest
+
+from kubernetes_tpu.api.labels import (
+    LabelSelector,
+    Requirement,
+    Selector,
+    parse_selector,
+    selector_from_label_selector,
+)
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+from kubernetes_tpu.api.types import Pod, Taint, Toleration
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,milli",
+        [
+            ("100m", 100),
+            ("1", 1000),
+            ("1.5", 1500),
+            ("0.1", 100),
+            (".5", 500),
+            ("2", 2000),
+            ("0", 0),
+        ],
+    )
+    def test_milli_value(self, s, milli):
+        assert parse_quantity(s).milli_value() == milli
+
+    @pytest.mark.parametrize(
+        "s,value",
+        [
+            ("128Mi", 128 * 2**20),
+            ("1Gi", 2**30),
+            ("1G", 10**9),
+            ("500k", 500_000),
+            ("1e3", 1000),
+            ("1.5Ki", 1536),
+            ("64", 64),
+        ],
+    )
+    def test_value(self, s, value):
+        assert parse_quantity(s).value() == value
+
+    def test_value_rounds_up(self):
+        # 100m of a countable resource is 1 unit (reference Value() ceils)
+        assert parse_quantity("100m").value() == 1
+        assert parse_quantity("1m").milli_value() == 1
+
+    def test_arithmetic_and_ordering(self):
+        a, b = parse_quantity("1"), parse_quantity("500m")
+        assert (a + b).milli_value() == 1500
+        assert (a - b).milli_value() == 500
+        assert b < a
+        assert parse_quantity("1Gi") == Quantity.from_value(2**30)
+
+    @pytest.mark.parametrize("bad", ["", "abc", "1x", "--1", "1.2.3", "Mi"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+    def test_int_float_passthrough(self):
+        assert parse_quantity(4).value() == 4
+        assert parse_quantity(0.25).milli_value() == 250
+
+
+class TestSelectors:
+    def test_from_map(self):
+        s = Selector.from_map({"app": "web"})
+        assert s.matches({"app": "web", "tier": "fe"})
+        assert not s.matches({"app": "db"})
+        assert not s.matches({})
+
+    def test_empty_matches_everything_nil_matches_nothing(self):
+        assert Selector.everything().matches({"a": "b"})
+        assert Selector.everything().matches({})
+        assert not Selector.nothing().matches({})
+        assert selector_from_label_selector(None).matches({}) is False
+        # empty LabelSelector matches everything (reference semantics)
+        assert selector_from_label_selector(LabelSelector()).matches({})
+
+    @pytest.mark.parametrize(
+        "op,values,labels,want",
+        [
+            ("In", ("a", "b"), {"k": "a"}, True),
+            ("In", ("a", "b"), {"k": "c"}, False),
+            ("In", ("a",), {}, False),
+            ("NotIn", ("a",), {"k": "b"}, True),
+            ("NotIn", ("a",), {"k": "a"}, False),
+            ("NotIn", ("a",), {}, False),  # key absent -> NotIn fails (k8s semantics)
+            ("Exists", (), {"k": "x"}, True),
+            ("Exists", (), {}, False),
+            ("DoesNotExist", (), {}, True),
+            ("DoesNotExist", (), {"k": "x"}, False),
+            ("Gt", ("5",), {"k": "7"}, True),
+            ("Gt", ("5",), {"k": "3"}, False),
+            ("Lt", ("5",), {"k": "3"}, True),
+            ("Gt", ("5",), {"k": "abc"}, False),
+        ],
+    )
+    def test_requirement_ops(self, op, values, labels, want):
+        assert Requirement("k", op, values).matches(labels) is want
+
+    def test_parse_selector(self):
+        s = parse_selector("app=web, tier in (fe, be), !legacy, env!=dev")
+        assert s.matches({"app": "web", "tier": "fe", "env": "prod"})
+        assert not s.matches({"app": "web", "tier": "fe", "legacy": "1", "env": "prod"})
+        assert not s.matches({"app": "web", "tier": "mid", "env": "prod"})
+        assert not s.matches({"app": "web", "tier": "fe", "env": "dev"})
+
+
+class TestTolerations:
+    def test_tolerates(self):
+        taint = Taint("gpu", "true", "NoSchedule")
+        assert Toleration(key="gpu", operator="Equal", value="true").tolerates(taint)
+        assert Toleration(key="gpu", operator="Exists").tolerates(taint)
+        assert Toleration(operator="Exists").tolerates(taint)  # empty key matches all
+        assert not Toleration(key="gpu", operator="Equal", value="false").tolerates(taint)
+        assert not Toleration(
+            key="gpu", operator="Equal", value="true", effect="NoExecute"
+        ).tolerates(taint)
+
+
+class TestWrappersAndFromDict:
+    def test_pod_wrapper(self):
+        p = (
+            MakePod()
+            .name("p1")
+            .namespace("ns")
+            .label("app", "web")
+            .req({"cpu": "500m", "memory": "1Gi"})
+            .priority(10)
+            .obj()
+        )
+        assert p.full_name() == "ns/p1"
+        assert p.priority() == 10
+        assert p.spec.containers[0].resources.requests["cpu"].milli_value() == 500
+
+    def test_node_wrapper(self):
+        n = MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj()
+        assert n.status.allocatable["cpu"].milli_value() == 4000
+        assert n.metadata.labels["kubernetes.io/hostname"] == "n1"
+
+    def test_pod_from_dict(self):
+        p = Pod.from_dict(
+            {
+                "metadata": {"name": "x", "labels": {"a": "b"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {"requests": {"cpu": "250m", "memory": "64Mi"}},
+                            "ports": [{"containerPort": 80, "hostPort": 8080}],
+                        }
+                    ],
+                    "nodeSelector": {"disk": "ssd"},
+                    "priority": 5,
+                    "tolerations": [{"key": "k", "operator": "Exists"}],
+                    "topologySpreadConstraints": [
+                        {
+                            "maxSkew": 2,
+                            "topologyKey": "zone",
+                            "whenUnsatisfiable": "DoNotSchedule",
+                            "labelSelector": {"matchLabels": {"a": "b"}},
+                        }
+                    ],
+                },
+            }
+        )
+        assert p.spec.containers[0].ports[0].host_port == 8080
+        assert p.spec.topology_spread_constraints[0].max_skew == 2
+        assert p.priority() == 5

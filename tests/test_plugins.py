@@ -1,0 +1,325 @@
+"""Plugin semantics tests (modeled on the reference's per-plugin table
+tests in ``pkg/scheduler/framework/plugins/*_test.go``)."""
+
+import pytest
+
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework import interface as fw
+from kubernetes_tpu.scheduler.framework.plugins import (
+    interpod_affinity as ipa,
+    node_affinity as na,
+    node_name as nn,
+    node_ports as np_,
+    node_resources as nr,
+    node_unschedulable as nu,
+    pod_topology_spread as pts,
+    taint_toleration as tt,
+)
+from kubernetes_tpu.scheduler.snapshot import new_snapshot
+from kubernetes_tpu.scheduler.types import NodeInfo
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+class FakeHandle:
+    """Minimal handle: snapshot + client listers (reference fake listers)."""
+
+    def __init__(self, snapshot=None, client=None):
+        self._snapshot = snapshot
+        self.client = client
+        self.pod_nominator = None
+
+    def snapshot(self):
+        return self._snapshot
+
+
+def node_info_for(node, *pods):
+    ni = NodeInfo()
+    ni.set_node(node)
+    for p in pods:
+        ni.add_pod(p)
+    return ni
+
+
+class TestNodeResourcesFit:
+    def run_filter(self, pod, node_info):
+        plugin = nr.Fit()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        return plugin.filter(state, pod, node_info)
+
+    def test_fits(self):
+        node = MakeNode().name("n").capacity({"cpu": "4", "memory": "8Gi"}).obj()
+        pod = MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj()
+        assert self.run_filter(pod, node_info_for(node)) is None
+
+    def test_insufficient_cpu(self):
+        node = MakeNode().name("n").capacity({"cpu": "1", "memory": "8Gi"}).obj()
+        existing = MakePod().name("e").req({"cpu": "800m"}).node("n").obj()
+        pod = MakePod().name("p").req({"cpu": "500m"}).obj()
+        status = self.run_filter(pod, node_info_for(node, existing))
+        assert status.code == fw.UNSCHEDULABLE
+        assert "Insufficient cpu" in status.reasons
+
+    def test_init_containers_max(self):
+        node = MakeNode().name("n").capacity({"cpu": "2", "memory": "8Gi"}).obj()
+        # init wants 1.5 CPU (max, not sum, with app containers)
+        pod = (
+            MakePod().name("p")
+            .req({"cpu": "1"})
+            .init_req({"cpu": "1500m"})
+            .obj()
+        )
+        assert self.run_filter(pod, node_info_for(node)) is None
+        smaller = MakeNode().name("n2").capacity({"cpu": "1", "memory": "8Gi"}).obj()
+        status = self.run_filter(pod, node_info_for(smaller))
+        assert "Insufficient cpu" in status.reasons
+
+    def test_overhead_counts(self):
+        node = MakeNode().name("n").capacity({"cpu": "1", "memory": "8Gi"}).obj()
+        pod = MakePod().name("p").req({"cpu": "800m"}).overhead({"cpu": "300m"}).obj()
+        status = self.run_filter(pod, node_info_for(node))
+        assert "Insufficient cpu" in status.reasons
+
+    def test_too_many_pods(self):
+        node = MakeNode().name("n").capacity({"cpu": "4", "pods": "1"}).obj()
+        existing = MakePod().name("e").node("n").obj()
+        pod = MakePod().name("p").obj()
+        status = self.run_filter(pod, node_info_for(node, existing))
+        assert "Too many pods" in status.reasons
+
+    def test_scalar_resources(self):
+        node = MakeNode().name("n").capacity(
+            {"cpu": "4", "memory": "8Gi", "example.com/gpu": "2"}
+        ).obj()
+        pod = MakePod().name("p").req({"example.com/gpu": "4"}).obj()
+        status = self.run_filter(pod, node_info_for(node))
+        assert "Insufficient example.com/gpu" in status.reasons
+
+
+class TestBalancedAllocation:
+    def test_perfectly_balanced(self):
+        node = MakeNode().name("n").capacity({"cpu": "4", "memory": "4Gi"}).obj()
+        snap = new_snapshot([], [node])
+        plugin = nr.BalancedAllocation(FakeHandle(snap))
+        # request 50% of cpu and 50% of memory -> perfectly balanced
+        pod = MakePod().name("p").req({"cpu": "2", "memory": "2Gi"}).obj()
+        score, status = plugin.score(CycleState(), pod, "n")
+        assert status is None
+        assert score == fw.MAX_NODE_SCORE
+
+    def test_imbalance_scores_lower(self):
+        node = MakeNode().name("n").capacity({"cpu": "4", "memory": "4Gi"}).obj()
+        snap = new_snapshot([], [node])
+        plugin = nr.BalancedAllocation(FakeHandle(snap))
+        pod = MakePod().name("p").req({"cpu": "3", "memory": "1Gi"}).obj()
+        score, _ = plugin.score(CycleState(), pod, "n")
+        assert score < fw.MAX_NODE_SCORE
+
+
+class TestLeastMostAllocated:
+    def make(self, cls):
+        node = MakeNode().name("n").capacity({"cpu": "4", "memory": "4Gi"}).obj()
+        snap = new_snapshot(
+            [MakePod().name("e").req({"cpu": "2", "memory": "2Gi"}).node("n").obj()],
+            [node],
+        )
+        return cls(FakeHandle(snap))
+
+    def test_least(self):
+        plugin = self.make(nr.LeastAllocated)
+        pod = MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj()
+        score, _ = plugin.score(CycleState(), pod, "n")
+        assert score == 25  # 1/4 free on both dimensions
+
+    def test_most(self):
+        plugin = self.make(nr.MostAllocated)
+        pod = MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj()
+        score, _ = plugin.score(CycleState(), pod, "n")
+        assert score == 75
+
+
+class TestSimpleFilters:
+    def test_node_name(self):
+        plugin = nn.NodeName()
+        ni = node_info_for(MakeNode().name("a").obj())
+        assert plugin.filter(CycleState(), MakePod().name("p").node("a").obj(), ni) is None
+        status = plugin.filter(CycleState(), MakePod().name("p").node("b").obj(), ni)
+        assert status.code == fw.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_node_ports_conflict(self):
+        plugin = np_.NodePorts()
+        existing = MakePod().name("e").host_port(8080).node("n").obj()
+        ni = node_info_for(MakeNode().name("n").obj(), existing)
+        pod = MakePod().name("p").host_port(8080).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, ni).code == fw.UNSCHEDULABLE
+        other = MakePod().name("q").host_port(8081).obj()
+        plugin.pre_filter(state, other)
+        assert plugin.filter(state, other, ni) is None
+
+    def test_node_unschedulable(self):
+        plugin = nu.NodeUnschedulable()
+        ni = node_info_for(MakeNode().name("n").unschedulable().obj())
+        pod = MakePod().name("p").obj()
+        assert plugin.filter(CycleState(), pod, ni).code == fw.UNSCHEDULABLE_AND_UNRESOLVABLE
+        tolerant = (
+            MakePod().name("t")
+            .toleration("node.kubernetes.io/unschedulable", operator="Exists")
+            .obj()
+        )
+        assert plugin.filter(CycleState(), tolerant, ni) is None
+
+    def test_taint_toleration_filter(self):
+        plugin = tt.TaintToleration()
+        ni = node_info_for(MakeNode().name("n").taint("gpu", "true").obj())
+        pod = MakePod().name("p").obj()
+        status = plugin.filter(CycleState(), pod, ni)
+        assert status.code == fw.UNSCHEDULABLE_AND_UNRESOLVABLE
+        ok = MakePod().name("q").toleration("gpu", "true", "NoSchedule").obj()
+        assert plugin.filter(CycleState(), ok, ni) is None
+
+    def test_node_affinity(self):
+        plugin = na.NodeAffinity()
+        ni = node_info_for(MakeNode().name("n").label("disk", "ssd").obj())
+        pod = MakePod().name("p").node_selector({"disk": "ssd"}).obj()
+        assert plugin.filter(CycleState(), pod, ni) is None
+        bad = MakePod().name("q").node_selector({"disk": "hdd"}).obj()
+        assert plugin.filter(CycleState(), bad, ni).code == fw.UNSCHEDULABLE
+        aff = MakePod().name("r").node_affinity_in("disk", ["ssd", "nvme"]).obj()
+        assert plugin.filter(CycleState(), aff, ni) is None
+
+
+class TestPodTopologySpread:
+    def _spread_state(self, pods, nodes, pod):
+        snap = new_snapshot(pods, nodes)
+        plugin = pts.PodTopologySpread(FakeHandle(snap))
+        state = CycleState()
+        assert plugin.pre_filter(state, pod) is None
+        return plugin, state, snap
+
+    def test_max_skew_enforced(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        pods = [
+            MakePod().name("e1").label("app", "web").node("a1").obj(),
+            MakePod().name("e2").label("app", "web").node("a1").obj(),
+        ]
+        pod = (
+            MakePod().name("p").label("app", "web")
+            .spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})
+            .obj()
+        )
+        plugin, state, snap = self._spread_state(pods, nodes, pod)
+        # zone za has 2, zb has 0: adding to za -> skew 3 > 1
+        assert plugin.filter(state, pod, snap.get("a1")).code == fw.UNSCHEDULABLE
+        # adding to zb -> skew 1-0=1 <= 1 OK
+        assert plugin.filter(state, pod, snap.get("b1")) is None
+
+    def test_missing_topology_label(self):
+        nodes = [MakeNode().name("a1").label("zone", "za").obj(),
+                 MakeNode().name("x").obj()]
+        pod = (
+            MakePod().name("p").label("app", "web")
+            .spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})
+            .obj()
+        )
+        plugin, state, snap = self._spread_state([], nodes, pod)
+        status = plugin.filter(state, pod, snap.get("x"))
+        assert status.code == fw.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_add_remove_pod_extension(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        pod = (
+            MakePod().name("p").label("app", "web")
+            .spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})
+            .obj()
+        )
+        plugin, state, snap = self._spread_state([], nodes, pod)
+        ext = plugin.pre_filter_extensions()
+        incoming = MakePod().name("v").label("app", "web").node("a1").obj()
+        ext.add_pod(state, pod, incoming, snap.get("a1"))
+        ext.add_pod(state, pod, incoming, snap.get("a1"))
+        status = plugin.filter(state, pod, snap.get("a1"))
+        assert status is not None and status.code == fw.UNSCHEDULABLE
+        ext.remove_pod(state, pod, incoming, snap.get("a1"))
+        ext.remove_pod(state, pod, incoming, snap.get("a1"))
+        assert plugin.filter(state, pod, snap.get("a1")) is None
+
+
+class TestInterPodAffinity:
+    def _setup(self, pods, nodes, pod):
+        snap = new_snapshot(pods, nodes)
+        plugin = ipa.InterPodAffinity(FakeHandle(snap))
+        state = CycleState()
+        assert plugin.pre_filter(state, pod) is None
+        return plugin, state, snap
+
+    def test_required_affinity(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        pods = [MakePod().name("e").label("app", "db").node("a1").obj()]
+        pod = MakePod().name("p").pod_affinity("app", ["db"], "zone").obj()
+        plugin, state, snap = self._setup(pods, nodes, pod)
+        assert plugin.filter(state, pod, snap.get("a1")) is None
+        assert plugin.filter(state, pod, snap.get("b1")).code == fw.UNSCHEDULABLE
+
+    def test_first_pod_of_group_allowed(self):
+        nodes = [MakeNode().name("a1").label("zone", "za").obj()]
+        pod = (
+            MakePod().name("p").label("app", "web")
+            .pod_affinity("app", ["web"], "zone").obj()
+        )
+        plugin, state, snap = self._setup([], nodes, pod)
+        assert plugin.filter(state, pod, snap.get("a1")) is None
+
+    def test_anti_affinity(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        pods = [MakePod().name("e").label("app", "web").node("a1").obj()]
+        pod = MakePod().name("p").pod_anti_affinity("app", ["web"], "zone").obj()
+        plugin, state, snap = self._setup(pods, nodes, pod)
+        assert plugin.filter(state, pod, snap.get("a1")).code == fw.UNSCHEDULABLE
+        assert plugin.filter(state, pod, snap.get("b1")) is None
+
+    def test_existing_pods_anti_affinity(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        # existing pod repels app=web within its zone
+        pods = [
+            MakePod().name("e").label("app", "db").node("a1")
+            .pod_anti_affinity("app", ["web"], "zone").obj()
+        ]
+        pod = MakePod().name("p").label("app", "web").obj()
+        plugin, state, snap = self._setup(pods, nodes, pod)
+        assert plugin.filter(state, pod, snap.get("a1")).code == fw.UNSCHEDULABLE
+        assert plugin.filter(state, pod, snap.get("b1")) is None
+
+    def test_preferred_scoring(self):
+        nodes = [
+            MakeNode().name("a1").label("zone", "za").obj(),
+            MakeNode().name("b1").label("zone", "zb").obj(),
+        ]
+        pods = [MakePod().name("e").label("app", "db").node("a1").obj()]
+        pod = (
+            MakePod().name("p")
+            .preferred_pod_affinity(10, "app", ["db"], "zone").obj()
+        )
+        snap = new_snapshot(pods, nodes)
+        plugin = ipa.InterPodAffinity(FakeHandle(snap))
+        state = CycleState()
+        plugin.pre_score(state, pod, snap.list())
+        sa, _ = plugin.score(state, pod, "a1")
+        sb, _ = plugin.score(state, pod, "b1")
+        assert sa > sb

@@ -1,0 +1,159 @@
+"""Scheduling-queue tests with a fake clock (reference
+``scheduling_queue_test.go`` patterns: priority ordering, backoff movement,
+moveRequestCycle race rule, affinity-triggered wakeups)."""
+
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.scheduler.types import QueuedPodInfo
+from kubernetes_tpu.testing import MakePod
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def qpod(name, priority=0, uid=None):
+    return MakePod().name(name).uid(uid or f"uid-{name}").priority(priority).obj()
+
+
+class TestPriorityOrdering:
+    def test_pop_highest_priority_first(self):
+        q = SchedulingQueue(clock=FakeClock())
+        q.add(qpod("low", 1))
+        q.add(qpod("high", 10))
+        q.add(qpod("mid", 5))
+        assert q.pop().pod.name == "high"
+        assert q.pop().pod.name == "mid"
+        assert q.pop().pod.name == "low"
+
+    def test_fifo_tiebreak(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.add(qpod("first", 5))
+        clock.step(1)
+        q.add(qpod("second", 5))
+        assert q.pop().pod.name == "first"
+
+    def test_pop_increments_cycle_and_attempts(self):
+        q = SchedulingQueue(clock=FakeClock())
+        q.add(qpod("p"))
+        qpi = q.pop()
+        assert qpi.attempts == 1
+        assert q.scheduling_cycle == 1
+
+
+class TestUnschedulableAndBackoff:
+    def test_unschedulable_then_move_event(self):
+        clock = FakeClock(start=1000.0)
+        q = SchedulingQueue(clock=clock)
+        q.add(qpod("p"))
+        qpi = q.pop()
+        q.add_unschedulable_if_not_present(qpi, q.scheduling_cycle)
+        assert q.num_unschedulable() == 1
+        assert q.pop(timeout=0.01) is None
+
+        clock.step(100)  # backoff long since complete
+        q.move_all_to_active_or_backoff_queue("NodeAdd")
+        assert q.num_unschedulable() == 0
+        assert q.pop().pod.name == "p"
+
+    def test_move_goes_to_backoff_when_backoff_incomplete(self):
+        clock = FakeClock(start=1000.0)
+        q = SchedulingQueue(clock=clock)
+        q.add(qpod("p"))
+        qpi = q.pop()
+        q.add_unschedulable_if_not_present(qpi, q.scheduling_cycle)
+        q.move_all_to_active_or_backoff_queue("NodeAdd")
+        assert q.num_backoff() == 1  # 1 attempt -> 1s backoff, not yet elapsed
+        clock.step(2.0)
+        q.flush_backoff_completed()
+        assert q.num_active() == 1
+
+    def test_move_request_cycle_race(self):
+        """A move event during this pod's scheduling cycle means the failed
+        pod must go to backoff, not unschedulable (scheduling_queue.go:317)."""
+        clock = FakeClock(start=1000.0)
+        q = SchedulingQueue(clock=clock)
+        q.add(qpod("p"))
+        qpi = q.pop()
+        cycle = q.scheduling_cycle
+        q.move_all_to_active_or_backoff_queue("NodeAdd")  # concurrent event
+        q.add_unschedulable_if_not_present(qpi, cycle)
+        assert q.num_unschedulable() == 0
+        assert q.num_backoff() == 1
+
+    def test_backoff_duration_doubles_and_caps(self):
+        clock = FakeClock(start=0.0)
+        q = SchedulingQueue(clock=clock)
+        qpi = QueuedPodInfo(qpod("p"), timestamp=0.0)
+        qpi.attempts = 1
+        assert q._backoff_duration(qpi) == 1.0
+        qpi.attempts = 3
+        assert q._backoff_duration(qpi) == 4.0
+        qpi.attempts = 10
+        assert q._backoff_duration(qpi) == 10.0  # capped
+
+    def test_flush_unschedulable_left_over(self):
+        clock = FakeClock(start=0.0)
+        q = SchedulingQueue(clock=clock)
+        q.add(qpod("p"))
+        qpi = q.pop()
+        q.add_unschedulable_if_not_present(qpi, q.scheduling_cycle)
+        clock.step(30)
+        q.flush_unschedulable_left_over()
+        assert q.num_unschedulable() == 1  # < 60s old
+        clock.step(31)
+        q.flush_unschedulable_left_over()
+        assert q.num_unschedulable() == 0
+
+
+class TestAffinityWakeup:
+    def test_assigned_pod_added_moves_matching(self):
+        clock = FakeClock(start=1000.0)
+        q = SchedulingQueue(clock=clock)
+        waiting = (
+            MakePod().name("w").uid("uw")
+            .pod_affinity("app", ["web"], "zone").obj()
+        )
+        other = MakePod().name("o").uid("uo").obj()
+        for p in (waiting, other):
+            q.add(p)
+            qpi = q.pop()
+            q.add_unschedulable_if_not_present(qpi, q.scheduling_cycle)
+        assert q.num_unschedulable() == 2
+
+        clock.step(100)
+        assigned = MakePod().name("a").uid("ua").label("app", "web").node("n1").obj()
+        q.assigned_pod_added(assigned)
+        assert q.num_unschedulable() == 1  # only the affinity-matching pod moved
+        assert q.pop().pod.name == "w"
+
+
+class TestNominator:
+    def test_nominate_and_delete(self):
+        q = SchedulingQueue(clock=FakeClock())
+        pod = qpod("p")
+        q.add_nominated_pod(pod, "n1")
+        assert [pi.pod.name for pi in q.nominated_pods_for_node("n1")] == ["p"]
+        q.delete_nominated_pod_if_exists(pod)
+        assert q.nominated_pods_for_node("n1") == []
+
+    def test_update_preserves_nomination(self):
+        q = SchedulingQueue(clock=FakeClock())
+        pod = qpod("p")
+        q.add_nominated_pod(pod, "n1")
+        newer = qpod("p")
+        newer.metadata.uid = pod.metadata.uid
+        q.update_nominated_pod(pod, newer)
+        assert [pi.pod.name for pi in q.nominated_pods_for_node("n1")] == ["p"]
+
+
+class TestDeleteAndUpdate:
+    def test_delete_everywhere(self):
+        q = SchedulingQueue(clock=FakeClock())
+        p = qpod("p")
+        q.add(p)
+        q.delete(p)
+        assert q.pop(timeout=0.01) is None
+
+    def test_update_unknown_adds(self):
+        q = SchedulingQueue(clock=FakeClock())
+        p = qpod("p")
+        q.update(None, p)
+        assert q.pop().pod.name == "p"

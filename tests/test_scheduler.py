@@ -1,0 +1,271 @@
+"""End-to-end scheduler tests (the "integration ring" of the reference:
+in-process state server + real scheduler, no kubelets — SURVEY.md section 4
+carry-over (b))."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.types import (
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    PluginEntry,
+    Plugins,
+    PluginSet,
+)
+from kubernetes_tpu.scheduler.framework import interface as fw
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def make_scheduler(store, config=None, **kwargs):
+    sched = Scheduler.create(store, config=config, **kwargs)
+    sched.start()
+    return sched
+
+
+def drain(sched, timeout=10.0):
+    """Run scheduling cycles until active+backoff queues are empty (flushing
+    backoff as the wall clock allows), then wait for in-flight bindings."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sched.queue.flush_backoff_completed()
+        if sched.schedule_one(pop_timeout=0.0):
+            continue
+        if sched.queue.num_active() == 0 and sched.queue.num_backoff() == 0:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("scheduler did not drain in time")
+    assert sched.wait_for_inflight_bindings()
+
+
+class TestBasicScheduling:
+    def test_single_pod_binds(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        sched = make_scheduler(store)
+        store.create_pod(MakePod().name("p1").req({"cpu": "1"}).obj())
+        drain(sched)
+        assert store.get_pod("default", "p1").spec.node_name == "n1"
+        sched.stop()
+
+    def test_spreads_over_nodes_least_allocated(self):
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"}).obj()
+            )
+        sched = make_scheduler(store)
+        for i in range(8):
+            store.create_pod(MakePod().name(f"p{i}").req({"cpu": "1"}).obj())
+        drain(sched)
+        placement = {}
+        for i in range(8):
+            node = store.get_pod("default", f"p{i}").spec.node_name
+            placement[node] = placement.get(node, 0) + 1
+        # LeastAllocated + BalancedAllocation spread 8 pods over 4 nodes
+        assert all(count == 2 for count in placement.values()), placement
+        sched.stop()
+
+    def test_unschedulable_pod_stays_pending(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "1", "memory": "1Gi"}).obj())
+        sched = make_scheduler(store)
+        store.create_pod(MakePod().name("big").req({"cpu": "8"}).obj())
+        drain(sched)
+        pod = store.get_pod("default", "big")
+        assert pod.spec.node_name == ""
+        conds = {c.type: c for c in pod.status.conditions}
+        assert conds["PodScheduled"].status == "False"
+        assert "Insufficient cpu" in conds["PodScheduled"].message
+        assert sched.queue.num_unschedulable() == 1
+        sched.stop()
+
+    def test_node_add_wakes_unschedulable_pod(self):
+        store = ClusterStore()
+        sched = make_scheduler(store)
+        store.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+        drain(sched)
+        assert sched.queue.num_unschedulable() == 1
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        # move event sends it to backoff (1s); wait out the backoff
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            sched.queue.flush_backoff_completed()
+            if sched.schedule_one(pop_timeout=0.05):
+                break
+        assert sched.wait_for_inflight_bindings()
+        assert store.get_pod("default", "p").spec.node_name == "n1"
+        sched.stop()
+
+    def test_affinity_workload(self):
+        store = ClusterStore()
+        for zone, names in (("za", ["a1", "a2"]), ("zb", ["b1", "b2"])):
+            for n in names:
+                store.add_node(
+                    MakeNode().name(n)
+                    .label("topology.kubernetes.io/zone", zone)
+                    .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+                )
+        sched = make_scheduler(store)
+        store.create_pod(
+            MakePod().name("db").label("app", "db").req({"cpu": "1"}).obj()
+        )
+        drain(sched)
+        db_node = store.get_pod("default", "db").spec.node_name
+        db_zone = store.get_node(db_node).metadata.labels["topology.kubernetes.io/zone"]
+
+        store.create_pod(
+            MakePod().name("web").req({"cpu": "1"})
+            .pod_affinity("app", ["db"], "topology.kubernetes.io/zone").obj()
+        )
+        drain(sched)
+        web_node = store.get_pod("default", "web").spec.node_name
+        web_zone = store.get_node(web_node).metadata.labels["topology.kubernetes.io/zone"]
+        assert web_zone == db_zone
+        sched.stop()
+
+    def test_anti_affinity_excludes_node(self):
+        store = ClusterStore()
+        for n in ("n1", "n2"):
+            store.add_node(
+                MakeNode().name(n).capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched = make_scheduler(store)
+        store.create_pod(
+            MakePod().name("a").label("app", "x").req({"cpu": "1"}).obj()
+        )
+        drain(sched)
+        first = store.get_pod("default", "a").spec.node_name
+        store.create_pod(
+            MakePod().name("b").label("app", "x").req({"cpu": "1"})
+            .pod_anti_affinity("app", ["x"], "kubernetes.io/hostname").obj()
+        )
+        drain(sched)
+        second = store.get_pod("default", "b").spec.node_name
+        assert second != first
+        sched.stop()
+
+    def test_topology_spread_workload(self):
+        store = ClusterStore()
+        for zone in ("za", "zb", "zc"):
+            store.add_node(
+                MakeNode().name(f"{zone}-n")
+                .label("topology.kubernetes.io/zone", zone)
+                .capacity({"cpu": "16", "memory": "32Gi"}).obj()
+            )
+        sched = make_scheduler(store)
+        for i in range(6):
+            store.create_pod(
+                MakePod().name(f"p{i}").label("app", "spread").req({"cpu": "1"})
+                .spread_constraint(
+                    1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "spread"},
+                ).obj()
+            )
+            drain(sched)  # schedule one-by-one so counts are visible
+        zones = {}
+        for i in range(6):
+            node = store.get_pod("default", f"p{i}").spec.node_name
+            zone = store.get_node(node).metadata.labels["topology.kubernetes.io/zone"]
+            zones[zone] = zones.get(zone, 0) + 1
+        assert all(c == 2 for c in zones.values()), zones
+        sched.stop()
+
+
+class TestPreemption:
+    def test_higher_priority_preempts(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "2", "memory": "4Gi"}).obj())
+        sched = make_scheduler(store)
+        store.create_pod(
+            MakePod().name("victim").priority(1).req({"cpu": "2"}).obj()
+        )
+        drain(sched)
+        assert store.get_pod("default", "victim").spec.node_name == "n1"
+
+        store.create_pod(
+            MakePod().name("vip").priority(100).req({"cpu": "2"}).obj()
+        )
+        drain(sched)
+        # victim evicted, vip nominated to n1
+        assert store.get_pod("default", "victim") is None
+        vip = store.get_pod("default", "vip")
+        assert vip.status.nominated_node_name == "n1"
+        # next cycle schedules vip onto the freed node
+        drain(sched)
+        assert store.get_pod("default", "vip").spec.node_name == "n1"
+        sched.stop()
+
+    def test_preemption_policy_never(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "2", "memory": "4Gi"}).obj())
+        sched = make_scheduler(store)
+        store.create_pod(MakePod().name("victim").priority(1).req({"cpu": "2"}).obj())
+        drain(sched)
+        vip = MakePod().name("gentle").priority(100).req({"cpu": "2"}).obj()
+        vip.spec.preemption_policy = "Never"
+        store.create_pod(vip)
+        drain(sched)
+        assert store.get_pod("default", "victim") is not None
+        assert store.get_pod("default", "gentle").status.nominated_node_name == ""
+        sched.stop()
+
+
+class TestGangScheduling:
+    def _gang_pod(self, name, group, min_available):
+        return (
+            MakePod().name(name)
+            .label("pod-group.scheduling.k8s.io/name", group)
+            .label("pod-group.scheduling.k8s.io/min-available", str(min_available))
+            .req({"cpu": "1"})
+            .obj()
+        )
+
+    def test_gang_waits_then_binds_together(self):
+        store = ClusterStore()
+        for i in range(3):
+            store.add_node(
+                MakeNode().name(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"}).obj()
+            )
+        profile = KubeSchedulerProfile(
+            plugins=Plugins(permit=PluginSet(enabled=[PluginEntry("Coscheduling")])),
+        )
+        config = KubeSchedulerConfiguration(profiles=[profile])
+        sched = make_scheduler(store, config=config)
+        store.create_pod(self._gang_pod("g1", "team", 2))
+        while sched.schedule_one(pop_timeout=0.0):
+            pass
+        # first member waits at permit: not bound yet
+        assert store.get_pod("default", "g1").spec.node_name == ""
+        store.create_pod(self._gang_pod("g2", "team", 2))
+        drain(sched)
+        assert store.get_pod("default", "g1").spec.node_name != ""
+        assert store.get_pod("default", "g2").spec.node_name != ""
+        sched.stop()
+
+
+class TestMultiProfile:
+    def test_second_profile(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        config = KubeSchedulerConfiguration(
+            profiles=[
+                KubeSchedulerProfile(scheduler_name="default-scheduler"),
+                KubeSchedulerProfile(scheduler_name="custom-scheduler"),
+            ]
+        )
+        sched = make_scheduler(store, config=config)
+        store.create_pod(
+            MakePod().name("p").scheduler_name("custom-scheduler").req({"cpu": "1"}).obj()
+        )
+        store.create_pod(
+            MakePod().name("q").scheduler_name("other-scheduler").req({"cpu": "1"}).obj()
+        )
+        drain(sched)
+        assert store.get_pod("default", "p").spec.node_name == "n1"
+        # not our pod: untouched
+        assert store.get_pod("default", "q").spec.node_name == ""
+        sched.stop()
