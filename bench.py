@@ -1,0 +1,97 @@
+"""Benchmark entry point (driver-run on real TPU hardware).
+
+Measures the headline metric from BASELINE.json — pods scheduled/sec at
+5k nodes / 30k pending pods — on the TPU batch path, against the host
+serial path measured on the same cluster (the stock-scheduler stand-in;
+BASELINE.md: "absolute reference numbers must be measured, not cited").
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
+
+Options (all optional):
+    --config {1..5}   BASELINE.json config to run (default: headline 5k/30k)
+    --quick           small scale smoke (CI-sized)
+    --skip-serial     reuse the last recorded serial baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubernetes_tpu.harness import make_workload, run_workload
+
+# measured host-serial baselines (pods/s), updated by full runs
+RECORDED_SERIAL_BASELINE = {
+    "default": 25.0,   # 5k nodes, python serial path (see BASELINE.md)
+}
+
+CONFIGS = {
+    # BASELINE.json configs -> (workload, nodes, init_pods, measure_pods)
+    "1": ("SchedulingBasic", 100, 0, 1000),
+    "2": ("SchedulingBasic", 1000, 0, 10000),
+    "3": ("TopologySpreading", 5000, 0, 30000),
+    "4": ("SchedulingPodAntiAffinity", 5000, 1000, 30000),
+    "5": ("GangScheduling", 5000, 0, 30000),
+    "headline": ("SchedulingBasic", 5000, 0, 30000),
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="headline", choices=sorted(CONFIGS))
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip-serial", action="store_true")
+    ap.add_argument("--serial-pods", type=int, default=300)
+    args = ap.parse_args()
+
+    name, nodes, init_pods, measure_pods = CONFIGS[args.config]
+    if args.quick:
+        nodes, init_pods, measure_pods = 200, 0, 1000
+
+    # --- serial baseline (host path = the stock-scheduler equivalent) ---
+    if args.skip_serial:
+        serial_rate = RECORDED_SERIAL_BASELINE["default"]
+        log(f"serial baseline (recorded): {serial_rate:.1f} pods/s")
+    else:
+        serial_pods = min(args.serial_pods, measure_pods)
+        ops = make_workload(name, nodes=nodes, init_pods=0,
+                            measure_pods=serial_pods)
+        t0 = time.time()
+        serial = run_workload(f"{name}/serial", ops, use_batch=False,
+                              wait_timeout=600, progress=log)
+        serial_rate = serial.pods_per_second
+        log(f"serial baseline: {serial_rate:.1f} pods/s "
+            f"({serial_pods} pods, wall {time.time() - t0:.1f}s)")
+
+    # --- TPU batch path --------------------------------------------------
+    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                        measure_pods=measure_pods)
+    t0 = time.time()
+    batch = run_workload(f"{name}/batch", ops, use_batch=True,
+                         max_batch=measure_pods, wait_timeout=1200,
+                         progress=log)
+    log(f"batch: {batch.pods_per_second:.1f} pods/s "
+        f"(wall {time.time() - t0:.1f}s, p99 latency "
+        f"{batch.metrics.get('Perc99', 0):.0f}ms)")
+
+    result = {
+        "metric": f"pods_scheduled_per_sec[{name} {nodes}nodes/"
+                  f"{measure_pods}pods, TPU batch path]",
+        "value": round(batch.pods_per_second, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(
+            batch.pods_per_second / serial_rate, 2
+        ) if serial_rate > 0 else 0.0,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from kubernetes_tpu.harness.perf import (
+    BenchmarkResult,
+    run_workload,
+    ThroughputCollector,
+)
+from kubernetes_tpu.harness.workloads import WORKLOADS, make_workload

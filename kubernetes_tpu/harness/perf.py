@@ -1,0 +1,213 @@
+"""scheduler_perf-style benchmark runner (reference
+``test/integration/scheduler_perf/``): executes an op list against an
+in-process store + scheduler (no kubelets — binding is the finish line,
+SURVEY.md section 3.5), samples scheduling throughput at 1 Hz
+(``util.go:220-280`` throughputCollector), scrapes the scheduler
+histograms, and emits DataItems-shaped JSON (``util.go:101-129``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.api.types import Node
+from kubernetes_tpu.config.feature_gates import FeatureGates
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.sidecar import attach_batch_scheduler
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(int(len(s) * q), len(s) - 1)
+    return s[idx]
+
+
+class ThroughputCollector:
+    """Samples scheduled-pod count at 1 Hz (util.go throughputCollector)."""
+
+    def __init__(self, store: ClusterStore, interval: float = 1.0):
+        self.store = store
+        self.interval = interval
+        self.samples: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _count_scheduled(self) -> int:
+        return sum(1 for p in self.store.list_pods() if p.spec.node_name)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        last = self._count_scheduled()
+        while not self._stop.wait(self.interval):
+            now = self._count_scheduled()
+            self.samples.append((now - last) / self.interval)
+            last = now
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def summary(self) -> Dict[str, float]:
+        samples = [s for s in self.samples if s > 0] or [0.0]
+        return {
+            "Average": sum(samples) / len(samples),
+            "Perc50": _percentile(samples, 0.50),
+            "Perc90": _percentile(samples, 0.90),
+            "Perc99": _percentile(samples, 0.99),
+        }
+
+
+@dataclass
+class BenchmarkResult:
+    name: str
+    total_pods: int
+    measured_pods: int
+    duration_seconds: float
+    pods_per_second: float
+    throughput: Dict[str, float]
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+    def data_items(self) -> dict:
+        """DataItems JSON shape (util.go:101-129)."""
+        return {
+            "version": "v1",
+            "dataItems": [
+                {
+                    "data": self.throughput,
+                    "unit": "pods/s",
+                    "labels": {"Name": self.name, "Metric": "SchedulingThroughput"},
+                },
+                {
+                    "data": {"Average": self.pods_per_second},
+                    "unit": "pods/s",
+                    "labels": {"Name": self.name, "Metric": "OverallRate"},
+                },
+                {
+                    "data": self.metrics,
+                    "unit": "ms",
+                    "labels": {"Name": self.name, "Metric": "SchedulingLatency"},
+                },
+            ],
+        }
+
+
+def run_workload(
+    name: str,
+    ops: List[dict],
+    use_batch: bool = False,
+    max_batch: int = 4096,
+    wait_timeout: float = 600.0,
+    progress: Optional[Callable[[str], None]] = None,
+) -> BenchmarkResult:
+    """Execute one workload (scheduler_perf_test.go:309 runWorkload)."""
+    store = ClusterStore()
+    gates = FeatureGates({"TPUBatchScheduler": use_batch})
+    sched = Scheduler.create(store, feature_gates=gates)
+    bs = attach_batch_scheduler(sched, max_batch=max_batch) if use_batch else None
+    sched.start()
+
+    def pump_until_scheduled(target: int, deadline: float) -> None:
+        """Drive scheduling until `target` pods are bound."""
+        while time.monotonic() < deadline:
+            sched.queue.flush_backoff_completed()
+            if bs is not None:
+                progressed = bs.run_batch(pop_timeout=0.01)
+            else:
+                progressed = sched.schedule_one(pop_timeout=0.01)
+            if not progressed:
+                bound = sum(1 for p in store.list_pods() if p.spec.node_name)
+                if bound >= target:
+                    return
+                time.sleep(0.005)
+        raise TimeoutError(
+            f"workload {name}: not all pods scheduled before deadline"
+        )
+
+    collector: Optional[ThroughputCollector] = None
+    measure_start = 0.0
+    measured_pods = 0
+    created_nodes = 0
+    created_pods = 0
+    try:
+        for op in ops:
+            opcode = op["opcode"]
+            if opcode == "createNodes":
+                for i in range(op["count"]):
+                    store.add_node(Node.from_dict(op["nodeTemplate"](created_nodes)))
+                    created_nodes += 1
+                if progress:
+                    progress(f"{name}: {created_nodes} nodes")
+            elif opcode == "createPods":
+                template = op["podTemplate"]
+                offset = op.get("offset", 0)
+                collect = op.get("collectMetrics", False)
+                if collect:
+                    collector = ThroughputCollector(store)
+                    measure_start = time.monotonic()
+                    measured_pods = op["count"]
+                    collector.start()
+                for i in range(op["count"]):
+                    store.create_pod(Pod.from_dict(template(offset + i)))
+                    created_pods += 1
+                if progress:
+                    progress(f"{name}: {created_pods} pods created")
+                if not op.get("skipWaitToCompletion", False):
+                    target = _schedulable_target(store)
+                    pump_until_scheduled(
+                        target, time.monotonic() + wait_timeout
+                    )
+            elif opcode == "barrier":
+                target = _schedulable_target(store)
+                pump_until_scheduled(target, time.monotonic() + wait_timeout)
+            else:
+                raise ValueError(f"unknown opcode {opcode!r}")
+        sched.wait_for_inflight_bindings(timeout=30.0)
+        duration = time.monotonic() - measure_start if measure_start else 0.0
+    finally:
+        if collector:
+            collector.stop()
+        sched.stop()
+
+    e2e = sched.metrics.e2e_scheduling_duration
+    metrics = {
+        "Perc50": e2e.quantile(0.50, "scheduled") * 1000,
+        "Perc90": e2e.quantile(0.90, "scheduled") * 1000,
+        "Perc99": e2e.quantile(0.99, "scheduled") * 1000,
+    }
+    return BenchmarkResult(
+        name=name,
+        total_pods=created_pods,
+        measured_pods=measured_pods,
+        duration_seconds=duration,
+        pods_per_second=(measured_pods / duration) if duration > 0 else 0.0,
+        throughput=collector.summary() if collector else {},
+        metrics=metrics,
+    )
+
+
+def _schedulable_target(store: ClusterStore) -> int:
+    """Pods that can possibly schedule (Unschedulable workloads leave
+    impossible pods pending on purpose)."""
+    total = 0
+    for p in store.list_pods():
+        if p.spec.node_name:
+            total += 1
+        elif p.spec.node_selector.get("no-such-label") != "true":
+            total += 1
+    return total
+
+
+def write_json(result: BenchmarkResult, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(result.data_items(), f, indent=2)

@@ -1,0 +1,353 @@
+"""Declarative benchmark workloads (reference
+``test/integration/scheduler_perf/config/performance-config.yaml`` +
+the op DSL of ``scheduler_perf_test.go:42-47``).
+
+An op is a dict: ``{"opcode": "createNodes"|"createPods"|"barrier", ...}``.
+``WORKLOADS`` carries the reference's 16 named test cases (SURVEY.md
+section 6), parameterizable by node/pod counts like the
+{500Nodes, 5000Nodes} variants.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def _zone(i: int, zones: int = 10) -> str:
+    return f"zone-{i % zones}"
+
+
+def node_template(i: int, cpu: str = "32", memory: str = "64Gi",
+                  zones: int = 10) -> dict:
+    return {
+        "metadata": {
+            "name": f"node-{i}",
+            "labels": {
+                "kubernetes.io/hostname": f"node-{i}",
+                "topology.kubernetes.io/zone": _zone(i, zones),
+            },
+        },
+        "status": {
+            "capacity": {"cpu": cpu, "memory": memory, "pods": "110"},
+        },
+    }
+
+
+def basic_pod(i: int, cpu: str = "500m", memory: str = "500Mi",
+              labels: Dict[str, str] = None, extra_spec: dict = None) -> dict:
+    spec = {
+        "containers": [
+            {"name": "c", "image": "registry/fake:1",
+             "resources": {"requests": {"cpu": cpu, "memory": memory}}}
+        ],
+    }
+    if extra_spec:
+        spec.update(extra_spec)
+    return {
+        "metadata": {"name": f"pod-{i}", "labels": dict(labels or {})},
+        "spec": spec,
+    }
+
+
+def _spread(max_skew: int, key: str, action: str, labels: Dict[str, str]) -> dict:
+    return {
+        "topologySpreadConstraints": [
+            {"maxSkew": max_skew, "topologyKey": key,
+             "whenUnsatisfiable": action,
+             "labelSelector": {"matchLabels": labels}}
+        ]
+    }
+
+
+def _affinity(kind: str, key: str, values: List[str], topo: str,
+              weight: int = 0) -> dict:
+    term = {
+        "labelSelector": {
+            "matchExpressions": [{"key": key, "operator": "In", "values": values}]
+        },
+        "topologyKey": topo,
+    }
+    if weight:
+        block = {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": weight, "podAffinityTerm": term}
+        ]}
+    else:
+        block = {"requiredDuringSchedulingIgnoredDuringExecution": [term]}
+    return {"affinity": {kind: block}}
+
+
+def make_workload(name: str, nodes: int, init_pods: int, measure_pods: int) -> List[dict]:
+    """Build the op list for a named workload at the given scale."""
+    builder = WORKLOADS[name]
+    return builder(nodes, init_pods, measure_pods)
+
+
+def _pods_op(count: int, pod_fn, collect: bool = False, offset: int = 0) -> dict:
+    return {
+        "opcode": "createPods",
+        "count": count,
+        "podTemplate": pod_fn,
+        "collectMetrics": collect,
+        "offset": offset,
+    }
+
+
+def _nodes_op(count: int, **kw) -> dict:
+    return {"opcode": "createNodes", "count": count,
+            "nodeTemplate": lambda i: node_template(i, **kw)}
+
+
+def _barrier() -> dict:
+    return {"opcode": "barrier"}
+
+
+def scheduling_basic(nodes, init_pods, measure_pods):
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, lambda i: basic_pod(i), collect=True,
+                 offset=init_pods),
+    ]
+
+
+def scheduling_pod_anti_affinity(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i, labels={"color": f"blue-{i % 100}"})
+        p["spec"].update(
+            _affinity("podAntiAffinity", "color", [f"blue-{i % 100}"],
+                      "kubernetes.io/hostname")
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def scheduling_pod_affinity(nodes, init_pods, measure_pods):
+    def init_pod(i):
+        return basic_pod(i, labels={"group": f"g{i % 50}"})
+
+    def pod(i):
+        p = basic_pod(i, labels={"group": f"g{i % 50}"})
+        p["spec"].update(
+            _affinity("podAffinity", "group", [f"g{i % 50}"],
+                      "topology.kubernetes.io/zone")
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, init_pod),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def scheduling_preferred_pod_affinity(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i, labels={"group": f"g{i % 50}"})
+        p["spec"].update(
+            _affinity("podAffinity", "group", [f"g{i % 50}"],
+                      "kubernetes.io/hostname", weight=10)
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i, labels={"group": f"g{i % 50}"})),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def scheduling_preferred_anti_affinity(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i, labels={"color": f"c{i % 100}"})
+        p["spec"].update(
+            _affinity("podAntiAffinity", "color", [f"c{i % 100}"],
+                      "kubernetes.io/hostname", weight=10)
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i, labels={"color": f"c{i % 100}"})),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def scheduling_node_affinity(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i)
+        p["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "topology.kubernetes.io/zone",
+                             "operator": "In",
+                             "values": [f"zone-{i % 10}"]}
+                        ]}
+                    ]
+                }
+            }
+        }
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def topology_spreading(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i, labels={"app": "spread"})
+        p["spec"].update(
+            _spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "spread"})
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def preferred_topology_spreading(nodes, init_pods, measure_pods):
+    def pod(i):
+        p = basic_pod(i, labels={"app": "spread"})
+        p["spec"].update(
+            _spread(1, "topology.kubernetes.io/zone", "ScheduleAnyway",
+                    {"app": "spread"})
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def mixed_scheduling_base_pod(nodes, init_pods, measure_pods):
+    """Interleaved init pods with every constraint family, then plain
+    measured pods (the reference's MixedSchedulingBasePod)."""
+    builders = [
+        lambda i: basic_pod(i),
+        lambda i: _with(basic_pod(i, labels={"color": f"x{i % 20}"}),
+                        _affinity("podAffinity", "color", [f"x{i % 20}"],
+                                  "topology.kubernetes.io/zone")),
+        lambda i: _with(basic_pod(i, labels={"color": f"y{i % 20}"}),
+                        _affinity("podAntiAffinity", "color", [f"y{i % 20}"],
+                                  "topology.kubernetes.io/zone")),
+        lambda i: _with(basic_pod(i, labels={"app": "mix"}),
+                        _spread(2, "topology.kubernetes.io/zone",
+                                "DoNotSchedule", {"app": "mix"})),
+    ]
+
+    def init_pod(i):
+        return builders[i % len(builders)](i)
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, init_pod),
+        _barrier(),
+        _pods_op(measure_pods, lambda i: basic_pod(i), collect=True,
+                 offset=init_pods),
+    ]
+
+
+def _with(pod: dict, extra: dict) -> dict:
+    pod["spec"].update(extra)
+    return pod
+
+
+def preemption(nodes, init_pods, measure_pods):
+    return [
+        _nodes_op(nodes, cpu="4", memory="8Gi"),
+        _pods_op(init_pods, lambda i: _prio(basic_pod(i, cpu="3"), 1)),
+        _barrier(),
+        _pods_op(measure_pods, lambda i: _prio(basic_pod(i, cpu="3"), 100),
+                 collect=True, offset=init_pods),
+    ]
+
+
+def _prio(pod: dict, priority: int) -> dict:
+    pod["spec"]["priority"] = priority
+    return pod
+
+
+def unschedulable(nodes, init_pods, measure_pods):
+    """Many unschedulable pods pending while measured pods schedule."""
+    def impossible(i):
+        p = basic_pod(i)
+        p["spec"]["nodeSelector"] = {"no-such-label": "true"}
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, impossible),
+        _pods_op(measure_pods, lambda i: basic_pod(i), collect=True,
+                 offset=init_pods),
+    ]
+
+
+def gang_scheduling(nodes, init_pods, measure_pods, gang_size: int = 10):
+    """Coscheduling gangs + spread + fit (BASELINE config #5; no in-tree
+    reference equivalent — the out-of-tree coscheduling pattern)."""
+    def pod(i):
+        gang = i // gang_size
+        p = basic_pod(i, labels={
+            "app": "gang",
+            "pod-group.scheduling.k8s.io/name": f"gang-{gang}",
+            "pod-group.scheduling.k8s.io/min-available": str(gang_size),
+        })
+        p["spec"].update(
+            _spread(5, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "gang"})
+        )
+        return p
+
+    return [
+        _nodes_op(nodes),
+        _pods_op(init_pods, lambda i: basic_pod(i)),
+        _barrier(),
+        _pods_op(measure_pods, pod, collect=True, offset=init_pods),
+    ]
+
+
+def scheduling_secrets(nodes, init_pods, measure_pods):
+    # secrets don't affect scheduling decisions; workload matches the
+    # reference shape (pods referencing secret volumes are expressible —
+    # secret volumes are not PVC volumes)
+    return scheduling_basic(nodes, init_pods, measure_pods)
+
+
+WORKLOADS = {
+    "SchedulingBasic": scheduling_basic,
+    "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
+    "SchedulingSecrets": scheduling_secrets,
+    "SchedulingPodAffinity": scheduling_pod_affinity,
+    "SchedulingPreferredPodAffinity": scheduling_preferred_pod_affinity,
+    "SchedulingPreferredPodAntiAffinity": scheduling_preferred_anti_affinity,
+    "SchedulingNodeAffinity": scheduling_node_affinity,
+    "TopologySpreading": topology_spreading,
+    "PreferredTopologySpreading": preferred_topology_spreading,
+    "MixedSchedulingBasePod": mixed_scheduling_base_pod,
+    "Preemption": preemption,
+    "Unschedulable": unschedulable,
+    "GangScheduling": gang_scheduling,
+}

@@ -1,0 +1,21 @@
+"""Device batch path: snapshot/pod encoders and the JAX solvers.
+
+This is the TPU-native replacement for the reference's hot loop: the
+per-node goroutine fan-out (``parallelize.Until``, 16 workers) becomes
+dense vector ops over the whole node axis, and the 30k sequential
+``scheduleOne`` cycles become one ``lax.scan`` commit (serial-equivalent)
+or conflict-resolution rounds on device (SURVEY.md section 2.5/7).
+
+Division of labor (deliberate, TPU-first):
+- **Host** (``encode.py``): the irregular, string-y, data-dependent work —
+  label-selector matching, taint/toleration profiles, topology-value
+  coding. All of it is O(distinct-profiles x nodes), tiny next to the
+  O(pods x nodes) math.
+- **Device** (``solver.py``): everything O(pods x nodes) or that mutates
+  during the batch — capacity fit, skew counts, (anti-)affinity domain
+  counts, scores, and the assignment itself. Static shapes, int32/f32,
+  one-hot segment updates; no data-dependent Python control flow.
+"""
+
+from kubernetes_tpu.ops.encode import BatchEncoder, EncodedBatch, EncodedCluster
+from kubernetes_tpu.ops.solver import solve_scan, SolverParams

@@ -1,0 +1,535 @@
+"""Host-side encoding: Snapshot + pod batch → dense device arrays.
+
+The reference's PreFilter phase builds per-pod maps over all nodes
+(``interpodaffinity/filtering.go:162-235``, ``podtopologyspread/
+filtering.go:198-273``); this encoder materializes the same information
+once per batch as tensors:
+
+- node capacity/usage matrices ``[N, R]`` (int32: milli-CPU, KiB memory,
+  KiB ephemeral, whole-unit scalars),
+- topology value codes ``[N, K]`` per tracked topology key,
+- per *static profile* node masks ``[U, N]`` — a profile is the tuple of a
+  pod's node-static predicates (nodeName, nodeSelector, required node
+  affinity, tolerations, unschedulable) evaluated with the SAME host
+  plugin code the serial path runs, guaranteeing differential equality,
+- tracked spread-constraint count matrices ``[SC, V]`` and per-pod match
+  vectors,
+- tracked (anti-)affinity term count/owner matrices ``[T, V]`` and
+  membership masks.
+
+Pods the tensor model cannot express (PVC volumes, host ports, extender
+interest) are flagged ``inexpressible`` and fall back to the serial path —
+the clean-fallback contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as labelslib
+from kubernetes_tpu.api.types import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Pod
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework.plugins.helpers import (
+    pod_matches_node_selector_and_affinity,
+)
+from kubernetes_tpu.scheduler.framework.plugins.node_unschedulable import (
+    NodeUnschedulable,
+)
+from kubernetes_tpu.scheduler.framework.plugins.taint_toleration import (
+    TaintToleration,
+)
+from kubernetes_tpu.scheduler.snapshot import Snapshot
+from kubernetes_tpu.scheduler.types import (
+    PodInfo,
+    Resource,
+    compute_pod_resource_request,
+)
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+# base resource columns; scalar/extended resources get appended per batch
+BASE_RESOURCES = 3  # cpu (milli), memory (KiB), ephemeral (KiB)
+
+
+def _resource_row(r: Resource, names: List[str]) -> List[int]:
+    row = [r.milli_cpu, _kib(r.memory), _kib(r.ephemeral_storage)]
+    for name in names[BASE_RESOURCES:]:
+        row.append(r.scalar_resources.get(name, 0))
+    return row
+
+
+def _kib(b: int) -> int:
+    return -((-b) // 1024)
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclass
+class _TrackedConstraint:
+    """One distinct topology-spread constraint shared by batch pods."""
+
+    key_idx: int
+    max_skew: int
+    selector: labelslib.Selector
+    namespace: str
+    hard: bool  # DoNotSchedule vs ScheduleAnyway
+
+    def matches(self, pod: Pod) -> bool:
+        return pod.namespace == self.namespace and self.selector.matches(
+            pod.metadata.labels
+        )
+
+
+@dataclass
+class _TrackedTerm:
+    """One distinct (anti-)affinity term."""
+
+    key_idx: int
+    selector: labelslib.Selector
+    namespaces: frozenset
+
+    def matches(self, pod: Pod) -> bool:
+        return pod.namespace in self.namespaces and self.selector.matches(
+            pod.metadata.labels
+        )
+
+
+@dataclass
+class EncodedCluster:
+    """Node-side arrays (all numpy; shipped to device by the solver)."""
+
+    node_names: List[str]
+    num_real_nodes: int
+    resource_names: List[str]
+    allocatable: np.ndarray        # [N, R] int32
+    requested: np.ndarray          # [N, R] int32
+    nonzero_requested: np.ndarray  # [N, 2] int32 (cpu milli, mem KiB) for scoring
+    pod_count: np.ndarray          # [N] int32
+    max_pods: np.ndarray           # [N] int32
+    topo_keys: List[str] = field(default_factory=list)
+    topo_codes: Optional[np.ndarray] = None   # [N, K] int32, V = missing
+    topo_num_values: Optional[np.ndarray] = None  # [K] int32
+
+
+@dataclass
+class EncodedBatch:
+    """Pod-side arrays + tracked dynamic constraint state."""
+
+    pods: List[Pod]
+    num_real_pods: int
+    requests: np.ndarray           # [B, R] int32
+    nonzero_requests: np.ndarray   # [B, 2] int32
+    profile_idx: np.ndarray        # [B] int32 into static masks
+    static_masks: np.ndarray       # [U, N] bool — node-static predicates
+    affinity_masks: np.ndarray     # [U, N] bool — nodeSelector/affinity only
+    static_scores: np.ndarray      # [U, N] float32 — static score plugins
+    inexpressible: np.ndarray      # [B] bool — pod must use serial path
+
+    # spread constraints
+    sc_key_idx: np.ndarray         # [SC] int32
+    sc_max_skew: np.ndarray        # [SC] int32
+    sc_hard: np.ndarray            # [SC] bool
+    sc_counts: np.ndarray          # [SC, V+1] int32 (existing matching pods)
+    sc_domain: np.ndarray          # [U, SC, V+1] bool (eligible domains)
+    pod_sc: np.ndarray             # [B, SC] bool — constraint belongs to pod
+    pod_sc_match: np.ndarray       # [B, SC] bool — pod counts toward constraint
+
+    # (anti-)affinity terms
+    term_key_idx: np.ndarray       # [T] int32
+    term_counts: np.ndarray        # [T, V+1] int32 (existing matched pods)
+    term_owners: np.ndarray        # [T, V+1] int32 (existing anti-term owners)
+    match_by: np.ndarray           # [B, T] bool — pod matched by term
+    own_aff: np.ndarray            # [B, T] bool — pod requires term (affinity)
+    own_anti: np.ndarray           # [B, T] bool — pod requires term (anti)
+    pref_weight: np.ndarray        # [B, T] float32 — preferred term weights
+
+    num_values: int                # V (shared topo-value space size)
+
+
+class BatchEncoder:
+    """Encodes one (snapshot, pod batch) pair. Stateless across batches in
+    v1 — incremental device-state updates are an optimization layered on
+    top (the Generation-LRU of the device mirror)."""
+
+    def __init__(self, snapshot: Snapshot, pad_nodes: int = 128):
+        self.snapshot = snapshot
+        self.node_infos = [ni for ni in snapshot.list() if ni.node is not None]
+        self.pad_nodes = pad_nodes
+        self._taint_plugin = TaintToleration()
+        self._unsched_plugin = NodeUnschedulable()
+
+    # ------------------------------------------------------------------
+    def encode(self, pods: List[Pod], pad_pods: int = 64) -> Tuple[
+        EncodedCluster, EncodedBatch
+    ]:
+        nis = self.node_infos
+        n_real = len(nis)
+        n_pad = max(_round_up(max(n_real, 1), self.pad_nodes), self.pad_nodes)
+
+        resource_names = self._resource_names(pods)
+        r = len(resource_names)
+
+        allocatable = np.zeros((n_pad, r), dtype=np.int32)
+        requested = np.zeros((n_pad, r), dtype=np.int32)
+        nonzero_req = np.zeros((n_pad, 2), dtype=np.int32)
+        pod_count = np.zeros(n_pad, dtype=np.int32)
+        max_pods = np.zeros(n_pad, dtype=np.int32)
+        for i, ni in enumerate(nis):
+            allocatable[i] = _resource_row(ni.allocatable, resource_names)
+            requested[i] = _resource_row(ni.requested, resource_names)
+            nonzero_req[i] = (
+                ni.non_zero_requested.milli_cpu,
+                _kib(ni.non_zero_requested.memory),
+            )
+            pod_count[i] = len(ni.pods)
+            max_pods[i] = ni.allocatable.allowed_pod_number or 1_000_000
+
+        cluster = EncodedCluster(
+            node_names=[ni.node.name for ni in nis],
+            num_real_nodes=n_real,
+            resource_names=resource_names,
+            allocatable=allocatable,
+            requested=requested,
+            nonzero_requested=nonzero_req,
+            pod_count=pod_count,
+            max_pods=max_pods,
+        )
+
+        batch = self._encode_pods(cluster, pods, n_pad, pad_pods)
+        return cluster, batch
+
+    def _resource_names(self, pods: List[Pod]) -> List[str]:
+        names = [CPU, MEMORY, EPHEMERAL_STORAGE]
+        seen = set(names) | {PODS}
+        for ni in self.node_infos:
+            for name in ni.allocatable.scalar_resources:
+                if name not in seen:
+                    seen.add(name)
+                    names.append(name)
+        for pod in pods:
+            req = compute_pod_resource_request(pod)
+            for name in req.scalar_resources:
+                if name not in seen:
+                    seen.add(name)
+                    names.append(name)
+        return names
+
+    # ------------------------------------------------------------------
+    def _encode_pods(self, cluster: EncodedCluster, pods: List[Pod],
+                     n_pad: int, pad_pods: int) -> EncodedBatch:
+        b_real = len(pods)
+        b_pad = max(_round_up(max(b_real, 1), pad_pods), pad_pods)
+        r = len(cluster.resource_names)
+        pod_infos = [PodInfo(p) for p in pods]
+
+        # -------- topology keys: collect from constraints and terms
+        topo_keys: List[str] = []
+        key_index: Dict[str, int] = {}
+
+        def key_idx(key: str) -> int:
+            if key not in key_index:
+                key_index[key] = len(topo_keys)
+                topo_keys.append(key)
+            return key_index[key]
+
+        # tracked spread constraints (dedup)
+        constraints: List[_TrackedConstraint] = []
+        con_index: Dict[tuple, int] = {}
+        pod_con: List[List[int]] = [[] for _ in range(b_real)]
+        for bi, pod in enumerate(pods):
+            for c in pod.spec.topology_spread_constraints:
+                if not c.topology_key:
+                    continue
+                sel = labelslib.selector_from_label_selector(c.label_selector)
+                key = (
+                    c.topology_key, c.max_skew,
+                    c.when_unsatisfiable == "DoNotSchedule",
+                    pod.namespace, repr(sel),
+                )
+                if key not in con_index:
+                    con_index[key] = len(constraints)
+                    constraints.append(
+                        _TrackedConstraint(
+                            key_idx(c.topology_key), c.max_skew, sel,
+                            pod.namespace,
+                            c.when_unsatisfiable == "DoNotSchedule",
+                        )
+                    )
+                pod_con[bi].append(con_index[key])
+
+        # tracked terms: batch pods' required aff/anti + preferred, plus
+        # existing pods' required anti-affinity (owners)
+        terms: List[_TrackedTerm] = []
+        term_index: Dict[tuple, int] = {}
+
+        def term_for(t) -> int:
+            key = (t.topology_key, repr(t.selector), tuple(sorted(t.namespaces)))
+            if key not in term_index:
+                term_index[key] = len(terms)
+                terms.append(
+                    _TrackedTerm(key_idx(t.topology_key), t.selector, t.namespaces)
+                )
+            return term_index[key]
+
+        pod_aff: List[List[int]] = [[] for _ in range(b_real)]
+        pod_anti: List[List[int]] = [[] for _ in range(b_real)]
+        pod_pref: List[List[Tuple[int, float]]] = [[] for _ in range(b_real)]
+        for bi, pi in enumerate(pod_infos):
+            for t in pi.required_affinity_terms:
+                pod_aff[bi].append(term_for(t))
+            for t in pi.required_anti_affinity_terms:
+                pod_anti[bi].append(term_for(t))
+            for wt in pi.preferred_affinity_terms:
+                pod_pref[bi].append((term_for(wt.term), float(wt.weight)))
+            for wt in pi.preferred_anti_affinity_terms:
+                pod_pref[bi].append((term_for(wt.term), -float(wt.weight)))
+
+        existing_anti_terms: List[Tuple[int, object]] = []  # (term idx, owner node)
+        for ni in self.snapshot.have_pods_with_required_anti_affinity_list():
+            if ni.node is None:
+                continue
+            for existing in ni.pods_with_required_anti_affinity:
+                for t in existing.required_anti_affinity_terms:
+                    existing_anti_terms.append((term_for(t), ni.node))
+
+        # -------- topology value coding (shared value space, padded)
+        k = len(topo_keys)
+        value_codes: List[Dict[str, int]] = [dict() for _ in range(k)]
+        topo_codes = np.full((n_pad, max(k, 1)), -1, dtype=np.int32)
+        for i, ni in enumerate(self.node_infos):
+            labels = ni.node.metadata.labels
+            for ki, key in enumerate(topo_keys):
+                if key in labels:
+                    vc = value_codes[ki]
+                    v = labels[key]
+                    if v not in vc:
+                        vc[v] = len(vc)
+                    topo_codes[i, ki] = vc[v]
+        num_values = max((len(vc) for vc in value_codes), default=0)
+        num_values = max(num_values, 1)
+        cluster.topo_keys = topo_keys
+        cluster.topo_codes = topo_codes
+        cluster.topo_num_values = np.array(
+            [len(vc) for vc in value_codes] or [0], dtype=np.int32
+        )
+        # missing key -> sentinel column V
+        topo_codes[topo_codes < 0] = num_values
+
+        # -------- static profiles
+        profiles: Dict[tuple, int] = {}
+        profile_idx = np.zeros(b_pad, dtype=np.int32)
+        profile_pods: List[Pod] = []
+        for bi, pod in enumerate(pods):
+            key = self._static_profile_key(pod)
+            if key not in profiles:
+                profiles[key] = len(profile_pods)
+                profile_pods.append(pod)
+            profile_idx[bi] = profiles[key]
+        u = max(len(profile_pods), 1)
+        static_masks = np.zeros((u, n_pad), dtype=bool)
+        affinity_masks = np.zeros((u, n_pad), dtype=bool)
+        static_scores = np.zeros((u, n_pad), dtype=np.float32)
+        for ui, pod in enumerate(profile_pods):
+            self._compute_static(pod, static_masks[ui], affinity_masks[ui],
+                                 static_scores[ui])
+
+        # -------- requests
+        requests = np.zeros((b_pad, r), dtype=np.int32)
+        nonzero_requests = np.zeros((b_pad, 2), dtype=np.int32)
+        inexpressible = np.zeros(b_pad, dtype=bool)
+        for bi, (pod, pi) in enumerate(zip(pods, pod_infos)):
+            requests[bi] = _resource_row(pi.resource_request, cluster.resource_names)
+            nonzero_requests[bi] = (
+                pi.non_zero_request.milli_cpu,
+                _kib(pi.non_zero_request.memory),
+            )
+            inexpressible[bi] = self._is_inexpressible(pod)
+
+        # -------- spread constraint arrays
+        sc = max(len(constraints), 1)
+        sc_key_idx = np.zeros(sc, dtype=np.int32)
+        sc_max_skew = np.ones(sc, dtype=np.int32)
+        sc_hard = np.zeros(sc, dtype=bool)
+        sc_counts = np.zeros((sc, num_values + 1), dtype=np.int32)
+        sc_domain = np.zeros((u, sc, num_values + 1), dtype=bool)
+        pod_sc = np.zeros((b_pad, sc), dtype=bool)
+        pod_sc_match = np.zeros((b_pad, sc), dtype=bool)
+        for ci, con in enumerate(constraints):
+            sc_key_idx[ci] = con.key_idx
+            sc_max_skew[ci] = con.max_skew
+            sc_hard[ci] = con.hard
+            # existing matching pods per domain value
+            for i, ni in enumerate(self.node_infos):
+                code = topo_codes[i, con.key_idx]
+                if code >= num_values:
+                    continue
+                count = sum(
+                    1
+                    for pi in ni.pods
+                    if pi.pod.metadata.deletion_timestamp is None
+                    and con.matches(pi.pod)
+                )
+                sc_counts[ci, code] += count
+            # eligible domains per profile
+            for ui in range(len(profile_pods)):
+                for i in range(len(self.node_infos)):
+                    if affinity_masks[ui, i]:
+                        code = topo_codes[i, con.key_idx]
+                        if code < num_values:
+                            sc_domain[ui, ci, code] = True
+        for bi, pod in enumerate(pods):
+            for ci in pod_con[bi]:
+                pod_sc[bi, ci] = True
+            for ci, con in enumerate(constraints):
+                pod_sc_match[bi, ci] = con.matches(pod)
+
+        # -------- term arrays
+        t_n = max(len(terms), 1)
+        term_key_idx = np.zeros(t_n, dtype=np.int32)
+        term_counts = np.zeros((t_n, num_values + 1), dtype=np.int32)
+        term_owners = np.zeros((t_n, num_values + 1), dtype=np.int32)
+        match_by = np.zeros((b_pad, t_n), dtype=bool)
+        own_aff = np.zeros((b_pad, t_n), dtype=bool)
+        own_anti = np.zeros((b_pad, t_n), dtype=bool)
+        pref_weight = np.zeros((b_pad, t_n), dtype=np.float32)
+        for ti, term in enumerate(terms):
+            term_key_idx[ti] = term.key_idx
+            for i, ni in enumerate(self.node_infos):
+                code = topo_codes[i, term.key_idx]
+                if code >= num_values:
+                    continue
+                count = sum(1 for pi in ni.pods if term.matches(pi.pod))
+                term_counts[ti, code] += count
+        node_idx = {ni.node.name: i for i, ni in enumerate(self.node_infos)}
+        for ti, owner_node in existing_anti_terms:
+            i = node_idx[owner_node.name]
+            code = topo_codes[i, terms[ti].key_idx]
+            if code < num_values:
+                term_owners[ti, code] += 1
+        for bi, pod in enumerate(pods):
+            for ti, term in enumerate(terms):
+                match_by[bi, ti] = term.matches(pod)
+            for ti in pod_aff[bi]:
+                own_aff[bi, ti] = True
+            for ti in pod_anti[bi]:
+                own_anti[bi, ti] = True
+            for ti, w in pod_pref[bi]:
+                pref_weight[bi, ti] += w
+
+        return EncodedBatch(
+            pods=pods,
+            num_real_pods=b_real,
+            requests=requests,
+            nonzero_requests=nonzero_requests,
+            profile_idx=profile_idx,
+            static_masks=static_masks,
+            affinity_masks=affinity_masks,
+            static_scores=static_scores,
+            inexpressible=inexpressible,
+            sc_key_idx=sc_key_idx,
+            sc_max_skew=sc_max_skew,
+            sc_hard=sc_hard,
+            sc_counts=sc_counts,
+            sc_domain=sc_domain,
+            pod_sc=pod_sc,
+            pod_sc_match=pod_sc_match,
+            term_key_idx=term_key_idx,
+            term_counts=term_counts,
+            term_owners=term_owners,
+            match_by=match_by,
+            own_aff=own_aff,
+            own_anti=own_anti,
+            pref_weight=pref_weight,
+            num_values=num_values,
+        )
+
+    # ------------------------------------------------------------------
+    def _static_profile_key(self, pod: Pod) -> tuple:
+        spec = pod.spec
+        aff_repr = ""
+        if spec.affinity is not None and spec.affinity.node_affinity is not None:
+            na = spec.affinity.node_affinity
+            req = na.required_during_scheduling_ignored_during_execution
+            aff_repr = repr(
+                [
+                    [(e.key, e.operator, tuple(e.values)) for e in t.match_expressions]
+                    + [("f:" + e.key, e.operator, tuple(e.values)) for e in t.match_fields]
+                    for t in (req.node_selector_terms if req else [])
+                ]
+            ) + repr(
+                [
+                    (p.weight,
+                     [(e.key, e.operator, tuple(e.values))
+                      for e in p.preference.match_expressions])
+                    for p in na.preferred_during_scheduling_ignored_during_execution
+                ]
+            )
+        return (
+            spec.node_name,
+            tuple(sorted(spec.node_selector.items())),
+            aff_repr,
+            tuple(
+                (t.key, t.operator, t.value, t.effect) for t in spec.tolerations
+            ),
+            tuple(sorted(c.image for c in spec.containers)),
+        )
+
+    def _compute_static(self, pod: Pod, mask: np.ndarray,
+                        affinity_mask: np.ndarray,
+                        scores: np.ndarray) -> None:
+        """Evaluate node-static predicates/scores with the real host
+        plugins so the device path is differentially exact."""
+        state = CycleState()
+        for i, ni in enumerate(self.node_infos):
+            node = ni.node
+            ok_affinity = pod_matches_node_selector_and_affinity(pod, node)
+            affinity_mask[i] = ok_affinity
+            ok = ok_affinity
+            if ok and pod.spec.node_name and pod.spec.node_name != node.name:
+                ok = False
+            if ok and self._unsched_plugin.filter(state, pod, ni) is not None:
+                ok = False
+            if ok and self._taint_plugin.filter(state, pod, ni) is not None:
+                ok = False
+            mask[i] = ok
+            if ok:
+                scores[i] = self._static_score(pod, ni)
+
+    @staticmethod
+    def _static_score(pod: Pod, ni) -> float:
+        """Static score contributions (preferred node affinity weights;
+        image locality). Dynamic scores live on device."""
+        from kubernetes_tpu.scheduler.framework.plugins.helpers import (
+            node_selector_term_matches,
+        )
+
+        score = 0.0
+        aff = pod.spec.affinity
+        if aff is not None and aff.node_affinity is not None:
+            for term in aff.node_affinity.preferred_during_scheduling_ignored_during_execution:
+                if term.weight and node_selector_term_matches(term.preference, ni.node):
+                    score += term.weight
+        for c in pod.spec.containers:
+            state = ni.image_states.get(c.image)
+            if state is not None:
+                score += min(state.size / (1024 * 1024 * 1024), 1.0)  # ≤1 pt/GiB
+        return score
+
+    def _is_inexpressible(self, pod: Pod) -> bool:
+        return is_host_only(pod)
+
+
+def is_host_only(pod: Pod) -> bool:
+    """Pods needing host-only machinery (volume binding, host-port
+    conflict tracking) take the serial path — the single source of truth
+    shared by the encoder and the sidecar's partitioner."""
+    if any(v.persistent_volume_claim for v in pod.spec.volumes):
+        return True
+    if any(p.host_port > 0 for c in pod.spec.containers for p in c.ports):
+        return True
+    return False

@@ -1,0 +1,271 @@
+"""Device assignment solvers.
+
+``solve_scan`` is the serial-equivalent batch solver: one ``lax.scan`` step
+per pod (in queue priority order), each step evaluating EVERY node with
+dense vector ops — feasibility (capacity fit, pod-count cap, topology-skew,
+(anti-)affinity domain counts, static predicate masks) and scores
+(balanced/least allocation, spread, preferred affinity, static) — then
+committing the argmax and updating capacity/count state with one-hot adds.
+
+This replaces the reference's hot path 1:1: a scan step IS one
+``scheduleOne`` cycle (SURVEY.md section 3.2), except the per-node work the
+reference fans out over 16 goroutines with adaptive sampling
+(``generic_scheduler.go:179-199``) runs as full-width vector ops — all
+nodes, no sampling. Intra-batch interactions (pod A consuming capacity,
+shifting topology counts for pod B) are exact by construction, which is the
+"hard part (2)" called out in SURVEY.md section 7.
+
+Everything is static-shaped (pods and nodes padded to buckets), int32/f32,
+with no data-dependent Python control flow — one XLA compilation per
+(bucket-shape) signature, reused across batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.ops.encode import EncodedBatch, EncodedCluster
+
+NEG_INF = -1e30
+BIG = np.int32(2**30)
+
+
+@dataclass(frozen=True)
+class SolverParams:
+    """Score weights mirroring the default provider's plugin weights
+    (provider.py): balanced 1, least-allocated 1, topology-spread 2,
+    inter-pod affinity 1. Spread/affinity device scores are rank-equivalent
+    to the host's min-max normalized forms (monotone in the same counts)."""
+
+    balanced_weight: float = 1.0
+    least_weight: float = 1.0
+    spread_weight: float = 2.0
+    affinity_weight: float = 1.0
+    static_weight: float = 1.0
+
+
+class _State(NamedTuple):
+    requested: jnp.ndarray          # [N, R] int32
+    nonzero_requested: jnp.ndarray  # [N, 2] int32
+    pod_count: jnp.ndarray          # [N] int32
+    sc_counts: jnp.ndarray          # [SC, V+1] int32
+    term_counts: jnp.ndarray        # [T, V+1] int32
+    term_owners: jnp.ndarray        # [T, V+1] int32
+
+
+class _PodIn(NamedTuple):
+    request: jnp.ndarray        # [R]
+    nonzero_request: jnp.ndarray  # [2]
+    profile: jnp.ndarray        # scalar int32
+    valid: jnp.ndarray          # scalar bool (real & expressible)
+    pod_sc: jnp.ndarray         # [SC] bool
+    pod_sc_match: jnp.ndarray   # [SC] bool
+    match_by: jnp.ndarray       # [T] bool
+    own_aff: jnp.ndarray        # [T] bool
+    own_anti: jnp.ndarray       # [T] bool
+    pref_weight: jnp.ndarray    # [T] f32
+
+
+class _Static(NamedTuple):
+    allocatable: jnp.ndarray     # [N, R]
+    max_pods: jnp.ndarray        # [N]
+    static_masks: jnp.ndarray    # [U, N] bool
+    static_scores: jnp.ndarray   # [U, N] f32
+    sc_codes: jnp.ndarray        # [SC, N] int32 (V = missing)
+    sc_max_skew: jnp.ndarray     # [SC]
+    sc_hard: jnp.ndarray         # [SC] bool
+    sc_domain: jnp.ndarray       # [U, SC, V+1] bool
+    term_codes: jnp.ndarray      # [T, N] int32
+    node_valid: jnp.ndarray      # [N] bool
+
+
+def _step(static: _Static, params: SolverParams, state: _State, pod: _PodIn):
+    n = static.allocatable.shape[0]
+    v = state.sc_counts.shape[1] - 1
+
+    # ---- feasibility --------------------------------------------------
+    fit = jnp.all(
+        state.requested + pod.request[None, :] <= static.allocatable, axis=1
+    )
+    fit &= state.pod_count < static.max_pods
+    static_ok = static.static_masks[pod.profile]
+
+    # topology spread (hard constraints)
+    counts_at = jnp.take_along_axis(state.sc_counts, static.sc_codes, axis=1)  # [SC, N]
+    domain = static.sc_domain[pod.profile]                                     # [SC, V+1]
+    min_c = jnp.min(
+        jnp.where(domain[:, :v], state.sc_counts[:, :v], BIG), axis=1
+    )
+    min_c = jnp.where(jnp.any(domain[:, :v], axis=1), min_c, 0)
+    skew = counts_at + pod.pod_sc_match[:, None].astype(jnp.int32) - min_c[:, None]
+    missing = static.sc_codes >= v
+    active_hard = pod.pod_sc & static.sc_hard
+    spread_violation = jnp.any(
+        active_hard[:, None] & ((skew > static.sc_max_skew[:, None]) | missing),
+        axis=0,
+    )
+
+    # inter-pod affinity
+    tcounts_at = jnp.take_along_axis(state.term_counts, static.term_codes, axis=1)  # [T, N]
+    towners_at = jnp.take_along_axis(state.term_owners, static.term_codes, axis=1)
+    t_missing = static.term_codes >= v
+    existing_anti_block = jnp.any(
+        pod.match_by[:, None] & (towners_at > 0), axis=0
+    )
+    own_anti_block = jnp.any(pod.own_anti[:, None] & (tcounts_at > 0), axis=0)
+    aff_here = (tcounts_at > 0) & ~t_missing
+    aff_sat = jnp.all(~pod.own_aff[:, None] | aff_here, axis=0)
+    # first-pod-of-group special case (filtering.go): no matches anywhere
+    # for ANY of its terms and the pod matches its own terms
+    totals = jnp.sum(state.term_counts[:, :v], axis=1)
+    no_any = jnp.all(~pod.own_aff | (totals == 0))
+    self_all = jnp.all(~pod.own_aff | pod.match_by)
+    has_aff = jnp.any(pod.own_aff)
+    aff_ok = jnp.where(has_aff, aff_sat | (no_any & self_all), True)
+
+    feasible = (
+        static.node_valid
+        & static_ok
+        & fit
+        & ~spread_violation
+        & ~existing_anti_block
+        & ~own_anti_block
+        & aff_ok
+        & pod.valid
+    )
+
+    # ---- scores -------------------------------------------------------
+    alloc_cpu = jnp.maximum(static.allocatable[:, 0], 1).astype(jnp.float32)
+    alloc_mem = jnp.maximum(static.allocatable[:, 1], 1).astype(jnp.float32)
+    cpu_frac = (
+        state.nonzero_requested[:, 0] + pod.nonzero_request[0]
+    ).astype(jnp.float32) / alloc_cpu
+    mem_frac = (
+        state.nonzero_requested[:, 1] + pod.nonzero_request[1]
+    ).astype(jnp.float32) / alloc_mem
+    over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
+    balanced = jnp.where(over, 0.0, (1.0 - jnp.abs(cpu_frac - mem_frac)) * 100.0)
+    least = (
+        jnp.clip(1.0 - cpu_frac, 0.0, 1.0) + jnp.clip(1.0 - mem_frac, 0.0, 1.0)
+    ) * 50.0
+
+    active_soft = pod.pod_sc & ~static.sc_hard
+    soft_counts = jnp.sum(
+        jnp.where(active_soft[:, None], counts_at, 0), axis=0
+    ).astype(jnp.float32)
+    spread_score = 100.0 / (1.0 + soft_counts)
+    has_soft = jnp.any(active_soft)
+    spread_score = jnp.where(has_soft, spread_score, 0.0)
+
+    pref_score = jnp.sum(
+        pod.pref_weight[:, None] * tcounts_at.astype(jnp.float32), axis=0
+    )
+
+    score = (
+        params.balanced_weight * balanced
+        + params.least_weight * least
+        + params.spread_weight * spread_score
+        + params.affinity_weight * pref_score
+        + params.static_weight * static.static_scores[pod.profile]
+    )
+    score = jnp.where(feasible, score, NEG_INF)
+
+    best = jnp.argmax(score)
+    found = jnp.any(feasible)
+    chosen = jnp.where(found, best, -1)
+    valid = found & pod.valid
+
+    # ---- commit (one-hot updates) ------------------------------------
+    onehot = (jnp.arange(n) == chosen) & valid
+    inc = onehot.astype(jnp.int32)
+    new_state = _State(
+        requested=state.requested + inc[:, None] * pod.request[None, :],
+        nonzero_requested=state.nonzero_requested
+        + inc[:, None] * pod.nonzero_request[None, :],
+        pod_count=state.pod_count + inc,
+        sc_counts=state.sc_counts.at[
+            jnp.arange(state.sc_counts.shape[0]),
+            static.sc_codes[:, jnp.maximum(chosen, 0)],
+        ].add((pod.pod_sc_match & valid).astype(jnp.int32)),
+        term_counts=state.term_counts.at[
+            jnp.arange(state.term_counts.shape[0]),
+            static.term_codes[:, jnp.maximum(chosen, 0)],
+        ].add((pod.match_by & valid).astype(jnp.int32)),
+        term_owners=state.term_owners.at[
+            jnp.arange(state.term_owners.shape[0]),
+            static.term_codes[:, jnp.maximum(chosen, 0)],
+        ].add((pod.own_anti & valid).astype(jnp.int32)),
+    )
+    return new_state, chosen
+
+
+@partial(jax.jit, static_argnames=("params",))
+def _solve(static: _Static, state: _State, pods: _PodIn, params: SolverParams):
+    final_state, assignments = jax.lax.scan(
+        partial(_step, static, params), state, pods
+    )
+    return final_state, assignments
+
+
+def solve_scan(
+    cluster: EncodedCluster, batch: EncodedBatch,
+    params: SolverParams = SolverParams(),
+):
+    """Run the scan solver. Returns (assignments [B] int32 node indices,
+    -1 = unschedulable/fallback)."""
+    n = cluster.allocatable.shape[0]
+    v = batch.num_values
+
+    sc_codes = np.minimum(
+        cluster.topo_codes[:, batch.sc_key_idx].T, v
+    ).astype(np.int32)
+    term_codes = np.minimum(
+        cluster.topo_codes[:, batch.term_key_idx].T, v
+    ).astype(np.int32)
+    node_valid = np.zeros(n, dtype=bool)
+    node_valid[: cluster.num_real_nodes] = True
+
+    static = _Static(
+        allocatable=jnp.asarray(cluster.allocatable),
+        max_pods=jnp.asarray(cluster.max_pods),
+        static_masks=jnp.asarray(batch.static_masks),
+        static_scores=jnp.asarray(batch.static_scores),
+        sc_codes=jnp.asarray(sc_codes),
+        sc_max_skew=jnp.asarray(batch.sc_max_skew),
+        sc_hard=jnp.asarray(batch.sc_hard),
+        sc_domain=jnp.asarray(batch.sc_domain),
+        term_codes=jnp.asarray(term_codes),
+        node_valid=jnp.asarray(node_valid),
+    )
+    state = _State(
+        requested=jnp.asarray(cluster.requested),
+        nonzero_requested=jnp.asarray(cluster.nonzero_requested),
+        pod_count=jnp.asarray(cluster.pod_count),
+        sc_counts=jnp.asarray(batch.sc_counts),
+        term_counts=jnp.asarray(batch.term_counts),
+        term_owners=jnp.asarray(batch.term_owners),
+    )
+    b = batch.requests.shape[0]
+    valid = np.zeros(b, dtype=bool)
+    valid[: batch.num_real_pods] = True
+    valid &= ~batch.inexpressible
+    pods = _PodIn(
+        request=jnp.asarray(batch.requests),
+        nonzero_request=jnp.asarray(batch.nonzero_requests),
+        profile=jnp.asarray(batch.profile_idx),
+        valid=jnp.asarray(valid),
+        pod_sc=jnp.asarray(batch.pod_sc),
+        pod_sc_match=jnp.asarray(batch.pod_sc_match),
+        match_by=jnp.asarray(batch.match_by),
+        own_aff=jnp.asarray(batch.own_aff),
+        own_anti=jnp.asarray(batch.own_anti),
+        pref_weight=jnp.asarray(batch.pref_weight),
+    )
+    _, assignments = _solve(static, state, pods, params)
+    return np.asarray(assignments)

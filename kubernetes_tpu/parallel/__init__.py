@@ -1,0 +1,4 @@
+from kubernetes_tpu.parallel.sharded import (
+    make_mesh,
+    solve_scan_sharded,
+)

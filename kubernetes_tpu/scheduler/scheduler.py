@@ -195,8 +195,17 @@ class Scheduler:
         return t
 
     def _loop(self) -> None:
+        import logging
+
+        logger = logging.getLogger("kubernetes_tpu.scheduler")
         while not self._stop.is_set():
-            self.schedule_one(pop_timeout=0.2)
+            try:
+                if self.batch_scheduler is not None:
+                    self.batch_scheduler.run_batch(pop_timeout=0.2)
+                else:
+                    self.schedule_one(pop_timeout=0.2)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("scheduling cycle failed; continuing")
 
     def stop(self) -> None:
         self._stop.set()
@@ -251,6 +260,14 @@ class Scheduler:
         if self.skip_pod_schedule(fwk, pod):
             return True
 
+        self.schedule_pod_serial(fwk, qpi)
+        return True
+
+    def schedule_pod_serial(self, fwk: Framework, qpi: QueuedPodInfo) -> None:
+        """The serial algorithm + commit for one popped pod (the body of
+        scheduleOne). Also the fallback path for pods the batch solver
+        declines."""
+        pod = qpi.pod
         pod_scheduling_cycle = self.queue.scheduling_cycle
         start = time.monotonic()
         state = CycleState()
@@ -263,15 +280,30 @@ class Scheduler:
         except fw.FitError as fit_err:
             self._handle_fit_error(fwk, state, qpi, fit_err, pod_scheduling_cycle)
             self.metrics.schedule_attempts.inc("unschedulable", fwk.profile_name)
-            return True
+            return
         except Exception as err:  # noqa: BLE001 - mirrors the error func path
             self._record_failure(fwk, qpi, err, "SchedulerError", "",
                                  pod_scheduling_cycle)
             self.metrics.schedule_attempts.inc("error", fwk.profile_name)
-            return True
+            return
 
         self.metrics.scheduling_algorithm_duration.observe(time.monotonic() - start)
+        self.commit_assignment(fwk, state, qpi, result, pod_scheduling_cycle,
+                               start)
 
+    def commit_assignment(
+        self,
+        fwk: Framework,
+        state: CycleState,
+        qpi: QueuedPodInfo,
+        result: ScheduleResult,
+        pod_scheduling_cycle: int,
+        start: float,
+        sync_bind: bool = False,
+    ) -> None:
+        """assume → Reserve → Permit → (async) binding cycle — the commit
+        half of scheduleOne, shared by the serial and batch paths."""
+        pod = qpi.pod
         # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
         assumed_pod = copy.copy(pod)
         assumed_pod.spec = copy.copy(pod.spec)
@@ -281,7 +313,7 @@ class Scheduler:
         except ValueError as err:
             self._record_failure(fwk, qpi, err, "SchedulerError", "",
                                  pod_scheduling_cycle)
-            return True
+            return
         self.queue.delete_nominated_pod_if_exists(pod)
 
         # Reserve
@@ -290,24 +322,29 @@ class Scheduler:
         if not fw.Status.is_ok(status):
             self._forget_and_fail(fwk, state, qpi, assumed_pod, result,
                                   status.as_error(), pod_scheduling_cycle)
-            return True
+            return
 
         # Permit
         status = fwk.run_permit_plugins(state, assumed_pod, result.suggested_host)
         if status is not None and status.code not in (fw.SUCCESS, fw.WAIT):
             self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
                                         status.as_error(), pod_scheduling_cycle)
-            return True
+            return
 
-        # binding cycle runs async (scheduler.go:540): the loop continues
         with self._inflight_lock:
             self._inflight_bindings += 1
         self.metrics.goroutines.inc("binding")
-        self._bind_pool.submit(
-            self._binding_cycle, fwk, state, qpi, assumed_pod, result,
-            pod_scheduling_cycle, start,
-        )
-        return True
+        if sync_bind and status is None:
+            # batch path: bindings are in-process; skipping the thread
+            # hop roughly halves per-pod commit cost
+            self._binding_cycle(fwk, state, qpi, assumed_pod, result,
+                                pod_scheduling_cycle, start)
+        else:
+            # binding cycle runs async (scheduler.go:540): the loop continues
+            self._bind_pool.submit(
+                self._binding_cycle, fwk, state, qpi, assumed_pod, result,
+                pod_scheduling_cycle, start,
+            )
 
     # ------------------------------------------------------------------
     def _binding_cycle(

@@ -1,0 +1,177 @@
+"""The TPU batch scheduler — the north-star component.
+
+Recasts the per-pod serial loop as batched constraint satisfaction on
+device (BASELINE.json north_star): drain the pending queue, ship the
+snapshot + pod batch to the JAX solver (``kubernetes_tpu.ops``), evaluate
+all predicates/scores as dense tensors, commit the returned assignments
+through the framework's assume → Reserve → Permit → Bind pipeline so every
+host-side contract (cache assume/TTL, volume reservations, gang permits,
+events, metrics) is preserved.
+
+Fallback contract (mirrors how extenders are ``IsIgnorable``,
+``core/extender.go:154``; SURVEY.md section 5): any pod the tensor model
+can't express — PVC volumes, host ports, foreign scheduler profiles — and
+any pod the device marks unschedulable goes through the UNMODIFIED serial
+path (``schedule_pod_serial``), which also supplies preemption. Disabling
+the ``TPUBatchScheduler`` feature gate removes the batch path entirely.
+
+Enable with::
+
+    sched = Scheduler.create(store, feature_gates=FeatureGates(
+        {"TPUBatchScheduler": True}))
+    attach_batch_scheduler(sched)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from kubernetes_tpu.ops.encode import BatchEncoder, is_host_only
+from kubernetes_tpu.ops.solver import SolverParams, solve_scan
+from kubernetes_tpu.scheduler.core import ScheduleResult
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.types import QueuedPodInfo
+
+
+class TPUBatchScheduler:
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        max_batch: int = 4096,
+        params: SolverParams = SolverParams(),
+        validate: bool = False,
+    ):
+        self.sched = scheduler
+        self.max_batch = max_batch
+        self.params = params
+        # differential-debug mode: re-check every device assignment with
+        # the host filter chain before committing
+        self.validate = validate
+
+    # ------------------------------------------------------------------
+    def _drain(self, pop_timeout: Optional[float]):
+        """Pop up to max_batch pods; first pop may block briefly. Each
+        pod's scheduling cycle is captured AT POP TIME (serial semantics:
+        the moveRequestCycle race rule compares against the cycle the pod
+        was popped in, scheduling_queue.go:317)."""
+        qpis: List[tuple] = []  # (QueuedPodInfo, pop-time cycle)
+        qpi = self.sched.queue.pop(timeout=pop_timeout)
+        while qpi is not None:
+            qpis.append((qpi, self.sched.queue.scheduling_cycle))
+            if len(qpis) >= self.max_batch:
+                break
+            qpi = self.sched.queue.pop(timeout=0.0)
+        return qpis
+
+    def run_batch(self, pop_timeout: Optional[float] = 0.2) -> int:
+        """One batch cycle. Returns the number of pods processed."""
+        sched = self.sched
+        qpis = self._drain(pop_timeout)
+        if not qpis:
+            return 0
+        start = time.monotonic()
+
+        # partition: batchable vs serial-fallback
+        batchable: List[tuple] = []
+        serial: List[QueuedPodInfo] = []
+        for qpi, cycle in qpis:
+            pod = qpi.pod
+            fwk = sched.profiles.get(pod.spec.scheduler_name)
+            if fwk is None:
+                continue
+            if sched.skip_pod_schedule(fwk, pod):
+                continue
+            if fwk.profile_name != "default-scheduler" or self._needs_serial(pod):
+                serial.append(qpi)
+            else:
+                batchable.append((qpi, cycle))
+
+        if batchable:
+            self._solve_and_commit(batchable, serial, start)
+
+        for qpi in serial:
+            fwk = sched.profiles[qpi.pod.spec.scheduler_name]
+            sched.schedule_pod_serial(fwk, qpi)
+        return len(qpis)
+
+    def _needs_serial(self, pod) -> bool:
+        if is_host_only(pod):
+            return True
+        return any(
+            ext.is_interested(pod) for ext in self.sched.algorithm.extenders
+        )
+
+    # ------------------------------------------------------------------
+    def _solve_and_commit(self, batchable: List[tuple],
+                          serial: List[QueuedPodInfo], start: float) -> None:
+        sched = self.sched
+        fwk = sched.profiles["default-scheduler"]
+
+        t0 = time.monotonic()
+        sched.algorithm.update_snapshot()
+        encoder = BatchEncoder(sched.algorithm.snapshot)
+        cluster, batch = encoder.encode([q.pod for q, _ in batchable])
+        sched.metrics.batch_solve_duration.observe(
+            time.monotonic() - t0, "encode"
+        )
+
+        t0 = time.monotonic()
+        assignments = solve_scan(cluster, batch, self.params)
+        sched.metrics.batch_solve_duration.observe(
+            time.monotonic() - t0, "solve"
+        )
+
+        t0 = time.monotonic()
+        for (qpi, cycle), assignment in zip(batchable, assignments):
+            if assignment < 0:
+                # device says unschedulable (or inexpressible): the serial
+                # path supplies exact statuses + preemption
+                serial.append(qpi)
+                continue
+            node_name = cluster.node_names[assignment]
+            if self.validate and not self._host_validates(fwk, qpi, node_name):
+                serial.append(qpi)
+                continue
+            result = ScheduleResult(
+                suggested_host=node_name,
+                evaluated_nodes=cluster.num_real_nodes,
+                feasible_nodes=1,
+            )
+            state = CycleState()
+            sched.commit_assignment(fwk, state, qpi, result, cycle, start,
+                                    sync_bind=True)
+        sched.metrics.batch_solve_duration.observe(
+            time.monotonic() - t0, "commit"
+        )
+
+    def _host_validates(self, fwk, qpi: QueuedPodInfo, node_name: str) -> bool:
+        from kubernetes_tpu.scheduler.framework import interface as fw_iface
+
+        state = CycleState()
+        status = fwk.run_pre_filter_plugins(state, qpi.pod)
+        if not fw_iface.Status.is_ok(status):
+            return False
+        ni = self.sched.algorithm.snapshot.get(node_name)
+        if ni is None:
+            return False
+        return fw_iface.Status.is_ok(
+            fwk.run_filter_plugins_with_nominated_pods(state, qpi.pod, ni)
+        )
+
+
+def attach_batch_scheduler(
+    sched: Scheduler,
+    max_batch: int = 4096,
+    params: SolverParams = SolverParams(),
+    validate: bool = False,
+) -> Optional[TPUBatchScheduler]:
+    """Install the batch path iff the TPUBatchScheduler gate is enabled
+    (the --feature-gates=TPUBatchScheduler wiring)."""
+    if not sched.feature_gates.enabled("TPUBatchScheduler"):
+        return None
+    bs = TPUBatchScheduler(sched, max_batch=max_batch, params=params,
+                           validate=validate)
+    sched.batch_scheduler = bs
+    return bs

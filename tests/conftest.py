@@ -1,13 +1,20 @@
-"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import (pytest loads conftest first).
+This environment's sitecustomize registers a TPU-tunnel PJRT plugin
+(platform "axon") in every interpreter and pins JAX_PLATFORMS=axon, so env
+vars set here are too late — the working override is jax.config.update
+AFTER import, BEFORE first backend use. XLA_FLAGS still applies because no
+backend has been initialized yet at conftest time.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

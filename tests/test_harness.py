@@ -1,0 +1,52 @@
+"""Perf-harness smoke tests (small scales; the real numbers come from
+bench.py on TPU hardware)."""
+
+import pytest
+
+from kubernetes_tpu.harness import WORKLOADS, make_workload, run_workload
+
+
+class TestHarness:
+    def test_scheduling_basic_serial(self):
+        ops = make_workload("SchedulingBasic", nodes=20, init_pods=10,
+                            measure_pods=30)
+        result = run_workload("SchedulingBasic", ops, use_batch=False,
+                              wait_timeout=60)
+        assert result.total_pods == 40
+        assert result.pods_per_second > 0
+
+    def test_scheduling_basic_batch(self):
+        ops = make_workload("SchedulingBasic", nodes=20, init_pods=10,
+                            measure_pods=30)
+        result = run_workload("SchedulingBasic", ops, use_batch=True,
+                              wait_timeout=120)
+        assert result.total_pods == 40
+        assert result.pods_per_second > 0
+
+    def test_topology_spreading_batch(self):
+        ops = make_workload("TopologySpreading", nodes=20, init_pods=0,
+                            measure_pods=20)
+        result = run_workload("TopologySpreading", ops, use_batch=True,
+                              wait_timeout=120)
+        assert result.measured_pods == 20
+
+    def test_unschedulable_leaves_pending(self):
+        ops = make_workload("Unschedulable", nodes=10, init_pods=5,
+                            measure_pods=10)
+        result = run_workload("Unschedulable", ops, use_batch=False,
+                              wait_timeout=60)
+        assert result.total_pods == 15
+
+    def test_data_items_shape(self):
+        ops = make_workload("SchedulingBasic", nodes=5, init_pods=0,
+                            measure_pods=5)
+        result = run_workload("SchedulingBasic", ops, wait_timeout=60)
+        items = result.data_items()
+        assert items["version"] == "v1"
+        metrics = {i["labels"]["Metric"] for i in items["dataItems"]}
+        assert "SchedulingThroughput" in metrics
+
+    @pytest.mark.parametrize("name", sorted(WORKLOADS))
+    def test_all_workloads_build(self, name):
+        ops = make_workload(name, nodes=10, init_pods=4, measure_pods=4)
+        assert any(op["opcode"] == "createPods" for op in ops)

@@ -1,0 +1,159 @@
+"""Batch-path integration tests: the TPUBatchScheduler gate, commit
+pipeline, and clean fallback to the serial path."""
+
+import time
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.feature_gates import FeatureGates
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.sidecar import attach_batch_scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def make_batch_scheduler(store, validate=False, gate=True):
+    sched = Scheduler.create(
+        store, feature_gates=FeatureGates({"TPUBatchScheduler": gate})
+    )
+    bs = attach_batch_scheduler(sched, validate=validate)
+    sched.start()
+    return sched, bs
+
+
+def drain_batches(sched, bs, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sched.queue.flush_backoff_completed()
+        if bs.run_batch(pop_timeout=0.0):
+            continue
+        if sched.queue.num_active() == 0 and sched.queue.num_backoff() == 0:
+            break
+        time.sleep(0.05)
+    assert sched.wait_for_inflight_bindings()
+
+
+class TestGate:
+    def test_gate_off_returns_none(self):
+        sched = Scheduler.create(ClusterStore())
+        assert attach_batch_scheduler(sched) is None
+        assert sched.batch_scheduler is None
+
+
+class TestBatchScheduling:
+    def test_batch_binds_all(self):
+        store = ClusterStore()
+        for i in range(10):
+            store.add_node(
+                MakeNode().name(f"n{i}").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store)
+        for i in range(40):
+            store.create_pod(MakePod().name(f"p{i}").req({"cpu": "1"}).obj())
+        drain_batches(sched, bs)
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 40
+        # capacity respected: 8 cpu per node, 1 cpu pods -> max 8/node
+        per_node = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(c <= 8 for c in per_node.values())
+        sched.stop()
+
+    def test_batch_respects_spread(self):
+        store = ClusterStore()
+        for z in ("za", "zb", "zc"):
+            for i in range(2):
+                store.add_node(
+                    MakeNode().name(f"{z}-{i}")
+                    .label("topology.kubernetes.io/zone", z)
+                    .capacity({"cpu": "16", "memory": "32Gi"}).obj()
+                )
+        sched, bs = make_batch_scheduler(store, validate=True)
+        for i in range(9):
+            store.create_pod(
+                MakePod().name(f"s{i}").label("app", "web").req({"cpu": "1"})
+                .spread_constraint(
+                    1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "web"},
+                ).obj()
+            )
+        drain_batches(sched, bs)
+        zones = {}
+        for p in store.list_pods():
+            assert p.spec.node_name, f"{p.name} not bound"
+            z = p.spec.node_name.split("-")[0]
+            zones[z] = zones.get(z, 0) + 1
+        assert all(c == 3 for c in zones.values()), zones
+        sched.stop()
+
+    def test_unschedulable_falls_back_with_status(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n0").capacity({"cpu": "1", "memory": "2Gi"}).obj())
+        sched, bs = make_batch_scheduler(store)
+        store.create_pod(MakePod().name("big").req({"cpu": "64"}).obj())
+        bs.run_batch(pop_timeout=0.1)
+        assert sched.wait_for_inflight_bindings()
+        pod = store.get_pod("default", "big")
+        conds = {c.type: c for c in pod.status.conditions}
+        assert "Insufficient cpu" in conds["PodScheduled"].message
+        assert sched.queue.num_unschedulable() == 1
+        sched.stop()
+
+    def test_pvc_pod_takes_serial_path(self):
+        from kubernetes_tpu.api.types import (
+            PersistentVolume,
+            PersistentVolumeClaim,
+            ObjectMeta,
+            StorageClass,
+        )
+        from kubernetes_tpu.api.resource import parse_quantity
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n0").capacity({"cpu": "8", "memory": "16Gi"}).obj())
+        store.add_storage_class(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner="x",
+                         volume_binding_mode="WaitForFirstConsumer")
+        )
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name="pv1"),
+            capacity={"storage": parse_quantity("10Gi")},
+            storage_class_name="fast",
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="claim", namespace="default"),
+            storage_class_name="fast",
+            requests={"storage": parse_quantity("5Gi")},
+        ))
+        sched, bs = make_batch_scheduler(store)
+        store.create_pod(MakePod().name("p").req({"cpu": "1"}).pvc("claim").obj())
+        drain_batches(sched, bs)
+        assert store.get_pod("default", "p").spec.node_name == "n0"
+        # volume got bound through Reserve/PreBind
+        assert store.get_pvc("default", "claim").volume_name == "pv1"
+        sched.stop()
+
+    def test_preemption_via_fallback(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n0").capacity({"cpu": "2", "memory": "4Gi"}).obj())
+        sched, bs = make_batch_scheduler(store)
+        store.create_pod(MakePod().name("victim").priority(1).req({"cpu": "2"}).obj())
+        drain_batches(sched, bs)
+        store.create_pod(MakePod().name("vip").priority(100).req({"cpu": "2"}).obj())
+        drain_batches(sched, bs)
+        assert store.get_pod("default", "victim") is None
+        assert store.get_pod("default", "vip").spec.node_name == "n0"
+        sched.stop()
+
+    def test_mixed_batch_and_serial(self):
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store)
+        for i in range(10):
+            store.create_pod(MakePod().name(f"b{i}").req({"cpu": "500m"}).obj())
+        # host-port pod must take the serial path
+        store.create_pod(MakePod().name("hp").req({"cpu": "500m"}).host_port(8080).obj())
+        drain_batches(sched, bs)
+        assert all(p.spec.node_name for p in store.list_pods())
+        sched.stop()
