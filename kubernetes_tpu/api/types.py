@@ -561,13 +561,48 @@ class StorageClass:
 
 
 @dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
 
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_pod: str = ""  # "ns/name" of the backing pod
+
+
+@dataclass
+class Endpoints:
+    """Service backend addresses (reference core/v1 Endpoints, maintained
+    by the endpoints controller and consumed by kube-proxy)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[ServicePort] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
 
 
 @dataclass

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from kubernetes_tpu.api.types import (
     CSINode,
+    Endpoints,
     Node,
     PersistentVolume,
     PersistentVolumeClaim,
@@ -78,6 +79,7 @@ class ClusterStore:
         self._storage_classes: Dict[str, StorageClass] = {}
         self._csi_nodes: Dict[str, CSINode] = {}
         self._pdbs: Dict[str, PodDisruptionBudget] = {}
+        self._endpoints: Dict[str, Endpoints] = {}
         self._leases: Dict[str, _Lease] = {}
         self._watches: List[WatchHandle] = []
         self._assumed_pvs: Dict[str, str] = {}  # pv name -> pvc key (Reserve)
@@ -290,6 +292,59 @@ class ClusterStore:
     def get_csi_node(self, name: str) -> Optional[CSINode]:
         with self._lock:
             return self._csi_nodes.get(name)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self._delete(self._services, "Service", f"{namespace}/{name}")
+
+    def list_all_services(self) -> List[Service]:
+        with self._lock:
+            return list(self._services.values())
+
+    def delete_replica_set(self, namespace: str, name: str) -> None:
+        self._delete(self._rss, "ReplicaSet", f"{namespace}/{name}")
+
+    def list_all_replica_sets(self) -> List[ReplicaSet]:
+        with self._lock:
+            return list(self._rss.values())
+
+    def get_replica_set(self, namespace: str, name: str) -> Optional[ReplicaSet]:
+        with self._lock:
+            return self._rss.get(f"{namespace}/{name}")
+
+    def list_all_replication_controllers(self) -> List[ReplicationController]:
+        with self._lock:
+            return list(self._rcs.values())
+
+    def list_all_stateful_sets(self) -> List[StatefulSet]:
+        with self._lock:
+            return list(self._sss.values())
+
+    def list_all_pvcs(self) -> List[PersistentVolumeClaim]:
+        with self._lock:
+            return list(self._pvcs.values())
+
+    def list_storage_classes(self) -> List[StorageClass]:
+        with self._lock:
+            return list(self._storage_classes.values())
+
+    def list_csi_nodes(self) -> List[CSINode]:
+        with self._lock:
+            return list(self._csi_nodes.values())
+
+    def upsert_endpoints(self, ep: Endpoints) -> None:
+        self._upsert(self._endpoints, "Endpoints",
+                     f"{ep.namespace}/{ep.name}", ep)
+
+    def delete_endpoints(self, namespace: str, name: str) -> None:
+        self._delete(self._endpoints, "Endpoints", f"{namespace}/{name}")
+
+    def get_endpoints(self, namespace: str, name: str) -> Optional[Endpoints]:
+        with self._lock:
+            return self._endpoints.get(f"{namespace}/{name}")
+
+    def list_endpoints(self) -> List[Endpoints]:
+        with self._lock:
+            return list(self._endpoints.values())
 
     def add_pdb(self, pdb: PodDisruptionBudget) -> None:
         self._upsert(self._pdbs, "PodDisruptionBudget",

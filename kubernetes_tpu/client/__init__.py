@@ -1,0 +1,34 @@
+"""Client layer: informers/listers, workqueues, leader election.
+
+The focused re-implementation of the reference's ``client-go`` surface the
+control plane actually uses (SURVEY.md section 2.6): typed object store +
+watch-driven delta feed + event handlers, rate-limited work queues, and
+lease-based leader election.
+"""
+
+from kubernetes_tpu.client.informers import (
+    Lister,
+    ResourceEventHandler,
+    SharedInformer,
+    SharedInformerFactory,
+)
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.client.workqueue import (
+    DelayingQueue,
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+    WorkQueue,
+)
+
+__all__ = [
+    "DelayingQueue",
+    "ItemExponentialFailureRateLimiter",
+    "LeaderElectionConfig",
+    "LeaderElector",
+    "Lister",
+    "RateLimitingQueue",
+    "ResourceEventHandler",
+    "SharedInformer",
+    "SharedInformerFactory",
+    "WorkQueue",
+]

@@ -1,0 +1,301 @@
+"""Shared informers + listers over the cluster store's watch feed.
+
+Behavioral equivalent of the reference's client-go informer machinery
+(``tools/cache/reflector.go:254`` ListAndWatch → DeltaFIFO →
+``tools/cache/controller.go:127`` sharedIndexInformer.processLoop →
+registered event handlers), collapsed for an in-process store: the initial
+List is replayed as synthetic ADDED deltas, then live watch events append
+to a per-factory delta FIFO drained by one dispatch thread, so handler
+ordering matches event ordering and handlers never run under the store
+lock.
+
+Listers read the informer's thread-safe indexer (the reference's
+``tools/cache/thread_safe_store.go``) — they see the informer's view, not
+the store's, exactly like client-go.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, ClusterStore, Event
+
+_logger = logging.getLogger(__name__)
+
+
+class ResourceEventHandler:
+    """Handler triple (reference ResourceEventHandlerFuncs)."""
+
+    def __init__(self, on_add=None, on_update=None, on_delete=None,
+                 filter_fn: Optional[Callable[[Any], bool]] = None):
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self.filter_fn = filter_fn
+
+    def handle(self, event: Event) -> None:
+        if self.filter_fn is not None and not self.filter_fn(event.obj):
+            # FilteringResourceEventHandler: an update moving the object
+            # out of the filter set is delivered as a delete (and into it,
+            # as an add) — reference tools/cache/controller.go:221-255.
+            if (
+                event.type == MODIFIED
+                and event.old_obj is not None
+                and self.filter_fn(event.old_obj)
+                and self.on_delete is not None
+            ):
+                self.on_delete(event.obj)
+            return
+        if event.type == ADDED and self.on_add is not None:
+            self.on_add(event.obj)
+        elif event.type == MODIFIED:
+            if (
+                self.filter_fn is not None
+                and event.old_obj is not None
+                and not self.filter_fn(event.old_obj)
+            ):
+                if self.on_add is not None:
+                    self.on_add(event.obj)
+            elif self.on_update is not None:
+                self.on_update(event.old_obj, event.obj)
+        elif event.type == DELETED and self.on_delete is not None:
+            self.on_delete(event.obj)
+
+
+# cluster-scoped kinds key by bare name; everything else by namespace/name
+# (ObjectMeta defaults namespace to "default" even for cluster-scoped
+# objects, so scoping must be decided by kind, not by metadata shape)
+_CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode"}
+
+
+def _meta_key(kind: str, obj: Any) -> str:
+    meta = obj.metadata
+    if kind in _CLUSTER_SCOPED:
+        return meta.name
+    return f"{meta.namespace}/{meta.name}"
+
+
+class Indexer:
+    """Thread-safe key→object map with namespace listing."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._items: Dict[str, Any] = {}
+
+    def replace(self, objs: List[Any]) -> None:
+        with self._lock:
+            self._items = {_meta_key(self.kind, o): o for o in objs}
+
+    def upsert(self, obj: Any) -> None:
+        with self._lock:
+            self._items[_meta_key(self.kind, obj)] = obj
+
+    def delete(self, obj: Any) -> None:
+        with self._lock:
+            self._items.pop(_meta_key(self.kind, obj), None)
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._items.values())
+
+    def list_keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+
+class SharedInformer:
+    """One kind's informer: indexer + handler fan-out."""
+
+    def __init__(self, kind: str, list_fn: Callable[[], List[Any]]):
+        self.kind = kind
+        self._list_fn = list_fn
+        self.indexer = Indexer(kind)
+        self._handlers: List[ResourceEventHandler] = []
+        self._synced = False
+
+    def add_event_handler(self, on_add=None, on_update=None, on_delete=None,
+                          filter_fn=None) -> ResourceEventHandler:
+        h = ResourceEventHandler(on_add, on_update, on_delete, filter_fn)
+        self._handlers.append(h)
+        return h
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    # -- called by the factory dispatch thread -------------------------
+    def _sync(self) -> List[Event]:
+        objs = self._list_fn()
+        self.indexer.replace(objs)
+        self._synced = True
+        return [Event(ADDED, self.kind, o) for o in objs]
+
+    def _apply(self, event: Event) -> None:
+        if event.type == DELETED:
+            self.indexer.delete(event.obj)
+        else:
+            self.indexer.upsert(event.obj)
+
+    def _dispatch(self, event: Event) -> None:
+        for h in list(self._handlers):
+            h.handle(event)
+
+
+class Lister:
+    """Reads an informer's indexer (reference listers/core/v1)."""
+
+    def __init__(self, informer: SharedInformer):
+        self._informer = informer
+
+    def list(self) -> List[Any]:
+        return self._informer.indexer.list()
+
+    def get(self, name: str, namespace: str = "default") -> Optional[Any]:
+        if self._informer.kind in _CLUSTER_SCOPED:
+            return self._informer.indexer.get(name)
+        return self._informer.indexer.get(f"{namespace}/{name}")
+
+    def by_namespace(self, namespace: str) -> List[Any]:
+        return [
+            o for o in self._informer.indexer.list()
+            if getattr(o.metadata, "namespace", "") == namespace
+        ]
+
+
+# kind -> ClusterStore list method name
+_KIND_LISTS = {
+    "Pod": "list_pods",
+    "Node": "list_nodes",
+    "Service": "list_all_services",
+    "ReplicaSet": "list_all_replica_sets",
+    "ReplicationController": "list_all_replication_controllers",
+    "StatefulSet": "list_all_stateful_sets",
+    "PersistentVolume": "list_pvs",
+    "PersistentVolumeClaim": "list_all_pvcs",
+    "StorageClass": "list_storage_classes",
+    "CSINode": "list_csi_nodes",
+    "PodDisruptionBudget": "list_pdbs",
+    "Endpoints": "list_endpoints",
+}
+
+
+class SharedInformerFactory:
+    """Per-store informer factory (reference informers.NewSharedInformerFactory).
+
+    ``start()`` replays the initial List into every requested informer and
+    begins draining live watch events on a dispatch thread;
+    ``wait_for_cache_sync()`` blocks until the replay completed.
+    """
+
+    def __init__(self, store: ClusterStore):
+        self._store = store
+        self._informers: Dict[str, SharedInformer] = {}
+        self._lock = threading.Lock()
+        self._deltas: deque = deque()
+        self._cond = threading.Condition(self._lock)
+        self._watch_handle = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._synced_event = threading.Event()
+        self._pending_sync: List[SharedInformer] = []
+
+    def informer_for(self, kind: str) -> SharedInformer:
+        with self._cond:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = SharedInformer(kind, getattr(self._store, _KIND_LISTS[kind]))
+                self._informers[kind] = inf
+                if self._thread is not None:
+                    # registered after start(): sync on the dispatch thread
+                    self._pending_sync.append(inf)
+                    self._cond.notify()
+            return inf
+
+    def lister_for(self, kind: str) -> Lister:
+        return Lister(self.informer_for(kind))
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._watch_handle = self._store.watch(self._enqueue)
+        self._thread = threading.Thread(target=self._process_loop, daemon=True,
+                                        name="informer-factory")
+        self._thread.start()
+
+    def _enqueue(self, event: Event) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._deltas.append(event)
+            self._cond.notify()
+
+    def _process_loop(self) -> None:
+        # initial list replay (the List half of ListAndWatch). Live events
+        # that arrived before/while listing are processed afterwards; the
+        # replay-dedup below keeps them from double-firing handlers.
+        for inf in list(self._informers.values()):
+            self._sync_one(inf)
+        self._synced_event.set()
+        while True:
+            with self._cond:
+                while (not self._deltas and not self._pending_sync
+                       and not self._stopped):
+                    self._cond.wait(0.5)
+                if self._stopped and not self._deltas:
+                    return
+                pending, self._pending_sync = self._pending_sync, []
+                event = self._deltas.popleft() if self._deltas else None
+            for inf in pending:  # informers registered after start()
+                self._sync_one(inf)
+            if event is None:
+                continue
+            inf = self._informers.get(event.kind)
+            if inf is None or not inf.has_synced():
+                continue
+            # replay dedup: an ADDED that raced the initial list is already
+            # in the indexer at the same resource version — skip it.
+            if event.type == ADDED:
+                existing = inf.indexer.get(_meta_key(inf.kind, event.obj))
+                if (existing is not None
+                        and existing.metadata.resource_version
+                        == event.obj.metadata.resource_version):
+                    continue
+            inf._apply(event)
+            self._dispatch_guarded(inf, event)
+
+    def _sync_one(self, inf: SharedInformer) -> None:
+        try:
+            for ev in inf._sync():
+                self._dispatch_guarded(inf, ev)
+        except Exception:  # noqa: BLE001 — the dispatch thread must survive
+            _logger.exception("informer %s initial sync failed", inf.kind)
+
+    @staticmethod
+    def _dispatch_guarded(inf: SharedInformer, event: Event) -> None:
+        try:
+            inf._dispatch(event)
+        except Exception:  # noqa: BLE001 — a bad handler must not kill
+            _logger.exception("event handler failed for %s %s",
+                              event.kind, event.type)
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced_event.wait(timeout)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._watch_handle is not None:
+            self._watch_handle.stop()
+            self._watch_handle = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,98 @@
+"""Lease-based leader election.
+
+Behavioral equivalent of the reference's
+``client-go/tools/leaderelection/leaderelection.go``: candidates race to
+acquire/renew a Lease record; only the holder runs its workload; losing
+the lease mid-run invokes ``on_stopped_leading`` (the reference
+``klog.Fatalf``s there — ``cmd/kube-scheduler/app/server.go:205`` — we
+leave the reaction to the caller so hollow control planes can restart).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.utils.clock import RealClock
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_name: str = "kube-scheduler"
+    identity: str = "scheduler-0"
+    lease_duration: float = 15.0   # reference defaults: 15s/10s/2s
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+    on_started_leading: Optional[Callable[[], None]] = None
+    on_stopped_leading: Optional[Callable[[], None]] = None
+    on_new_leader: Optional[Callable[[str], None]] = field(default=None)
+
+
+class LeaderElector:
+    def __init__(self, store: ClusterStore, config: LeaderElectionConfig,
+                 clock=None):
+        self._store = store
+        self.config = config
+        self._clock = clock or RealClock()
+        self._stop = threading.Event()
+        self._is_leader = False
+        self._observed_leader = ""
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def try_acquire_or_renew(self) -> bool:
+        ok = self._store.try_acquire_or_renew(
+            self.config.lock_name, self.config.identity,
+            self._clock.now(), self.config.lease_duration,
+        )
+        holder = self._store.lease_holder(self.config.lock_name) or ""
+        if holder != self._observed_leader:
+            self._observed_leader = holder
+            if self.config.on_new_leader is not None:
+                self.config.on_new_leader(holder)
+        return ok
+
+    def run(self) -> None:
+        """Blocks: acquire loop → leading callback → renew loop."""
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                self._is_leader = True
+                if self.config.on_started_leading is not None:
+                    # the reference runs OnStartedLeading in its own
+                    # goroutine so a blocking workload can't starve renewal
+                    threading.Thread(
+                        target=self.config.on_started_leading,
+                        daemon=True, name="leading",
+                    ).start()
+                self._renew_loop()
+                self._is_leader = False
+                if self.config.on_stopped_leading is not None:
+                    self.config.on_stopped_leading()
+                if self._stop.is_set():
+                    return
+            self._stop.wait(self.config.retry_period)
+
+    def run_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True, name="leader-elect")
+        t.start()
+        return t
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            deadline = self._clock.now() + self.config.renew_deadline
+            renewed = False
+            while self._clock.now() < deadline and not self._stop.is_set():
+                if self.try_acquire_or_renew():
+                    renewed = True
+                    break
+                self._stop.wait(self.config.retry_period)
+            if not renewed:
+                return  # lost the lease
+            self._stop.wait(self.config.retry_period)
+
+    def stop(self) -> None:
+        self._stop.set()

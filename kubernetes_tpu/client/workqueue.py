@@ -1,0 +1,181 @@
+"""Work queues: FIFO-with-dedup, delaying, and rate-limited variants.
+
+Behavioral equivalent of the reference's ``client-go/util/workqueue``
+(``queue.go`` Type with dirty/processing sets, ``delaying_queue.go``,
+``default_rate_limiters.go`` ItemExponentialFailureRateLimiter +
+MaxOfRateLimiter), which every controller uses to decouple informer event
+delivery from reconciliation: an item enqueued many times while being
+processed is re-processed exactly once more.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class WorkQueue:
+    """FIFO queue with the dirty/processing dedup protocol.
+
+    - ``add`` while the item is queued (dirty) is a no-op;
+    - ``add`` while the item is being processed marks it dirty so ``done``
+      re-queues it once;
+    - ``get`` blocks until an item or shutdown.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutting_down = False
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Returns the next item, or None on shutdown/timeout. Callers must
+        pair every successful get with ``done``."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutting_down:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining)
+            if not self._queue:
+                return None
+            item = self._queue.popleft()
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._lock:
+            return self._shutting_down
+
+
+class DelayingQueue(WorkQueue):
+    """WorkQueue + ``add_after``: deliver an item once its delay elapses
+    (reference ``delaying_queue.go`` waitingLoop with a heap)."""
+
+    def __init__(self, clock=None):
+        super().__init__()
+        from kubernetes_tpu.utils.clock import RealClock
+
+        self._clock = clock or RealClock()
+        self._waiting: List[tuple] = []  # (ready_time, seq, item)
+        self._seq = 0
+        self._waiting_cond = threading.Condition()
+        self._waiter = threading.Thread(target=self._wait_loop, daemon=True,
+                                        name="delaying-queue")
+        self._waiter_started = False
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if self.shutting_down:
+            return
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._waiting_cond:
+            heapq.heappush(
+                self._waiting, (self._clock.now() + delay, self._seq, item)
+            )
+            self._seq += 1
+            if not self._waiter_started:
+                self._waiter.start()
+                self._waiter_started = True
+            self._waiting_cond.notify()
+
+    def _wait_loop(self) -> None:
+        # sleep until the earliest waiting item is due (or a new item
+        # arrives with an earlier deadline) — reference waitingLoop.
+        while not self.shutting_down:
+            with self._waiting_cond:
+                now = self._clock.now()
+                while self._waiting and self._waiting[0][0] <= now:
+                    _, _, item = heapq.heappop(self._waiting)
+                    self.add(item)
+                if self._waiting:
+                    # cap the wait so FakeClock-driven tests still progress
+                    timeout = min(self._waiting[0][0] - self._clock.now(), 0.05)
+                else:
+                    timeout = 1.0
+                self._waiting_cond.wait(timeout)
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        with self._waiting_cond:
+            self._waiting_cond.notify_all()
+
+
+class ItemExponentialFailureRateLimiter:
+    """Per-item exponential backoff: base * 2^failures, capped."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._base = base_delay
+        self._max = max_delay
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+            return min(self._base * (2 ** n), self._max)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class RateLimitingQueue(DelayingQueue):
+    """DelayingQueue + a rate limiter (reference ``rate_limiting_queue.go``)."""
+
+    def __init__(self, rate_limiter: Optional[ItemExponentialFailureRateLimiter] = None,
+                 clock=None):
+        super().__init__(clock=clock)
+        self.rate_limiter = rate_limiter or ItemExponentialFailureRateLimiter()
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self.rate_limiter.num_requeues(item)

@@ -59,7 +59,9 @@ class ThroughputCollector:
             self._thread.join(timeout=2.0)
 
     def summary(self) -> Dict[str, float]:
-        samples = [s for s in self.samples if s > 0] or [0.0]
+        # every 1 Hz sample counts, including idle ones (util.go appends
+        # unconditionally) — dropping zeros would overstate burst paths
+        samples = list(self.samples) or [0.0]
         return {
             "Average": sum(samples) / len(samples),
             "Perc50": _percentile(samples, 0.50),
@@ -117,19 +119,26 @@ def run_workload(
     bs = attach_batch_scheduler(sched, max_batch=max_batch) if use_batch else None
     sched.start()
 
-    def pump_until_scheduled(target: int, deadline: float) -> None:
-        """Drive scheduling until `target` pods are bound."""
+    def pump_until_quiescent(deadline: float) -> None:
+        """Drive scheduling until every pending pod is either bound or has
+        been tried and parked unschedulable (the active/backoff queues are
+        drained and no bindings are in flight). This tracks pods deleted
+        mid-run by preemption — a fixed bound-count target would not."""
         while time.monotonic() < deadline:
             sched.queue.flush_backoff_completed()
             if bs is not None:
                 progressed = bs.run_batch(pop_timeout=0.01)
             else:
                 progressed = sched.schedule_one(pop_timeout=0.01)
-            if not progressed:
-                bound = sum(1 for p in store.list_pods() if p.spec.node_name)
-                if bound >= target:
+            if progressed:
+                continue
+            if sched.queue.pending_active_count() == 0:
+                # async bind failures re-queue; settle them, then re-check
+                sched.wait_for_inflight_bindings(timeout=10.0)
+                sched.queue.flush_backoff_completed()
+                if sched.queue.pending_active_count() == 0:
                     return
-                time.sleep(0.005)
+            time.sleep(0.005)
         raise TimeoutError(
             f"workload {name}: not all pods scheduled before deadline"
         )
@@ -163,13 +172,9 @@ def run_workload(
                 if progress:
                     progress(f"{name}: {created_pods} pods created")
                 if not op.get("skipWaitToCompletion", False):
-                    target = _schedulable_target(store)
-                    pump_until_scheduled(
-                        target, time.monotonic() + wait_timeout
-                    )
+                    pump_until_quiescent(time.monotonic() + wait_timeout)
             elif opcode == "barrier":
-                target = _schedulable_target(store)
-                pump_until_scheduled(target, time.monotonic() + wait_timeout)
+                pump_until_quiescent(time.monotonic() + wait_timeout)
             else:
                 raise ValueError(f"unknown opcode {opcode!r}")
         sched.wait_for_inflight_bindings(timeout=30.0)
@@ -194,18 +199,6 @@ def run_workload(
         throughput=collector.summary() if collector else {},
         metrics=metrics,
     )
-
-
-def _schedulable_target(store: ClusterStore) -> int:
-    """Pods that can possibly schedule (Unschedulable workloads leave
-    impossible pods pending on purpose)."""
-    total = 0
-    for p in store.list_pods():
-        if p.spec.node_name:
-            total += 1
-        elif p.spec.node_selector.get("no-such-label") != "true":
-            total += 1
-    return total
 
 
 def write_json(result: BenchmarkResult, path: str) -> None:

@@ -346,6 +346,12 @@ class SchedulingQueue(PodNominator):
         with self._qlock:
             return len(self._unschedulable_q)
 
+    def pending_active_count(self) -> int:
+        """Pods still due a scheduling attempt (active + backoff); pods
+        parked in unschedulableQ have been tried and wait on events."""
+        with self._qlock:
+            return len(self._active_q) + len(self._backoff_q)
+
 
 def _pod_updated_may_help(old: Pod, new: Pod) -> bool:
     """Reference isPodUpdated: strip ResourceVersion/Status-y fields and

@@ -24,8 +24,11 @@ Enable with::
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import List, Optional
+
+_logger = logging.getLogger(__name__)
 
 from kubernetes_tpu.ops.encode import BatchEncoder, is_host_only
 from kubernetes_tpu.ops.solver import SolverParams, solve_scan
@@ -89,10 +92,24 @@ class TPUBatchScheduler:
                 batchable.append((qpi, cycle))
 
         if batchable:
-            self._solve_and_commit(batchable, serial, start)
+            try:
+                self._solve_and_commit(batchable, serial, start)
+            except Exception:  # noqa: BLE001 — popped pods must not be lost
+                _logger.exception(
+                    "batch solve failed; %d pods fall back to the serial path",
+                    len(batchable),
+                )
+                serial.extend(q for q, _ in batchable)
 
+        seen = set()
         for qpi in serial:
+            if qpi.pod.full_name() in seen:
+                continue  # appended both pre- and post-solve-failure
+            seen.add(qpi.pod.full_name())
             fwk = sched.profiles[qpi.pod.spec.scheduler_name]
+            # a partial batch commit may already have assumed some of these
+            if sched.skip_pod_schedule(fwk, qpi.pod):
+                continue
             sched.schedule_pod_serial(fwk, qpi)
         return len(qpis)
 

@@ -1,0 +1,206 @@
+"""Client layer: workqueue dedup/backoff, informers, leader election."""
+
+import threading
+import time
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client import (
+    ItemExponentialFailureRateLimiter,
+    LeaderElectionConfig,
+    LeaderElector,
+    RateLimitingQueue,
+    SharedInformerFactory,
+    WorkQueue,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+# ---------------------------------------------------------------- workqueue
+def test_workqueue_dedups_while_queued():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+    assert q.get() == "a"
+    q.done("a")
+    assert q.get() == "b"
+
+
+def test_workqueue_requeues_item_added_during_processing():
+    q = WorkQueue()
+    q.add("a")
+    item = q.get()
+    q.add("a")          # arrives while processing -> marked dirty
+    assert len(q) == 0  # not queued yet
+    q.done(item)
+    assert q.get(timeout=1) == "a"  # exactly one re-delivery
+
+
+def test_workqueue_get_timeout_and_shutdown():
+    q = WorkQueue()
+    assert q.get(timeout=0.05) is None
+    q.shutdown()
+    assert q.get() is None
+    q.add("x")  # add after shutdown is dropped
+    assert len(q) == 0
+
+
+def test_rate_limiting_queue_backoff_and_forget():
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.01, max_delay=1.0)
+    assert rl.when("x") == 0.01
+    assert rl.when("x") == 0.02
+    assert rl.num_requeues("x") == 2
+    rl.forget("x")
+    assert rl.when("x") == 0.01
+
+    q = RateLimitingQueue(
+        ItemExponentialFailureRateLimiter(base_delay=0.02, max_delay=1.0)
+    )
+    q.add_rate_limited("a")
+    assert q.get(timeout=0.005) is None  # still delayed
+    assert q.get(timeout=2.0) == "a"
+    q.shutdown()
+
+
+# ---------------------------------------------------------------- informers
+def _mkstore():
+    store = ClusterStore()
+    store.add_node(MakeNode().name("n1").capacity({"cpu": "4"}).obj())
+    store.create_pod(MakePod().name("p1").uid("u1").obj())
+    return store
+
+
+def test_informer_initial_list_and_live_events():
+    store = _mkstore()
+    factory = SharedInformerFactory(store)
+    adds, deletes = [], []
+    pods = factory.informer_for("Pod")
+    pods.add_event_handler(on_add=lambda o: adds.append(o.name),
+                           on_delete=lambda o: deletes.append(o.name))
+    node_lister = factory.lister_for("Node")
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    assert adds == ["p1"]                      # replayed initial list
+    assert [n.name for n in node_lister.list()] == ["n1"]
+
+    store.create_pod(MakePod().name("p2").uid("u2").obj())
+    store.delete_pod("default", "p1")
+    deadline = time.monotonic() + 5
+    while (adds, deletes) != (["p1", "p2"], ["p1"]):
+        if time.monotonic() > deadline:
+            raise AssertionError(f"events not delivered: {adds}, {deletes}")
+        time.sleep(0.01)
+    assert factory.lister_for("Pod").get("p2", "default") is not None
+    assert factory.lister_for("Pod").get("p1", "default") is None
+    factory.stop()
+
+
+def test_informer_filter_handler_add_delete_transitions():
+    store = ClusterStore()
+    factory = SharedInformerFactory(store)
+    events = []
+    pods = factory.informer_for("Pod")
+    pods.add_event_handler(
+        on_add=lambda o: events.append(("add", o.name)),
+        on_delete=lambda o: events.append(("del", o.name)),
+        filter_fn=lambda p: bool(p.spec.node_name),  # only assigned pods
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+
+    pod = MakePod().name("p").uid("u").obj()
+    store.create_pod(pod)                 # unassigned: filtered out
+    store.bind("default", "p", "u", "n1")  # now assigned: delivered as add
+    deadline = time.monotonic() + 5
+    while events != [("add", "p")]:
+        if time.monotonic() > deadline:
+            raise AssertionError(f"unexpected events: {events}")
+        time.sleep(0.01)
+    factory.stop()
+
+
+def test_informer_registered_after_start_still_syncs():
+    store = _mkstore()
+    factory = SharedInformerFactory(store)
+    factory.informer_for("Pod")
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    # late registration: must replay the existing list and get live events
+    node_lister = factory.lister_for("Node")
+    deadline = time.monotonic() + 5
+    while not [n.name for n in node_lister.list()] == ["n1"]:
+        if time.monotonic() > deadline:
+            raise AssertionError("late informer never synced")
+        time.sleep(0.01)
+    store.add_node(MakeNode().name("n2").capacity({"cpu": "4"}).obj())
+    while node_lister.get("n2") is None:
+        if time.monotonic() > deadline:
+            raise AssertionError("late informer missed live event")
+        time.sleep(0.01)
+    factory.stop()
+
+
+def test_informer_survives_handler_exception():
+    store = _mkstore()
+    factory = SharedInformerFactory(store)
+    seen = []
+    pods = factory.informer_for("Pod")
+
+    def bad_handler(obj):
+        seen.append(obj.name)
+        raise RuntimeError("boom")
+
+    pods.add_event_handler(on_add=bad_handler)
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    store.create_pod(MakePod().name("p2").uid("u2").obj())
+    deadline = time.monotonic() + 5
+    while seen != ["p1", "p2"]:
+        if time.monotonic() > deadline:
+            raise AssertionError(f"dispatch thread died: {seen}")
+        time.sleep(0.01)
+    factory.stop()
+
+
+# ------------------------------------------------------------ leader election
+def test_leader_election_single_holder_and_failover():
+    store = ClusterStore()
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    leading = []
+
+    def elector(name):
+        return LeaderElector(
+            store,
+            LeaderElectionConfig(
+                identity=name, lease_duration=10.0,
+                on_started_leading=lambda: leading.append(name),
+            ),
+            clock=clock,
+        )
+
+    a, b = elector("a"), elector("b")
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()   # a holds the lease
+    assert a.try_acquire_or_renew()       # renewal succeeds
+    clock.step(11.0)                      # lease expires
+    assert b.try_acquire_or_renew()       # failover
+    assert store.lease_holder("kube-scheduler") == "b"
+
+
+def test_leader_election_run_loop():
+    store = ClusterStore()
+    started = threading.Event()
+    el = LeaderElector(
+        store,
+        LeaderElectionConfig(identity="x", retry_period=0.01,
+                             on_started_leading=started.set),
+    )
+    t = el.run_in_thread()
+    assert started.wait(2.0)
+    assert el.is_leader
+    el.stop()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
