@@ -409,6 +409,9 @@ class PodStatus:
     phase: str = PENDING
     conditions: List[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
+    pod_ip: str = ""
+    host_ip: str = ""
+    start_time: float = 0.0
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
@@ -606,21 +609,116 @@ class Endpoints:
 
 
 @dataclass
+class WorkloadStatus:
+    """Common observed state for workload controllers (the slice of
+    ReplicaSetStatus/DeploymentStatus/... the control loops maintain)."""
+
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+    succeeded: int = 0  # Job only
+    failed: int = 0     # Job only
+
+
+@dataclass
 class ReplicaSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
+    replicas: int = 0
+    template: Optional[dict] = None  # manifest-shaped pod template
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
 
 
 @dataclass
 class ReplicationController:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Dict[str, str] = field(default_factory=dict)
+    replicas: int = 0
+    template: Optional[dict] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
 
 
 @dataclass
 class StatefulSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
+    replicas: int = 0
+    template: Optional[dict] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 0
+    template: Optional[dict] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    template: Optional[dict] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    completions: int = 1
+    parallelism: int = 1
+    template: Optional[dict] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
 
 
 @dataclass

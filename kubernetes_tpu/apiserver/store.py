@@ -22,7 +22,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 from kubernetes_tpu.api.types import (
     CSINode,
+    DaemonSet,
+    Deployment,
     Endpoints,
+    Job,
     Node,
     PersistentVolume,
     PersistentVolumeClaim,
@@ -80,6 +83,9 @@ class ClusterStore:
         self._csi_nodes: Dict[str, CSINode] = {}
         self._pdbs: Dict[str, PodDisruptionBudget] = {}
         self._endpoints: Dict[str, Endpoints] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._daemon_sets: Dict[str, DaemonSet] = {}
+        self._jobs: Dict[str, Job] = {}
         self._leases: Dict[str, _Lease] = {}
         self._watches: List[WatchHandle] = []
         self._assumed_pvs: Dict[str, str] = {}  # pv name -> pvc key (Reserve)
@@ -346,6 +352,73 @@ class ClusterStore:
         with self._lock:
             return list(self._endpoints.values())
 
+    def add_deployment(self, d: Deployment) -> None:
+        self._upsert(self._deployments, "Deployment", f"{d.namespace}/{d.name}", d)
+
+    def update_deployment(self, d: Deployment) -> None:
+        self._upsert(self._deployments, "Deployment", f"{d.namespace}/{d.name}", d)
+
+    def delete_deployment(self, namespace: str, name: str) -> None:
+        self._delete(self._deployments, "Deployment", f"{namespace}/{name}")
+
+    def get_deployment(self, namespace: str, name: str) -> Optional[Deployment]:
+        with self._lock:
+            return self._deployments.get(f"{namespace}/{name}")
+
+    def list_deployments(self) -> List[Deployment]:
+        with self._lock:
+            return list(self._deployments.values())
+
+    def add_daemon_set(self, ds: DaemonSet) -> None:
+        self._upsert(self._daemon_sets, "DaemonSet", f"{ds.namespace}/{ds.name}", ds)
+
+    def delete_daemon_set(self, namespace: str, name: str) -> None:
+        self._delete(self._daemon_sets, "DaemonSet", f"{namespace}/{name}")
+
+    def list_daemon_sets(self) -> List[DaemonSet]:
+        with self._lock:
+            return list(self._daemon_sets.values())
+
+    def add_job(self, job: Job) -> None:
+        self._upsert(self._jobs, "Job", f"{job.namespace}/{job.name}", job)
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        self._delete(self._jobs, "Job", f"{namespace}/{name}")
+
+    def get_job(self, namespace: str, name: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(f"{namespace}/{name}")
+
+    def list_jobs(self) -> List[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def update_replica_set(self, rs: ReplicaSet) -> None:
+        self._upsert(self._rss, "ReplicaSet", f"{rs.namespace}/{rs.name}", rs)
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str,
+                      pod_ip: str = "", host_ip: str = "") -> None:
+        """Pod status subresource update (the kubelet's status manager
+        path): phase + network identity, dispatched as MODIFIED."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            pod = self._pods.get(key)
+            if pod is None:
+                return
+            import copy
+
+            new_pod = copy.copy(pod)
+            new_pod.status = copy.copy(pod.status)
+            new_pod.status.phase = phase
+            if pod_ip:
+                new_pod.status.pod_ip = pod_ip
+            if host_ip:
+                new_pod.status.host_ip = host_ip
+            new_pod.metadata = copy.copy(pod.metadata)
+            new_pod.metadata.resource_version = self._next_rv()
+            self._pods[key] = new_pod
+            self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))
+
     def add_pdb(self, pdb: PodDisruptionBudget) -> None:
         self._upsert(self._pdbs, "PodDisruptionBudget",
                      f"{pdb.namespace}/{pdb.name}", pdb)
@@ -399,3 +472,9 @@ class ClusterStore:
         with self._lock:
             lease = self._leases.get(name)
             return lease.holder if lease else None
+
+    def lease_info(self, name: str):
+        """(holder, renew_time) without touching the lease, or None."""
+        with self._lock:
+            lease = self._leases.get(name)
+            return (lease.holder, lease.renew_time) if lease else None

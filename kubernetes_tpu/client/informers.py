@@ -183,6 +183,9 @@ _KIND_LISTS = {
     "CSINode": "list_csi_nodes",
     "PodDisruptionBudget": "list_pdbs",
     "Endpoints": "list_endpoints",
+    "Deployment": "list_deployments",
+    "DaemonSet": "list_daemon_sets",
+    "Job": "list_jobs",
 }
 
 

@@ -1,0 +1,131 @@
+"""Shared controller scaffolding: informer → workqueue → reconcile workers.
+
+The universal control-loop shape from the reference's
+``pkg/controller/`` packages: event handlers enqueue object keys on a
+rate-limited workqueue; worker threads pop keys and reconcile observed →
+desired state, re-queuing with backoff on error and forgetting the key on
+success (e.g. ``pkg/controller/replicaset/replica_set.go`` syncHandler
+loop).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client import RateLimitingQueue, SharedInformerFactory
+
+_logger = logging.getLogger(__name__)
+
+
+class Controller:
+    """Base: subclasses set ``name``, wire handlers in ``register`` and
+    implement ``sync(key)``."""
+
+    name = "controller"
+    workers = 1
+    max_requeues = 10
+
+    def __init__(self, store: ClusterStore, factory: SharedInformerFactory):
+        self.store = store
+        self.factory = factory
+        self.queue = RateLimitingQueue()
+        self._threads: List[threading.Thread] = []
+        self._stopped = False
+        self.register()
+
+    # -- subclass surface ----------------------------------------------
+    def register(self) -> None:
+        raise NotImplementedError
+
+    def sync(self, key: str) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def enqueue(self, obj) -> None:
+        meta = obj.metadata
+        ns = getattr(meta, "namespace", "")
+        self.queue.add(f"{ns}/{meta.name}" if ns else meta.name)
+
+    def enqueue_key(self, key: str) -> None:
+        self.queue.add(key)
+
+    def run(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"{self.name}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        while not self._stopped:
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                if self.queue.shutting_down:
+                    return
+                continue
+            try:
+                self.sync(key)
+            except Exception:  # noqa: BLE001 — reconcile must retry, not die
+                if self.queue.num_requeues(key) < self.max_requeues:
+                    _logger.exception("%s: sync %s failed; requeueing",
+                                      self.name, key)
+                    self.queue.add_rate_limited(key)
+                else:
+                    _logger.exception("%s: sync %s failed too many times; "
+                                      "dropping", self.name, key)
+                    self.queue.forget(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def split_key(key: str) -> tuple:
+    ns, _, name = key.partition("/")
+    return ns, name
+
+
+def owner_ref(kind: str, obj) -> dict:
+    """controller=True OwnerReference (reference metav1.OwnerReference)."""
+    return {
+        "kind": kind,
+        "name": obj.metadata.name,
+        "uid": obj.metadata.uid,
+        "controller": True,
+    }
+
+
+def is_owned_by(pod, kind: str, owner) -> bool:
+    return any(
+        r.get("controller") and r.get("kind") == kind
+        and r.get("uid") == owner.metadata.uid
+        for r in pod.metadata.owner_references
+    )
+
+
+def controller_of(obj) -> Optional[dict]:
+    for r in obj.metadata.owner_references:
+        if r.get("controller"):
+            return r
+    return None
+
+
+def with_status(obj, status):
+    """Shallow-copy ``obj`` carrying ``status`` — controllers must never
+    mutate store/informer-cached instances in place (watch consumers
+    compare old vs new objects)."""
+    import copy
+
+    new = copy.copy(obj)
+    new.metadata = copy.copy(obj.metadata)
+    new.status = status
+    return new

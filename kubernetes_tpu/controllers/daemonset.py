@@ -1,0 +1,141 @@
+"""DaemonSet reconcile loop.
+
+Behavioral equivalent of the reference's
+``pkg/controller/daemon/daemon_controller.go``: one pod per eligible
+node. Like post-1.12 upstream, the controller does not place pods itself
+— it stamps each pod with a required node-affinity to its target node
+(``metadata.name`` matchFields) plus the daemon tolerations, and the
+default scheduler binds it (reference ``util/daemonset_util.go``
+ReplaceDaemonSetPodNodeNameNodeAffinity).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import DaemonSet, Node, Pod, WorkloadStatus
+from kubernetes_tpu.controllers.base import (
+    Controller,
+    is_owned_by,
+    owner_ref,
+    split_key,
+    with_status,
+)
+
+
+class DaemonSetController(Controller):
+    name = "daemonset"
+
+    def register(self) -> None:
+        self.factory.informer_for("DaemonSet").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Node").add_event_handler(
+            on_add=lambda n: self._all_daemonsets(),
+            on_delete=lambda n: self._all_daemonsets(),
+        )
+        self.factory.informer_for("Pod").add_event_handler(
+            on_delete=self._pod_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+        self.node_lister = self.factory.lister_for("Node")
+
+    def _all_daemonsets(self) -> None:
+        for ds in self.store.list_daemon_sets():
+            self.enqueue(ds)
+
+    def _pod_changed(self, pod: Pod) -> None:
+        for r in pod.metadata.owner_references:
+            if r.get("controller") and r.get("kind") == "DaemonSet":
+                self.enqueue_key(f"{pod.namespace}/{r['name']}")
+
+    def _eligible(self, ds: DaemonSet, node: Node) -> bool:
+        if node.spec.unschedulable:
+            return False
+        tols = self._tolerations(ds)
+        return all(
+            taint.effect not in ("NoSchedule", "NoExecute")
+            or any(t.tolerates(taint) for t in tols)
+            for taint in node.spec.taints
+        )
+
+    @staticmethod
+    def _tolerations(ds: DaemonSet):
+        from kubernetes_tpu.api.types import Toleration
+
+        spec = (ds.template or {}).get("spec", {})
+        return [Toleration.from_dict(t) for t in (spec.get("tolerations") or [])]
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        ds = None
+        for d in self.store.list_daemon_sets():
+            if d.metadata.namespace == ns and d.metadata.name == name:
+                ds = d
+                break
+        if ds is None:
+            return
+        owned = [
+            p for p in self.pod_lister.by_namespace(ns)
+            if is_owned_by(p, "DaemonSet", ds)
+        ]
+        by_node = {}
+        for p in owned:
+            by_node.setdefault(self._target_node(p), []).append(p)
+        want_nodes = {
+            n.name for n in self.node_lister.list() if self._eligible(ds, n)
+        }
+        for node_name in want_nodes:
+            if not by_node.get(node_name):
+                self._create_pod(ds, node_name)
+        for node_name, pods in by_node.items():
+            keep = 1 if node_name in want_nodes else 0
+            for p in pods[keep:]:
+                self.store.delete_pod(p.namespace, p.name)
+        status = WorkloadStatus(
+            replicas=len(want_nodes),
+            ready_replicas=sum(
+                1 for node, pods in by_node.items()
+                if node in want_nodes and pods and pods[0].spec.node_name
+            ),
+        )
+        if status != ds.status:
+            self.store.add_daemon_set(with_status(ds, status))
+
+    @staticmethod
+    def _target_node(pod: Pod) -> str:
+        if pod.spec.node_name:
+            return pod.spec.node_name
+        aff = pod.spec.affinity
+        if aff and aff.node_affinity and \
+                aff.node_affinity.required_during_scheduling_ignored_during_execution:
+            for term in (aff.node_affinity
+                         .required_during_scheduling_ignored_during_execution
+                         .node_selector_terms):
+                for req in term.match_fields:
+                    if req.key == "metadata.name" and req.values:
+                        return req.values[0]
+        return ""
+
+    def _create_pod(self, ds: DaemonSet, node_name: str) -> None:
+        import json
+
+        template = json.loads(json.dumps(ds.template or {}))
+        spec = template.setdefault("spec", {})
+        aff = spec.setdefault("affinity", {}).setdefault("nodeAffinity", {})
+        aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [{
+                "matchFields": [{
+                    "key": "metadata.name",
+                    "operator": "In",
+                    "values": [node_name],
+                }],
+            }],
+        }
+        pod = Pod.from_dict(template)
+        pod.metadata.namespace = ds.metadata.namespace
+        pod.metadata.name = f"{ds.metadata.name}-{node_name}-{pod.metadata.uid}"
+        pod.metadata.owner_references = list(pod.metadata.owner_references) + [
+            owner_ref("DaemonSet", ds)
+        ]
+        self.store.create_pod(pod)

@@ -1,0 +1,118 @@
+"""Deployment reconcile loop (manages ReplicaSets).
+
+Behavioral equivalent of the reference's
+``pkg/controller/deployment/deployment_controller.go`` + ``sync.go``:
+a Deployment owns one ReplicaSet per pod-template revision (identified by
+a template hash, reference ``pod_template_hash``); sync scales the
+current-revision RS up to ``spec.replicas`` and old-revision RSes to 0
+(the Recreate/rolling surface collapsed to its fixed point — the
+scheduler-facing behavior the harness needs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import copy
+
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.api.types import Deployment, ReplicaSet, WorkloadStatus
+from kubernetes_tpu.controllers.base import (
+    Controller,
+    controller_of,
+    owner_ref,
+    split_key,
+    with_status,
+)
+
+
+def template_hash(template: dict) -> str:
+    return hashlib.sha1(
+        json.dumps(template or {}, sort_keys=True).encode()
+    ).hexdigest()[:10]
+
+
+class DeploymentController(Controller):
+    name = "deployment"
+
+    def register(self) -> None:
+        self.factory.informer_for("Deployment").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("ReplicaSet").add_event_handler(
+            on_add=self._rs_changed,
+            on_update=lambda old, new: self._rs_changed(new),
+            on_delete=self._rs_changed,
+        )
+        self.rs_lister = self.factory.lister_for("ReplicaSet")
+
+    def _rs_changed(self, rs: ReplicaSet) -> None:
+        ref = controller_of(rs)
+        if ref is not None and ref.get("kind") == "Deployment":
+            self.enqueue_key(f"{rs.metadata.namespace}/{ref['name']}")
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        deploy = self.store.get_deployment(ns, name)
+        if deploy is None:
+            return
+        want_hash = template_hash(deploy.template)
+        owned = [
+            rs for rs in self.rs_lister.by_namespace(ns)
+            if any(r.get("controller") and r.get("kind") == "Deployment"
+                   and r.get("uid") == deploy.metadata.uid
+                   for r in rs.metadata.owner_references)
+        ]
+        current = None
+        for rs in owned:
+            if rs.metadata.labels.get("pod-template-hash") == want_hash:
+                current = rs
+                break
+        if current is None:
+            current = self._new_rs(deploy, want_hash)
+            owned.append(current)
+        elif current.replicas != deploy.replicas:
+            current = self._scale_rs(current, deploy.replicas)
+        owned = [
+            self._scale_rs(rs, 0)
+            if rs.metadata.uid != current.metadata.uid and rs.replicas != 0
+            else rs
+            for rs in owned
+        ]
+        status = WorkloadStatus(
+            replicas=sum(rs.status.replicas for rs in owned),
+            ready_replicas=sum(rs.status.ready_replicas for rs in owned),
+        )
+        if status != deploy.status:
+            self.store.update_deployment(with_status(deploy, status))
+
+    def _scale_rs(self, rs: ReplicaSet, replicas: int) -> ReplicaSet:
+        scaled = copy.copy(rs)
+        scaled.metadata = copy.copy(rs.metadata)
+        scaled.replicas = replicas
+        self.store.update_replica_set(scaled)
+        return scaled
+
+    def _new_rs(self, deploy: Deployment, want_hash: str) -> ReplicaSet:
+        template = json.loads(json.dumps(deploy.template or {}))
+        labels = dict(template.get("metadata", {}).get("labels") or {})
+        labels["pod-template-hash"] = want_hash
+        template.setdefault("metadata", {})["labels"] = labels
+        sel = deploy.selector or LabelSelector()
+        match = dict(sel.match_labels)
+        match["pod-template-hash"] = want_hash
+        rs = ReplicaSet(
+            selector=LabelSelector(match_labels=match,
+                                   match_expressions=list(sel.match_expressions)),
+            replicas=deploy.replicas,
+            template=template,
+        )
+        rs.metadata.name = f"{deploy.metadata.name}-{want_hash}"
+        rs.metadata.namespace = deploy.metadata.namespace
+        rs.metadata.labels = labels
+        rs.metadata.owner_references = [owner_ref("Deployment", deploy)]
+        self.store.add_replica_set(rs)
+        return rs

@@ -1,0 +1,82 @@
+"""Endpoints reconcile loop.
+
+Behavioral equivalent of the reference's
+``pkg/controller/endpoint/endpoints_controller.go``: for every Service,
+maintain an Endpoints object listing the addresses of ready bound pods
+matching the service selector (kube-proxy's input).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import (
+    FAILED,
+    SUCCEEDED,
+    EndpointAddress,
+    Endpoints,
+    Pod,
+    Service,
+)
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+
+class EndpointsController(Controller):
+    name = "endpoints"
+
+    def register(self) -> None:
+        self.factory.informer_for("Service").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            # both sides: a label change must resync the service the pod
+            # LEFT as well as the one it joined
+            on_update=lambda old, new: (self._pod_changed(old),
+                                        self._pod_changed(new)),
+            on_delete=self._pod_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+        self.svc_lister = self.factory.lister_for("Service")
+
+    def _pod_changed(self, pod: Pod) -> None:
+        for svc in self.svc_lister.by_namespace(pod.namespace):
+            if self._selects(svc, pod):
+                self.enqueue(svc)
+
+    @staticmethod
+    def _selects(svc: Service, pod: Pod) -> bool:
+        if not svc.selector:
+            return False
+        return all(
+            pod.metadata.labels.get(k) == v for k, v in svc.selector.items()
+        )
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        svc = None
+        for s in self.store.list_all_services():
+            if s.metadata.namespace == ns and s.metadata.name == name:
+                svc = s
+                break
+        if svc is None:
+            self.store.delete_endpoints(ns, name)
+            return
+        addresses = []
+        for pod in self.pod_lister.by_namespace(ns):
+            if not self._selects(svc, pod):
+                continue
+            if not pod.spec.node_name or pod.status.phase in (SUCCEEDED, FAILED):
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            addresses.append(EndpointAddress(
+                ip=pod.status.pod_ip or pod.full_name(),
+                node_name=pod.spec.node_name,
+                target_pod=pod.full_name(),
+            ))
+        ep = Endpoints(addresses=sorted(addresses, key=lambda a: a.target_pod),
+                       ports=list(svc.ports))
+        ep.metadata.name = name
+        ep.metadata.namespace = ns
+        self.store.upsert_endpoints(ep)

@@ -1,0 +1,83 @@
+"""Job reconcile loop.
+
+Behavioral equivalent of the reference's ``pkg/controller/job/job_controller.go``
+syncJob: keep up to ``parallelism`` active pods until ``completions`` pods
+have Succeeded; count terminal pods into status. Pods reach Succeeded via
+the (hollow) kubelet marking container completion — without kubelets the
+job stays active, matching the reference's behavior with no nodes.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Job, Pod, WorkloadStatus
+from kubernetes_tpu.controllers.base import (
+    Controller,
+    is_owned_by,
+    owner_ref,
+    split_key,
+    with_status,
+)
+
+
+class JobController(Controller):
+    name = "job"
+
+    def register(self) -> None:
+        self.factory.informer_for("Job").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: self._pod_changed(new),
+            on_delete=self._pod_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+
+    def _pod_changed(self, pod: Pod) -> None:
+        for r in pod.metadata.owner_references:
+            if r.get("controller") and r.get("kind") == "Job":
+                self.enqueue_key(f"{pod.namespace}/{r['name']}")
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        job = self.store.get_job(ns, name)
+        if job is None:
+            return
+        owned = [
+            p for p in self.pod_lister.by_namespace(ns)
+            if is_owned_by(p, "Job", job)
+        ]
+        succeeded = sum(1 for p in owned if p.status.phase == SUCCEEDED)
+        failed = sum(1 for p in owned if p.status.phase == FAILED)
+        active = [
+            p for p in owned
+            if p.status.phase not in (SUCCEEDED, FAILED)
+            and p.metadata.deletion_timestamp is None
+        ]
+        remaining = job.completions - succeeded
+        want_active = max(0, min(job.parallelism, remaining))
+        for _ in range(want_active - len(active)):
+            self._create_pod(job)
+        for p in active[want_active:] if want_active < len(active) else []:
+            self.store.delete_pod(p.namespace, p.name)
+        status = WorkloadStatus(
+            replicas=min(len(active), want_active),
+            succeeded=succeeded,
+            failed=failed,
+        )
+        if status != job.status:
+            self.store.add_job(with_status(job, status))
+
+    def _create_pod(self, job: Job) -> None:
+        pod = Pod.from_dict(dict(job.template or {}))
+        pod.metadata.namespace = job.metadata.namespace
+        pod.metadata.name = f"{job.metadata.name}-{pod.metadata.uid}"
+        pod.metadata.owner_references = list(pod.metadata.owner_references) + [
+            owner_ref("Job", job)
+        ]
+        # jobs run to completion: the hollow kubelet uses this annotation
+        # to transition Running -> Succeeded
+        pod.metadata.annotations.setdefault("kubernetes-tpu/run-to-completion", "true")
+        self.store.create_pod(pod)

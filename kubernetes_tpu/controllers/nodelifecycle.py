@@ -1,0 +1,135 @@
+"""Node lifecycle controller: health monitoring + eviction.
+
+Behavioral equivalent of the reference's
+``pkg/controller/nodelifecycle/node_lifecycle_controller.go``
+(monitorNodeHealth :337-352): nodes must heartbeat (renew the
+``node-<name>`` lease / update Ready condition); a node silent past the
+grace period is marked NotReady, tainted ``node.kubernetes.io/unreachable``
+(NoExecute), and after the eviction grace its pods are deleted so their
+controllers replace them elsewhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from kubernetes_tpu.api.types import Node, PodCondition, Taint
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.utils.clock import RealClock
+
+UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+
+
+class NodeLifecycleController(Controller):
+    name = "nodelifecycle"
+    monitor_interval = 1.0
+    grace_period = 40.0        # reference nodeMonitorGracePeriod default
+    eviction_grace = 10.0      # collapsed pod-eviction-timeout
+
+    def __init__(self, store, factory, clock=None):
+        self._clock = clock or RealClock()
+        self._not_ready_since: Dict[str, float] = {}
+        self._first_seen: Dict[str, float] = {}
+        super().__init__(store, factory)
+
+    def register(self) -> None:
+        self.node_lister = self.factory.lister_for("Node")
+        self.pod_lister = self.factory.lister_for("Pod")
+        self._monitor_stop = threading.Event()
+
+    def run(self) -> None:
+        super().run()
+        t = threading.Thread(target=self._monitor_loop, daemon=True,
+                             name="node-health-monitor")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._monitor_stop.set()
+        super().stop()
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(self.monitor_interval):
+            self.monitor_node_health()
+
+    # ------------------------------------------------------------------
+    def heartbeat(self, node_name: str) -> None:
+        """Called by the (hollow) kubelet: renew the node lease."""
+        self.store.try_acquire_or_renew(
+            f"node-{node_name}", node_name, self._clock.now(),
+            self.grace_period,
+        )
+
+    def monitor_node_health(self) -> None:
+        now = self._clock.now()
+        for node in self.node_lister.list():
+            # a node that has never heartbeated gets the full grace period
+            # from first observation (reference grants
+            # nodeMonitorGracePeriod from node creation)
+            first_seen = self._first_seen.setdefault(node.name, now)
+            fresh = (
+                self._lease_fresh(node.name, now)
+                or (self.store.lease_info(f"node-{node.name}") is None
+                    and now - first_seen <= self.grace_period)
+            )
+            if fresh:
+                if node.name in self._not_ready_since:
+                    del self._not_ready_since[node.name]
+                    self._mark_ready(node)
+            else:
+                since = self._not_ready_since.setdefault(node.name, now)
+                self._mark_not_ready(node)
+                if now - since >= self.eviction_grace:
+                    self._evict_pods(node)
+
+    def _lease_fresh(self, node_name: str, now: float) -> bool:
+        info = self.store.lease_info(f"node-{node_name}")
+        return info is not None and now - info[1] <= self.grace_period
+
+    def _mark_not_ready(self, node: Node) -> None:
+        if any(t.key == UNREACHABLE_TAINT for t in node.spec.taints):
+            return
+        node = self._copy(node)
+        node.spec.taints = list(node.spec.taints) + [
+            Taint(key=UNREACHABLE_TAINT, effect="NoExecute")
+        ]
+        node.status.conditions = [
+            c for c in node.status.conditions if c.type != "Ready"
+        ] + [PodCondition("Ready", "False", "NodeStatusUnknown",
+                          "node heartbeat lost")]
+        self.store.update_node(node)
+
+    def _mark_ready(self, node: Node) -> None:
+        node = self._copy(node)
+        node.spec.taints = [
+            t for t in node.spec.taints if t.key != UNREACHABLE_TAINT
+        ]
+        node.status.conditions = [
+            c for c in node.status.conditions if c.type != "Ready"
+        ] + [PodCondition("Ready", "True", "KubeletReady", "")]
+        self.store.update_node(node)
+
+    @staticmethod
+    def _copy(node: Node) -> Node:
+        """Never mutate informer-cached instances in place."""
+        import copy
+
+        new = copy.copy(node)
+        new.metadata = copy.copy(node.metadata)
+        new.spec = copy.copy(node.spec)
+        new.status = copy.copy(node.status)
+        return new
+
+    def _evict_pods(self, node: Node) -> None:
+        for pod in self.pod_lister.list():
+            if pod.spec.node_name != node.name:
+                continue
+            if any(t.key == UNREACHABLE_TAINT
+                   and t.toleration_seconds is None
+                   for t in pod.spec.tolerations):
+                continue  # tolerates unreachable forever (e.g. daemons)
+            self.store.delete_pod(pod.namespace, pod.name)
+
+    def sync(self, key: str) -> None:  # queue unused; monitor loop drives
+        pass

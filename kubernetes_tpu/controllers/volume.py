@@ -1,0 +1,65 @@
+"""PersistentVolume binder controller.
+
+Behavioral equivalent of the reference's PV controller
+(``pkg/controller/volume/persistentvolume/pv_controller.go``) in the shape
+scheduler_perf uses it (``test/integration/scheduler_perf/util.go:109``
+StartFakePVController): Immediate-mode PVCs are matched to Available PVs
+by storage class, access modes and capacity; WaitForFirstConsumer PVCs are
+left for the scheduler's VolumeBinding plugin to assume/commit.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import PersistentVolume, PersistentVolumeClaim
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+
+class PersistentVolumeController(Controller):
+    name = "persistentvolume-binder"
+
+    def register(self) -> None:
+        self.factory.informer_for("PersistentVolumeClaim").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+        )
+        self.factory.informer_for("PersistentVolume").add_event_handler(
+            on_add=lambda pv: self._all_pending_pvcs(),
+        )
+        self.pvc_lister = self.factory.lister_for("PersistentVolumeClaim")
+
+    def _all_pending_pvcs(self) -> None:
+        for pvc in self.store.list_all_pvcs():
+            if pvc.phase == "Pending":
+                self.enqueue(pvc)
+
+    def _binding_mode(self, pvc: PersistentVolumeClaim) -> str:
+        if not pvc.storage_class_name:
+            return "Immediate"
+        sc = self.store.get_storage_class(pvc.storage_class_name)
+        return sc.volume_binding_mode if sc else "Immediate"
+
+    @staticmethod
+    def _matches(pv: PersistentVolume, pvc: PersistentVolumeClaim) -> bool:
+        if pv.phase != "Available" or pv.claim_ref:
+            return False
+        if pv.storage_class_name != (pvc.storage_class_name or ""):
+            return False
+        if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes):
+            return False
+        want = pvc.requests.get("storage")
+        have = pv.capacity.get("storage")
+        if want is not None and (have is None or have.nano < want.nano):
+            return False
+        return True
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        pvc = self.store.get_pvc(ns, name)
+        if pvc is None or pvc.phase != "Pending":
+            return
+        if self._binding_mode(pvc) != "Immediate":
+            return  # WaitForFirstConsumer: scheduler VolumeBinding binds
+        for pv in self.store.list_pvs():
+            if self._matches(pv, pvc):
+                self.store.bind_pv(pv.name, ns, name)
+                return

@@ -1,0 +1,370 @@
+"""Controller manager + control loops, driven end-to-end against the
+store (and, where placement matters, a live scheduler)."""
+
+import time
+
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.api.types import (
+    DaemonSet,
+    Deployment,
+    Job,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    ReplicaSet,
+    Service,
+    StatefulSet,
+    StorageClass,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.controllers import ControllerManager, new_controller_initializers
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timeout waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _template(labels=None, cpu="100m"):
+    return {
+        "metadata": {"labels": labels or {"app": "web"}},
+        "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+        ]},
+    }
+
+
+def _rs(name, replicas, labels=None):
+    labels = labels or {"app": "web"}
+    rs = ReplicaSet(
+        selector=LabelSelector(match_labels=dict(labels)),
+        replicas=replicas,
+        template=_template(labels),
+    )
+    rs.metadata.name = name
+    return rs
+
+
+def test_controller_registry_covers_core_loops():
+    names = set(new_controller_initializers())
+    assert {"replicaset", "deployment", "statefulset", "daemonset", "job",
+            "endpoints", "garbagecollector", "nodelifecycle",
+            "persistentvolume-binder"} <= names
+
+
+def test_replicaset_scales_up_and_down():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["replicaset"])
+    cm.start()
+    try:
+        store.add_replica_set(_rs("web", 3))
+        _wait(lambda: len(store.list_pods()) == 3, msg="3 pods")
+        rs = store.get_replica_set("default", "web")
+        rs.replicas = 1
+        store.update_replica_set(rs)
+        _wait(lambda: len(store.list_pods()) == 1, msg="scale down to 1")
+        # killed pod is replaced
+        store.delete_pod("default", store.list_pods()[0].name)
+        _wait(lambda: len(store.list_pods()) == 1, msg="replacement pod")
+    finally:
+        cm.stop()
+
+
+def test_idle_controllers_do_not_spin():
+    """Status writes must be skipped when unchanged, otherwise the
+    controller MODIFY-events itself into a hot reconcile loop."""
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["replicaset", "deployment"])
+    cm.start()
+    try:
+        store.add_replica_set(_rs("web", 2))
+        _wait(lambda: len(store.list_pods()) == 2, msg="pods created")
+        time.sleep(0.3)  # let status writes settle
+        rv_before = store._rv
+        time.sleep(1.0)
+        assert store._rv - rv_before <= 2, (
+            f"idle controllers burned {store._rv - rv_before} RVs/s"
+        )
+    finally:
+        cm.stop()
+
+
+def test_replicaset_adopts_matching_orphans():
+    store = ClusterStore()
+    from kubernetes_tpu.testing import MakePod
+
+    orphan = MakePod().name("stray").uid("stray-u").label("app", "web").obj()
+    store.create_pod(orphan)
+    cm = ControllerManager(store, controllers=["replicaset"])
+    cm.start()
+    try:
+        store.add_replica_set(_rs("web", 2))
+        _wait(lambda: len(store.list_pods()) == 2, msg="orphan counted")
+        stray = store.get_pod("default", "stray")
+        _wait(lambda: any(
+            r.get("kind") == "ReplicaSet"
+            for r in store.get_pod("default", "stray").metadata.owner_references
+        ), msg="orphan adopted")
+        del stray
+        # deleting the adopted orphan now routes back to the RS
+        store.delete_pod("default", "stray")
+        _wait(lambda: len(store.list_pods()) == 2, msg="replacement created")
+    finally:
+        cm.stop()
+
+
+def test_deployment_creates_rs_and_rolls_template():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["deployment", "replicaset"])
+    cm.start()
+    try:
+        d = Deployment(
+            selector=LabelSelector(match_labels={"app": "web"}),
+            replicas=2,
+            template=_template(),
+        )
+        d.metadata.name = "web"
+        store.add_deployment(d)
+        _wait(lambda: len(store.list_all_replica_sets()) == 1, msg="RS created")
+        _wait(lambda: len(store.list_pods()) == 2, msg="2 pods via RS")
+        old_rs = store.list_all_replica_sets()[0].name
+
+        d = store.get_deployment("default", "web")
+        d.template = _template(cpu="200m")
+        store.update_deployment(d)
+        _wait(lambda: len(store.list_all_replica_sets()) == 2, msg="new RS")
+        def rolled():
+            pods = store.list_pods()
+            return (len(pods) == 2 and all(
+                p.spec.containers[0].resources.requests["cpu"].milli_value() == 200
+                for p in pods))
+        _wait(rolled, msg="pods rolled to new template")
+        new_rs = [rs for rs in store.list_all_replica_sets()
+                  if rs.name != old_rs][0]
+        assert new_rs.replicas == 2
+        assert [rs for rs in store.list_all_replica_sets()
+                if rs.name == old_rs][0].replicas == 0
+    finally:
+        cm.stop()
+
+
+def test_statefulset_ordered_creation_with_scheduler():
+    store = ClusterStore()
+    store.add_node(MakeNode().name("n1").capacity(
+        {"cpu": "8", "memory": "16Gi"}).obj())
+    sched = Scheduler.create(store)
+    sched.run()
+    cm = ControllerManager(store, controllers=["statefulset"])
+    cm.start()
+    try:
+        ss = StatefulSet(
+            selector=LabelSelector(match_labels={"app": "db"}),
+            replicas=3,
+            template=_template({"app": "db"}),
+        )
+        ss.metadata.name = "db"
+        store.add_stateful_set(ss)
+        _wait(lambda: store.get_pod("default", "db-2") is not None
+              and store.get_pod("default", "db-2").spec.node_name,
+              msg="db-2 bound")
+        names = sorted(p.name for p in store.list_pods())
+        assert names == ["db-0", "db-1", "db-2"]
+        # ordinal order: db-0 must have been created before db-2
+        assert (int(store.get_pod("default", "db-0").metadata.resource_version)
+                < int(store.get_pod("default", "db-2").metadata.resource_version))
+    finally:
+        cm.stop()
+        sched.stop()
+
+
+def test_daemonset_runs_one_pod_per_node():
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(MakeNode().name(f"n{i}").capacity(
+            {"cpu": "8", "memory": "16Gi"}).obj())
+    sched = Scheduler.create(store)
+    sched.run()
+    cm = ControllerManager(store, controllers=["daemonset"])
+    cm.start()
+    try:
+        ds = DaemonSet(template=_template({"app": "agent"}))
+        ds.metadata.name = "agent"
+        store.add_daemon_set(ds)
+        def one_per_node():
+            hosts = sorted(p.spec.node_name for p in store.list_pods())
+            return hosts == ["n0", "n1", "n2"]
+        _wait(one_per_node, msg="one daemon pod bound per node")
+        # a node added later gets its daemon pod too
+        store.add_node(MakeNode().name("n3").capacity(
+            {"cpu": "8", "memory": "16Gi"}).obj())
+        _wait(lambda: sorted(p.spec.node_name for p in store.list_pods())
+              == ["n0", "n1", "n2", "n3"], msg="daemon pod on new node")
+    finally:
+        cm.stop()
+        sched.stop()
+
+
+def test_job_runs_to_completion_with_pod_phases():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["job"])
+    cm.start()
+    try:
+        job = Job(completions=4, parallelism=2, template=_template({"app": "batch"}))
+        job.metadata.name = "batch"
+        store.add_job(job)
+        _wait(lambda: len([p for p in store.list_pods()
+                           if p.status.phase == "Pending"]) == 2,
+              msg="2 parallel pods")
+        # simulate kubelet completing pods as they appear
+        done = set()
+        def finish_pods():
+            for p in store.list_pods():
+                if p.name not in done and p.status.phase == "Pending":
+                    done.add(p.name)
+                    store.set_pod_phase(p.namespace, p.name, "Succeeded")
+            j = store.get_job("default", "batch")
+            return j.status.succeeded >= 4
+        _wait(finish_pods, msg="job completes")
+        j = store.get_job("default", "batch")
+        assert j.status.succeeded == 4
+        assert j.status.replicas == 0  # no active pods remain wanted
+    finally:
+        cm.stop()
+
+
+def test_endpoints_follow_service_selector_and_bindings():
+    store = ClusterStore()
+    store.add_node(MakeNode().name("n1").capacity(
+        {"cpu": "8", "memory": "16Gi"}).obj())
+    sched = Scheduler.create(store)
+    sched.run()
+    cm = ControllerManager(store, controllers=["endpoints", "replicaset"])
+    cm.start()
+    try:
+        svc = Service(selector={"app": "web"})
+        svc.metadata.name = "web"
+        store.add_service(svc)
+        store.add_replica_set(_rs("web", 2))
+        def ready():
+            ep = store.get_endpoints("default", "web")
+            return ep is not None and len(ep.addresses) == 2
+        _wait(ready, msg="2 endpoint addresses")
+        ep = store.get_endpoints("default", "web")
+        assert all(a.node_name == "n1" for a in ep.addresses)
+        # scale down -> endpoints shrink
+        rs = store.get_replica_set("default", "web")
+        rs.replicas = 1
+        store.update_replica_set(rs)
+        _wait(lambda: len(store.get_endpoints("default", "web").addresses) == 1,
+              msg="endpoints shrink")
+    finally:
+        cm.stop()
+        sched.stop()
+
+
+def test_garbage_collector_cascades_orphaned_pods():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["replicaset", "garbagecollector"])
+    cm.get("garbagecollector").sweep_interval = 0.1
+    cm.start()
+    try:
+        store.add_replica_set(_rs("web", 2))
+        _wait(lambda: len(store.list_pods()) == 2, msg="pods exist")
+        store.delete_replica_set("default", "web")
+        _wait(lambda: len(store.list_pods()) == 0, msg="cascade delete")
+    finally:
+        cm.stop()
+
+
+def test_node_lifecycle_marks_and_evicts_silent_nodes():
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    store = ClusterStore()
+    clock = FakeClock(start=100.0)
+    store.add_node(MakeNode().name("n1").capacity(
+        {"cpu": "8", "memory": "16Gi"}).obj())
+    cm = ControllerManager(store, controllers=[])
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        UNREACHABLE_TAINT,
+        NodeLifecycleController,
+    )
+
+    nlc = NodeLifecycleController(store, cm.factory, clock=clock)
+    cm.factory.start()
+    assert cm.factory.wait_for_cache_sync()
+    try:
+        # bind a pod onto n1 manually
+        from kubernetes_tpu.testing import MakePod
+
+        store.create_pod(MakePod().name("p").uid("u").obj())
+        store.bind("default", "p", "u", "n1")
+        _wait(lambda: (nlc.pod_lister.get("p", "default") or MakePod().obj())
+              .spec.node_name == "n1", msg="informer sees binding")
+
+        nlc.heartbeat("n1")
+        nlc.monitor_node_health()
+        assert not any(t.key == UNREACHABLE_TAINT
+                       for t in store.get_node("n1").spec.taints)
+
+        clock.step(45.0)  # past the 40s grace period
+        nlc.monitor_node_health()
+        node = store.get_node("n1")
+        assert any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+        assert any(c.type == "Ready" and c.status == "False"
+                   for c in node.status.conditions)
+        assert store.get_pod("default", "p") is not None  # not evicted yet
+
+        clock.step(11.0)  # past the eviction grace
+        nlc.monitor_node_health()
+        assert store.get_pod("default", "p") is None
+
+        # heartbeat returns: node recovers
+        nlc.heartbeat("n1")
+        nlc.monitor_node_health()
+        assert not any(t.key == UNREACHABLE_TAINT
+                       for t in store.get_node("n1").spec.taints)
+    finally:
+        cm.stop()
+
+
+def test_pv_binder_binds_immediate_claims():
+    store = ClusterStore()
+    sc = StorageClass(provisioner="x", volume_binding_mode="Immediate")
+    sc.metadata.name = "standard"
+    store.add_storage_class(sc)
+    pv = PersistentVolume(storage_class_name="standard",
+                          access_modes=["ReadWriteOnce"])
+    pv.metadata.name = "pv-1"
+    store.add_pv(pv)
+    cm = ControllerManager(store, controllers=["persistentvolume-binder"])
+    cm.start()
+    try:
+        pvc = PersistentVolumeClaim(storage_class_name="standard",
+                                    access_modes=["ReadWriteOnce"])
+        pvc.metadata.name = "claim-1"
+        store.add_pvc(pvc)
+        _wait(lambda: store.get_pvc("default", "claim-1").phase == "Bound",
+              msg="pvc bound")
+        assert store.get_pv("pv-1").claim_ref == "default/claim-1"
+
+        # WaitForFirstConsumer claims are left alone
+        sc2 = StorageClass(provisioner="x",
+                           volume_binding_mode="WaitForFirstConsumer")
+        sc2.metadata.name = "wffc"
+        store.add_storage_class(sc2)
+        pv2 = PersistentVolume(storage_class_name="wffc",
+                               access_modes=["ReadWriteOnce"])
+        pv2.metadata.name = "pv-2"
+        store.add_pv(pv2)
+        pvc2 = PersistentVolumeClaim(storage_class_name="wffc",
+                                     access_modes=["ReadWriteOnce"])
+        pvc2.metadata.name = "claim-2"
+        store.add_pvc(pvc2)
+        time.sleep(0.3)
+        assert store.get_pvc("default", "claim-2").phase == "Pending"
+    finally:
+        cm.stop()
