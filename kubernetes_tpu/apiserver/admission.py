@@ -1,0 +1,172 @@
+"""Admission control chain for the REST path.
+
+Behavioral equivalent of the reference's admission stage in the apiserver
+handler chain (``staging/src/k8s.io/apiserver/pkg/admission``): after
+authn/authz and before the registry write, every mutating request passes
+through an ordered chain of admission plugins, each of which may mutate
+the object (``MutationInterface``) and/or reject it
+(``ValidationInterface``). Built-ins here mirror the upstream plugins the
+scheduling path actually feels:
+
+- ``NamespaceLifecycle`` — reject creates in terminating/absent namespaces
+  (``plugin/pkg/admission/namespace/lifecycle``)
+- ``DefaultTolerationSeconds`` — add default 300s tolerations for the
+  not-ready/unreachable NoExecute taints to every pod
+  (``plugin/pkg/admission/defaulttolerationseconds``)
+- ``LimitRanger``-style request defaulting — containers with no cpu/mem
+  request get namespace defaults so the scheduler's fit math sees nonzero
+  vectors (``plugin/pkg/admission/limitranger``)
+- ``TaintNodesByCondition``-adjacent ``PodPriority`` resolution — map
+  priorityClassName → numeric priority (``plugin/pkg/admission/priority``)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import Pod, Toleration
+
+CREATE, UPDATE, DELETE = "CREATE", "UPDATE", "DELETE"
+
+
+class AdmissionError(Exception):
+    """Request rejected by an admission plugin (HTTP 403/422 at the REST
+    layer)."""
+
+
+@dataclass
+class AdmissionRequest:
+    operation: str
+    kind: str
+    namespace: str
+    obj: Any
+    old_obj: Any = None
+    user: str = "system:anonymous"
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, req: AdmissionRequest) -> None:
+        """Mutating pass — may modify req.obj in place."""
+
+    def validate(self, req: AdmissionRequest) -> None:
+        """Validating pass — raise AdmissionError to reject."""
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    name = "NamespaceLifecycle"
+
+    def __init__(self, namespaces: Optional[Dict[str, str]] = None):
+        # namespace -> phase ("Active"/"Terminating"); None = open world
+        self.namespaces = namespaces
+
+    def validate(self, req: AdmissionRequest) -> None:
+        if self.namespaces is None or req.operation != CREATE:
+            return
+        phase = self.namespaces.get(req.namespace)
+        if phase is None:
+            raise AdmissionError(f"namespace {req.namespace!r} not found")
+        if phase == "Terminating":
+            raise AdmissionError(
+                f"namespace {req.namespace!r} is terminating; "
+                "no new objects may be created"
+            )
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    name = "DefaultTolerationSeconds"
+
+    NOT_READY = "node.kubernetes.io/not-ready"
+    UNREACHABLE = "node.kubernetes.io/unreachable"
+
+    def __init__(self, seconds: int = 300):
+        self.seconds = seconds
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        tols = pod.spec.tolerations
+        have = {
+            t.key
+            for t in tols
+            if t.effect in ("NoExecute", "") and t.key in (self.NOT_READY, self.UNREACHABLE)
+        }
+        for key in (self.NOT_READY, self.UNREACHABLE):
+            if key not in have:
+                tols.append(
+                    Toleration(
+                        key=key,
+                        operator="Exists",
+                        effect="NoExecute",
+                        toleration_seconds=self.seconds,
+                    )
+                )
+
+
+class LimitRanger(AdmissionPlugin):
+    name = "LimitRanger"
+
+    def __init__(self, default_requests: Optional[Dict[str, str]] = None):
+        self.defaults = {
+            k: parse_quantity(v)
+            for k, v in (default_requests or {}).items()
+        }
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE or not self.defaults:
+            return
+        pod: Pod = req.obj
+        for c in pod.spec.containers:
+            for res, qty in self.defaults.items():
+                if res not in c.resources.requests:
+                    c.resources.requests[res] = qty
+
+
+class PodPriorityResolver(AdmissionPlugin):
+    name = "Priority"
+
+    def __init__(self, priority_classes: Optional[Dict[str, int]] = None):
+        self.classes = dict(priority_classes or {})
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        cls = getattr(pod.spec, "priority_class_name", "")
+        if cls:
+            if cls not in self.classes:
+                raise AdmissionError(f"no PriorityClass {cls!r}")
+            pod.spec.priority = self.classes[cls]
+
+    def validate(self, req: AdmissionRequest) -> None:
+        pass
+
+
+@dataclass
+class AdmissionChain:
+    """Ordered plugin chain: all mutating passes, then all validating
+    passes (reference admission.NewChainHandler ordering)."""
+
+    plugins: List[AdmissionPlugin] = field(default_factory=list)
+
+    @classmethod
+    def default(cls) -> "AdmissionChain":
+        return cls(
+            [
+                NamespaceLifecycle(),
+                DefaultTolerationSeconds(),
+                LimitRanger(),
+                PodPriorityResolver(),
+            ]
+        )
+
+    def run(self, req: AdmissionRequest) -> Any:
+        for p in self.plugins:
+            p.admit(req)
+        for p in self.plugins:
+            p.validate(req)
+        return req.obj

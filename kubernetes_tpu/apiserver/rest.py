@@ -1,0 +1,644 @@
+"""HTTP REST API server + remote client.
+
+The process-boundary surface of the framework — the behavioral equivalent
+of kube-apiserver's endpoint layer (reference
+``staging/src/k8s.io/apiserver/pkg/endpoints/handlers/{create,get,update,
+delete,watch}.go`` + ``pkg/controlplane/instance.go:547 InstallLegacyAPI``):
+
+- handler chain per request: authenticate → authorize → (mutating requests)
+  admission → registry operation against the cluster store
+- resource routes ``/api/v1/<plural>``, ``/api/v1/namespaces/{ns}/<plural>``,
+  object routes ``.../{name}``, subresources ``.../pods/{name}/binding``
+  (reference ``pkg/registry/core/pod/storage/storage.go:159``) and
+  ``.../pods/{name}/status``
+- watches: ``GET ...?watch=true&resourceVersion=N`` streams newline-
+  delimited ``{"type": ..., "object": {...}}`` frames over a chunked
+  response, replaying from N via the revisioned watch cache — the same
+  List+Watch contract client-go reflectors consume. A compacted N returns
+  HTTP 410 Gone ("Expired"), telling the client to relist.
+- ``/healthz`` ``/livez`` ``/readyz`` probes and Prometheus ``/metrics``
+
+Transport is JSON over HTTP/1.1 chunked streams (the wire codec in
+``kubernetes_tpu.api.serialization``); the reference's protobuf negotiation
+is an encoding detail its clients don't observe.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.api.serialization import SCHEME, from_wire, is_namespaced, to_wire
+from kubernetes_tpu.apiserver.admission import (
+    CREATE,
+    DELETE,
+    UPDATE,
+    AdmissionChain,
+    AdmissionError,
+    AdmissionRequest,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore, ConflictError, Event
+from kubernetes_tpu.apiserver.watchcache import TooOldResourceVersion, WatchCache
+
+# plural route segment ↔ kind
+PLURALS: Dict[str, str] = {
+    "pods": "Pod",
+    "nodes": "Node",
+    "services": "Service",
+    "endpoints": "Endpoints",
+    "replicasets": "ReplicaSet",
+    "replicationcontrollers": "ReplicationController",
+    "statefulsets": "StatefulSet",
+    "deployments": "Deployment",
+    "daemonsets": "DaemonSet",
+    "jobs": "Job",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+    "persistentvolumes": "PersistentVolume",
+    "storageclasses": "StorageClass",
+    "csinodes": "CSINode",
+    "poddisruptionbudgets": "PodDisruptionBudget",
+}
+KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
+
+
+class Forbidden(Exception):
+    pass
+
+
+Authorizer = Callable[[str, str, str, str], bool]  # (user, verb, kind, ns)
+
+
+def allow_all(user: str, verb: str, kind: str, namespace: str) -> bool:
+    return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "APIServer"
+
+    # -- plumbing ------------------------------------------------------
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send_json(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error(self, code: int, reason: str, message: str) -> None:
+        # reference metav1.Status error envelope
+        self._send_json(
+            code,
+            {
+                "kind": "Status",
+                "status": "Failure",
+                "reason": reason,
+                "message": message,
+                "code": code,
+            },
+        )
+
+    def _read_body(self) -> Any:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    # -- authn/authz ---------------------------------------------------
+    def _user(self) -> str:
+        auth = self.headers.get("Authorization") or ""
+        if auth.startswith("Bearer "):
+            token = auth[len("Bearer "):].strip()
+            return self.server.tokens.get(token, f"token:{token[:8]}")
+        return "system:anonymous"
+
+    def _check_authz(self, verb: str, kind: str, namespace: str) -> str:
+        user = self._user()
+        if not self.server.authorizer(user, verb, kind, namespace):
+            raise Forbidden(f"user {user!r} cannot {verb} {kind}")
+        return user
+
+    # -- routing -------------------------------------------------------
+    def _route(self) -> Tuple[Optional[str], Optional[str], Optional[str], Optional[str], Dict]:
+        """→ (kind, namespace, name, subresource, query)"""
+        u = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        parts = [p for p in u.path.split("/") if p]
+        # /api/v1/... only
+        if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+            return None, None, None, None, q
+        rest = parts[2:]
+        ns: Optional[str] = None
+        if rest and rest[0] == "namespaces" and len(rest) >= 2:
+            ns = rest[1]
+            rest = rest[2:]
+        if not rest:
+            return None, ns, None, None, q
+        kind = PLURALS.get(rest[0])
+        name = rest[1] if len(rest) >= 2 else None
+        sub = rest[2] if len(rest) >= 3 else None
+        return kind, ns, name, sub, q
+
+    # -- verbs ---------------------------------------------------------
+    def do_GET(self) -> None:
+        u = urlparse(self.path)
+        if u.path in ("/healthz", "/livez", "/readyz"):
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/metrics":
+            text = self.server.metrics_text()
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        kind, ns, name, sub, q = self._route()
+        if kind is None:
+            self._send_error(404, "NotFound", f"no route for {self.path}")
+            return
+        try:
+            self._check_authz("get" if name else "list", kind, ns or "")
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        store = self.server.store
+        if q.get("watch") in ("true", "1"):
+            try:
+                rv = int(q.get("resourceVersion") or 0)
+            except ValueError:
+                self._send_error(
+                    400, "BadRequest",
+                    f"invalid resourceVersion {q.get('resourceVersion')!r}",
+                )
+                return
+            self._serve_watch(kind, ns, rv)
+            return
+        if name is not None:
+            obj = store.get_object(kind, ns or "default", name)
+            if obj is None:
+                self._send_error(404, "NotFound", f"{kind} {name!r} not found")
+                return
+            self._send_json(200, to_wire(obj))
+            return
+        # list + RV atomically: a watch from this RV misses nothing
+        objs, rv = store.list_objects_with_rv(kind, ns)
+        self._send_json(
+            200,
+            {
+                "kind": f"{kind}List",
+                "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(rv)},
+                "items": [to_wire(o) for o in objs],
+            },
+        )
+
+    def do_POST(self) -> None:
+        kind, ns, name, sub, q = self._route()
+        if kind is None:
+            self._send_error(404, "NotFound", f"no route for {self.path}")
+            return
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._send_error(400, "BadRequest", f"invalid JSON: {e}")
+            return
+        store = self.server.store
+        # Binding subresource: POST .../pods/{name}/binding
+        if kind == "Pod" and sub == "binding" and name is not None:
+            try:
+                self._check_authz("create", "Binding", ns or "")
+                target = (body.get("target") or {}).get("name") or body.get("nodeName", "")
+                store.bind(ns or "default", name, body.get("uid", ""), target)
+                self._send_json(201, {"kind": "Status", "status": "Success"})
+            except Forbidden as e:
+                self._send_error(403, "Forbidden", str(e))
+            except KeyError as e:
+                self._send_error(404, "NotFound", str(e))
+            except ValueError as e:
+                self._send_error(409, "Conflict", str(e))
+            return
+        try:
+            user = self._check_authz("create", kind, ns or "")
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        try:
+            obj = from_wire(body, kind)
+        except (ValueError, TypeError) as e:
+            # decode failure (bad quantity, wrong shape) is the client's
+            # fault — 400, never the store-conflict 409
+            self._send_error(400, "BadRequest", str(e))
+            return
+        try:
+            if ns is not None and store.kind_is_namespaced(kind):
+                obj.metadata.namespace = ns
+            obj = self.server.admission.run(
+                AdmissionRequest(CREATE, kind, obj.metadata.namespace, obj, user=user)
+            )
+            created = store.create_object(kind, obj)
+            self._send_json(201, to_wire(created))
+        except AdmissionError as e:
+            self._send_error(422, "Invalid", str(e))
+        except ValueError as e:
+            self._send_error(409, "AlreadyExists", str(e))
+
+    def do_PUT(self) -> None:
+        kind, ns, name, sub, q = self._route()
+        if kind is None or name is None:
+            self._send_error(404, "NotFound", f"no route for {self.path}")
+            return
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._send_error(400, "BadRequest", f"invalid JSON: {e}")
+            return
+        store = self.server.store
+        # status subresource — phase/podIP only (kubelet status-manager path)
+        if kind == "Pod" and sub == "status":
+            try:
+                self._check_authz("update", "Pod", ns or "")
+            except Forbidden as e:
+                self._send_error(403, "Forbidden", str(e))
+                return
+            status = body.get("status") or {}
+            if store.set_pod_phase(
+                ns or "default",
+                name,
+                status.get("phase", ""),
+                status.get("podIP", ""),
+                status.get("hostIP", ""),
+            ):
+                self._send_json(200, {"kind": "Status", "status": "Success"})
+            else:
+                self._send_error(404, "NotFound", f"pod {name!r} not found")
+            return
+        try:
+            user = self._check_authz("update", kind, ns or "")
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        try:
+            obj = from_wire(body, kind)
+        except (ValueError, TypeError) as e:
+            self._send_error(400, "BadRequest", str(e))
+            return
+        if obj.metadata.name and obj.metadata.name != name:
+            # reference returns 400 when the body renames the URL's object
+            self._send_error(
+                400, "BadRequest",
+                f"name in body ({obj.metadata.name!r}) must match URL ({name!r})",
+            )
+            return
+        obj.metadata.name = name
+        try:
+            if ns is not None and store.kind_is_namespaced(kind):
+                obj.metadata.namespace = ns
+            old = store.get_object(kind, obj.metadata.namespace, name)
+            obj = self.server.admission.run(
+                AdmissionRequest(
+                    UPDATE, kind, obj.metadata.namespace, obj, old_obj=old, user=user
+                )
+            )
+            expect = body.get("metadata", {}).get("resourceVersion") or None
+            updated = store.update_object(kind, obj, expect_rv=expect)
+            self._send_json(200, to_wire(updated))
+        except AdmissionError as e:
+            self._send_error(422, "Invalid", str(e))
+        except ConflictError as e:
+            self._send_error(409, "Conflict", str(e))
+        except KeyError as e:
+            self._send_error(404, "NotFound", str(e))
+
+    def do_DELETE(self) -> None:
+        kind, ns, name, sub, q = self._route()
+        if kind is None or name is None:
+            self._send_error(404, "NotFound", f"no route for {self.path}")
+            return
+        try:
+            self._check_authz("delete", kind, ns or "")
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        if self.server.store.delete_object(kind, ns or "default", name):
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+        else:
+            self._send_error(404, "NotFound", f"{kind} {name!r} not found")
+
+    # -- watch streaming ----------------------------------------------
+    def _serve_watch(self, kind: str, ns: Optional[str], rv: int) -> None:
+        frames: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=10_000)
+
+        def sink(event_rv: int, event: Event) -> None:
+            if event.kind != kind:
+                return
+            if ns is not None and getattr(event.obj.metadata, "namespace", None) != ns:
+                return
+            frame = json.dumps(
+                {"type": event.type, "object": to_wire(event.obj)}
+            ).encode() + b"\n"
+            try:
+                frames.put_nowait(frame)
+            except queue.Full:
+                # slow watcher: drop the connection (apiserver does the
+                # same). This sink runs under the store lock, so never
+                # block — make room for the close sentinel instead.
+                try:
+                    frames.get_nowait()
+                    frames.put_nowait(None)
+                except (queue.Empty, queue.Full):
+                    pass
+
+        try:
+            handle = self.server.watch_cache.watch_from(rv, sink)
+        except TooOldResourceVersion as e:
+            self._send_error(410, "Expired", str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.server.stopping.is_set():
+                try:
+                    frame = frames.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if frame is None:
+                    break
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            handle.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+
+class APIServer(ThreadingHTTPServer):
+    """In-process kube-apiserver equivalent. Serves a ClusterStore over
+    REST; start with .start(), stop with .shutdown_server()."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        store: Optional[ClusterStore] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admission: Optional[AdmissionChain] = None,
+        authorizer: Authorizer = allow_all,
+        tokens: Optional[Dict[str, str]] = None,
+        metrics_text_fn: Optional[Callable[[], str]] = None,
+    ):
+        super().__init__((host, port), _Handler)
+        self.store = store if store is not None else ClusterStore()
+        self.watch_cache = WatchCache(self.store)
+        self.admission = admission if admission is not None else AdmissionChain.default()
+        self.authorizer = authorizer
+        self.tokens = dict(tokens or {})  # bearer token -> username
+        self.stopping = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._metrics_text_fn = metrics_text_fn
+
+    def metrics_text(self) -> str:
+        if self._metrics_text_fn is not None:
+            return self._metrics_text_fn()
+        try:
+            from kubernetes_tpu.metrics import default_registry
+
+            return default_registry().expose()
+        except Exception:
+            return ""
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server_address[0]}:{self.port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown_server(self) -> None:
+        self.stopping.set()
+        self.shutdown()
+        self.watch_cache.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Client (the remote face of client-go's RESTClient + watch package)
+
+
+class WatchHandle:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resp = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Force the blocked readline() to return so the thread, socket,
+        # and the server-side sink registration are all released. Must be
+        # socket.shutdown, NOT resp.close(): close() needs the buffered-
+        # reader lock the blocked readline() holds → deadlock.
+        import socket as _socket
+
+        resp = self._resp
+        sock = getattr(getattr(resp, "fp", None), "raw", None)
+        sock = getattr(sock, "_sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class RestClient:
+    """Typed HTTP client. list/watch feed the same informer machinery the
+    in-process store feeds (reference client-go RESTClient +
+    tools/watch)."""
+
+    def __init__(self, base_url: str, token: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+
+    # -- low-level -----------------------------------------------------
+    def _request(self, method: str, path: str, body: Any = None):
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method
+        )
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def _path(self, kind: str, namespace: Optional[str], name: Optional[str] = None,
+              sub: Optional[str] = None) -> str:
+        plural = KIND_TO_PLURAL[kind]
+        p = f"/api/v1/namespaces/{namespace}/{plural}" if namespace else f"/api/v1/{plural}"
+        if name:
+            p += f"/{name}"
+        if sub:
+            p += f"/{sub}"
+        return p
+
+    @staticmethod
+    def _raise_for(code: int, payload: Any) -> None:
+        if code < 400:
+            return
+        msg = payload.get("message", "") if isinstance(payload, dict) else str(payload)
+        if code == 404:
+            raise KeyError(msg)
+        if code == 409:
+            raise ConflictError(msg)
+        if code in (403, 422):
+            raise PermissionError(msg)
+        raise RuntimeError(f"HTTP {code}: {msg}")
+
+    # -- typed verbs ---------------------------------------------------
+    def create(self, obj) -> Any:
+        kind = type(obj).__name__
+        ns = obj.metadata.namespace if is_namespaced(kind) else None
+        code, payload = self._request(
+            "POST", self._path(kind, ns), to_wire(obj)
+        )
+        self._raise_for(code, payload)
+        return from_wire(payload, kind)
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = "default"):
+        ns = namespace if is_namespaced(kind) else None
+        code, payload = self._request("GET", self._path(kind, ns, name))
+        if code == 404:
+            return None
+        self._raise_for(code, payload)
+        return from_wire(payload, kind)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> Tuple[List[Any], int]:
+        """→ (objects, listResourceVersion) for watch bootstrapping."""
+        code, payload = self._request("GET", self._path(kind, namespace))
+        self._raise_for(code, payload)
+        rv = int(payload.get("metadata", {}).get("resourceVersion") or 0)
+        return [from_wire(item, kind) for item in payload.get("items", [])], rv
+
+    def update(self, obj) -> Any:
+        kind = type(obj).__name__
+        ns = obj.metadata.namespace if is_namespaced(kind) else None
+        code, payload = self._request(
+            "PUT", self._path(kind, ns, obj.metadata.name), to_wire(obj)
+        )
+        self._raise_for(code, payload)
+        return from_wire(payload, kind)
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = "default") -> bool:
+        ns = namespace if is_namespaced(kind) else None
+        code, payload = self._request("DELETE", self._path(kind, ns, name))
+        return code == 200
+
+    def bind(self, namespace: str, name: str, uid: str, node_name: str) -> None:
+        code, payload = self._request(
+            "POST",
+            self._path("Pod", namespace, name, "binding"),
+            {"kind": "Binding", "target": {"name": node_name}, "uid": uid},
+        )
+        self._raise_for(code, payload)
+
+    def update_pod_status(self, namespace: str, name: str, phase: str,
+                          pod_ip: str = "", host_ip: str = "") -> None:
+        code, payload = self._request(
+            "PUT",
+            self._path("Pod", namespace, name, "status"),
+            {"status": {"phase": phase, "podIP": pod_ip, "hostIP": host_ip}},
+        )
+        self._raise_for(code, payload)
+
+    def healthz(self) -> bool:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.base_url + "/healthz", timeout=5) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    # -- watch ---------------------------------------------------------
+    def watch(
+        self,
+        kind: str,
+        resource_version: int,
+        fn: Callable[[str, Any], None],
+        namespace: Optional[str] = None,
+        on_expired: Optional[Callable[[], None]] = None,
+    ) -> WatchHandle:
+        """Stream watch events; fn(type, obj) per frame on a daemon
+        thread. On HTTP 410 (compacted RV) calls on_expired and exits —
+        the reflector's relist trigger."""
+        import urllib.error
+        import urllib.request
+
+        handle = WatchHandle()
+        path = self._path(kind, namespace) + f"?watch=true&resourceVersion={resource_version}"
+        req = urllib.request.Request(self.base_url + path)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+
+        def run() -> None:
+            try:
+                resp = urllib.request.urlopen(req)
+            except urllib.error.HTTPError as e:
+                if e.code == 410 and on_expired is not None:
+                    on_expired()
+                return
+            handle._resp = resp
+            if handle._stop.is_set():
+                resp.close()
+                return
+            with resp:
+                try:
+                    while not handle._stop.is_set():
+                        line = resp.readline()
+                        if not line:
+                            break
+                        line = line.strip()
+                        if not line:
+                            continue
+                        frame = json.loads(line)
+                        fn(frame["type"], from_wire(frame["object"], kind))
+                except (OSError, ValueError, json.JSONDecodeError):
+                    # connection closed (possibly mid-frame) by stop()
+                    pass
+
+        handle._thread = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")
+        handle._thread.start()
+        return handle

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import (
     CSINode,
@@ -39,6 +39,11 @@ from kubernetes_tpu.api.types import (
 )
 
 ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
+
+
+class ConflictError(Exception):
+    """resourceVersion precondition failed (HTTP 409; reference
+    apierrors.NewConflict from GuaranteedUpdate)."""
 
 
 @dataclass
@@ -138,6 +143,9 @@ class ClusterStore:
             key = f"{namespace}/{name}"
             old = self._pods.pop(key, None)
             if old is not None:
+                # a delete creates a new revision (etcd semantics); stamp it
+                # on the final object so watch logs stay monotonic
+                old.metadata.resource_version = self._next_rv()
                 self._dispatch(Event(DELETED, "Pod", old))
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
@@ -207,6 +215,7 @@ class ClusterStore:
         with self._lock:
             old = table.pop(key, None)
             if old is not None:
+                old.metadata.resource_version = self._next_rv()
                 self._dispatch(Event(DELETED, kind, old))
 
     def add_node(self, node: Node) -> None:
@@ -397,19 +406,21 @@ class ClusterStore:
         self._upsert(self._rss, "ReplicaSet", f"{rs.namespace}/{rs.name}", rs)
 
     def set_pod_phase(self, namespace: str, name: str, phase: str,
-                      pod_ip: str = "", host_ip: str = "") -> None:
+                      pod_ip: str = "", host_ip: str = "") -> bool:
         """Pod status subresource update (the kubelet's status manager
-        path): phase + network identity, dispatched as MODIFIED."""
+        path): phase + network identity, dispatched as MODIFIED. Returns
+        False if the pod no longer exists (REST layer's 404)."""
         with self._lock:
             key = f"{namespace}/{name}"
             pod = self._pods.get(key)
             if pod is None:
-                return
+                return False
             import copy
 
             new_pod = copy.copy(pod)
             new_pod.status = copy.copy(pod.status)
-            new_pod.status.phase = phase
+            if phase:
+                new_pod.status.phase = phase
             if pod_ip:
                 new_pod.status.pod_ip = pod_ip
             if host_ip:
@@ -418,6 +429,7 @@ class ClusterStore:
             new_pod.metadata.resource_version = self._next_rv()
             self._pods[key] = new_pod
             self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))
+            return True
 
     def add_pdb(self, pdb: PodDisruptionBudget) -> None:
         self._upsert(self._pdbs, "PodDisruptionBudget",
@@ -426,6 +438,107 @@ class ClusterStore:
     def list_pdbs(self) -> List[PodDisruptionBudget]:
         with self._lock:
             return list(self._pdbs.values())
+
+    # ------------------------------------------------------------------
+    # generic typed-object surface (the REST registry's view;
+    # reference generic/registry/store.go serves every resource through
+    # one generic Store parameterized by strategy)
+    _KIND_TABLES = {
+        "Pod": ("_pods", True),
+        "Node": ("_nodes", False),
+        "Service": ("_services", True),
+        "Endpoints": ("_endpoints", True),
+        "ReplicaSet": ("_rss", True),
+        "ReplicationController": ("_rcs", True),
+        "StatefulSet": ("_sss", True),
+        "Deployment": ("_deployments", True),
+        "DaemonSet": ("_daemon_sets", True),
+        "Job": ("_jobs", True),
+        "PersistentVolumeClaim": ("_pvcs", True),
+        "PersistentVolume": ("_pvs", False),
+        "StorageClass": ("_storage_classes", False),
+        "CSINode": ("_csi_nodes", False),
+        "PodDisruptionBudget": ("_pdbs", True),
+    }
+
+    def _table_key(self, kind: str, namespace: str, name: str):
+        attr, namespaced = self._KIND_TABLES[kind]
+        key = f"{namespace}/{name}" if namespaced else name
+        return getattr(self, attr), key
+
+    def kind_is_namespaced(self, kind: str) -> bool:
+        return self._KIND_TABLES[kind][1]
+
+    def known_kinds(self) -> List[str]:
+        return list(self._KIND_TABLES)
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def create_object(self, kind: str, obj) -> Any:
+        with self._lock:
+            table, key = self._table_key(
+                kind, obj.metadata.namespace, obj.metadata.name
+            )
+            if key in table:
+                raise ValueError(f"{kind} {key!r} already exists")
+            obj.metadata.resource_version = self._next_rv()
+            table[key] = obj
+            self._dispatch(Event(ADDED, kind, obj))
+            return obj
+
+    def update_object(self, kind: str, obj, expect_rv: Optional[str] = None) -> Any:
+        """Optimistic-concurrency update: fails on missing object or, when
+        expect_rv is given, on a resourceVersion conflict (HTTP 409 path —
+        reference GuaranteedUpdate's revision precondition)."""
+        with self._lock:
+            table, key = self._table_key(
+                kind, obj.metadata.namespace, obj.metadata.name
+            )
+            old = table.get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            if expect_rv and old.metadata.resource_version != expect_rv:
+                raise ConflictError(
+                    f"{kind} {key!r}: resourceVersion conflict "
+                    f"(have {old.metadata.resource_version}, want {expect_rv})"
+                )
+            obj.metadata.resource_version = self._next_rv()
+            table[key] = obj
+            self._dispatch(Event(MODIFIED, kind, obj, old))
+            return obj
+
+    def delete_object(self, kind: str, namespace: str, name: str) -> bool:
+        with self._lock:
+            table, key = self._table_key(kind, namespace, name)
+            old = table.pop(key, None)
+            if old is None:
+                return False
+            old.metadata.resource_version = self._next_rv()
+            self._dispatch(Event(DELETED, kind, old))
+            return True
+
+    def get_object(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            table, key = self._table_key(kind, namespace, name)
+            return table.get(key)
+
+    def list_objects(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
+        return self.list_objects_with_rv(kind, namespace)[0]
+
+    def list_objects_with_rv(
+        self, kind: str, namespace: Optional[str] = None
+    ) -> Tuple[List[Any], int]:
+        """List + the RV the list is consistent at, atomically — the
+        List+Watch bootstrap contract (a watch from this RV misses
+        nothing that isn't already in the list)."""
+        with self._lock:
+            attr, namespaced = self._KIND_TABLES[kind]
+            objs = list(getattr(self, attr).values())
+            if namespace is not None and namespaced:
+                objs = [o for o in objs if o.metadata.namespace == namespace]
+            return objs, self._rv
 
     # ------------------------------------------------------------------
     # volume binding support (SchedulerVolumeBinder assume/commit)

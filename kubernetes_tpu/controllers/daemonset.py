@@ -32,9 +32,17 @@ class DaemonSetController(Controller):
         )
         self.factory.informer_for("Node").add_event_handler(
             on_add=lambda n: self._all_daemonsets(),
+            # cordon/taint/uncordon arrive as node updates and change
+            # daemon-pod eligibility (reference daemon controller's
+            # updateNode path)
+            on_update=lambda old, new: self._all_daemonsets(),
             on_delete=lambda n: self._all_daemonsets(),
         )
         self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            # binding arrives as MODIFIED; without it ready_replicas
+            # would stay stale until an unrelated event
+            on_update=lambda old, new: self._pod_changed(new),
             on_delete=self._pod_changed,
         )
         self.pod_lister = self.factory.lister_for("Pod")

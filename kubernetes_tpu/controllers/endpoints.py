@@ -79,4 +79,10 @@ class EndpointsController(Controller):
                        ports=list(svc.ports))
         ep.metadata.name = name
         ep.metadata.namespace = ns
+        # skip the no-op write: an unconditional upsert would bump the
+        # resourceVersion and fan a spurious MODIFIED to every watcher on
+        # each pod event per selecting service
+        old = self.store.get_endpoints(ns, name)
+        if old is not None and old.addresses == ep.addresses and old.ports == ep.ports:
+            return
         self.store.upsert_endpoints(ep)

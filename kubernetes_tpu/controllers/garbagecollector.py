@@ -56,15 +56,28 @@ class GarbageCollector(Controller):
         for list_name in _OWNER_KINDS.values():
             for obj in getattr(self.store, list_name)():
                 live_uids.add(obj.metadata.uid)
-        # dependents: pods owned by a controller that no longer exists
+        # dependents: pods owned by a controller that no longer exists.
+        # Only kinds we track count as "absent"; an owner of an untracked
+        # kind can't be proven dead, so its dependents are left alone
+        # (upstream GC deletes only when the referenced object is
+        # actually verified absent).
+        tracked = set(_OWNER_KINDS)
         for pod in self.pod_lister.list():
             for ref in pod.metadata.owner_references:
-                if ref.get("controller") and ref.get("uid") not in live_uids:
+                if (
+                    ref.get("controller")
+                    and ref.get("kind") in tracked
+                    and ref.get("uid") not in live_uids
+                ):
                     self.store.delete_pod(pod.namespace, pod.name)
                     break
         # second-level: ReplicaSets owned by a vanished Deployment
         for rs in self.store.list_all_replica_sets():
             for ref in rs.metadata.owner_references:
-                if ref.get("controller") and ref.get("uid") not in live_uids:
+                if (
+                    ref.get("controller")
+                    and ref.get("kind") in tracked
+                    and ref.get("uid") not in live_uids
+                ):
                     self.store.delete_replica_set(rs.namespace, rs.name)
                     break

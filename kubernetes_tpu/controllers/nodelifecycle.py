@@ -36,6 +36,15 @@ class NodeLifecycleController(Controller):
     def register(self) -> None:
         self.node_lister = self.factory.lister_for("Node")
         self.pod_lister = self.factory.lister_for("Pod")
+        # purge health bookkeeping on delete, or a re-registered node with
+        # the same name inherits stale not-ready timestamps and gets its
+        # pods evicted on the first monitor tick instead of a grace period
+        self.factory.informer_for("Node").add_event_handler(
+            on_delete=lambda n: (
+                self._not_ready_since.pop(n.name, None),
+                self._first_seen.pop(n.name, None),
+            ),
+        )
         self._monitor_stop = threading.Event()
 
     def run(self) -> None:

@@ -54,16 +54,25 @@ class StatefulSetController(Controller):
                 break
         if sset is None:
             return
-        # ordinal -> pod, from the live store (names are deterministic)
-        pods = {}
-        for i in range(max(sset.replicas, 0) + 1024):
-            p = self.store.get_pod(ns, f"{name}-{i}")
-            if p is None:
-                if i >= sset.replicas:
-                    break
-                pods[i] = None
-            else:
-                pods[i] = p
+        # ordinal -> pod, in one list pass (names are deterministic
+        # "{name}-{ordinal}"). A full listing also finds higher ordinals
+        # stranded behind a gap after a scale-down race, which a scan
+        # stopping at the first missing ordinal would leak forever.
+        prefix = f"{name}-"
+        pods = {i: None for i in range(max(sset.replicas, 0))}
+        for p in self.store.list_pods(ns):
+            if not p.name.startswith(prefix):
+                continue
+            suffix = p.name[len(prefix):]
+            if not suffix.isdigit():
+                continue
+            refs = p.metadata.owner_references
+            if refs and not any(
+                r.get("kind") == "StatefulSet" and r.get("name") == name
+                for r in refs
+            ):
+                continue  # same name prefix, different owner
+            pods[int(suffix)] = p
         existing = [i for i, p in pods.items() if p is not None]
         # scale down: delete highest ordinal first, one at a time
         if existing and max(existing) >= sset.replicas:

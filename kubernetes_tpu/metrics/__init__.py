@@ -5,3 +5,11 @@ from kubernetes_tpu.metrics.registry import (
     MetricsRegistry,
 )
 from kubernetes_tpu.metrics.scheduler_metrics import SchedulerMetrics
+
+# process-wide registry (reference component-base/metrics/legacyregistry):
+# components register into this unless given their own; /metrics serves it
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default_registry

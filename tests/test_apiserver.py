@@ -1,0 +1,251 @@
+"""REST apiserver ring: codec, watch cache, admission, HTTP CRUD + watch.
+
+Mirrors the reference's integration-test ring (SURVEY.md section 4 ring 2):
+a real in-process apiserver, real HTTP, no kubelets.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.serialization import from_wire, roundtrip_equal, to_wire
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.apiserver.admission import (
+    AdmissionChain,
+    AdmissionError,
+    AdmissionRequest,
+    CREATE,
+    DefaultTolerationSeconds,
+    LimitRanger,
+    NamespaceLifecycle,
+    PodPriorityResolver,
+)
+from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+from kubernetes_tpu.apiserver.store import ClusterStore, ConflictError
+from kubernetes_tpu.apiserver.watchcache import TooOldResourceVersion, WatchCache
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+def test_codec_roundtrip_pod_with_affinity():
+    pod = (
+        MakePod().name("p").uid("u1").req({"cpu": "250m", "memory": "64Mi"})
+        .label("app", "web")
+        .pod_anti_affinity("app", ["web"], "kubernetes.io/hostname")
+        .spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})
+        .obj()
+    )
+    assert roundtrip_equal(pod)
+    back = from_wire(to_wire(pod))
+    assert back.uid == "u1"
+    assert back.spec.containers[0].resources.requests["cpu"].milli_value() == 250
+    assert back.spec.topology_spread_constraints[0].max_skew == 1
+
+
+def test_codec_roundtrip_node():
+    node = (
+        MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": "110"})
+        .label("topology.kubernetes.io/zone", "z1").obj()
+    )
+    assert roundtrip_equal(node)
+    back = from_wire(to_wire(node))
+    assert back.status.allocatable["memory"].value() == 8 * 2**30
+
+
+# ---------------------------------------------------------------------------
+# watch cache
+
+
+def test_watchcache_replay_from_rv():
+    store = ClusterStore()
+    cache = WatchCache(store)
+    store.create_pod(MakePod().name("a").obj())
+    p_b = store.create_pod(MakePod().name("b").obj())
+    rv_after_b = int(p_b.metadata.resource_version)
+    store.create_pod(MakePod().name("c").obj())
+
+    seen = []
+    handle = cache.watch_from(rv_after_b, lambda rv, e: seen.append(e.obj.name))
+    assert seen == ["c"]  # only events after rv(b) replayed
+    store.create_pod(MakePod().name("d").obj())
+    assert seen == ["c", "d"]  # live event delivered
+    handle.stop()
+    store.create_pod(MakePod().name("e").obj())
+    assert "e" not in seen
+
+
+def test_watchcache_too_old_rv_after_compaction():
+    store = ClusterStore()
+    cache = WatchCache(store)
+    for i in range(10):
+        store.create_pod(MakePod().name(f"p{i}").obj())
+    cache.compact(keep_last=2)
+    with pytest.raises(TooOldResourceVersion):
+        cache.watch_from(0, lambda rv, e: None)
+    # watching from the newest rv still works
+    cache.watch_from(cache.latest_rv(), lambda rv, e: None)
+
+
+def test_delete_bumps_resource_version():
+    store = ClusterStore()
+    cache = WatchCache(store)
+    p = store.create_pod(MakePod().name("a").obj())
+    rv_created = int(p.metadata.resource_version)
+    events = []
+    cache.watch_from(rv_created, lambda rv, e: events.append((rv, e.type)))
+    store.delete_pod("default", "a")
+    assert events and events[-1][1] == "DELETED"
+    assert events[-1][0] > rv_created
+
+
+# ---------------------------------------------------------------------------
+# admission
+
+
+def test_admission_default_tolerations_and_requests():
+    chain = AdmissionChain(
+        [DefaultTolerationSeconds(), LimitRanger({"cpu": "100m", "memory": "200Mi"})]
+    )
+    pod = MakePod().name("p").container().obj()
+    chain.run(AdmissionRequest(CREATE, "Pod", "default", pod))
+    keys = {t.key for t in pod.spec.tolerations}
+    assert "node.kubernetes.io/not-ready" in keys
+    assert "node.kubernetes.io/unreachable" in keys
+    assert pod.spec.containers[0].resources.requests["cpu"].milli_value() == 100
+
+
+def test_admission_namespace_lifecycle_rejects_terminating():
+    chain = AdmissionChain([NamespaceLifecycle({"default": "Active", "dying": "Terminating"})])
+    ok = MakePod().name("p").obj()
+    chain.run(AdmissionRequest(CREATE, "Pod", "default", ok))
+    bad = MakePod().name("q").namespace("dying").obj()
+    with pytest.raises(AdmissionError):
+        chain.run(AdmissionRequest(CREATE, "Pod", "dying", bad))
+
+
+def test_admission_priority_class_resolution():
+    chain = AdmissionChain([PodPriorityResolver({"high": 1000})])
+    pod = MakePod().name("p").obj()
+    pod.spec.priority_class_name = "high"
+    chain.run(AdmissionRequest(CREATE, "Pod", "default", pod))
+    assert pod.spec.priority == 1000
+    bad = MakePod().name("q").obj()
+    bad.spec.priority_class_name = "nonexistent"
+    with pytest.raises(AdmissionError):
+        chain.run(AdmissionRequest(CREATE, "Pod", "default", bad))
+
+
+# ---------------------------------------------------------------------------
+# HTTP server end-to-end
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.shutdown_server()
+
+
+def test_rest_crud_and_binding(server):
+    client = RestClient(server.url)
+    assert client.healthz()
+
+    node = client.create(MakeNode().name("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+    assert node.metadata.resource_version != ""
+
+    pod = client.create(MakePod().name("web").uid("u-web").req({"cpu": "100m"}).obj())
+    assert pod.spec.node_name == ""
+    # admission chain ran on the REST path
+    assert any(t.key == "node.kubernetes.io/not-ready" for t in pod.spec.tolerations)
+
+    # bind via the Binding subresource, observe nodeName on read-back
+    client.bind("default", "web", "u-web", "n1")
+    bound = client.get("Pod", "web")
+    assert bound.spec.node_name == "n1"
+    # double-bind conflicts
+    with pytest.raises(ConflictError):
+        client.bind("default", "web", "u-web", "n2")
+
+    pods, rv = client.list("Pod")
+    assert [p.name for p in pods] == ["web"] and rv > 0
+
+    client.update_pod_status("default", "web", "Running", pod_ip="10.0.0.5")
+    assert client.get("Pod", "web").status.phase == "Running"
+
+    assert client.delete("Pod", "web")
+    assert client.get("Pod", "web") is None
+    assert not client.delete("Pod", "web")
+
+
+def test_rest_update_conflict_on_stale_rv(server):
+    client = RestClient(server.url)
+    client.create(MakeNode().name("n1").obj())
+    n1 = client.get("Node", "n1")
+    n1b = client.get("Node", "n1")
+    n1.metadata.labels["a"] = "1"
+    client.update(n1)
+    n1b.metadata.labels["b"] = "2"
+    with pytest.raises(ConflictError):
+        client.update(n1b)  # stale resourceVersion
+
+
+def test_rest_watch_stream_replays_and_streams(server):
+    client = RestClient(server.url)
+    client.create(MakePod().name("p0").obj())
+    _, rv0 = client.list("Pod")
+
+    got = []
+    done = threading.Event()
+
+    def on_event(etype, obj):
+        got.append((etype, obj.name))
+        if len(got) >= 2:
+            done.set()
+
+    handle = client.watch("Pod", 0, on_event)  # rv=0 → replay everything
+    client.create(MakePod().name("p1").obj())
+    assert done.wait(5), f"watch frames: {got}"
+    assert ("ADDED", "p0") in got and ("ADDED", "p1") in got
+    handle.stop()
+
+    # watch from the list RV sees only the new pod
+    got2 = []
+    done2 = threading.Event()
+    handle2 = client.watch(
+        "Pod", rv0, lambda t, o: (got2.append((t, o.name)), done2.set())
+    )
+    # p1's create happened after rv0 — replayed; nothing else required
+    assert done2.wait(5)
+    assert got2[0] == ("ADDED", "p1")
+    handle2.stop()
+
+
+def test_rest_authz_denies(server):
+    server.authorizer = lambda user, verb, kind, ns: verb != "delete"
+    client = RestClient(server.url)
+    client.create(MakeNode().name("n1").obj())
+    assert not client.delete("Node", "n1")
+    assert client.get("Node", "n1") is not None
+
+
+def test_rest_feeds_informers_over_http(server):
+    """The reflector contract: list+watch over real HTTP drives handlers."""
+    client = RestClient(server.url)
+    client.create(MakePod().name("seed").obj())
+
+    adds = []
+    synced = threading.Event()
+    objs, rv = client.list("Pod")
+    for o in objs:
+        adds.append(o.name)
+    handle = client.watch(
+        "Pod", rv, lambda t, o: (adds.append(o.name), synced.set())
+    )
+    client.create(MakePod().name("late").obj())
+    assert synced.wait(5)
+    assert adds == ["seed", "late"]
+    handle.stop()
