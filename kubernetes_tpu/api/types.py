@@ -370,6 +370,7 @@ class PodSpec:
     )
     volumes: List[Volume] = field(default_factory=list)
     host_network: bool = False
+    restart_policy: str = "Always"  # Always | OnFailure | Never
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "PodSpec":
@@ -393,6 +394,7 @@ class PodSpec:
             ],
             volumes=[Volume.from_dict(v) for v in (d.get("volumes") or [])],
             host_network=bool(d.get("hostNetwork")),
+            restart_policy=d.get("restartPolicy") or "Always",
         )
 
 
@@ -577,6 +579,7 @@ class Service:
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""
+    session_affinity: str = "None"  # None | ClientIP
 
     @property
     def name(self) -> str:

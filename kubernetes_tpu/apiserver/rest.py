@@ -248,7 +248,34 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self.server.admission.run(
                 AdmissionRequest(CREATE, kind, obj.metadata.namespace, obj, user=user)
             )
-            created = store.create_object(kind, obj)
+            allocated_ip = None
+            if kind == "Service":
+                # the registry assigns the VIP (reference
+                # pkg/registry/core/service/ipallocator)
+                from kubernetes_tpu.proxy.ipallocator import IPAllocatorFull
+
+                try:
+                    if obj.cluster_ip:
+                        if not self.server.ip_allocator.reserve(obj.cluster_ip):
+                            self._send_error(
+                                422, "Invalid",
+                                f"clusterIP {obj.cluster_ip!r} unavailable",
+                            )
+                            return
+                        allocated_ip = obj.cluster_ip
+                    else:
+                        allocated_ip = self.server.ip_allocator.allocate()
+                        obj.cluster_ip = allocated_ip
+                except IPAllocatorFull as e:
+                    self._send_error(422, "Invalid", str(e))
+                    return
+            try:
+                created = store.create_object(kind, obj)
+            except ValueError:
+                # don't leak the VIP when the create conflicts
+                if allocated_ip is not None:
+                    self.server.ip_allocator.release(allocated_ip)
+                raise
             self._send_json(201, to_wire(created))
         except AdmissionError as e:
             self._send_error(422, "Invalid", str(e))
@@ -307,6 +334,17 @@ class _Handler(BaseHTTPRequestHandler):
             if ns is not None and store.kind_is_namespaced(kind):
                 obj.metadata.namespace = ns
             old = store.get_object(kind, obj.metadata.namespace, name)
+            if kind == "Service" and old is not None:
+                # clusterIP is immutable (reference service strategy
+                # ValidateUpdate); an omitted field keeps the assigned VIP
+                if not obj.cluster_ip:
+                    obj.cluster_ip = old.cluster_ip
+                elif obj.cluster_ip != old.cluster_ip:
+                    self._send_error(
+                        422, "Invalid",
+                        f"clusterIP is immutable (have {old.cluster_ip!r})",
+                    )
+                    return
             obj = self.server.admission.run(
                 AdmissionRequest(
                     UPDATE, kind, obj.metadata.namespace, obj, old_obj=old, user=user
@@ -332,7 +370,10 @@ class _Handler(BaseHTTPRequestHandler):
         except Forbidden as e:
             self._send_error(403, "Forbidden", str(e))
             return
+        old = self.server.store.get_object(kind, ns or "default", name)
         if self.server.store.delete_object(kind, ns or "default", name):
+            if kind == "Service" and old is not None and old.cluster_ip:
+                self.server.ip_allocator.release(old.cluster_ip)
             self._send_json(200, {"kind": "Status", "status": "Success"})
         else:
             self._send_error(404, "NotFound", f"{kind} {name!r} not found")
@@ -415,6 +456,14 @@ class APIServer(ThreadingHTTPServer):
         self.stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._metrics_text_fn = metrics_text_fn
+        from kubernetes_tpu.proxy.ipallocator import IPAllocator
+
+        self.ip_allocator = IPAllocator()
+        # seed with VIPs of services already in a caller-supplied store so
+        # allocate() never hands out an in-use address
+        for svc in self.store.list_all_services():
+            if svc.cluster_ip:
+                self.ip_allocator.reserve(svc.cluster_ip)
 
     def metrics_text(self) -> str:
         if self._metrics_text_fn is not None:

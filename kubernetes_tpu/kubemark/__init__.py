@@ -1,0 +1,1 @@
+from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode

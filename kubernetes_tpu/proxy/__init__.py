@@ -1,0 +1,2 @@
+from kubernetes_tpu.proxy.ipallocator import IPAllocator, IPAllocatorFull
+from kubernetes_tpu.proxy.proxier import Proxier, Rule

@@ -1,0 +1,263 @@
+"""Node-agent ring: CRI state machine, checkpoints, device manager,
+probes, and the kubelet sync loop end-to-end against the cluster store."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import FAILED, RUNNING, SUCCEEDED
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubelet import (
+    CheckpointManager,
+    CorruptCheckpointError,
+    DeviceAllocationError,
+    DeviceManager,
+    DevicePlugin,
+    FakeRuntime,
+    Kubelet,
+    LIVENESS,
+    ProbeManager,
+    ProbeSpec,
+    READINESS,
+    TPU_RESOURCE,
+)
+from kubernetes_tpu.testing import MakePod
+
+
+def wait_for(cond, timeout=5.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# CRI
+
+
+def test_fake_runtime_lifecycle():
+    rt = FakeRuntime()
+    sid = rt.run_pod_sandbox("u1", "p", "default")
+    cid = rt.create_container(sid, "main", "busybox")
+    rt.start_container(cid)
+    assert rt.container_status(cid).state == "RUNNING"
+    with pytest.raises(RuntimeError):
+        rt.remove_container(cid)  # still running
+    rt.stop_container(cid)
+    st = rt.container_status(cid)
+    assert st.state == "EXITED" and st.exit_code == 137
+    with pytest.raises(RuntimeError):
+        rt.remove_pod_sandbox(sid)  # must stop first
+    rt.stop_pod_sandbox(sid)
+    rt.remove_pod_sandbox(sid)
+    assert rt.list_pod_sandboxes() == []
+    assert rt.list_containers() == []
+
+
+def test_fake_runtime_batch_exit_and_restart_count():
+    rt = FakeRuntime(exit_after={"job-image": 0.0})
+    sid = rt.run_pod_sandbox("u1", "p", "default")
+    cid = rt.create_container(sid, "main", "job-image")
+    rt.start_container(cid)
+    st = rt.container_status(cid)
+    assert st.state == "EXITED" and st.exit_code == 0
+    rt.start_container(cid)  # restart bumps counter
+    assert rt.container_status(cid).restarts == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoints
+
+
+def test_checkpoint_roundtrip_and_corruption(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    cm.create("state", {"a": [1, 2, 3]})
+    assert cm.get("state") == {"a": [1, 2, 3]}
+    assert cm.list() == ["state"]
+    # corrupt the file on disk → integrity error, not silent bad state
+    path = tmp_path / "state.ckpt"
+    raw = path.read_text().replace("[1, 2, 3]", "[1, 2, 9]")
+    path.write_text(raw)
+    with pytest.raises(CorruptCheckpointError):
+        cm.get("state")
+    cm.remove("state")
+    assert cm.get("state") is None
+
+
+# ---------------------------------------------------------------------------
+# device manager
+
+
+def test_device_manager_allocation_and_checkpoint(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    dm = DeviceManager(cm)
+    dm.register(DevicePlugin(TPU_RESOURCE, [f"tpu{i}" for i in range(8)]))
+    ids = dm.allocate("u1", "main", TPU_RESOURCE, 4)
+    assert len(ids) == 4
+    assert dm.allocatable()[TPU_RESOURCE] == 4
+    with pytest.raises(DeviceAllocationError):
+        dm.allocate("u2", "main", TPU_RESOURCE, 5)
+    # restart: a fresh manager reloads assignments from the checkpoint
+    dm2 = DeviceManager(cm)
+    dm2.register(DevicePlugin(TPU_RESOURCE, [f"tpu{i}" for i in range(8)]))
+    assert dm2.allocatable()[TPU_RESOURCE] == 4
+    assert dm2.devices_of("u1")[TPU_RESOURCE] == sorted(ids)
+    dm2.free("u1")
+    assert dm2.allocatable()[TPU_RESOURCE] == 8
+
+
+def test_device_manager_topology_contiguous():
+    dm = DeviceManager()
+    topo = {f"t{x}{y}": (x, y) for x in range(4) for y in range(4)}
+    dm.register(DevicePlugin(TPU_RESOURCE, sorted(topo), topology=topo))
+    ids = dm.allocate("u1", "c", TPU_RESOURCE, 4)
+    coords = [topo[d] for d in ids]
+    # every chosen chip is L1-adjacent to at least one other chosen chip
+    for c in coords:
+        assert any(
+            abs(c[0] - o[0]) + abs(c[1] - o[1]) == 1 for o in coords if o != c
+        ), coords
+
+
+# ---------------------------------------------------------------------------
+# probes
+
+
+def test_probe_thresholds():
+    pm = ProbeManager()
+    flaky = {"ok": True}
+    pm.add("u1", "main", READINESS, ProbeSpec(lambda: flaky["ok"], period=0.0,
+                                              failure_threshold=2))
+    pm.tick()
+    assert pm.pod_ready("u1")
+    flaky["ok"] = False
+    pm.tick()
+    assert pm.pod_ready("u1")  # one failure < threshold
+    pm.tick()
+    assert not pm.pod_ready("u1")
+    flaky["ok"] = True
+    pm.tick()
+    assert pm.pod_ready("u1")
+
+
+# ---------------------------------------------------------------------------
+# kubelet end-to-end (store-driven, no scheduler needed: bind directly)
+
+
+@pytest.fixture()
+def cluster():
+    store = ClusterStore()
+    kubelet = Kubelet(store, "n1", capacity={"cpu": "8", "memory": "16Gi"})
+    kubelet.start()
+    yield store, kubelet
+    kubelet.stop()
+
+
+def _bound_pod(store, name, node="n1", image="app", uid=None, **pod_kw):
+    pod = MakePod().name(name).uid(uid or f"u-{name}").container(image=image).obj()
+    for k, v in pod_kw.items():
+        setattr(pod.spec, k, v)
+    store.create_pod(pod)
+    store.bind("default", name, pod.uid, node)
+    return pod
+
+
+def test_kubelet_registers_node_and_runs_pod(cluster):
+    store, kubelet = cluster
+    node = store.get_node("n1")
+    assert node is not None and node.status.allocatable["cpu"].value() == 8
+
+    _bound_pod(store, "web")
+    assert wait_for(lambda: store.get_pod("default", "web").status.phase == RUNNING)
+    pod = store.get_pod("default", "web")
+    assert pod.status.pod_ip.startswith("10.88.0.")
+    assert pod.status.host_ip == "n1"
+    assert kubelet.running_pods()
+
+
+def test_kubelet_pod_delete_tears_down(cluster):
+    store, kubelet = cluster
+    p = _bound_pod(store, "web")
+    assert wait_for(lambda: kubelet.running_pods())
+    store.delete_pod("default", "web")
+    assert wait_for(lambda: not kubelet.running_pods())
+    assert kubelet.runtime.list_pod_sandboxes() == []
+    assert kubelet.volumes.mounted(p.uid) == []
+
+
+def test_kubelet_job_pod_succeeds():
+    store = ClusterStore()
+    kubelet = Kubelet(store, "n1", runtime=FakeRuntime(exit_after={"job": 0.0}))
+    kubelet.start()
+    try:
+        _bound_pod(store, "batch", image="job", restart_policy="Never")
+        assert wait_for(
+            lambda: store.get_pod("default", "batch").status.phase == SUCCEEDED
+        )
+        # terminal pod released its sandbox
+        assert wait_for(lambda: not kubelet.running_pods())
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_crashing_pod_fails_with_never_policy():
+    store = ClusterStore()
+    kubelet = Kubelet(store, "n1", runtime=FakeRuntime(fail_images={"bad"}))
+    kubelet.start()
+    try:
+        _bound_pod(store, "crash", image="bad", restart_policy="Never")
+        assert wait_for(
+            lambda: store.get_pod("default", "crash").status.phase == FAILED
+        )
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_tpu_device_admission():
+    store = ClusterStore()
+    dm = DeviceManager()
+    dm.register(DevicePlugin(TPU_RESOURCE, ["tpu0", "tpu1"]))
+    kubelet = Kubelet(store, "n1", device_manager=dm)
+    kubelet.start()
+    try:
+        node = store.get_node("n1")
+        assert node.status.capacity[TPU_RESOURCE].value() == 2
+
+        pod = MakePod().name("train").uid("u-train").req(
+            {"cpu": "1", TPU_RESOURCE: "2"}
+        ).obj()
+        store.create_pod(pod)
+        store.bind("default", "train", "u-train", "n1")
+        assert wait_for(
+            lambda: store.get_pod("default", "train").status.phase == RUNNING
+        )
+        assert dm.devices_of("u-train")[TPU_RESOURCE] == ["tpu0", "tpu1"]
+
+        # second TPU pod cannot be satisfied → Failed, devices intact
+        pod2 = MakePod().name("train2").uid("u-t2").req({TPU_RESOURCE: "1"}).obj()
+        store.create_pod(pod2)
+        store.bind("default", "train2", "u-t2", "n1")
+        assert wait_for(
+            lambda: store.get_pod("default", "train2").status.phase == FAILED
+        )
+        # deleting the first frees chips
+        store.delete_pod("default", "train")
+        assert wait_for(lambda: dm.allocatable()[TPU_RESOURCE] == 2)
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_liveness_restart(cluster):
+    store, kubelet = cluster
+    p = _bound_pod(store, "web")
+    assert wait_for(lambda: store.get_pod("default", "web").status.phase == RUNNING)
+    # inject a failing liveness probe → container restarted, pod stays Running
+    healthy = {"ok": False}
+    kubelet.probes.add(p.uid, "c0", LIVENESS,
+                       ProbeSpec(lambda: healthy["ok"], period=0.0,
+                                 failure_threshold=1))
+    cid = kubelet._containers_of[p.uid]["c0"]
+    assert wait_for(lambda: kubelet.runtime.container_status(cid).restarts >= 1)
+    assert store.get_pod("default", "web").status.phase == RUNNING

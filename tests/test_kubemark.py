@@ -1,0 +1,113 @@
+"""Kubemark ring: hollow nodes under the real scheduler — the full pod
+lifecycle (create → schedule → bind → kubelet runs → Running) without any
+real machines, plus node-lifecycle health integration."""
+
+import time
+
+from kubernetes_tpu.api.types import RUNNING
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubelet.devicemanager import TPU_RESOURCE
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import MakePod
+
+
+def wait_for(cond, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_hollow_cluster_runs_pods_end_to_end():
+    store = ClusterStore()
+    cluster = HollowCluster(store)
+    cluster.start_nodes(5, capacity={"cpu": "8", "memory": "16Gi"})
+    sched = Scheduler.create(store)
+    sched.run()
+    try:
+        for i in range(20):
+            store.create_pod(
+                MakePod().name(f"p{i}").uid(f"u{i}").req({"cpu": "500m"}).obj()
+            )
+        assert wait_for(
+            lambda: all(
+                p.status.phase == RUNNING and p.spec.node_name
+                for p in store.list_pods()
+            )
+        ), [(p.name, p.spec.node_name, p.status.phase) for p in store.list_pods()]
+        # pods spread across hollow nodes, each with a real pod IP
+        nodes_used = {p.spec.node_name for p in store.list_pods()}
+        assert len(nodes_used) >= 3
+        assert all(p.status.pod_ip for p in store.list_pods())
+    finally:
+        sched.stop()
+        cluster.stop()
+
+
+def test_hollow_nodes_expose_tpu_capacity_and_run_tpu_pods():
+    store = ClusterStore()
+    cluster = HollowCluster(store)
+    cluster.start_nodes(2, tpu_chips=4)
+    sched = Scheduler.create(store)
+    sched.run()
+    try:
+        node = store.get_node("hollow-0")
+        assert node.status.capacity[TPU_RESOURCE].value() == 4
+        store.create_pod(
+            MakePod().name("train").uid("ut").req(
+                {"cpu": "1", TPU_RESOURCE: "4"}
+            ).obj()
+        )
+        assert wait_for(
+            lambda: store.get_pod("default", "train").status.phase == RUNNING
+        )
+        node_name = store.get_pod("default", "train").spec.node_name
+        hollow = next(n for n in cluster.nodes if n.name == node_name)
+        uid = store.get_pod("default", "train").uid
+        assert len(hollow.kubelet.devices.devices_of(uid)[TPU_RESOURCE]) == 4
+    finally:
+        sched.stop()
+        cluster.stop()
+
+
+def test_hollow_heartbeats_keep_nodelifecycle_quiet():
+    from kubernetes_tpu.client import SharedInformerFactory
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+        UNREACHABLE_TAINT,
+    )
+
+    store = ClusterStore()
+    factory = SharedInformerFactory(store)
+    nlc = NodeLifecycleController(store, factory)
+    nlc.monitor_interval = 0.1
+    nlc.grace_period = 1.0
+    nlc.eviction_grace = 0.5
+    cluster = HollowCluster(store, heartbeat_fn=nlc.heartbeat)
+    cluster.start_nodes(3)
+    factory.start()
+    nlc.run()
+    try:
+        time.sleep(1.5)  # several grace periods with live heartbeats
+        for node in store.list_nodes():
+            assert not any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+        # kill one hollow node → it gets tainted, the others stay clean
+        dead = cluster.nodes[0]
+        dead.kubelet.stop()
+        assert wait_for(
+            lambda: any(
+                t.key == UNREACHABLE_TAINT
+                for t in store.get_node(dead.name).spec.taints
+            ),
+            timeout=5,
+        )
+        for node in store.list_nodes():
+            if node.name != dead.name:
+                assert not any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+    finally:
+        nlc.stop()
+        factory.stop()
+        cluster.stop()

@@ -1,0 +1,165 @@
+"""Dataplane ring: IP allocator, proxier rule sync, routing, affinity,
+and the full Service→Endpoints→Proxier pipeline with the endpoints
+controller."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    EndpointAddress,
+    Endpoints,
+    RUNNING,
+    Service,
+    ServicePort,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.proxy import IPAllocator, IPAllocatorFull, Proxier
+from kubernetes_tpu.testing import MakePod
+
+
+def _svc(name, selector, port=80, target=8080, ip="10.96.0.10",
+         affinity="None", ns="default"):
+    s = Service(selector=selector,
+                ports=[ServicePort(name="http", port=port, target_port=target)],
+                cluster_ip=ip, session_affinity=affinity)
+    s.metadata.name = name
+    s.metadata.namespace = ns
+    return s
+
+
+def _ep(name, ips, port=8080, ns="default"):
+    e = Endpoints(addresses=[EndpointAddress(ip=ip, target_pod=f"{ns}/p{i}")
+                             for i, ip in enumerate(ips)],
+                  ports=[ServicePort(name="http", port=port)])
+    e.metadata.name = name
+    e.metadata.namespace = ns
+    return e
+
+
+def test_ip_allocator_allocate_reserve_release():
+    alloc = IPAllocator("10.96.0.0/29")  # 8 addrs → 5 usable
+    ips = {alloc.allocate() for _ in range(5)}
+    assert len(ips) == 5
+    with pytest.raises(IPAllocatorFull):
+        alloc.allocate()
+    ip = ips.pop()
+    alloc.release(ip)
+    assert alloc.allocate() == ip
+    assert not alloc.reserve(ip)  # already used again
+    alloc.release(ip)
+    assert alloc.reserve(ip)
+
+
+def test_proxier_builds_rules_and_round_robins():
+    store = ClusterStore()
+    store.add_service(_svc("web", {"app": "web"}))
+    store.upsert_endpoints(_ep("web", ["10.88.0.2", "10.88.0.3"]))
+    proxier = Proxier(store).start()
+
+    rules = proxier.rules()
+    assert len(rules) == 1
+    assert rules[0].backends == ["10.88.0.2:8080", "10.88.0.3:8080"]
+
+    picks = [proxier.route("10.96.0.10", 80) for _ in range(4)]
+    assert picks == ["10.88.0.2:8080", "10.88.0.3:8080",
+                     "10.88.0.2:8080", "10.88.0.3:8080"]
+    proxier.stop()
+
+
+def test_proxier_no_endpoints_rejects():
+    store = ClusterStore()
+    store.add_service(_svc("lonely", {"app": "x"}))
+    proxier = Proxier(store).start()
+    assert proxier.route("10.96.0.10", 80) is None
+    proxier.stop()
+
+
+def test_proxier_session_affinity():
+    store = ClusterStore()
+    store.add_service(_svc("web", {"app": "web"}, affinity="ClientIP"))
+    store.upsert_endpoints(_ep("web", ["10.88.0.2", "10.88.0.3"]))
+    proxier = Proxier(store).start()
+    first = proxier.route("10.96.0.10", 80, client_ip="1.2.3.4")
+    for _ in range(5):
+        assert proxier.route("10.96.0.10", 80, client_ip="1.2.3.4") == first
+    other = proxier.route("10.96.0.10", 80, client_ip="5.6.7.8")
+    # the second client stays sticky too, independent of the first
+    assert proxier.route("10.96.0.10", 80, client_ip="5.6.7.8") == other
+    proxier.stop()
+
+
+def test_proxier_reacts_to_endpoint_changes():
+    store = ClusterStore()
+    store.add_service(_svc("web", {"app": "web"}))
+    store.upsert_endpoints(_ep("web", ["10.88.0.2"]))
+    proxier = Proxier(store).start()
+    assert proxier.route("10.96.0.10", 80) == "10.88.0.2:8080"
+    before = proxier.syncs
+    # backend set changes → next route sees the new endpoints
+    store.upsert_endpoints(_ep("web", ["10.88.0.9"]))
+    assert proxier.route("10.96.0.10", 80) == "10.88.0.9:8080"
+    assert proxier.syncs == before + 1
+    # service deleted → VIP gone
+    store.delete_service("default", "web")
+    assert proxier.route("10.96.0.10", 80) is None
+    proxier.stop()
+
+
+def test_service_to_proxier_pipeline_with_endpoints_controller():
+    """Full path: bound+running pods → endpoints controller materializes
+    Endpoints → proxier routes to pod IPs (the cluster-networking loop the
+    reference closes across kcm + kube-proxy)."""
+    from kubernetes_tpu.client import SharedInformerFactory
+    from kubernetes_tpu.controllers.endpoints import EndpointsController
+
+    store = ClusterStore()
+    factory = SharedInformerFactory(store)
+    ctrl = EndpointsController(store, factory)
+    factory.start()
+    ctrl.run()
+    try:
+        store.add_service(_svc("web", {"app": "web"}, target=9000))
+        for i, ip in enumerate(["10.88.0.2", "10.88.0.3"]):
+            pod = MakePod().name(f"w{i}").uid(f"uw{i}").label("app", "web").obj()
+            store.create_pod(pod)
+            store.bind("default", f"w{i}", pod.uid, "n1")
+            store.set_pod_phase("default", f"w{i}", RUNNING, pod_ip=ip)
+        proxier = Proxier(store).start()
+        deadline = time.time() + 5
+        backends = set()
+        while time.time() < deadline:
+            b = proxier.route("10.96.0.10", 80)
+            if b:
+                backends.add(b)
+            if len(backends) == 2:
+                break
+            time.sleep(0.05)
+        assert backends == {"10.88.0.2:9000", "10.88.0.3:9000"}
+        proxier.stop()
+    finally:
+        ctrl.stop()
+        factory.stop()
+
+
+def test_rest_assigns_cluster_ip():
+    from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+
+    srv = APIServer().start()
+    try:
+        client = RestClient(srv.url)
+        svc = _svc("auto", {"app": "a"}, ip="")
+        created = client.create(svc)
+        assert created.cluster_ip.startswith("10.96.")
+        # explicit IP is reserved; duplicate explicit IP is rejected
+        svc2 = _svc("manual", {"app": "b"}, ip="10.96.1.1")
+        assert client.create(svc2).cluster_ip == "10.96.1.1"
+        svc3 = _svc("dup", {"app": "c"}, ip="10.96.1.1")
+        with pytest.raises(PermissionError):
+            client.create(svc3)
+        # delete releases the VIP for reuse
+        client.delete("Service", "manual")
+        svc4 = _svc("again", {"app": "d"}, ip="10.96.1.1")
+        assert client.create(svc4).cluster_ip == "10.96.1.1"
+    finally:
+        srv.shutdown_server()
