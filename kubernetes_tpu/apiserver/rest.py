@@ -266,7 +266,9 @@ class _Handler(BaseHTTPRequestHandler):
                     else:
                         allocated_ip = self.server.ip_allocator.allocate()
                         obj.cluster_ip = allocated_ip
-                except IPAllocatorFull as e:
+                except (IPAllocatorFull, ValueError) as e:
+                    # ValueError = malformed IP string — a validation
+                    # error, not a store conflict
                     self._send_error(422, "Invalid", str(e))
                     return
             try:

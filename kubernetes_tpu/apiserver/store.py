@@ -17,6 +17,7 @@ watch-cache fan-out), so handler ordering matches event ordering.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -122,6 +123,8 @@ class ClusterStore:
             key = pod.full_name()
             if key in self._pods:
                 raise ValueError(f"pod {key} already exists")
+            if not pod.metadata.creation_timestamp:
+                pod.metadata.creation_timestamp = time.time()
             pod.metadata.resource_version = self._next_rv()
             self._pods[key] = pod
             self._dispatch(Event(ADDED, "Pod", pod))
@@ -207,6 +210,8 @@ class ClusterStore:
     def _upsert(self, table: Dict, kind: str, key: str, obj) -> None:
         with self._lock:
             old = table.get(key)
+            if old is None and not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = time.time()
             obj.metadata.resource_version = self._next_rv()
             table[key] = obj
             self._dispatch(Event(MODIFIED if old is not None else ADDED, kind, obj, old))
@@ -483,6 +488,8 @@ class ClusterStore:
             )
             if key in table:
                 raise ValueError(f"{kind} {key!r} already exists")
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = time.time()
             obj.metadata.resource_version = self._next_rv()
             table[key] = obj
             self._dispatch(Event(ADDED, kind, obj))

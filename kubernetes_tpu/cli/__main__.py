@@ -1,0 +1,3 @@
+from kubernetes_tpu.cli.kubectl import main
+
+main()

@@ -1,0 +1,444 @@
+"""ktpu — the CLI (kubectl equivalent).
+
+Behavioral equivalent of the reference's kubectl
+(``staging/src/k8s.io/kubectl``; 52-line shim at ``cmd/kubectl``): verbs
+over the REST API — get/describe with kubectl-style tables, create/apply
+from YAML or JSON manifests, delete, scale, cordon/uncordon/drain, taint,
+label, top nodes — plus api-resources and version. Talks HTTP to an
+``APIServer`` (``--server`` or ``KTPU_SERVER``); every subcommand is a thin
+client of ``RestClient``, mirroring how kubectl is a thin client of
+client-go.
+
+Usage:  python -m kubernetes_tpu.cli get pods [-n NS | -A] [-o wide|json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubernetes_tpu.api.serialization import from_wire, is_namespaced, to_wire
+from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL, PLURALS, RestClient
+from kubernetes_tpu.apiserver.store import ConflictError
+
+VERSION = "v0.1.0-tpu"
+
+# aliases kubectl accepts
+_KIND_ALIASES = {
+    "po": "Pod", "pod": "Pod", "pods": "Pod",
+    "no": "Node", "node": "Node", "nodes": "Node",
+    "svc": "Service", "service": "Service", "services": "Service",
+    "ep": "Endpoints", "endpoints": "Endpoints",
+    "rs": "ReplicaSet", "replicaset": "ReplicaSet", "replicasets": "ReplicaSet",
+    "rc": "ReplicationController", "replicationcontroller": "ReplicationController",
+    "replicationcontrollers": "ReplicationController",
+    "sts": "StatefulSet", "statefulset": "StatefulSet", "statefulsets": "StatefulSet",
+    "deploy": "Deployment", "deployment": "Deployment", "deployments": "Deployment",
+    "ds": "DaemonSet", "daemonset": "DaemonSet", "daemonsets": "DaemonSet",
+    "job": "Job", "jobs": "Job",
+    "pvc": "PersistentVolumeClaim", "persistentvolumeclaim": "PersistentVolumeClaim",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+    "pv": "PersistentVolume", "persistentvolume": "PersistentVolume",
+    "persistentvolumes": "PersistentVolume",
+    "sc": "StorageClass", "storageclass": "StorageClass",
+    "storageclasses": "StorageClass",
+    "csinode": "CSINode", "csinodes": "CSINode",
+    "pdb": "PodDisruptionBudget", "poddisruptionbudget": "PodDisruptionBudget",
+    "poddisruptionbudgets": "PodDisruptionBudget",
+}
+
+
+def _resolve_kind(token: str) -> str:
+    kind = _KIND_ALIASES.get(token.lower())
+    if kind is None:
+        raise SystemExit(f"error: the server doesn't have a resource type {token!r}")
+    return kind
+
+
+def _age(meta) -> str:
+    if not meta.creation_timestamp:
+        return "<unknown>"
+    s = int(time.time() - meta.creation_timestamp)
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    if s < 172800:
+        return f"{s // 3600}h"
+    return f"{s // 86400}d"
+
+
+def _table(headers: Sequence[str], rows: List[Sequence[str]], out) -> None:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+        for i, h in enumerate(headers)
+    ]
+    print("   ".join(str(h).ljust(w) for h, w in zip(headers, widths)), file=out)
+    for r in rows:
+        print("   ".join(str(c).ljust(w) for c, w in zip(r, widths)), file=out)
+
+
+def _pod_row(p, wide: bool):
+    ready = "1/1" if p.status.phase == "Running" else "0/1"
+    row = [p.metadata.name, ready, p.status.phase, _age(p.metadata)]
+    if wide:
+        row += [p.status.pod_ip or "<none>", p.spec.node_name or "<none>"]
+    return row
+
+
+def _node_row(n, wide: bool):
+    ready = "Ready"
+    for c in n.status.conditions:
+        if c.type == "Ready" and c.status != "True":
+            ready = "NotReady"
+    if n.spec.unschedulable:
+        ready += ",SchedulingDisabled"
+    row = [n.metadata.name, ready, _age(n.metadata)]
+    if wide:
+        cpu = n.status.allocatable.get("cpu")
+        mem = n.status.allocatable.get("memory")
+        row += [str(cpu.value()) if cpu else "?",
+                str(mem.value() >> 20) + "Mi" if mem else "?"]
+    return row
+
+
+def _generic_row(obj, wide: bool):
+    return [obj.metadata.name, _age(obj.metadata)]
+
+
+_ROWS = {
+    "Pod": (["NAME", "READY", "STATUS", "AGE"],
+            ["NAME", "READY", "STATUS", "AGE", "IP", "NODE"], _pod_row),
+    "Node": (["NAME", "STATUS", "AGE"],
+             ["NAME", "STATUS", "AGE", "CPU", "MEMORY"], _node_row),
+}
+
+
+class Kubectl:
+    def __init__(self, client: RestClient, out=None, err=None):
+        self.client = client
+        self.out = out or sys.stdout
+        self.err = err or sys.stderr
+
+    # -- verbs ---------------------------------------------------------
+    def get(self, kind_token: str, name: Optional[str], namespace: Optional[str],
+            all_namespaces: bool, output: Optional[str]) -> int:
+        kind = _resolve_kind(kind_token)
+        ns = None if all_namespaces or not is_namespaced(kind) else (namespace or "default")
+        if name:
+            obj = self.client.get(kind, name, ns or "default")
+            if obj is None:
+                print(f"Error from server (NotFound): "
+                      f"{kind.lower()} {name!r} not found", file=self.err)
+                return 1
+            objs = [obj]
+        else:
+            objs, _ = self.client.list(kind, ns)
+        if output == "json":
+            docs = [to_wire(o) for o in objs]
+            print(json.dumps(docs[0] if name else docs, indent=2), file=self.out)
+            return 0
+        wide = output == "wide"
+        narrow, wides, row_fn = _ROWS.get(kind, (["NAME", "AGE"], ["NAME", "AGE"],
+                                                 _generic_row))
+        headers = wides if wide else narrow
+        _table(headers, [row_fn(o, wide) for o in objs], self.out)
+        return 0
+
+    def describe(self, kind_token: str, name: str, namespace: str) -> int:
+        kind = _resolve_kind(kind_token)
+        obj = self.client.get(kind, name, namespace)
+        if obj is None:
+            print(f"Error from server (NotFound): {kind.lower()} {name!r} not found",
+                  file=self.err)
+            return 1
+        doc = to_wire(obj)
+        import yaml
+
+        print(yaml.safe_dump(doc, sort_keys=False, default_flow_style=False),
+              file=self.out)
+        return 0
+
+    def _load_manifests(self, path: str) -> List[Any]:
+        import yaml
+
+        if path == "-":
+            raw = sys.stdin.read()
+        else:
+            with open(path) as f:
+                raw = f.read()
+        docs = list(yaml.safe_load_all(raw))
+        objs = []
+        for doc in docs:
+            if not doc:
+                continue
+            if "kind" not in doc:
+                raise SystemExit("error: manifest missing 'kind'")
+            objs.append(from_wire(doc))
+        return objs
+
+    def create(self, filename: str, namespace: Optional[str]) -> int:
+        for obj in self._load_manifests(filename):
+            if namespace and is_namespaced(type(obj).__name__):
+                obj.metadata.namespace = namespace
+            created = self.client.create(obj)
+            print(f"{type(created).__name__.lower()}/{created.metadata.name} created",
+                  file=self.out)
+        return 0
+
+    def apply(self, filename: str, namespace: Optional[str]) -> int:
+        """Create-or-update (the declarative path)."""
+        for obj in self._load_manifests(filename):
+            kind = type(obj).__name__
+            if namespace and is_namespaced(kind):
+                obj.metadata.namespace = namespace
+            existing = self.client.get(kind, obj.metadata.name,
+                                       obj.metadata.namespace)
+            if existing is None:
+                self.client.create(obj)
+                print(f"{kind.lower()}/{obj.metadata.name} created", file=self.out)
+            else:
+                obj.metadata.resource_version = existing.metadata.resource_version
+                obj.metadata.uid = existing.metadata.uid
+                self.client.update(obj)
+                print(f"{kind.lower()}/{obj.metadata.name} configured", file=self.out)
+        return 0
+
+    def delete(self, kind_token: str, name: str, namespace: str) -> int:
+        kind = _resolve_kind(kind_token)
+        if self.client.delete(kind, name, namespace):
+            print(f"{kind.lower()} \"{name}\" deleted", file=self.out)
+            return 0
+        print(f"Error from server (NotFound): {kind.lower()} {name!r} not found",
+              file=self.err)
+        return 1
+
+    def scale(self, kind_token: str, name: str, replicas: int, namespace: str) -> int:
+        kind = _resolve_kind(kind_token)
+        obj = self.client.get(kind, name, namespace)
+        if obj is None or not hasattr(obj, "replicas"):
+            print(f"error: cannot scale {kind_token} {name!r}", file=self.err)
+            return 1
+        obj.replicas = replicas
+        self.client.update(obj)
+        print(f"{kind.lower()}/{name} scaled", file=self.out)
+        return 0
+
+    def cordon(self, name: str, on: bool) -> int:
+        node = self.client.get("Node", name)
+        if node is None:
+            print(f"error: node {name!r} not found", file=self.err)
+            return 1
+        node.spec.unschedulable = on
+        self.client.update(node)
+        print(f"node/{name} {'cordoned' if on else 'uncordoned'}", file=self.out)
+        return 0
+
+    def drain(self, name: str) -> int:
+        """cordon + evict all pods on the node (kubectl drain semantics,
+        sans daemonset handling)."""
+        rc = self.cordon(name, True)
+        if rc:
+            return rc
+        pods, _ = self.client.list("Pod")
+        for p in pods:
+            if p.spec.node_name == name:
+                self.client.delete("Pod", p.metadata.name, p.metadata.namespace)
+                print(f"pod/{p.metadata.name} evicted", file=self.out)
+        return 0
+
+    def taint(self, name: str, spec: str) -> int:
+        """ktpu taint <node> key=value:Effect  (suffix '-' removes)."""
+        from kubernetes_tpu.api.types import Taint
+
+        node = self.client.get("Node", name)
+        if node is None:
+            print(f"error: node {name!r} not found", file=self.err)
+            return 1
+        remove = spec.endswith("-")
+        spec = spec.rstrip("-")
+        kv, _, effect = spec.partition(":")
+        key, _, value = kv.partition("=")
+        if remove:
+            node.spec.taints = [t for t in node.spec.taints if t.key != key]
+        else:
+            node.spec.taints = [t for t in node.spec.taints if t.key != key] + [
+                Taint(key=key, value=value, effect=effect or "NoSchedule")
+            ]
+        self.client.update(node)
+        print(f"node/{name} {'untainted' if remove else 'tainted'}", file=self.out)
+        return 0
+
+    def label(self, kind_token: str, name: str, spec: str, namespace: str) -> int:
+        kind = _resolve_kind(kind_token)
+        obj = self.client.get(kind, name, namespace)
+        if obj is None:
+            print(f"error: {kind_token} {name!r} not found", file=self.err)
+            return 1
+        if spec.endswith("-"):
+            obj.metadata.labels.pop(spec[:-1], None)
+        else:
+            k, _, v = spec.partition("=")
+            obj.metadata.labels[k] = v
+        self.client.update(obj)
+        print(f"{kind.lower()}/{name} labeled", file=self.out)
+        return 0
+
+    def top_nodes(self) -> int:
+        """Requested/allocatable per node (the /metrics/resources view)."""
+        nodes, _ = self.client.list("Node")
+        pods, _ = self.client.list("Pod")
+        rows = []
+        for n in nodes:
+            cpu_req = sum(
+                (q.milli_value() for p in pods if p.spec.node_name == n.metadata.name
+                 for c in p.spec.containers
+                 for r, q in c.resources.requests.items() if r == "cpu"),
+            )
+            alloc = n.status.allocatable.get("cpu")
+            alloc_m = alloc.milli_value() if alloc else 0
+            pct = f"{100 * cpu_req // alloc_m}%" if alloc_m else "?"
+            rows.append([n.metadata.name, f"{cpu_req}m", pct])
+        _table(["NAME", "CPU(requests)", "CPU%"], rows, self.out)
+        return 0
+
+    def api_resources(self) -> int:
+        rows = [
+            [plural, kind, str(is_namespaced(kind)).lower()]
+            for plural, kind in sorted(PLURALS.items())
+        ]
+        _table(["NAME", "KIND", "NAMESPACED"], rows, self.out)
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ktpu", description=__doc__.split("\n")[0])
+    p.add_argument("--server", default=None, help="API server URL "
+                   "(default: $KTPU_SERVER)")
+    p.add_argument("--token", default="", help="bearer token")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-n", "--namespace", default=None)
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("-o", "--output", choices=["wide", "json"], default=None)
+
+    d = sub.add_parser("describe")
+    d.add_argument("kind")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="default")
+
+    for verb in ("create", "apply"):
+        c = sub.add_parser(verb)
+        c.add_argument("-f", "--filename", required=True)
+        c.add_argument("-n", "--namespace", default=None)
+
+    dl = sub.add_parser("delete")
+    dl.add_argument("kind")
+    dl.add_argument("name")
+    dl.add_argument("-n", "--namespace", default="default")
+
+    s = sub.add_parser("scale")
+    s.add_argument("kind")
+    s.add_argument("name")
+    s.add_argument("--replicas", type=int, required=True)
+    s.add_argument("-n", "--namespace", default="default")
+
+    for verb in ("cordon", "uncordon", "drain"):
+        cv = sub.add_parser(verb)
+        cv.add_argument("name")
+
+    t = sub.add_parser("taint")
+    t.add_argument("name")
+    t.add_argument("spec")
+
+    lb = sub.add_parser("label")
+    lb.add_argument("kind")
+    lb.add_argument("name")
+    lb.add_argument("spec")
+    lb.add_argument("-n", "--namespace", default="default")
+
+    tp = sub.add_parser("top")
+    tp.add_argument("what", choices=["nodes"])
+
+    sub.add_parser("api-resources")
+    sub.add_parser("version")
+    return p
+
+
+def run_command(argv: Sequence[str], client: Optional[RestClient] = None,
+                out=None, err=None) -> int:
+    import os
+
+    args = build_parser().parse_args(argv)
+    out = out or sys.stdout
+    err = err or sys.stderr
+    if args.verb == "version":
+        print(f"Client Version: {VERSION}", file=out)
+        return 0
+    if client is None:
+        server = args.server or os.environ.get("KTPU_SERVER")
+        if not server:
+            print("error: no API server (--server or $KTPU_SERVER)", file=err)
+            return 1
+        client = RestClient(server, token=args.token)
+    k = Kubectl(client, out=out, err=err)
+    try:
+        return _dispatch(k, args)
+    except ConflictError as e:
+        print(f"Error from server (Conflict): {e}", file=err)
+        return 1
+    except PermissionError as e:
+        print(f"Error from server (Forbidden/Invalid): {e}", file=err)
+        return 1
+    except KeyError as e:
+        print(f"Error from server (NotFound): {e}", file=err)
+        return 1
+    except RuntimeError as e:
+        print(f"Error from server: {e}", file=err)
+        return 1
+
+
+def _dispatch(k: "Kubectl", args) -> int:
+    if args.verb == "get":
+        return k.get(args.kind, args.name, args.namespace, args.all_namespaces,
+                     args.output)
+    if args.verb == "describe":
+        return k.describe(args.kind, args.name, args.namespace)
+    if args.verb == "create":
+        return k.create(args.filename, args.namespace)
+    if args.verb == "apply":
+        return k.apply(args.filename, args.namespace)
+    if args.verb == "delete":
+        return k.delete(args.kind, args.name, args.namespace)
+    if args.verb == "scale":
+        return k.scale(args.kind, args.name, args.replicas, args.namespace)
+    if args.verb == "cordon":
+        return k.cordon(args.name, True)
+    if args.verb == "uncordon":
+        return k.cordon(args.name, False)
+    if args.verb == "drain":
+        return k.drain(args.name)
+    if args.verb == "taint":
+        return k.taint(args.name, args.spec)
+    if args.verb == "label":
+        return k.label(args.kind, args.name, args.spec, args.namespace)
+    if args.verb == "top":
+        return k.top_nodes()
+    if args.verb == "api-resources":
+        return k.api_resources()
+    return 2
+
+
+def main() -> None:
+    sys.exit(run_command(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,10 +22,8 @@ import logging
 import threading
 from typing import Dict, List, Optional
 
-from kubernetes_tpu.api.resource import Quantity, parse_quantity
 from kubernetes_tpu.api.types import (
     FAILED,
-    PENDING,
     RUNNING,
     SUCCEEDED,
     Node,
@@ -209,12 +207,15 @@ class Kubelet:
 
     def _admit_and_start(self, pod: Pod) -> None:
         # device admission first: unsatisfiable extended resources fail the
-        # pod rather than half-starting it
+        # pod rather than half-starting it. A checkpointed assignment from
+        # a previous kubelet incarnation satisfies admission as-is — that
+        # is the whole point of the device checkpoint.
         try:
-            for c in pod.spec.containers:
-                for res, qty in c.resources.requests.items():
-                    if res == TPU_RESOURCE:
-                        self.devices.allocate(pod.uid, c.name, res, qty.value())
+            if not self.devices.devices_of(pod.uid):
+                for c in pod.spec.containers:
+                    for res, qty in c.resources.requests.items():
+                        if res == TPU_RESOURCE:
+                            self.devices.allocate(pod.uid, c.name, res, qty.value())
         except Exception as e:
             # roll back devices granted to earlier containers of this pod
             self.devices.free(pod.uid)

@@ -120,7 +120,5 @@ class HollowCluster:
 
     def stop(self) -> None:
         for node in self.nodes:
-            node.kubelet.stop()
-            if node.proxier._watch is not None:
-                node.proxier.stop()
+            node.stop()  # Proxier.stop is already a no-op if never started
         self.nodes.clear()

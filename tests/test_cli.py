@@ -1,0 +1,174 @@
+"""CLI + bootstrap ring: ktpu verbs against a bootstrapped cluster —
+the kubectl/kubeadm surface over real HTTP."""
+
+import io
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import RUNNING
+from kubernetes_tpu.bootstrap import Cluster
+from kubernetes_tpu.cli import run_command
+from kubernetes_tpu.testing import MakePod
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster.up(nodes=3, capacity={"cpu": "8", "memory": "16Gi"})
+    yield c
+    c.down()
+
+
+def ktpu(cluster, *argv):
+    out, err = io.StringIO(), io.StringIO()
+    rc = run_command(list(argv), client=cluster.client(), out=out, err=err)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def wait_for(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return cond()
+
+
+def test_bootstrap_brings_up_full_cluster(cluster):
+    assert cluster.client().healthz()
+    nodes, _ = cluster.client().list("Node")
+    assert len(nodes) == 3
+    # token-authenticated join rejects a bad token
+    with pytest.raises(PermissionError):
+        cluster.phase_join_nodes(1, token="bad.token")
+
+
+def test_cli_get_nodes_and_api_resources(cluster):
+    rc, out, _ = ktpu(cluster, "get", "nodes")
+    assert rc == 0
+    assert "hollow-0" in out and "Ready" in out
+    rc, out, _ = ktpu(cluster, "api-resources")
+    assert rc == 0 and "pods" in out and "storageclasses" in out
+
+
+def test_cli_create_apply_get_delete_pod(cluster, tmp_path):
+    manifest = tmp_path / "pod.yaml"
+    manifest.write_text(
+        """
+kind: Pod
+metadata:
+  name: cli-pod
+  uid: u-cli
+spec:
+  containers:
+  - name: main
+    image: app
+    resources:
+      requests:
+        cpu: 250m
+"""
+    )
+    rc, out, _ = ktpu(cluster, "create", "-f", str(manifest))
+    assert rc == 0 and "pod/cli-pod created" in out
+    # scheduler + hollow kubelet take it to Running
+    assert wait_for(
+        lambda: cluster.store.get_pod("default", "cli-pod").status.phase == RUNNING
+    )
+    rc, out, _ = ktpu(cluster, "get", "pods", "-o", "wide")
+    assert rc == 0 and "cli-pod" in out and "hollow-" in out
+
+    rc, out, _ = ktpu(cluster, "get", "pod", "cli-pod", "-o", "json")
+    doc = json.loads(out)
+    assert doc["metadata"]["name"] == "cli-pod"
+
+    rc, out, _ = ktpu(cluster, "describe", "pod", "cli-pod")
+    assert rc == 0 and "cli-pod" in out
+
+    rc, out, _ = ktpu(cluster, "delete", "pod", "cli-pod")
+    assert rc == 0 and "deleted" in out
+    rc, _, err = ktpu(cluster, "get", "pod", "cli-pod")
+    assert rc == 1 and "NotFound" in err
+
+
+def test_cli_apply_is_create_or_update(cluster, tmp_path):
+    manifest = tmp_path / "svc.yaml"
+    manifest.write_text(
+        """
+kind: Service
+metadata:
+  name: web
+selector:
+  app: web
+ports:
+- name: http
+  port: 80
+  targetPort: 8080
+"""
+    )
+    rc, out, _ = ktpu(cluster, "apply", "-f", str(manifest))
+    assert rc == 0 and "created" in out
+    vip = cluster.client().get("Service", "web").cluster_ip
+    assert vip  # registry assigned one
+    rc, out, _ = ktpu(cluster, "apply", "-f", str(manifest))
+    assert rc == 0 and "configured" in out
+    assert cluster.client().get("Service", "web").cluster_ip == vip  # kept
+
+
+def test_cli_cordon_drain_taint_label(cluster):
+    rc, out, _ = ktpu(cluster, "cordon", "hollow-1")
+    assert rc == 0
+    assert cluster.client().get("Node", "hollow-1").spec.unschedulable
+    rc, out, _ = ktpu(cluster, "get", "nodes")
+    assert "SchedulingDisabled" in out
+    rc, _, _ = ktpu(cluster, "uncordon", "hollow-1")
+    assert not cluster.client().get("Node", "hollow-1").spec.unschedulable
+
+    rc, _, _ = ktpu(cluster, "taint", "hollow-1", "dedicated=tpu:NoSchedule")
+    taints = cluster.client().get("Node", "hollow-1").spec.taints
+    assert any(t.key == "dedicated" and t.effect == "NoSchedule" for t in taints)
+    rc, _, _ = ktpu(cluster, "taint", "hollow-1", "dedicated-")
+    assert not cluster.client().get("Node", "hollow-1").spec.taints
+
+    rc, _, _ = ktpu(cluster, "label", "node", "hollow-1", "pool=a")
+    assert cluster.client().get("Node", "hollow-1").metadata.labels["pool"] == "a"
+    rc, _, _ = ktpu(cluster, "label", "node", "hollow-1", "pool-")
+    assert "pool" not in cluster.client().get("Node", "hollow-1").metadata.labels
+
+
+def test_cli_drain_evicts_pods(cluster):
+    client = cluster.client()
+    client.create(MakePod().name("victim").uid("u-v").req({"cpu": "100m"}).obj())
+    assert wait_for(
+        lambda: client.get("Pod", "victim") is not None
+        and client.get("Pod", "victim").spec.node_name
+    )
+    node = client.get("Pod", "victim").spec.node_name
+    rc, out, _ = ktpu(cluster, "drain", node)
+    assert rc == 0 and "evicted" in out
+    assert wait_for(lambda: client.get("Pod", "victim") is None)
+    ktpu(cluster, "uncordon", node)
+
+
+def test_cli_scale_and_top(cluster):
+    from kubernetes_tpu.api.types import ReplicaSet
+    from kubernetes_tpu.api.labels import LabelSelector
+
+    rs = ReplicaSet(selector=LabelSelector(match_labels={"app": "s"}),
+                    replicas=1,
+                    template={"metadata": {"labels": {"app": "s"}},
+                              "spec": {"containers": [
+                                  {"name": "c", "image": "app",
+                                   "resources": {"requests": {"cpu": "100m"}}}]}})
+    rs.metadata.name = "scaleme"
+    cluster.client().create(rs)
+    rc, out, _ = ktpu(cluster, "scale", "rs", "scaleme", "--replicas", "3")
+    assert rc == 0
+    assert wait_for(
+        lambda: len([p for p in cluster.store.list_pods()
+                     if p.metadata.labels.get("app") == "s"]) == 3
+    )
+    rc, out, _ = ktpu(cluster, "top", "nodes")
+    assert rc == 0 and "CPU(requests)" in out
+    rc, out, _ = ktpu(cluster, "version")
+    assert rc == 0 and "Client Version" in out

@@ -261,3 +261,32 @@ def test_kubelet_liveness_restart(cluster):
     cid = kubelet._containers_of[p.uid]["c0"]
     assert wait_for(lambda: kubelet.runtime.container_status(cid).restarts >= 1)
     assert store.get_pod("default", "web").status.phase == RUNNING
+
+
+def test_kubelet_restart_preserves_checkpointed_devices(tmp_path):
+    """A restarted kubelet must re-admit its running TPU pods from the
+    device checkpoint instead of failing them on re-allocation."""
+    store = ClusterStore()
+    cm = CheckpointManager(str(tmp_path))
+    dm = DeviceManager(cm)
+    dm.register(DevicePlugin(TPU_RESOURCE, ["tpu0", "tpu1"]))
+    kubelet = Kubelet(store, "n1", device_manager=dm)
+    kubelet.start()
+    pod = MakePod().name("train").uid("u-train").req({TPU_RESOURCE: "2"}).obj()
+    store.create_pod(pod)
+    store.bind("default", "train", "u-train", "n1")
+    assert wait_for(lambda: store.get_pod("default", "train").status.phase == RUNNING)
+    kubelet.stop()
+
+    # "process restart": fresh kubelet, fresh DeviceManager, same checkpoint
+    dm2 = DeviceManager(CheckpointManager(str(tmp_path)))
+    dm2.register(DevicePlugin(TPU_RESOURCE, ["tpu0", "tpu1"]))
+    assert dm2.allocatable()[TPU_RESOURCE] == 0  # assignment survived
+    kubelet2 = Kubelet(store, "n1", device_manager=dm2)
+    kubelet2.start()
+    try:
+        time.sleep(0.5)  # several sync ticks
+        assert store.get_pod("default", "train").status.phase == RUNNING
+        assert dm2.devices_of("u-train")[TPU_RESOURCE] == ["tpu0", "tpu1"]
+    finally:
+        kubelet2.stop()
