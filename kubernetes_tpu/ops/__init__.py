@@ -17,5 +17,6 @@ Division of labor (deliberate, TPU-first):
   one-hot segment updates; no data-dependent Python control flow.
 """
 
+from kubernetes_tpu.ops import jax_setup  # noqa: F401  (must precede first jit)
 from kubernetes_tpu.ops.encode import BatchEncoder, EncodedBatch, EncodedCluster
 from kubernetes_tpu.ops.solver import solve_scan, SolverParams

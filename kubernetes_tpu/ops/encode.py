@@ -169,7 +169,10 @@ class BatchEncoder:
     ]:
         nis = self.node_infos
         n_real = len(nis)
-        n_pad = max(_round_up(max(n_real, 1), self.pad_nodes), self.pad_nodes)
+        # coarse node buckets: few distinct compiled shapes (each XLA
+        # binary is reused via the persistent cache), bounded padding waste
+        gran = self.pad_nodes if n_real <= 1024 else 512
+        n_pad = max(_round_up(max(n_real, 1), gran), self.pad_nodes)
 
         resource_names = self._resource_names(pods)
         r = len(resource_names)
@@ -223,7 +226,9 @@ class BatchEncoder:
     def _encode_pods(self, cluster: EncodedCluster, pods: List[Pod],
                      n_pad: int, pad_pods: int) -> EncodedBatch:
         b_real = len(pods)
-        b_pad = max(_round_up(max(b_real, 1), pad_pods), pad_pods)
+        # power-of-two pod buckets (min pad_pods): ≤7 shapes up to 4096,
+        # so steady state never recompiles on a short final batch
+        b_pad = max(pad_pods, 1 << (max(b_real, 1) - 1).bit_length())
         r = len(cluster.resource_names)
         pod_infos = [PodInfo(p) for p in pods]
 
