@@ -164,8 +164,12 @@ def run_workload(
                 if collect and bs is not None:
                     # compile/cache-load the solver outside the measured
                     # window (JIT warm-up is setup, like the reference's
-                    # informer warm-up before scheduler_perf collects)
-                    warm = bs.warmup()
+                    # informer warm-up before scheduler_perf collects).
+                    # Warm with this op's actual pod template so the
+                    # constraint/resource dims match the measured batches.
+                    warm = bs.warmup(
+                        sample_pods=[Pod.from_dict(template(offset))]
+                    )
                     if progress and warm > 0.05:
                         progress(f"{name}: solver warmup {warm:.1f}s")
                 if collect:

@@ -170,8 +170,14 @@ class BatchEncoder:
         nis = self.node_infos
         n_real = len(nis)
         # coarse node buckets: few distinct compiled shapes (each XLA
-        # binary is reused via the persistent cache), bounded padding waste
-        gran = self.pad_nodes if n_real <= 1024 else 512
+        # binary is reused via the persistent cache), bounded padding waste.
+        # Above 1024 nodes the bucket stays a multiple of pad_nodes so the
+        # sharded solver's divisibility contract (pad_nodes is chosen as a
+        # multiple of the mesh nodes axis) still holds.
+        gran = (
+            self.pad_nodes if n_real <= 1024
+            else _round_up(512, self.pad_nodes)
+        )
         n_pad = max(_round_up(max(n_real, 1), gran), self.pad_nodes)
 
         resource_names = self._resource_names(pods)

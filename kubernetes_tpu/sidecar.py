@@ -113,23 +113,43 @@ class TPUBatchScheduler:
             sched.schedule_pod_serial(fwk, qpi)
         return len(qpis)
 
-    def warmup(self) -> float:
+    def warmup(self, sample_pods: Optional[List] = None) -> float:
         """Compile (or cache-load) the solver for this cluster's shapes by
-        solving a dummy single-pod batch. Returns seconds spent. Call
-        after nodes exist and before the measured phase — the analog of
-        the reference excluding informer warm-up from scheduler_perf's
-        measured window."""
+        solving a representative batch. Returns seconds spent. Call after
+        nodes exist and before the measured phase — the analog of the
+        reference excluding informer warm-up from scheduler_perf's
+        measured window.
+
+        The compiled XLA signature depends on the batch's constraint and
+        resource dims (spread constraints, affinity terms, topology value
+        space, extended resources), so pass ``sample_pods`` drawn from the
+        actual workload (e.g. one pod per template); constraints are
+        deduped during encoding, so one representative pod per template
+        yields the same shapes as the full batch. Without samples, only
+        the constraint-free shape is warmed."""
         t0 = time.monotonic()
         sched = self.sched
         try:
             sched.algorithm.update_snapshot()
             if not sched.algorithm.snapshot.list():
                 return 0.0
-            from kubernetes_tpu.testing.wrappers import MakePod
+            pods = list(sample_pods) if sample_pods else []
+            if not pods:
+                from kubernetes_tpu.api.resource import parse_quantity
+                from kubernetes_tpu.api.types import (
+                    Container, ObjectMeta, Pod, PodSpec, ResourceRequirements,
+                )
 
-            pod = MakePod().name("__warmup__").req({"cpu": "1m"}).obj()
+                pods = [Pod(
+                    metadata=ObjectMeta(name="__warmup__", namespace="default"),
+                    spec=PodSpec(containers=[Container(
+                        name="c",
+                        resources=ResourceRequirements(
+                            requests={"cpu": parse_quantity("1m")}),
+                    )]),
+                )]
             encoder = BatchEncoder(sched.algorithm.snapshot)
-            cluster, batch = encoder.encode([pod], pad_pods=self.max_batch)
+            cluster, batch = encoder.encode(pods, pad_pods=self.max_batch)
             solve_scan(cluster, batch, self.params)
         except Exception:
             _logger.exception("solver warmup failed (continuing cold)")

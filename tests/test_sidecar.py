@@ -157,3 +157,40 @@ class TestBatchScheduling:
         drain_batches(sched, bs)
         assert all(p.spec.node_name for p in store.list_pods())
         sched.stop()
+
+
+class TestWarmup:
+    def test_warmup_without_samples_compiles(self, caplog):
+        """warmup() with no sample pods must encode+solve cleanly (not
+        swallow an exception and silently leave the solver cold)."""
+        store = ClusterStore()
+        store.add_node(
+            MakeNode().name("n0").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+        )
+        sched, bs = make_batch_scheduler(store)
+        import logging
+
+        with caplog.at_level(logging.ERROR, logger="kubernetes_tpu.sidecar"):
+            spent = bs.warmup()
+        assert spent > 0.0
+        assert "warmup failed" not in caplog.text
+        sched.stop()
+
+    def test_warmup_with_workload_samples(self):
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .label("topology.kubernetes.io/zone", f"z{i % 2}")
+                .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store)
+        sample = (
+            MakePod().name("tmpl").uid("tmpl-u").label("app", "w")
+            .req({"cpu": "1"})
+            .spread_constraint(
+                1, "topology.kubernetes.io/zone", "DoNotSchedule", {"app": "w"}
+            ).obj()
+        )
+        assert bs.warmup(sample_pods=[sample]) > 0.0
+        sched.stop()
