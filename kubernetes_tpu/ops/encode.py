@@ -42,11 +42,7 @@ from kubernetes_tpu.scheduler.framework.plugins.taint_toleration import (
     TaintToleration,
 )
 from kubernetes_tpu.scheduler.snapshot import Snapshot
-from kubernetes_tpu.scheduler.types import (
-    PodInfo,
-    Resource,
-    compute_pod_resource_request,
-)
+from kubernetes_tpu.scheduler.types import PodInfo, Resource
 
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
@@ -67,6 +63,22 @@ def _kib(b: int) -> int:
 
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def _constraint_key(pod: Pod, c, sel: labelslib.Selector) -> tuple:
+    """Dedup identity of a topology-spread constraint. Shared by the full
+    and incremental encoders — the two must never diverge or incremental
+    batches would map pods onto the wrong tracked constraint."""
+    return (
+        c.topology_key, c.max_skew,
+        c.when_unsatisfiable == "DoNotSchedule",
+        pod.namespace, repr(sel),
+    )
+
+
+def _term_key(t) -> tuple:
+    """Dedup identity of an (anti-)affinity term (same sharing contract)."""
+    return (t.topology_key, repr(t.selector), tuple(sorted(t.namespaces)))
 
 
 @dataclass
@@ -151,10 +163,33 @@ class EncodedBatch:
     num_values: int                # V (shared topo-value space size)
 
 
+@dataclass
+class EncodedPodBatch:
+    """Pod-side-only arrays for an incremental batch against an existing
+    encoding space (the device already holds the cluster/static arrays and
+    the carried dynamic count state)."""
+
+    pods: List[Pod]
+    num_real_pods: int
+    requests: np.ndarray           # [B, R] int32
+    nonzero_requests: np.ndarray   # [B, 2] int32
+    profile_idx: np.ndarray        # [B] int32
+    inexpressible: np.ndarray      # [B] bool
+    pod_sc: np.ndarray             # [B, SC] bool
+    pod_sc_match: np.ndarray       # [B, SC] bool
+    match_by: np.ndarray           # [B, T] bool
+    own_aff: np.ndarray            # [B, T] bool
+    own_anti: np.ndarray           # [B, T] bool
+    pref_weight: np.ndarray        # [B, T] float32
+
+
 class BatchEncoder:
-    """Encodes one (snapshot, pod batch) pair. Stateless across batches in
-    v1 — incremental device-state updates are an optimization layered on
-    top (the Generation-LRU of the device mirror)."""
+    """Encodes one (snapshot, pod batch) pair. After a full ``encode`` the
+    encoder retains the *encoding space* — resource columns, topology-key/
+    value codes, tracked constraints/terms, static profiles — so later
+    batches whose pods fit the same space can be encoded pod-side-only
+    (``encode_pods_only``) against device-resident cluster state (the
+    Generation-LRU of the device mirror, SURVEY.md section 7 hard part 1)."""
 
     def __init__(self, snapshot: Snapshot, pad_nodes: int = 128):
         self.snapshot = snapshot
@@ -162,6 +197,15 @@ class BatchEncoder:
         self.pad_nodes = pad_nodes
         self._taint_plugin = TaintToleration()
         self._unsched_plugin = NodeUnschedulable()
+        # encoding space retained by the last full encode()
+        self._resource_names: Optional[List[str]] = None
+        self._key_index: Optional[Dict[str, int]] = None
+        self._con_index: Optional[Dict[tuple, int]] = None
+        self._constraints: Optional[List[_TrackedConstraint]] = None
+        self._term_index: Optional[Dict[tuple, int]] = None
+        self._terms: Optional[List[_TrackedTerm]] = None
+        self._profiles: Optional[Dict[tuple, int]] = None
+        self._num_values: int = 0
 
     # ------------------------------------------------------------------
     def encode(self, pods: List[Pod], pad_pods: int = 64) -> Tuple[
@@ -180,7 +224,8 @@ class BatchEncoder:
         )
         n_pad = max(_round_up(max(n_real, 1), gran), self.pad_nodes)
 
-        resource_names = self._resource_names(pods)
+        pod_infos = [PodInfo.of(p) for p in pods]
+        resource_names = self._collect_resource_names(pod_infos)
         r = len(resource_names)
 
         allocatable = np.zeros((n_pad, r), dtype=np.int32)
@@ -209,10 +254,10 @@ class BatchEncoder:
             max_pods=max_pods,
         )
 
-        batch = self._encode_pods(cluster, pods, n_pad, pad_pods)
+        batch = self._encode_pods(cluster, pods, pod_infos, n_pad, pad_pods)
         return cluster, batch
 
-    def _resource_names(self, pods: List[Pod]) -> List[str]:
+    def _collect_resource_names(self, pod_infos: List[PodInfo]) -> List[str]:
         names = [CPU, MEMORY, EPHEMERAL_STORAGE]
         seen = set(names) | {PODS}
         for ni in self.node_infos:
@@ -220,9 +265,8 @@ class BatchEncoder:
                 if name not in seen:
                     seen.add(name)
                     names.append(name)
-        for pod in pods:
-            req = compute_pod_resource_request(pod)
-            for name in req.scalar_resources:
+        for pi in pod_infos:
+            for name in pi.resource_request.scalar_resources:
                 if name not in seen:
                     seen.add(name)
                     names.append(name)
@@ -230,13 +274,9 @@ class BatchEncoder:
 
     # ------------------------------------------------------------------
     def _encode_pods(self, cluster: EncodedCluster, pods: List[Pod],
-                     n_pad: int, pad_pods: int) -> EncodedBatch:
+                     pod_infos: List[PodInfo], n_pad: int,
+                     pad_pods: int) -> EncodedBatch:
         b_real = len(pods)
-        # power-of-two pod buckets (min pad_pods): ≤7 shapes up to 4096,
-        # so steady state never recompiles on a short final batch
-        b_pad = max(pad_pods, 1 << (max(b_real, 1) - 1).bit_length())
-        r = len(cluster.resource_names)
-        pod_infos = [PodInfo(p) for p in pods]
 
         # -------- topology keys: collect from constraints and terms
         topo_keys: List[str] = []
@@ -248,20 +288,16 @@ class BatchEncoder:
                 topo_keys.append(key)
             return key_index[key]
 
-        # tracked spread constraints (dedup)
+        # tracked spread constraints (dedup); the per-pod membership masks
+        # are filled later by encode_pods_only via the same indices
         constraints: List[_TrackedConstraint] = []
         con_index: Dict[tuple, int] = {}
-        pod_con: List[List[int]] = [[] for _ in range(b_real)]
-        for bi, pod in enumerate(pods):
+        for pod in pods:
             for c in pod.spec.topology_spread_constraints:
                 if not c.topology_key:
                     continue
                 sel = labelslib.selector_from_label_selector(c.label_selector)
-                key = (
-                    c.topology_key, c.max_skew,
-                    c.when_unsatisfiable == "DoNotSchedule",
-                    pod.namespace, repr(sel),
-                )
+                key = _constraint_key(pod, c, sel)
                 if key not in con_index:
                     con_index[key] = len(constraints)
                     constraints.append(
@@ -271,7 +307,6 @@ class BatchEncoder:
                             c.when_unsatisfiable == "DoNotSchedule",
                         )
                     )
-                pod_con[bi].append(con_index[key])
 
         # tracked terms: batch pods' required aff/anti + preferred, plus
         # existing pods' required anti-affinity (owners)
@@ -279,7 +314,7 @@ class BatchEncoder:
         term_index: Dict[tuple, int] = {}
 
         def term_for(t) -> int:
-            key = (t.topology_key, repr(t.selector), tuple(sorted(t.namespaces)))
+            key = _term_key(t)
             if key not in term_index:
                 term_index[key] = len(terms)
                 terms.append(
@@ -287,18 +322,15 @@ class BatchEncoder:
                 )
             return term_index[key]
 
-        pod_aff: List[List[int]] = [[] for _ in range(b_real)]
-        pod_anti: List[List[int]] = [[] for _ in range(b_real)]
-        pod_pref: List[List[Tuple[int, float]]] = [[] for _ in range(b_real)]
-        for bi, pi in enumerate(pod_infos):
+        for pi in pod_infos:
             for t in pi.required_affinity_terms:
-                pod_aff[bi].append(term_for(t))
+                term_for(t)
             for t in pi.required_anti_affinity_terms:
-                pod_anti[bi].append(term_for(t))
+                term_for(t)
             for wt in pi.preferred_affinity_terms:
-                pod_pref[bi].append((term_for(wt.term), float(wt.weight)))
+                term_for(wt.term)
             for wt in pi.preferred_anti_affinity_terms:
-                pod_pref[bi].append((term_for(wt.term), -float(wt.weight)))
+                term_for(wt.term)
 
         existing_anti_terms: List[Tuple[int, object]] = []  # (term idx, owner node)
         for ni in self.snapshot.have_pods_with_required_anti_affinity_list():
@@ -333,14 +365,12 @@ class BatchEncoder:
 
         # -------- static profiles
         profiles: Dict[tuple, int] = {}
-        profile_idx = np.zeros(b_pad, dtype=np.int32)
         profile_pods: List[Pod] = []
-        for bi, pod in enumerate(pods):
+        for pod in pods:
             key = self._static_profile_key(pod)
             if key not in profiles:
                 profiles[key] = len(profile_pods)
                 profile_pods.append(pod)
-            profile_idx[bi] = profiles[key]
         u = max(len(profile_pods), 1)
         static_masks = np.zeros((u, n_pad), dtype=bool)
         affinity_masks = np.zeros((u, n_pad), dtype=bool)
@@ -349,27 +379,30 @@ class BatchEncoder:
             self._compute_static(pod, static_masks[ui], affinity_masks[ui],
                                  static_scores[ui])
 
-        # -------- requests
-        requests = np.zeros((b_pad, r), dtype=np.int32)
-        nonzero_requests = np.zeros((b_pad, 2), dtype=np.int32)
-        inexpressible = np.zeros(b_pad, dtype=bool)
-        for bi, (pod, pi) in enumerate(zip(pods, pod_infos)):
-            requests[bi] = _resource_row(pi.resource_request, cluster.resource_names)
-            nonzero_requests[bi] = (
-                pi.non_zero_request.milli_cpu,
-                _kib(pi.non_zero_request.memory),
-            )
-            inexpressible[bi] = self._is_inexpressible(pod)
+        # retain the encoding space, then fill the pod-side arrays with
+        # THE SAME code the incremental path uses — a single
+        # implementation cannot diverge between the two paths
+        self._resource_names = cluster.resource_names
+        self._key_index = key_index
+        self._con_index = con_index
+        self._constraints = constraints
+        self._term_index = term_index
+        self._terms = terms
+        self._profiles = profiles
+        self._num_values = num_values
+        pb = self.encode_pods_only(pods, pad_pods)
+        if pb is None:  # cannot happen: every pod was just registered
+            raise RuntimeError("pod-side encode failed against a space "
+                               "built from the same pods")
+        b_pad = pb.requests.shape[0]
 
-        # -------- spread constraint arrays
+        # -------- cluster-side spread constraint arrays
         sc = max(len(constraints), 1)
         sc_key_idx = np.zeros(sc, dtype=np.int32)
         sc_max_skew = np.ones(sc, dtype=np.int32)
         sc_hard = np.zeros(sc, dtype=bool)
         sc_counts = np.zeros((sc, num_values + 1), dtype=np.int32)
         sc_domain = np.zeros((u, sc, num_values + 1), dtype=bool)
-        pod_sc = np.zeros((b_pad, sc), dtype=bool)
-        pod_sc_match = np.zeros((b_pad, sc), dtype=bool)
         for ci, con in enumerate(constraints):
             sc_key_idx[ci] = con.key_idx
             sc_max_skew[ci] = con.max_skew
@@ -393,21 +426,12 @@ class BatchEncoder:
                         code = topo_codes[i, con.key_idx]
                         if code < num_values:
                             sc_domain[ui, ci, code] = True
-        for bi, pod in enumerate(pods):
-            for ci in pod_con[bi]:
-                pod_sc[bi, ci] = True
-            for ci, con in enumerate(constraints):
-                pod_sc_match[bi, ci] = con.matches(pod)
 
-        # -------- term arrays
+        # -------- cluster-side term arrays
         t_n = max(len(terms), 1)
         term_key_idx = np.zeros(t_n, dtype=np.int32)
         term_counts = np.zeros((t_n, num_values + 1), dtype=np.int32)
         term_owners = np.zeros((t_n, num_values + 1), dtype=np.int32)
-        match_by = np.zeros((b_pad, t_n), dtype=bool)
-        own_aff = np.zeros((b_pad, t_n), dtype=bool)
-        own_anti = np.zeros((b_pad, t_n), dtype=bool)
-        pref_weight = np.zeros((b_pad, t_n), dtype=np.float32)
         for ti, term in enumerate(terms):
             term_key_idx[ti] = term.key_idx
             for i, ni in enumerate(self.node_infos):
@@ -422,41 +446,133 @@ class BatchEncoder:
             code = topo_codes[i, terms[ti].key_idx]
             if code < num_values:
                 term_owners[ti, code] += 1
-        for bi, pod in enumerate(pods):
-            for ti, term in enumerate(terms):
-                match_by[bi, ti] = term.matches(pod)
-            for ti in pod_aff[bi]:
-                own_aff[bi, ti] = True
-            for ti in pod_anti[bi]:
-                own_anti[bi, ti] = True
-            for ti, w in pod_pref[bi]:
-                pref_weight[bi, ti] += w
 
         return EncodedBatch(
             pods=pods,
             num_real_pods=b_real,
-            requests=requests,
-            nonzero_requests=nonzero_requests,
-            profile_idx=profile_idx,
+            requests=pb.requests,
+            nonzero_requests=pb.nonzero_requests,
+            profile_idx=pb.profile_idx,
             static_masks=static_masks,
             affinity_masks=affinity_masks,
             static_scores=static_scores,
-            inexpressible=inexpressible,
+            inexpressible=pb.inexpressible,
             sc_key_idx=sc_key_idx,
             sc_max_skew=sc_max_skew,
             sc_hard=sc_hard,
             sc_counts=sc_counts,
             sc_domain=sc_domain,
-            pod_sc=pod_sc,
-            pod_sc_match=pod_sc_match,
+            pod_sc=pb.pod_sc,
+            pod_sc_match=pb.pod_sc_match,
             term_key_idx=term_key_idx,
             term_counts=term_counts,
             term_owners=term_owners,
+            match_by=pb.match_by,
+            own_aff=pb.own_aff,
+            own_anti=pb.own_anti,
+            pref_weight=pb.pref_weight,
+            num_values=num_values,
+        )
+
+    # ------------------------------------------------------------------
+    def encode_pods_only(self, pods: List[Pod],
+                         pad_pods: int) -> Optional[EncodedPodBatch]:
+        """Encode ONLY the pod-side arrays of ``pods`` against the space
+        retained by the last full ``encode``. Returns None when any pod
+        does not fit that space (new scalar resource, untracked topology
+        constraint/term, unseen static profile) — the caller then rebuilds
+        the session with a full encode."""
+        if self._resource_names is None:
+            return None
+        b_real = len(pods)
+        b_pad = max(pad_pods, 1 << (max(b_real, 1) - 1).bit_length())
+        resource_names = self._resource_names
+        known_resources = set(resource_names)
+        constraints = self._constraints
+        terms = self._terms
+        r = len(resource_names)
+        sc = max(len(constraints), 1)
+        t_n = max(len(terms), 1)
+
+        requests = np.zeros((b_pad, r), dtype=np.int32)
+        nonzero_requests = np.zeros((b_pad, 2), dtype=np.int32)
+        profile_idx = np.zeros(b_pad, dtype=np.int32)
+        inexpressible = np.zeros(b_pad, dtype=bool)
+        pod_sc = np.zeros((b_pad, sc), dtype=bool)
+        pod_sc_match = np.zeros((b_pad, sc), dtype=bool)
+        match_by = np.zeros((b_pad, t_n), dtype=bool)
+        own_aff = np.zeros((b_pad, t_n), dtype=bool)
+        own_anti = np.zeros((b_pad, t_n), dtype=bool)
+        pref_weight = np.zeros((b_pad, t_n), dtype=np.float32)
+
+        for bi, pod in enumerate(pods):
+            pi = PodInfo.of(pod)
+            if any(
+                name not in known_resources
+                for name in pi.resource_request.scalar_resources
+            ):
+                return None
+            ui = self._profiles.get(self._static_profile_key(pod))
+            if ui is None:
+                return None
+            profile_idx[bi] = ui
+            requests[bi] = _resource_row(pi.resource_request, resource_names)
+            nonzero_requests[bi] = (
+                pi.non_zero_request.milli_cpu,
+                _kib(pi.non_zero_request.memory),
+            )
+            inexpressible[bi] = self._is_inexpressible(pod)
+
+            for c in pod.spec.topology_spread_constraints:
+                if not c.topology_key:
+                    continue
+                sel = labelslib.selector_from_label_selector(c.label_selector)
+                ci = self._con_index.get(_constraint_key(pod, c, sel))
+                if ci is None:
+                    return None
+                pod_sc[bi, ci] = True
+            for ci, con in enumerate(constraints):
+                pod_sc_match[bi, ci] = con.matches(pod)
+
+            def tracked(t) -> Optional[int]:
+                return self._term_index.get(_term_key(t))
+
+            for t in pi.required_affinity_terms:
+                ti = tracked(t)
+                if ti is None:
+                    return None
+                own_aff[bi, ti] = True
+            for t in pi.required_anti_affinity_terms:
+                ti = tracked(t)
+                if ti is None:
+                    return None
+                own_anti[bi, ti] = True
+            for wt in pi.preferred_affinity_terms:
+                ti = tracked(wt.term)
+                if ti is None:
+                    return None
+                pref_weight[bi, ti] += float(wt.weight)
+            for wt in pi.preferred_anti_affinity_terms:
+                ti = tracked(wt.term)
+                if ti is None:
+                    return None
+                pref_weight[bi, ti] -= float(wt.weight)
+            for ti, term in enumerate(terms):
+                match_by[bi, ti] = term.matches(pod)
+
+        return EncodedPodBatch(
+            pods=pods,
+            num_real_pods=b_real,
+            requests=requests,
+            nonzero_requests=nonzero_requests,
+            profile_idx=profile_idx,
+            inexpressible=inexpressible,
+            pod_sc=pod_sc,
+            pod_sc_match=pod_sc_match,
             match_by=match_by,
             own_aff=own_aff,
             own_anti=own_anti,
             pref_weight=pref_weight,
-            num_values=num_values,
         )
 
     # ------------------------------------------------------------------

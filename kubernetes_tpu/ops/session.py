@@ -1,0 +1,144 @@
+"""Device-resident solver session: the incremental host→device mirror.
+
+The reference keeps its cluster snapshot incrementally updated with a
+Generation-ordered LRU (``internal/cache/cache.go:203-287``); this module
+is the device half of that idea (SURVEY.md section 7, hard part 1).
+Re-encoding and re-uploading the whole cluster every batch costs more than
+the solve itself (host→device over the TPU tunnel dominated the profile),
+so a session:
+
+- uploads the solve-invariant arrays (allocatable, static predicate masks,
+  topology codes) to the device ONCE per cluster epoch,
+- carries the dynamic state (per-node requested vectors, pod counts,
+  topology/affinity count matrices) ON DEVICE between batches — the scan's
+  final carry IS the next batch's initial state,
+- encodes only the pod-side arrays per batch (``encode_pods_only``),
+- and invalidates on ``SchedulerCache.mutation_seq`` drift: the sidecar
+  accounts one expected mutation (the assume) per successfully committed
+  pod; anything else that touched the cache — external pod/node events,
+  serial-path binds, TTL expiry, failed binds — means the device mirror
+  no longer matches the host truth and is rebuilt from a fresh snapshot.
+
+Correctness therefore never depends on the incremental path: any doubt →
+full rebuild, which is exactly the v1 behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.ops.encode import BatchEncoder, EncodedCluster
+from kubernetes_tpu.ops.solver import (
+    SolverParams,
+    _solve,
+    build_podin,
+    build_state,
+    build_static,
+)
+
+_logger = logging.getLogger(__name__)
+
+
+class SolverSession:
+    """Owns the device mirror for one scheduler's batch path."""
+
+    def __init__(self, scheduler, params: SolverParams = SolverParams(),
+                 max_batch: int = 4096, pad_nodes: int = 128):
+        self.sched = scheduler
+        self.params = params
+        self.max_batch = max_batch
+        self.pad_nodes = pad_nodes
+        self._encoder: Optional[BatchEncoder] = None
+        self._cluster: Optional[EncodedCluster] = None
+        self._static = None   # device-resident _Static
+        self._state = None    # device-resident _State (carried)
+        self._last_seq: int = -1
+        self._poisoned = False
+        # telemetry: how often the incremental path was taken
+        self.incremental_hits = 0
+        self.rebuilds = 0
+
+    # ------------------------------------------------------------------
+    def invalidate(self) -> None:
+        """Mark the device mirror diverged. Sticky until the next rebuild:
+        a later ``note_committed`` must not re-validate (e.g. a host-
+        rejected assignment the device already counted leaves the mirror
+        wrong even when the mutation arithmetic works out)."""
+        self._last_seq = -1
+        self._poisoned = True
+
+    def note_committed(self, expected_mutations: int, seq_before: int) -> None:
+        """Called by the sidecar after committing a batch: the session
+        stays valid only if the mirror was valid going INTO this batch
+        (``_last_seq == seq_before`` — otherwise a zero-mutation batch
+        would launder an earlier invalidation) and the cache saw exactly
+        the expected number of mutations (one assume per committed pod)
+        since ``seq_before``."""
+        seq_now = self.sched.cache.mutation_seq
+        if (
+            not self._poisoned
+            and self._last_seq == seq_before
+            and seq_now == seq_before + expected_mutations
+        ):
+            self._last_seq = seq_now
+        else:
+            self._last_seq = -1
+
+    # ------------------------------------------------------------------
+    def solve(self, pods: List) -> Tuple[np.ndarray, EncodedCluster, int]:
+        """Solve one batch. Returns (assignments [B], cluster,
+        seq_before) where assignments map batch index → node index in
+        ``cluster.node_names`` (-1 = unschedulable on device)."""
+        seq_before = self.sched.cache.mutation_seq
+        if self._state is not None and seq_before == self._last_seq:
+            t0 = time.monotonic()
+            pb = self._encoder.encode_pods_only(pods, self.max_batch)
+            if pb is not None and pb.requests.shape[1] == \
+                    self._cluster.allocatable.shape[1]:
+                pods_in = build_podin(pb)
+                self._observe("encode", time.monotonic() - t0)
+                t0 = time.monotonic()
+                new_state, assignments = _solve(
+                    self._static, self._state, pods_in, self.params
+                )
+                out = np.asarray(assignments)
+                self._observe("device", time.monotonic() - t0)
+                self._state = new_state
+                self.incremental_hits += 1
+                return out, self._cluster, seq_before
+        return self._rebuild_and_solve(pods, seq_before)
+
+    def _rebuild_and_solve(self, pods: List, seq_before: int):
+        self.rebuilds += 1
+        self._poisoned = False
+        t0 = time.monotonic()
+        self.sched.algorithm.update_snapshot()
+        self._encoder = BatchEncoder(
+            self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes
+        )
+        cluster, batch = self._encoder.encode(pods, pad_pods=self.max_batch)
+        self._cluster = cluster
+        self._static = build_static(cluster, batch, device=True)
+        state = build_state(cluster, batch, device=True)
+        pods_in = build_podin(batch)
+        self._observe("encode", time.monotonic() - t0)
+        t0 = time.monotonic()
+        new_state, assignments = _solve(
+            self._static, state, pods_in, self.params
+        )
+        out = np.asarray(assignments)
+        self._observe("device", time.monotonic() - t0)
+        self._state = new_state
+        # valid-until-next-mutation; the sidecar's note_committed refines
+        self._last_seq = seq_before
+        return out, cluster, seq_before
+
+    def _observe(self, segment: str, seconds: float) -> None:
+        try:
+            self.sched.metrics.batch_solve_duration.observe(seconds, segment)
+        except Exception:  # pragma: no cover — metrics must never break solves
+            pass

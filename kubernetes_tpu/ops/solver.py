@@ -213,15 +213,13 @@ def _solve(static: _Static, state: _State, pods: _PodIn, params: SolverParams):
     return final_state, assignments
 
 
-def solve_scan(
-    cluster: EncodedCluster, batch: EncodedBatch,
-    params: SolverParams = SolverParams(),
-):
-    """Run the scan solver. Returns (assignments [B] int32 node indices,
-    -1 = unschedulable/fallback)."""
+def build_static(cluster: EncodedCluster, batch: EncodedBatch,
+                 device: bool = False) -> _Static:
+    """Assemble the solve-invariant arrays (static across batches of one
+    session). With ``device=True`` they are committed to the default
+    device immediately so later jit calls skip the host→device transfer."""
     n = cluster.allocatable.shape[0]
     v = batch.num_values
-
     sc_codes = np.minimum(
         cluster.topo_codes[:, batch.sc_key_idx].T, v
     ).astype(np.int32)
@@ -230,32 +228,42 @@ def solve_scan(
     ).astype(np.int32)
     node_valid = np.zeros(n, dtype=bool)
     node_valid[: cluster.num_real_nodes] = True
+    put = jax.device_put if device else jnp.asarray
+    return _Static(
+        allocatable=put(cluster.allocatable),
+        max_pods=put(cluster.max_pods),
+        static_masks=put(batch.static_masks),
+        static_scores=put(batch.static_scores),
+        sc_codes=put(sc_codes),
+        sc_max_skew=put(batch.sc_max_skew),
+        sc_hard=put(batch.sc_hard),
+        sc_domain=put(batch.sc_domain),
+        term_codes=put(term_codes),
+        node_valid=put(node_valid),
+    )
 
-    static = _Static(
-        allocatable=jnp.asarray(cluster.allocatable),
-        max_pods=jnp.asarray(cluster.max_pods),
-        static_masks=jnp.asarray(batch.static_masks),
-        static_scores=jnp.asarray(batch.static_scores),
-        sc_codes=jnp.asarray(sc_codes),
-        sc_max_skew=jnp.asarray(batch.sc_max_skew),
-        sc_hard=jnp.asarray(batch.sc_hard),
-        sc_domain=jnp.asarray(batch.sc_domain),
-        term_codes=jnp.asarray(term_codes),
-        node_valid=jnp.asarray(node_valid),
+
+def build_state(cluster: EncodedCluster, batch: EncodedBatch,
+                device: bool = False) -> _State:
+    put = jax.device_put if device else jnp.asarray
+    return _State(
+        requested=put(cluster.requested),
+        nonzero_requested=put(cluster.nonzero_requested),
+        pod_count=put(cluster.pod_count),
+        sc_counts=put(batch.sc_counts),
+        term_counts=put(batch.term_counts),
+        term_owners=put(batch.term_owners),
     )
-    state = _State(
-        requested=jnp.asarray(cluster.requested),
-        nonzero_requested=jnp.asarray(cluster.nonzero_requested),
-        pod_count=jnp.asarray(cluster.pod_count),
-        sc_counts=jnp.asarray(batch.sc_counts),
-        term_counts=jnp.asarray(batch.term_counts),
-        term_owners=jnp.asarray(batch.term_owners),
-    )
+
+
+def build_podin(batch) -> _PodIn:
+    """Pod-stream arrays from a full EncodedBatch or an incremental
+    EncodedPodBatch (both carry the same pod-side fields)."""
     b = batch.requests.shape[0]
     valid = np.zeros(b, dtype=bool)
     valid[: batch.num_real_pods] = True
     valid &= ~batch.inexpressible
-    pods = _PodIn(
+    return _PodIn(
         request=jnp.asarray(batch.requests),
         nonzero_request=jnp.asarray(batch.nonzero_requests),
         profile=jnp.asarray(batch.profile_idx),
@@ -267,5 +275,16 @@ def solve_scan(
         own_anti=jnp.asarray(batch.own_anti),
         pref_weight=jnp.asarray(batch.pref_weight),
     )
+
+
+def solve_scan(
+    cluster: EncodedCluster, batch: EncodedBatch,
+    params: SolverParams = SolverParams(),
+):
+    """Run the scan solver. Returns (assignments [B] int32 node indices,
+    -1 = unschedulable/fallback)."""
+    static = build_static(cluster, batch)
+    state = build_state(cluster, batch)
+    pods = build_podin(batch)
     _, assignments = _solve(static, state, pods, params)
     return np.asarray(assignments)

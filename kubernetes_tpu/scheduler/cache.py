@@ -27,6 +27,33 @@ DEFAULT_ASSUME_TTL = 30.0
 CLEANUP_INTERVAL = 1.0
 
 
+def _pod_mirror_changed(old: Pod, new: Pod) -> bool:
+    """Whether a pod update changes anything the device mirror tracks
+    (requests, affinity, node assignment, labels, deletion). Status-only
+    patches — the overwhelming majority of live-informer MODIFIED events —
+    must not invalidate the mirror."""
+    return (
+        old.spec != new.spec
+        or old.metadata.labels != new.metadata.labels
+        or old.metadata.deletion_timestamp != new.metadata.deletion_timestamp
+    )
+
+
+def _node_mirror_changed(old: Node, new: Node) -> bool:
+    """Whether a node update changes anything the device mirror tracks
+    (allocatable/images via status, taints/unschedulable via spec,
+    topology via labels). Heartbeat-only updates must not invalidate."""
+    if old is None:
+        return True
+    # status.conditions carries heartbeat timestamps — deliberately excluded
+    return (
+        old.status.allocatable != new.status.allocatable
+        or old.status.images != new.status.images
+        or old.spec != new.spec
+        or old.metadata.labels != new.metadata.labels
+    )
+
+
 class _PodState:
     __slots__ = ("pod", "deadline", "binding_finished")
 
@@ -66,6 +93,14 @@ class SchedulerCache:
         self._ttl = ttl
         self._now = now
         self._lock = threading.RLock()
+        # Monotonic counter of every NodeInfo-affecting mutation. The TPU
+        # solver session snapshots it after committing a batch; a mismatch
+        # at the next batch means the cluster changed underneath the
+        # device-resident state mirror, which must then be rebuilt.
+        # Informer confirmations of assumed pods (add_pod with a matching
+        # nodeName) change nothing the device mirror tracks, so they do
+        # not bump it.
+        self._mutation_seq = 0
         self._nodes: Dict[str, _NodeInfoListItem] = {}
         self._head: Optional[_NodeInfoListItem] = None
         self._node_tree = NodeTree()
@@ -115,12 +150,19 @@ class SchedulerCache:
         return item
 
     # ------------------------------------------------------------------
+    @property
+    def mutation_seq(self) -> int:
+        with self._lock:
+            return self._mutation_seq
+
+    # ------------------------------------------------------------------
     # pods
     def assume_pod(self, pod: Pod) -> None:
         key = get_pod_key(pod)
         with self._lock:
             if key in self._pod_states:
                 raise ValueError(f"pod {key} is in the cache, so can't be assumed")
+            self._mutation_seq += 1
             self._add_pod_locked(pod)
             self._pod_states[key] = _PodState(pod)
             self._assumed_pods.add(key)
@@ -138,6 +180,7 @@ class SchedulerCache:
         with self._lock:
             if key not in self._assumed_pods:
                 raise ValueError(f"pod {key} wasn't assumed, so can't be forgotten")
+            self._mutation_seq += 1
             self._remove_pod_locked(self._pod_states[key].pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -150,15 +193,18 @@ class SchedulerCache:
                 state = self._pod_states[key]
                 if state.pod.spec.node_name != pod.spec.node_name:
                     # scheduler result differs from api truth: relocate
+                    self._mutation_seq += 1
                     self._remove_pod_locked(state.pod)
                     self._add_pod_locked(pod)
                 self._assumed_pods.discard(key)
                 self._pod_states[key] = _PodState(pod)
             elif key in self._pod_states:
                 # duplicate add: treat as update
+                self._mutation_seq += 1
                 self._update_pod_locked(self._pod_states[key].pod, pod)
                 self._pod_states[key] = _PodState(pod)
             else:
+                self._mutation_seq += 1
                 self._add_pod_locked(pod)
                 self._pod_states[key] = _PodState(pod)
 
@@ -167,6 +213,8 @@ class SchedulerCache:
         with self._lock:
             if key in self._assumed_pods:
                 raise ValueError(f"assumed pod {key} shouldn't be updated")
+            if _pod_mirror_changed(old, new):
+                self._mutation_seq += 1
             self._update_pod_locked(old, new)
             self._pod_states[key] = _PodState(new)
 
@@ -176,6 +224,7 @@ class SchedulerCache:
             state = self._pod_states.get(key)
             if state is None:
                 return
+            self._mutation_seq += 1
             self._remove_pod_locked(state.pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -215,6 +264,7 @@ class SchedulerCache:
     # nodes
     def add_node(self, node: Node) -> None:
         with self._lock:
+            self._mutation_seq += 1
             item = self._ensure_node(node.name)
             self._remove_node_image_states(item.info.node)
             item.info.set_node(node)
@@ -224,6 +274,8 @@ class SchedulerCache:
 
     def update_node(self, old: Node, new: Node) -> None:
         with self._lock:
+            if _node_mirror_changed(old, new):
+                self._mutation_seq += 1
             item = self._ensure_node(new.name)
             self._remove_node_image_states(item.info.node)
             item.info.set_node(new)
@@ -236,6 +288,7 @@ class SchedulerCache:
             item = self._nodes.get(node.name)
             if item is None:
                 return
+            self._mutation_seq += 1
             item.info.remove_node()
             self._remove_node_image_states(node)
             # keep the entry while pods remain (they'll be removed by events)
@@ -388,6 +441,7 @@ class SchedulerCache:
                     continue
                 if state.binding_finished and state.deadline is not None and now >= state.deadline:
                     # expire: the bind never became visible; undo the assume
+                    self._mutation_seq += 1
                     self._remove_pod_locked(state.pod)
                     del self._pod_states[key]
                     self._assumed_pods.discard(key)

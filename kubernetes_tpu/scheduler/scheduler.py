@@ -300,9 +300,14 @@ class Scheduler:
         pod_scheduling_cycle: int,
         start: float,
         sync_bind: bool = False,
-    ) -> None:
+    ) -> bool:
         """assume → Reserve → Permit → (async) binding cycle — the commit
-        half of scheduleOne, shared by the serial and batch paths."""
+        half of scheduleOne, shared by the serial and batch paths.
+
+        Returns True only when the pod was fully committed in this call
+        (synchronous bind reached PostBind). Async commits return False;
+        callers that must know (the batch session's device-state
+        accounting) use sync_bind."""
         pod = qpi.pod
         # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
         assumed_pod = copy.copy(pod)
@@ -313,7 +318,7 @@ class Scheduler:
         except ValueError as err:
             self._record_failure(fwk, qpi, err, "SchedulerError", "",
                                  pod_scheduling_cycle)
-            return
+            return False
         self.queue.delete_nominated_pod_if_exists(pod)
 
         # Reserve
@@ -322,14 +327,14 @@ class Scheduler:
         if not fw.Status.is_ok(status):
             self._forget_and_fail(fwk, state, qpi, assumed_pod, result,
                                   status.as_error(), pod_scheduling_cycle)
-            return
+            return False
 
         # Permit
         status = fwk.run_permit_plugins(state, assumed_pod, result.suggested_host)
         if status is not None and status.code not in (fw.SUCCESS, fw.WAIT):
             self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
                                         status.as_error(), pod_scheduling_cycle)
-            return
+            return False
 
         with self._inflight_lock:
             self._inflight_bindings += 1
@@ -337,14 +342,15 @@ class Scheduler:
         if sync_bind and status is None:
             # batch path: bindings are in-process; skipping the thread
             # hop roughly halves per-pod commit cost
-            self._binding_cycle(fwk, state, qpi, assumed_pod, result,
-                                pod_scheduling_cycle, start)
+            return self._binding_cycle(fwk, state, qpi, assumed_pod, result,
+                                       pod_scheduling_cycle, start)
         else:
             # binding cycle runs async (scheduler.go:540): the loop continues
             self._bind_pool.submit(
                 self._binding_cycle, fwk, state, qpi, assumed_pod, result,
                 pod_scheduling_cycle, start,
             )
+        return False
 
     # ------------------------------------------------------------------
     def _binding_cycle(
@@ -356,24 +362,24 @@ class Scheduler:
         result: ScheduleResult,
         cycle: int,
         start: float,
-    ) -> None:
+    ) -> bool:
         try:
             status = fwk.wait_on_permit(assumed_pod)
             if not fw.Status.is_ok(status):
                 self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
                                             status.as_error(), cycle)
-                return
+                return False
             status = fwk.run_pre_bind_plugins(state, assumed_pod,
                                               result.suggested_host)
             if not fw.Status.is_ok(status):
                 self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
                                             status.as_error(), cycle)
-                return
+                return False
             err = self._bind(fwk, state, assumed_pod, result.suggested_host)
             if err is not None:
                 self._unreserve_forget_fail(fwk, state, qpi, assumed_pod, result,
                                             err, cycle)
-                return
+                return False
             fwk.run_post_bind_plugins(state, assumed_pod, result.suggested_host)
             elapsed = time.monotonic() - start
             self.metrics.e2e_scheduling_duration.observe(elapsed, "scheduled")
@@ -383,6 +389,7 @@ class Scheduler:
                 time.monotonic() - qpi.initial_attempt_timestamp,
                 str(qpi.attempts),
             )
+            return True
         finally:
             self.metrics.goroutines.dec("binding")
             with self._inflight_zero:

@@ -177,6 +177,20 @@ class PodInfo:
         "non_zero_request",
     )
 
+    @classmethod
+    def of(cls, pod: Pod) -> "PodInfo":
+        """Memoized constructor: parsing terms and summing resource vectors
+        dominates the hot commit path when the same Pod object flows
+        through queue → cache → encoder, so cache the PodInfo on the pod.
+        The identity check guards against ``copy.copy`` propagating the
+        memo to a new pod revision (the copied ``__dict__`` aliases it):
+        a hit requires the cached parse to belong to THIS object."""
+        pi = pod.__dict__.get("_pod_info")
+        if pi is None or pi.pod is not pod:
+            pi = cls(pod)
+            pod.__dict__["_pod_info"] = pi
+        return pi
+
     def __init__(self, pod: Pod):
         self.pod = pod
         self.required_affinity_terms: List[AffinityTerm] = []
@@ -210,7 +224,7 @@ class QueuedPodInfo:
 
     def __init__(self, pod: Pod, timestamp: Optional[float] = None, attempts: int = 0):
         now = time.monotonic() if timestamp is None else timestamp
-        self.pod_info = PodInfo(pod)
+        self.pod_info = PodInfo.of(pod)
         self.timestamp = now
         self.attempts = attempts
         self.initial_attempt_timestamp = now
@@ -292,7 +306,7 @@ class NodeInfo:
         self.generation = next_generation()
 
     def add_pod(self, pod: Pod) -> None:
-        self.add_pod_info(PodInfo(pod))
+        self.add_pod_info(PodInfo.of(pod))
 
     def add_pod_info(self, pi: PodInfo) -> None:
         self.pods.append(pi)

@@ -31,6 +31,7 @@ from typing import List, Optional
 _logger = logging.getLogger(__name__)
 
 from kubernetes_tpu.ops.encode import BatchEncoder, is_host_only
+from kubernetes_tpu.ops.session import SolverSession
 from kubernetes_tpu.ops.solver import SolverParams, solve_scan
 from kubernetes_tpu.scheduler.core import ScheduleResult
 from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
@@ -52,6 +53,9 @@ class TPUBatchScheduler:
         # differential-debug mode: re-check every device assignment with
         # the host filter chain before committing
         self.validate = validate
+        # device-resident state mirror, carried across batches
+        self.session = SolverSession(scheduler, params=params,
+                                     max_batch=max_batch)
 
     # ------------------------------------------------------------------
     def _drain(self, pop_timeout: Optional[float]):
@@ -91,14 +95,19 @@ class TPUBatchScheduler:
             else:
                 batchable.append((qpi, cycle))
 
+        committed = 0
+        seq_before = sched.cache.mutation_seq
         if batchable:
             try:
-                self._solve_and_commit(batchable, serial, start)
+                committed, seq_before = self._solve_and_commit(
+                    batchable, serial, start
+                )
             except Exception:  # noqa: BLE001 — popped pods must not be lost
                 _logger.exception(
                     "batch solve failed; %d pods fall back to the serial path",
                     len(batchable),
                 )
+                self.session.invalidate()
                 serial.extend(q for q, _ in batchable)
 
         seen = set()
@@ -111,6 +120,10 @@ class TPUBatchScheduler:
             if sched.skip_pod_schedule(fwk, qpi.pod):
                 continue
             sched.schedule_pod_serial(fwk, qpi)
+        # session validity: exactly one cache mutation (the assume) per
+        # committed pod — serial binds, failed binds, or external events
+        # in between show up as extra mutations and invalidate the mirror
+        self.session.note_committed(committed, seq_before)
         return len(qpis)
 
     def warmup(self, sample_pods: Optional[List] = None) -> float:
@@ -164,30 +177,18 @@ class TPUBatchScheduler:
 
     # ------------------------------------------------------------------
     def _solve_and_commit(self, batchable: List[tuple],
-                          serial: List[QueuedPodInfo], start: float) -> None:
+                          serial: List[QueuedPodInfo], start: float):
+        """Returns (committed_count, seq_before) for session accounting."""
         sched = self.sched
         fwk = sched.profiles["default-scheduler"]
 
-        t0 = time.monotonic()
-        sched.algorithm.update_snapshot()
-        encoder = BatchEncoder(sched.algorithm.snapshot)
-        # pad every batch to max_batch: one device shape per run, so the
-        # tail batch never recompiles (scan waste on padding is ~0.1s,
-        # a recompile is seconds)
-        cluster, batch = encoder.encode(
-            [q.pod for q, _ in batchable], pad_pods=self.max_batch
-        )
-        sched.metrics.batch_solve_duration.observe(
-            time.monotonic() - t0, "encode"
+        # the session records the disjoint "encode" and "device" segments
+        assignments, cluster, seq_before = self.session.solve(
+            [q.pod for q, _ in batchable]
         )
 
         t0 = time.monotonic()
-        assignments = solve_scan(cluster, batch, self.params)
-        sched.metrics.batch_solve_duration.observe(
-            time.monotonic() - t0, "solve"
-        )
-
-        t0 = time.monotonic()
+        committed = 0
         for (qpi, cycle), assignment in zip(batchable, assignments):
             if assignment < 0:
                 # device says unschedulable (or inexpressible): the serial
@@ -196,6 +197,8 @@ class TPUBatchScheduler:
                 continue
             node_name = cluster.node_names[assignment]
             if self.validate and not self._host_validates(fwk, qpi, node_name):
+                # the device state counts this pod but the host refused it
+                self.session.invalidate()
                 serial.append(qpi)
                 continue
             result = ScheduleResult(
@@ -204,15 +207,24 @@ class TPUBatchScheduler:
                 feasible_nodes=1,
             )
             state = CycleState()
-            sched.commit_assignment(fwk, state, qpi, result, cycle, start,
-                                    sync_bind=True)
+            if sched.commit_assignment(fwk, state, qpi, result, cycle, start,
+                                       sync_bind=True):
+                committed += 1
+            else:
+                # committed on device, rejected on host: mirrors diverged
+                self.session.invalidate()
         sched.metrics.batch_solve_duration.observe(
             time.monotonic() - t0, "commit"
         )
+        return committed, seq_before
 
     def _host_validates(self, fwk, qpi: QueuedPodInfo, node_name: str) -> bool:
         from kubernetes_tpu.scheduler.framework import interface as fw_iface
 
+        # the session only refreshes the snapshot on rebuild; validation
+        # must see the live cache INCLUDING this batch's earlier commits
+        # (incremental update: O(changed nodes) per call)
+        self.sched.algorithm.update_snapshot()
         state = CycleState()
         status = fwk.run_pre_filter_plugins(state, qpi.pod)
         if not fw_iface.Status.is_ok(status):
