@@ -34,10 +34,10 @@ import numpy as np
 from kubernetes_tpu.ops.encode import BatchEncoder, EncodedCluster
 from kubernetes_tpu.ops.solver import (
     SolverParams,
-    _solve,
-    build_podin,
+    _solve_packed,
     build_state,
     build_static,
+    pack_podin,
 )
 
 _logger = logging.getLogger(__name__)
@@ -99,11 +99,11 @@ class SolverSession:
             pb = self._encoder.encode_pods_only(pods, self.max_batch)
             if pb is not None and pb.requests.shape[1] == \
                     self._cluster.allocatable.shape[1]:
-                pods_in = build_podin(pb)
+                ints, floats = pack_podin(pb)
                 self._observe("encode", time.monotonic() - t0)
                 t0 = time.monotonic()
-                new_state, assignments = _solve(
-                    self._static, self._state, pods_in, self.params
+                new_state, assignments = _solve_packed(
+                    self._static, self._state, ints, floats, self.params
                 )
                 out = np.asarray(assignments)
                 self._observe("device", time.monotonic() - t0)
@@ -124,11 +124,11 @@ class SolverSession:
         self._cluster = cluster
         self._static = build_static(cluster, batch, device=True)
         state = build_state(cluster, batch, device=True)
-        pods_in = build_podin(batch)
+        ints, floats = pack_podin(batch)
         self._observe("encode", time.monotonic() - t0)
         t0 = time.monotonic()
-        new_state, assignments = _solve(
-            self._static, state, pods_in, self.params
+        new_state, assignments = _solve_packed(
+            self._static, state, ints, floats, self.params
         )
         out = np.asarray(assignments)
         self._observe("device", time.monotonic() - t0)

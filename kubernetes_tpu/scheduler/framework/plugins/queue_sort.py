@@ -18,3 +18,9 @@ class PrioritySort(QueueSortPlugin):
         if pa != pb:
             return pa > pb
         return a.timestamp < b.timestamp
+
+    @staticmethod
+    def sort_key(qpi: QueuedPodInfo) -> tuple:
+        """Total-order key equivalent of ``less`` (ascending sort puts
+        the queue head first). Enables the queue's bulk C-sorted drain."""
+        return (-qpi.pod.priority(), qpi.timestamp)

@@ -213,6 +213,12 @@ class Framework:
     def queue_sort_less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
         return self.queue_sort_plugins[0].less(a, b)
 
+    @property
+    def queue_sort_key(self):
+        """The QueueSort plugin's total-order key fn, or None when the
+        plugin only defines a comparator."""
+        return getattr(self.queue_sort_plugins[0], "sort_key", None)
+
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Optional[fw.Status]:
         start = time.monotonic()
         for p in self.pre_filter_plugins:

@@ -76,6 +76,26 @@ class Heap:
             self._metric.dec()
         return True
 
+    def pop_all(self) -> List[Any]:
+        """Remove and return every item (arbitrary order) in O(n)."""
+        items = self._items
+        self._items = []
+        self._index = {}
+        if self._metric:
+            for _ in items:
+                self._metric.dec()
+        return items
+
+    def replace_all(self, items_in_heap_order: List[Any]) -> None:
+        """Install ``items`` as the heap content. The caller must provide
+        them already satisfying the heap property (a list sorted by the
+        less-function does); no sifting is performed."""
+        self._items = list(items_in_heap_order)
+        self._index = {self._key(o): i for i, o in enumerate(self._items)}
+        if self._metric:
+            for _ in self._items:
+                self._metric.inc()
+
     def peek(self) -> Optional[Any]:
         return self._items[0] if self._items else None
 

@@ -13,7 +13,7 @@ and an embedded PodNominator for preemption nominations.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.scheduler.heap import Heap
@@ -101,6 +101,9 @@ class SchedulingQueue(PodNominator):
             return get_pod_key(qpi.pod)
 
         self._active_q = Heap(key, less_func)
+        # total-order key published by the QueueSort plugin (wired by the
+        # configurator when available); enables the bulk C-sorted drain
+        self.sort_key: Optional[Callable[[QueuedPodInfo], tuple]] = None
         self._backoff_q = Heap(
             key, lambda a, b: self._backoff_time(a) < self._backoff_time(b)
         )
@@ -191,6 +194,40 @@ class SchedulingQueue(PodNominator):
             self.scheduling_cycle += 1
             return qpi
 
+    def pop_batch(self, max_n: int, timeout: Optional[float] = None,
+                  ) -> Tuple[List[QueuedPodInfo], int]:
+        """Pop up to ``max_n`` pods in queue order under ONE lock — the
+        batch path's drain. When the QueueSort plugin publishes a total-
+        order ``sort_key`` (PrioritySort does), the whole active heap is
+        drained and C-sorted instead of popping one by one: per-pop heap
+        maintenance with a Python less-function costs more than the solve
+        for large batches. Each popped pod consumes one scheduling cycle,
+        exactly as ``max_n`` serial pops would; returns (pods, cycle of
+        the FIRST pop) — computed under the lock so callers need no
+        single-consumer assumption to reconstruct per-pod cycles."""
+        with self._cond:
+            while len(self._active_q) == 0:
+                if self._closed:
+                    return [], self.scheduling_cycle
+                if not self._cond.wait(timeout):
+                    return [], self.scheduling_cycle
+            n = min(max_n, len(self._active_q))
+            if self.sort_key is not None:
+                items = self._active_q.pop_all()
+                items.sort(key=self.sort_key)
+                if len(items) > n:
+                    # a sorted list satisfies the heap property: the
+                    # remainder goes straight back without sifting
+                    self._active_q.replace_all(items[n:])
+                    items = items[:n]
+            else:
+                items = [self._active_q.pop() for _ in range(n)]
+            for qpi in items:
+                qpi.attempts += 1
+            first_cycle = self.scheduling_cycle + 1
+            self.scheduling_cycle += len(items)
+            return items, first_cycle
+
     def update(self, old: Optional[Pod], new: Pod) -> None:
         with self._cond:
             key = get_pod_key(new)
@@ -251,6 +288,8 @@ class SchedulingQueue(PodNominator):
     def _unschedulable_pods_with_matching_affinity(self, pod: Pod) -> List[QueuedPodInfo]:
         """Pods whose (anti-)affinity terms match the newly-assigned pod
         (scheduling_queue.go:483 getUnschedulablePodsWithMatchingAffinityTerm)."""
+        if not self._unschedulable_q:
+            return []
         out = []
         for qpi in self._unschedulable_q.values():
             pi = qpi.pod_info

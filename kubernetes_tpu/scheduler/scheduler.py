@@ -38,7 +38,7 @@ from kubernetes_tpu.scheduler.framework.plugins import new_in_tree_registry
 from kubernetes_tpu.scheduler.framework.runtime import Framework, Registry
 from kubernetes_tpu.scheduler.provider import PROVIDERS
 from kubernetes_tpu.scheduler.queue import SchedulingQueue
-from kubernetes_tpu.scheduler.types import QueuedPodInfo
+from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo
 from kubernetes_tpu.utils.clock import RealClock
 
 PLUGIN_METRICS_SAMPLE_PERCENT = 10  # scheduler.go:56
@@ -168,6 +168,7 @@ class Scheduler:
             raise ValueError("all profiles must use the same QueueSort plugin")
         any_profile = next(iter(sched.profiles.values()))
         queue._active_q._less = any_profile.queue_sort_less
+        queue.sort_key = any_profile.queue_sort_key
         return sched
 
     # ------------------------------------------------------------------
@@ -313,6 +314,8 @@ class Scheduler:
         assumed_pod = copy.copy(pod)
         assumed_pod.spec = copy.copy(pod.spec)
         assumed_pod.spec.node_name = result.suggested_host
+        # reuse the queue's parse — the copy differs only in nodeName
+        PodInfo.derived(assumed_pod, qpi.pod_info)
         try:
             self.cache.assume_pod(assumed_pod)
         except ValueError as err:

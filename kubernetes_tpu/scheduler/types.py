@@ -191,6 +191,23 @@ class PodInfo:
             pod.__dict__["_pod_info"] = pi
         return pi
 
+    @classmethod
+    def derived(cls, pod: Pod, base: "PodInfo") -> "PodInfo":
+        """A PodInfo for a shallow variant of ``base.pod`` (the assumed
+        copy, which differs only in spec.nodeName): share the parsed
+        terms and resource vectors instead of re-parsing. The caller
+        guarantees containers/affinity/labels are unchanged."""
+        pi = cls.__new__(cls)
+        pi.pod = pod
+        pi.required_affinity_terms = base.required_affinity_terms
+        pi.required_anti_affinity_terms = base.required_anti_affinity_terms
+        pi.preferred_affinity_terms = base.preferred_affinity_terms
+        pi.preferred_anti_affinity_terms = base.preferred_anti_affinity_terms
+        pi.resource_request = base.resource_request
+        pi.non_zero_request = base.non_zero_request
+        pod.__dict__["_pod_info"] = pi
+        return pi
+
     def __init__(self, pod: Pod):
         self.pod = pod
         self.required_affinity_terms: List[AffinityTerm] = []

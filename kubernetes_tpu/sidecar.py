@@ -59,18 +59,15 @@ class TPUBatchScheduler:
 
     # ------------------------------------------------------------------
     def _drain(self, pop_timeout: Optional[float]):
-        """Pop up to max_batch pods; first pop may block briefly. Each
-        pod's scheduling cycle is captured AT POP TIME (serial semantics:
-        the moveRequestCycle race rule compares against the cycle the pod
-        was popped in, scheduling_queue.go:317)."""
-        qpis: List[tuple] = []  # (QueuedPodInfo, pop-time cycle)
-        qpi = self.sched.queue.pop(timeout=pop_timeout)
-        while qpi is not None:
-            qpis.append((qpi, self.sched.queue.scheduling_cycle))
-            if len(qpis) >= self.max_batch:
-                break
-            qpi = self.sched.queue.pop(timeout=0.0)
-        return qpis
+        """Pop up to max_batch pods (bulk, one lock). Each pod's
+        scheduling cycle is captured AT POP TIME (serial semantics: the
+        moveRequestCycle race rule compares against the cycle the pod was
+        popped in, scheduling_queue.go:317) — pop_batch consumes one
+        cycle per pod, so cycles are reconstructed from the final value."""
+        items, first_cycle = self.sched.queue.pop_batch(
+            self.max_batch, timeout=pop_timeout
+        )
+        return [(qpi, first_cycle + i) for i, qpi in enumerate(items)]
 
     def run_batch(self, pop_timeout: Optional[float] = 0.2) -> int:
         """One batch cycle. Returns the number of pods processed."""
