@@ -34,6 +34,16 @@ PENDING, RUNNING, SUCCEEDED, FAILED = "Pending", "Running", "Succeeded", "Failed
 _uid_counter = itertools.count(1)
 
 
+def shallow_copy(obj):
+    """Fast shallow copy for API dataclasses. ``copy.copy`` routes
+    through ``__reduce_ex__``/``_reconstruct`` (~8µs per object), which
+    dominates the bind hot path at thousands of pods/sec; a ``__dict__``
+    copy is semantically identical for plain (non-slots) dataclasses."""
+    new = object.__new__(type(obj))
+    new.__dict__.update(obj.__dict__)
+    return new
+
+
 def new_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu.api.types import (
     CSINode,
     DaemonSet,
+    shallow_copy,
     Deployment,
     Endpoints,
     Job,
@@ -177,12 +178,10 @@ class ClusterStore:
                                  f"{pod.spec.node_name!r}")
             # build a fresh object so watchers' `old` stays unassigned
             # (in-process stores have no serialization boundary to copy for us)
-            import copy
-
-            new_pod = copy.copy(pod)
-            new_pod.spec = copy.copy(pod.spec)
+            new_pod = shallow_copy(pod)
+            new_pod.spec = shallow_copy(pod.spec)
             new_pod.spec.node_name = node_name
-            new_pod.metadata = copy.copy(pod.metadata)
+            new_pod.metadata = shallow_copy(pod.metadata)
             new_pod.metadata.resource_version = self._next_rv()
             self._pods[key] = new_pod
             self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))
@@ -420,17 +419,15 @@ class ClusterStore:
             pod = self._pods.get(key)
             if pod is None:
                 return False
-            import copy
-
-            new_pod = copy.copy(pod)
-            new_pod.status = copy.copy(pod.status)
+            new_pod = shallow_copy(pod)
+            new_pod.status = shallow_copy(pod.status)
             if phase:
                 new_pod.status.phase = phase
             if pod_ip:
                 new_pod.status.pod_ip = pod_ip
             if host_ip:
                 new_pod.status.host_ip = host_ip
-            new_pod.metadata = copy.copy(pod.metadata)
+            new_pod.metadata = shallow_copy(pod.metadata)
             new_pod.metadata.resource_version = self._next_rv()
             self._pods[key] = new_pod
             self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))

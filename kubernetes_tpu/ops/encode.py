@@ -485,7 +485,14 @@ class BatchEncoder:
         if self._resource_names is None:
             return None
         b_real = len(pods)
-        b_pad = max(pad_pods, 1 << (max(b_real, 1) - 1).bit_length())
+        # ONE compiled shape for every batch up to pad_pods (the sidecar's
+        # max_batch): a pow2 bucket between b_real and pad_pods would
+        # recompile mid-run on a partially-filled drain. Rounded to 8 for
+        # the pallas kernel's SMEM sublane tiling.
+        b_pad = _round_up(
+            pad_pods if b_real <= pad_pods
+            else 1 << (b_real - 1).bit_length(), 8
+        )
         resource_names = self._resource_names
         known_resources = set(resource_names)
         constraints = self._constraints

@@ -43,21 +43,63 @@ from kubernetes_tpu.ops.solver import (
 _logger = logging.getLogger(__name__)
 
 
+class XlaBackend:
+    """Scan-based solve backend (works on every jax platform)."""
+
+    name = "xla"
+
+    def prepare(self, cluster, batch):
+        return (build_static(cluster, batch, device=True),
+                build_state(cluster, batch, device=True))
+
+    def solve(self, params, static, state, pod_ints, pod_floats):
+        new_state, assignments = _solve_packed(
+            static, state, pod_ints, pod_floats, params
+        )
+        return np.asarray(assignments), new_state
+
+
+def default_backend():
+    """Pallas kernel on real TPU hardware, XLA scan elsewhere (Mosaic
+    does not target CPU; interpret mode is for tests only). Override
+    with KTPU_SOLVER=pallas|xla."""
+    import os
+
+    import jax
+
+    choice = os.environ.get("KTPU_SOLVER", "")
+    if choice == "xla":
+        return XlaBackend()
+    if choice == "pallas":
+        from kubernetes_tpu.ops.pallas_solver import PallasBackend
+
+        return PallasBackend(interpret=jax.default_backend() == "cpu")
+    if jax.default_backend() == "tpu":
+        from kubernetes_tpu.ops.pallas_solver import PallasBackend
+
+        return PallasBackend()
+    # gpu/metal/cpu: Mosaic does not lower there — use the scan
+    return XlaBackend()
+
+
 class SolverSession:
     """Owns the device mirror for one scheduler's batch path."""
 
     def __init__(self, scheduler, params: SolverParams = SolverParams(),
-                 max_batch: int = 4096, pad_nodes: int = 128):
+                 max_batch: int = 4096, pad_nodes: int = 128,
+                 backend=None):
         self.sched = scheduler
         self.params = params
         self.max_batch = max_batch
         self.pad_nodes = pad_nodes
+        self.backend = backend or default_backend()
         self._encoder: Optional[BatchEncoder] = None
         self._cluster: Optional[EncodedCluster] = None
-        self._static = None   # device-resident _Static
-        self._state = None    # device-resident _State (carried)
+        self._static = None   # device-resident solve-invariant arrays
+        self._state = None    # device-resident dynamic state (carried)
         self._last_seq: int = -1
         self._poisoned = False
+        self._warming = False
         # telemetry: how often the incremental path was taken
         self.incremental_hits = 0
         self.rebuilds = 0
@@ -89,10 +131,14 @@ class SolverSession:
             self._last_seq = -1
 
     # ------------------------------------------------------------------
-    def solve(self, pods: List) -> Tuple[np.ndarray, EncodedCluster, int]:
+    def solve(self, pods: List, warming: bool = False
+              ) -> Tuple[np.ndarray, EncodedCluster, int]:
         """Solve one batch. Returns (assignments [B], cluster,
         seq_before) where assignments map batch index → node index in
-        ``cluster.node_names`` (-1 = unschedulable on device)."""
+        ``cluster.node_names`` (-1 = unschedulable on device).
+        ``warming`` suppresses telemetry (metrics segments, rebuild
+        counters) so JIT-compile time stays out of the measured series."""
+        self._warming = warming
         seq_before = self.sched.cache.mutation_seq
         if self._state is not None and seq_before == self._last_seq:
             t0 = time.monotonic()
@@ -102,18 +148,18 @@ class SolverSession:
                 ints, floats = pack_podin(pb)
                 self._observe("encode", time.monotonic() - t0)
                 t0 = time.monotonic()
-                new_state, assignments = _solve_packed(
-                    self._static, self._state, ints, floats, self.params
+                out, self._state = self.backend.solve(
+                    self.params, self._static, self._state, ints, floats
                 )
-                out = np.asarray(assignments)
                 self._observe("device", time.monotonic() - t0)
-                self._state = new_state
-                self.incremental_hits += 1
+                if not self._warming:
+                    self.incremental_hits += 1
                 return out, self._cluster, seq_before
         return self._rebuild_and_solve(pods, seq_before)
 
     def _rebuild_and_solve(self, pods: List, seq_before: int):
-        self.rebuilds += 1
+        if not self._warming:
+            self.rebuilds += 1
         self._poisoned = False
         t0 = time.monotonic()
         self.sched.algorithm.update_snapshot()
@@ -122,22 +168,37 @@ class SolverSession:
         )
         cluster, batch = self._encoder.encode(pods, pad_pods=self.max_batch)
         self._cluster = cluster
-        self._static = build_static(cluster, batch, device=True)
-        state = build_state(cluster, batch, device=True)
         ints, floats = pack_podin(batch)
         self._observe("encode", time.monotonic() - t0)
-        t0 = time.monotonic()
-        new_state, assignments = _solve_packed(
-            self._static, state, ints, floats, self.params
-        )
-        out = np.asarray(assignments)
+        try:
+            t0 = time.monotonic()
+            self._static, state = self.backend.prepare(cluster, batch)
+            out, self._state = self.backend.solve(
+                self.params, self._static, state, ints, floats
+            )
+        except Exception:
+            if self.backend.name == "xla":
+                raise
+            # the pallas kernel failed to compile/run on this platform:
+            # fall back to the scan backend permanently (clean-fallback
+            # contract, like an IsIgnorable extender)
+            _logger.exception(
+                "pallas solve backend failed; falling back to xla scan"
+            )
+            self.backend = XlaBackend()
+            t0 = time.monotonic()
+            self._static, state = self.backend.prepare(cluster, batch)
+            out, self._state = self.backend.solve(
+                self.params, self._static, state, ints, floats
+            )
         self._observe("device", time.monotonic() - t0)
-        self._state = new_state
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
         return out, cluster, seq_before
 
     def _observe(self, segment: str, seconds: float) -> None:
+        if self._warming:
+            return
         try:
             self.sched.metrics.batch_solve_duration.observe(seconds, segment)
         except Exception:  # pragma: no cover — metrics must never break solves

@@ -16,14 +16,13 @@ an ``IsIgnorable`` extender — SURVEY.md section 5).
 
 from __future__ import annotations
 
-import copy
 import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
-from kubernetes_tpu.api.types import Pod, PodCondition
+from kubernetes_tpu.api.types import Pod, PodCondition, shallow_copy
 from kubernetes_tpu.apiserver.store import ClusterStore
 from kubernetes_tpu.config.feature_gates import FeatureGates
 from kubernetes_tpu.config.types import KubeSchedulerConfiguration
@@ -311,8 +310,8 @@ class Scheduler:
         accounting) use sync_bind."""
         pod = qpi.pod
         # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
-        assumed_pod = copy.copy(pod)
-        assumed_pod.spec = copy.copy(pod.spec)
+        assumed_pod = shallow_copy(pod)
+        assumed_pod.spec = shallow_copy(pod.spec)
         assumed_pod.spec.node_name = result.suggested_host
         # reuse the queue's parse — the copy differs only in nodeName
         PodInfo.derived(assumed_pod, qpi.pod_info)

@@ -30,9 +30,9 @@ from typing import List, Optional
 
 _logger = logging.getLogger(__name__)
 
-from kubernetes_tpu.ops.encode import BatchEncoder, is_host_only
+from kubernetes_tpu.ops.encode import is_host_only
 from kubernetes_tpu.ops.session import SolverSession
-from kubernetes_tpu.ops.solver import SolverParams, solve_scan
+from kubernetes_tpu.ops.solver import SolverParams
 from kubernetes_tpu.scheduler.core import ScheduleResult
 from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
 from kubernetes_tpu.scheduler.scheduler import Scheduler
@@ -158,9 +158,12 @@ class TPUBatchScheduler:
                             requests={"cpu": parse_quantity("1m")}),
                     )]),
                 )]
-            encoder = BatchEncoder(sched.algorithm.snapshot)
-            cluster, batch = encoder.encode(pods, pad_pods=self.max_batch)
-            solve_scan(cluster, batch, self.params)
+            # drive the session itself so the ACTIVE backend (pallas
+            # kernel or xla scan) compiles for the exact steady-state
+            # shapes; then invalidate — warmup pods were solved into the
+            # device mirror but never committed on the host
+            self.session.solve(pods, warming=True)
+            self.session.invalidate()
         except Exception:
             _logger.exception("solver warmup failed (continuing cold)")
         return time.monotonic() - t0

@@ -1,0 +1,99 @@
+"""Pallas kernel differential tests (interpret mode — CPU-safe).
+
+The kernel must produce EXACTLY the scan solver's assignments (same
+serial-equivalent semantics) on mixed workloads: resource fit, hard
+topology spread, and (anti-)affinity, including intra-batch interaction.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.ops import pallas_solver as ps
+from kubernetes_tpu.ops.encode import BatchEncoder
+from kubernetes_tpu.ops.solver import SolverParams, pack_podin, solve_scan
+from kubernetes_tpu.scheduler.snapshot import new_snapshot
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _problem(n_nodes=12, n_pods=16, mixed=True):
+    nodes = [
+        MakeNode().name(f"n{i}")
+        .label("topology.kubernetes.io/zone", f"z{i % 3}")
+        .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_pods):
+        w = MakePod().name(f"p{i}").uid(f"pu{i}").label("app", "w").req(
+            {"cpu": "500m", "memory": "256Mi"})
+        if mixed and i % 3 == 0:
+            w.spread_constraint(2, "topology.kubernetes.io/zone",
+                                "DoNotSchedule", {"app": "w"})
+        elif mixed and i % 3 == 1:
+            w.pod_anti_affinity("app", ["w"], "kubernetes.io/hostname")
+        pods.append(w.obj())
+    snap = new_snapshot([], nodes)
+    enc = BatchEncoder(snap, pad_nodes=128)
+    return enc.encode(pods, pad_pods=32)
+
+
+@pytest.mark.parametrize("mixed", [False, True])
+def test_kernel_matches_scan(mixed):
+    cluster, batch = _problem(mixed=mixed)
+    ref = solve_scan(cluster, batch, SolverParams())
+    pstatic, pstate = ps.prepare(cluster, batch)
+    ints, floats = pack_podin(batch)
+    backend = ps.PallasBackend(interpret=True)
+    got, _ = backend.solve(SolverParams(), pstatic, pstate, ints, floats)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_kernel_state_carry_across_batches():
+    """Two sequential 8-pod batches through the kernel must equal one
+    16-pod scan: the carried PState (capacity + topology counts) is the
+    cross-batch contract."""
+    cluster, batch = _problem(n_pods=16)
+    ref = solve_scan(cluster, batch, SolverParams())
+
+    pstatic, pstate = ps.prepare(cluster, batch)
+    backend = ps.PallasBackend(interpret=True)
+    outs = []
+    pods_all = batch.pods
+    for half in (slice(0, 8), slice(8, 16)):
+        sub = dataclasses.replace(
+            batch,
+            pods=pods_all[half],
+            num_real_pods=8,
+            requests=np.vstack([batch.requests[half],
+                                np.zeros((8, batch.requests.shape[1]),
+                                         np.int32)]),
+            nonzero_requests=np.vstack([batch.nonzero_requests[half],
+                                        np.zeros((8, 2), np.int32)]),
+            profile_idx=np.concatenate([batch.profile_idx[half],
+                                        np.zeros(8, np.int32)]),
+            inexpressible=np.concatenate([batch.inexpressible[half],
+                                          np.zeros(8, bool)]),
+            pod_sc=np.vstack([batch.pod_sc[half],
+                              np.zeros((8, batch.pod_sc.shape[1]), bool)]),
+            pod_sc_match=np.vstack(
+                [batch.pod_sc_match[half],
+                 np.zeros((8, batch.pod_sc_match.shape[1]), bool)]),
+            match_by=np.vstack([batch.match_by[half],
+                                np.zeros((8, batch.match_by.shape[1]),
+                                         bool)]),
+            own_aff=np.vstack([batch.own_aff[half],
+                               np.zeros((8, batch.own_aff.shape[1]), bool)]),
+            own_anti=np.vstack([batch.own_anti[half],
+                                np.zeros((8, batch.own_anti.shape[1]),
+                                         bool)]),
+            pref_weight=np.vstack(
+                [batch.pref_weight[half],
+                 np.zeros((8, batch.pref_weight.shape[1]), np.float32)]),
+        )
+        ints, floats = pack_podin(sub)
+        got, pstate = backend.solve(SolverParams(), pstatic, pstate,
+                                    ints, floats)
+        outs.extend(got[:8].tolist())
+    np.testing.assert_array_equal(ref[:16], outs)
