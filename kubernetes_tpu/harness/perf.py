@@ -165,11 +165,15 @@ def run_workload(
                     # compile/cache-load the solver outside the measured
                     # window (JIT warm-up is setup, like the reference's
                     # informer warm-up before scheduler_perf collects).
-                    # Warm with this op's actual pod template so the
-                    # constraint/resource dims match the measured batches.
-                    warm = bs.warmup(
-                        sample_pods=[Pod.from_dict(template(offset))]
-                    )
+                    # Warm with a representative SAMPLE of this op's pods:
+                    # the compiled shape depends on the deduped constraint/
+                    # term/profile space, and workload templates commonly
+                    # cycle through modulo-k groups (one pod would warm a
+                    # 1-term shape while the real batches carry k terms).
+                    warm = bs.warmup(sample_pods=[
+                        Pod.from_dict(template(offset + i))
+                        for i in range(min(200, op["count"]))
+                    ])
                     if progress and warm > 0.05:
                         progress(f"{name}: solver warmup {warm:.1f}s")
                 if collect:

@@ -81,6 +81,38 @@ def _term_key(t) -> tuple:
     return (t.topology_key, repr(t.selector), tuple(sorted(t.namespaces)))
 
 
+def _simple_label_eq(selector: labelslib.Selector):
+    """(key, value) when the selector is a single ``key IN (value)``
+    requirement — the overwhelmingly common shape — else None."""
+    reqs = selector.requirements
+    if (
+        not getattr(selector, "_nothing", False)
+        and len(reqs) == 1
+        and reqs[0].operator == labelslib.IN
+        and len(reqs[0].values) == 1
+    ):
+        return (reqs[0].key, reqs[0].values[0])
+    return None
+
+
+def _build_match_index(items):
+    """Split tracked constraints/terms into an inverted index of simple
+    single-label selectors ((key, value) → [(idx, item)]) plus the
+    complex remainder. Filling per-pod match masks via the index is
+    O(pod labels) instead of O(tracked items): workloads with many
+    modulo-k groups (e.g. 100 anti-affinity colors) otherwise spend
+    longer matching selectors on the host than solving on device."""
+    simple: Dict[tuple, list] = {}
+    complex_items = []
+    for idx, item in enumerate(items):
+        kv = _simple_label_eq(item.selector)
+        if kv is None:
+            complex_items.append((idx, item))
+        else:
+            simple.setdefault(kv, []).append((idx, item))
+    return simple, complex_items
+
+
 @dataclass
 class _TrackedConstraint:
     """One distinct topology-spread constraint shared by batch pods."""
@@ -206,6 +238,8 @@ class BatchEncoder:
         self._terms: Optional[List[_TrackedTerm]] = None
         self._profiles: Optional[Dict[tuple, int]] = None
         self._num_values: int = 0
+        self._con_match_idx = ({}, [])
+        self._term_match_idx = ({}, [])
 
     # ------------------------------------------------------------------
     def encode(self, pods: List[Pod], pad_pods: int = 64) -> Tuple[
@@ -390,6 +424,8 @@ class BatchEncoder:
         self._terms = terms
         self._profiles = profiles
         self._num_values = num_values
+        self._con_match_idx = _build_match_index(constraints)
+        self._term_match_idx = _build_match_index(terms)
         pb = self.encode_pods_only(pods, pad_pods)
         if pb is None:  # cannot happen: every pod was just registered
             raise RuntimeError("pod-side encode failed against a space "
@@ -538,7 +574,13 @@ class BatchEncoder:
                 if ci is None:
                     return None
                 pod_sc[bi, ci] = True
-            for ci, con in enumerate(constraints):
+            simple_cons, complex_cons = self._con_match_idx
+            labels = pod.metadata.labels or {}
+            for kv in labels.items():
+                for ci, con in simple_cons.get(kv, ()):
+                    if pod.namespace == con.namespace:
+                        pod_sc_match[bi, ci] = True
+            for ci, con in complex_cons:
                 pod_sc_match[bi, ci] = con.matches(pod)
 
             def tracked(t) -> Optional[int]:
@@ -564,7 +606,12 @@ class BatchEncoder:
                 if ti is None:
                     return None
                 pref_weight[bi, ti] -= float(wt.weight)
-            for ti, term in enumerate(terms):
+            simple_terms, complex_terms = self._term_match_idx
+            for kv in labels.items():
+                for ti, term in simple_terms.get(kv, ()):
+                    if pod.namespace in term.namespaces:
+                        match_by[bi, ti] = True
+            for ti, term in complex_terms:
                 match_by[bi, ti] = term.matches(pod)
 
         return EncodedPodBatch(

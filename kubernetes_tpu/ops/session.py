@@ -44,9 +44,12 @@ _logger = logging.getLogger(__name__)
 
 
 class XlaBackend:
-    """Scan-based solve backend (works on every jax platform)."""
+    """Legacy scan backend (per-value count tables + gathers). Slow for
+    wide hostname-keyed term spaces, but free of the planes layout's
+    structural limits (e.g. tracked terms > padded nodes) — kept as the
+    solve chain's last resort."""
 
-    name = "xla"
+    name = "xla-legacy"
 
     def prepare(self, cluster, batch):
         return (build_static(cluster, batch, device=True),
@@ -60,16 +63,18 @@ class XlaBackend:
 
 
 def default_backend():
-    """Pallas kernel on real TPU hardware, XLA scan elsewhere (Mosaic
-    does not target CPU; interpret mode is for tests only). Override
-    with KTPU_SOLVER=pallas|xla."""
+    """Pallas kernel on real TPU hardware, gather-free XLA planes scan
+    elsewhere (Mosaic does not target CPU; interpret mode is for tests
+    only). Override with KTPU_SOLVER=pallas|xla."""
     import os
 
     import jax
 
     choice = os.environ.get("KTPU_SOLVER", "")
     if choice == "xla":
-        return XlaBackend()
+        from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
+
+        return XlaPlanesBackend()
     if choice == "pallas":
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
@@ -78,8 +83,27 @@ def default_backend():
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
         return PallasBackend()
-    # gpu/metal/cpu: Mosaic does not lower there — use the scan
-    return XlaBackend()
+    # gpu/metal/cpu: Mosaic does not lower there — use the planes scan
+    from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
+
+    return XlaPlanesBackend()
+
+
+# beyond these per-axis sizes the pallas kernel's Python-unrolled
+# constraint loops stop paying off (compile time and per-step vector-op
+# count scale linearly in SC/T/U, while the XLA scan handles the same
+# axes as single fused [K, N] ops)
+PALLAS_MAX_SC = 8
+PALLAS_MAX_TERMS = 8
+PALLAS_MAX_PROFILES = 8
+
+
+def _pallas_fits(batch) -> bool:
+    return (
+        batch.sc_counts.shape[0] <= PALLAS_MAX_SC
+        and batch.term_counts.shape[0] <= PALLAS_MAX_TERMS
+        and batch.static_masks.shape[0] <= PALLAS_MAX_PROFILES
+    )
 
 
 class SolverSession:
@@ -93,6 +117,9 @@ class SolverSession:
         self.max_batch = max_batch
         self.pad_nodes = pad_nodes
         self.backend = backend or default_backend()
+        # backend actually used for the current epoch (a wide constraint
+        # space demotes pallas to the scan for that epoch only)
+        self._active = self.backend
         self._encoder: Optional[BatchEncoder] = None
         self._cluster: Optional[EncodedCluster] = None
         self._static = None   # device-resident solve-invariant arrays
@@ -148,7 +175,7 @@ class SolverSession:
                 ints, floats = pack_podin(pb)
                 self._observe("encode", time.monotonic() - t0)
                 t0 = time.monotonic()
-                out, self._state = self.backend.solve(
+                out, self._state = self._active.solve(
                     self.params, self._static, self._state, ints, floats
                 )
                 self._observe("device", time.monotonic() - t0)
@@ -170,27 +197,41 @@ class SolverSession:
         self._cluster = cluster
         ints, floats = pack_podin(batch)
         self._observe("encode", time.monotonic() - t0)
-        try:
-            t0 = time.monotonic()
-            self._static, state = self.backend.prepare(cluster, batch)
-            out, self._state = self.backend.solve(
-                self.params, self._static, state, ints, floats
-            )
-        except Exception:
-            if self.backend.name == "xla":
-                raise
-            # the pallas kernel failed to compile/run on this platform:
-            # fall back to the scan backend permanently (clean-fallback
-            # contract, like an IsIgnorable extender)
-            _logger.exception(
-                "pallas solve backend failed; falling back to xla scan"
-            )
-            self.backend = XlaBackend()
-            t0 = time.monotonic()
-            self._static, state = self.backend.prepare(cluster, batch)
-            out, self._state = self.backend.solve(
-                self.params, self._static, state, ints, floats
-            )
+        from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
+
+        # solve chain (clean-fallback contract, like an IsIgnorable
+        # extender): preferred backend when the space fits it, then the
+        # gather-free planes scan, then the legacy scan — which has no
+        # structural layout limits and runs on every platform
+        if self.backend.name == "xla-legacy":   # demoted all the way down
+            chain = [self.backend]
+        else:
+            chain = []
+            if self.backend.name == "pallas" and _pallas_fits(batch):
+                chain.append(self.backend)
+            chain.append(self.backend if self.backend.name == "xla-planes"
+                         else XlaPlanesBackend())
+            chain.append(XlaBackend())
+        t0 = time.monotonic()
+        for i, backend in enumerate(chain):
+            try:
+                t0 = time.monotonic()
+                self._static, state = backend.prepare(cluster, batch)
+                out, self._state = backend.solve(
+                    self.params, self._static, state, ints, floats
+                )
+                self._active = backend
+                break
+            except Exception:
+                if i == len(chain) - 1:
+                    raise
+                _logger.exception(
+                    "%s solve backend failed; trying %s",
+                    backend.name, chain[i + 1].name,
+                )
+                if backend is self.backend:
+                    # don't re-pay a failing compile on every rebuild
+                    self.backend = chain[i + 1]
         self._observe("device", time.monotonic() - t0)
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before

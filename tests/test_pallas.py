@@ -97,3 +97,16 @@ def test_kernel_state_carry_across_batches():
                                     ints, floats)
         outs.extend(got[:8].tolist())
     np.testing.assert_array_equal(ref[:16], outs)
+
+
+@pytest.mark.parametrize("mixed", [False, True])
+def test_xla_planes_backend_matches_scan(mixed):
+    """The gather-free planes scan (wide-constraint fallback) must also
+    match the legacy scan exactly."""
+    cluster, batch = _problem(mixed=mixed)
+    ref = solve_scan(cluster, batch, SolverParams())
+    backend = ps.XlaPlanesBackend()
+    pstatic, pstate = backend.prepare(cluster, batch)
+    ints, floats = pack_podin(batch)
+    got, _ = backend.solve(SolverParams(), pstatic, pstate, ints, floats)
+    np.testing.assert_array_equal(ref, got)
