@@ -1,0 +1,89 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's scheduling algorithm is native (Go); this package holds
+the framework's native pieces — currently the planes-layout batch solver
+(``solver.cc``), used as the CPU-native backend and as an independent
+differential oracle for the TPU kernels.
+
+No pybind11 in this environment: the library is a plain ``extern "C"``
+shared object built with g++ and bound with ctypes on flat numpy
+buffers (the planes layout is already columnar, so there is no object
+marshalling at the boundary). Everything degrades gracefully: if the
+compiler or library is unavailable, ``load()`` returns None and callers
+fall back to the JAX backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "solver.cc")
+_LIB = os.path.join(_DIR, "libktpu_solver.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile solver.cc → libktpu_solver.so. Returns True on success.
+    Skipped when the library is newer than the source."""
+    if (
+        not force
+        and os.path.exists(_LIB)
+        and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+    ):
+        return True
+    cmd = [
+        # -ffp-contract=off: no FMA contraction — the solver's f32 math
+        # must round exactly like XLA's separate mul/add for the
+        # bit-identical differential contract
+        "g++", "-O3", "-march=native", "-ffp-contract=off",
+        "-shared", "-fPIC", "-o", _LIB, _SRC,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _logger.warning("native solver build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        _logger.warning("native solver build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def load():
+    """Load (building on first use) the native library. Returns the
+    ctypes CDLL with ``ktpu_solve`` configured, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            _logger.warning("native solver load failed: %s", e)
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ktpu_solve.restype = ctypes.c_int
+        lib.ktpu_solve.argtypes = [
+            i32p, f32p, i32p, i32p, i32p, i32p, f32p, i32p, f32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib

@@ -1,0 +1,245 @@
+// Native (C++) batch scheduling solver on the planes layout.
+//
+// Mirrors ops/solver.py::_step one-to-one (see also the pallas kernel in
+// ops/pallas_solver.py): per pod, evaluate feasibility (capacity fit,
+// pod-count cap, static predicate masks, hard topology-spread skew,
+// (anti-)affinity domain counts) and scores (balanced/least allocation,
+// soft spread, preferred affinity, static) over every node, commit the
+// argmax (first max wins = lowest node index, matching jnp.argmax), and
+// update the dynamic state in place.
+//
+// Topology/affinity counts are kept PER NODE (the kernel's gather-free
+// representation): a commit to node j increments every node sharing j's
+// domain value via one compare loop.
+//
+// All float math is single-precision with the same operation order as
+// the JAX paths so results are bit-identical (the differential tests
+// assert exact equality of assignments).
+//
+// Layout contracts (must match ops/pallas_solver.py):
+//   static ints  [CS, N]: alloc[R] | max_pods | masks[U] | sc_codes[SC]
+//                         | sc_domain[U*SC] | term_codes[T] | node_valid
+//   state planes [CD, N]: requested[R] | nonzero[2] | pod_count
+//                         | sc_counts[SC] | term_counts[T]
+//                         | term_owners[T] | totals (flat [0..T) slots)
+//   pod ints     [B, C]:  req[R] | nonzero[2] | profile | valid
+//                         | pod_sc[SC] | sc_match[SC] | match_by[T]
+//                         | own_aff[T] | own_anti[T]   (pack_podin)
+//
+// Built as a shared library; loaded with ctypes (no pybind11 in this
+// environment). The runtime gracefully falls back to the JAX backends
+// when the library is absent.
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr float kNegInf = -1e30f;
+constexpr int32_t kBig = 1 << 30;
+
+inline float clip01(float x) {
+  return x < 0.0f ? 0.0f : (x > 1.0f ? 1.0f : x);
+}
+
+}  // namespace
+
+extern "C" {
+
+// weights: balanced, least, spread, affinity, static (SolverParams order)
+// Returns 0 on success.
+int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
+               const int32_t* sc_meta, int32_t* state, int32_t* totals,
+               const int32_t* pod_ints, const float* pod_floats,
+               int32_t* assignments, const float* weights,
+               int32_t r, int32_t sc, int32_t t, int32_t u, int32_t v,
+               int64_t n, int32_t b, int32_t c_cols) {
+  // static plane offsets
+  const int64_t so_alloc = 0;
+  const int64_t so_max_pods = so_alloc + r;
+  const int64_t so_masks = so_max_pods + 1;
+  const int64_t so_sc_codes = so_masks + u;
+  const int64_t so_sc_domain = so_sc_codes + sc;
+  const int64_t so_term_codes = so_sc_domain + (int64_t)u * sc;
+  const int64_t so_node_valid = so_term_codes + t;
+  // state plane offsets
+  const int64_t do_requested = 0;
+  const int64_t do_nonzero = do_requested + r;
+  const int64_t do_pod_count = do_nonzero + 2;
+  const int64_t do_sc_counts = do_pod_count + 1;
+  const int64_t do_term_counts = do_sc_counts + sc;
+  const int64_t do_term_owners = do_term_counts + t;
+  // pod column offsets (pack_podin)
+  const int32_t c_req = 0;
+  const int32_t c_nonzero = r;
+  const int32_t c_profile = r + 2;
+  const int32_t c_valid = r + 3;
+  const int32_t c_pod_sc = r + 4;
+  const int32_t c_sc_match = r + 4 + sc;
+  const int32_t c_match_by = r + 4 + 2 * sc;
+  const int32_t c_own_aff = r + 4 + 2 * sc + t;
+  const int32_t c_own_anti = r + 4 + 2 * sc + 2 * t;
+
+  const int32_t* node_valid = static_ints + so_node_valid * n;
+  const int32_t* max_pods = static_ints + so_max_pods * n;
+
+  std::vector<int32_t> min_c(sc);
+  std::vector<float> score(n);
+  std::vector<uint8_t> feas(n);
+
+  for (int32_t bi = 0; bi < b; ++bi) {
+    const int32_t* row = pod_ints + (int64_t)bi * c_cols;
+    const float* pref_w = pod_floats + (int64_t)bi * (t > 0 ? t : 1);
+    const bool pod_valid = row[c_valid] != 0;
+    if (!pod_valid) {  // padding rows: no feasible node, no state change
+      assignments[bi] = -1;
+      continue;
+    }
+    const int32_t profile = row[c_profile];
+    const int32_t* masks = static_ints + (so_masks + profile) * n;
+    const float* static_score = static_f32s + (int64_t)profile * n;
+
+    // per-constraint min count over the profile's eligible domain
+    for (int32_t sci = 0; sci < sc; ++sci) {
+      const int32_t* dom =
+          static_ints + (so_sc_domain + (int64_t)profile * sc + sci) * n;
+      const int32_t* counts = state + (do_sc_counts + sci) * n;
+      int32_t m = kBig;
+      bool any = false;
+      for (int64_t i = 0; i < n; ++i) {
+        if (dom[i] && counts[i] < m) { m = counts[i]; any = true; }
+      }
+      min_c[sci] = any ? m : 0;
+    }
+
+    // affinity batch-level predicates (match _step's first-pod rule)
+    bool has_aff = false, no_any = true, self_all = true;
+    for (int32_t ti = 0; ti < t; ++ti) {
+      if (row[c_own_aff + ti]) {
+        has_aff = true;
+        if (totals[ti] != 0) no_any = false;
+        if (!row[c_match_by + ti]) self_all = false;
+      }
+    }
+
+    // ---- per-node feasibility + score ------------------------------
+    for (int64_t i = 0; i < n; ++i) {
+      bool ok = pod_valid && node_valid[i] && masks[i] &&
+                state[do_pod_count * n + i] < max_pods[i];
+      for (int32_t ri = 0; ok && ri < r; ++ri) {
+        ok = state[(do_requested + ri) * n + i] + row[c_req + ri] <=
+             static_ints[(so_alloc + ri) * n + i];
+      }
+      if (ok) {
+        for (int32_t sci = 0; sci < sc; ++sci) {
+          if (!row[c_pod_sc + sci] || !sc_meta[sc + sci]) continue;  // hard?
+          const int32_t code =
+              static_ints[(so_sc_codes + sci) * n + i];
+          const int32_t cnt = state[(do_sc_counts + sci) * n + i];
+          const int32_t skew = cnt + row[c_sc_match + sci] - min_c[sci];
+          if (code >= v || skew > sc_meta[sci]) { ok = false; break; }
+        }
+      }
+      bool aff_sat = true;
+      if (ok) {
+        for (int32_t ti = 0; ti < t; ++ti) {
+          const int32_t tcnt = state[(do_term_counts + ti) * n + i];
+          const int32_t town = state[(do_term_owners + ti) * n + i];
+          if (row[c_match_by + ti] && town > 0) { ok = false; break; }
+          if (row[c_own_anti + ti] && tcnt > 0) { ok = false; break; }
+          if (row[c_own_aff + ti]) {
+            const int32_t code =
+                static_ints[(so_term_codes + ti) * n + i];
+            if (!(tcnt > 0 && code < v)) aff_sat = false;
+          }
+        }
+      }
+      if (ok && has_aff && !aff_sat && !(no_any && self_all)) ok = false;
+      feas[i] = ok;
+      if (!ok) { score[i] = kNegInf; continue; }
+
+      // scores — same op order as _step for bit-identical f32 results
+      const float alloc_cpu =
+          (float)(static_ints[so_alloc * n + i] < 1
+                      ? 1 : static_ints[so_alloc * n + i]);
+      const float alloc_mem =
+          (float)(static_ints[(so_alloc + 1) * n + i] < 1
+                      ? 1 : static_ints[(so_alloc + 1) * n + i]);
+      const float cpu_frac =
+          (float)(state[do_nonzero * n + i] + row[c_nonzero]) / alloc_cpu;
+      const float mem_frac =
+          (float)(state[(do_nonzero + 1) * n + i] + row[c_nonzero + 1]) /
+          alloc_mem;
+      const bool over = cpu_frac >= 1.0f || mem_frac >= 1.0f;
+      const float balanced =
+          over ? 0.0f : (1.0f - std::fabs(cpu_frac - mem_frac)) * 100.0f;
+      const float least =
+          (clip01(1.0f - cpu_frac) + clip01(1.0f - mem_frac)) * 50.0f;
+      float soft_counts = 0.0f;
+      bool any_soft = false;
+      for (int32_t sci = 0; sci < sc; ++sci) {
+        if (row[c_pod_sc + sci] && !sc_meta[sc + sci]) {
+          soft_counts += (float)state[(do_sc_counts + sci) * n + i];
+          any_soft = true;
+        }
+      }
+      const float spread =
+          any_soft ? 100.0f / (1.0f + soft_counts) : 0.0f;
+      float pref = 0.0f;
+      for (int32_t ti = 0; ti < t; ++ti) {
+        pref += pref_w[ti] * (float)state[(do_term_counts + ti) * n + i];
+      }
+      score[i] = weights[0] * balanced + weights[1] * least +
+                 weights[2] * spread + weights[3] * pref +
+                 weights[4] * static_score[i];
+    }
+
+    // argmax, first max wins (== jnp.argmax tie rule)
+    float mx = kNegInf;
+    int64_t chosen = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (feas[i] && score[i] > mx) { mx = score[i]; chosen = i; }
+    }
+    const bool found = chosen >= 0;
+    assignments[bi] = found ? (int32_t)chosen : -1;
+    if (!found || !pod_valid) continue;
+
+    // ---- commit ----------------------------------------------------
+    for (int32_t ri = 0; ri < r; ++ri) {
+      state[(do_requested + ri) * n + chosen] += row[c_req + ri];
+    }
+    state[do_nonzero * n + chosen] += row[c_nonzero];
+    state[(do_nonzero + 1) * n + chosen] += row[c_nonzero + 1];
+    state[do_pod_count * n + chosen] += 1;
+    for (int32_t sci = 0; sci < sc; ++sci) {
+      if (!row[c_sc_match + sci]) continue;
+      const int32_t* codes = static_ints + (so_sc_codes + sci) * n;
+      const int32_t code_j = codes[chosen];
+      int32_t* counts = state + (do_sc_counts + sci) * n;
+      for (int64_t i = 0; i < n; ++i) {
+        if (codes[i] == code_j) counts[i] += 1;
+      }
+    }
+    for (int32_t ti = 0; ti < t; ++ti) {
+      const bool matched = row[c_match_by + ti];
+      const bool own_anti = row[c_own_anti + ti];
+      if (!matched && !own_anti) continue;
+      const int32_t* codes = static_ints + (so_term_codes + ti) * n;
+      const int32_t code_j = codes[chosen];
+      int32_t* counts = state + (do_term_counts + ti) * n;
+      int32_t* owners = state + (do_term_owners + ti) * n;
+      for (int64_t i = 0; i < n; ++i) {
+        if (codes[i] == code_j) {
+          if (matched) counts[i] += 1;
+          if (own_anti) owners[i] += 1;
+        }
+      }
+      if (matched && code_j < v) totals[ti] += 1;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

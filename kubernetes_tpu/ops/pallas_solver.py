@@ -102,9 +102,11 @@ def _to_planes(arr: np.ndarray, nb: int) -> np.ndarray:
     return np.ascontiguousarray(arr.reshape(k, nb, LANES))
 
 
-def prepare(cluster: EncodedCluster, batch: EncodedBatch
-            ) -> Tuple[PStatic, PState]:
-    """Host-side packing of the encoder output into kernel layout."""
+def prepare(cluster: EncodedCluster, batch: EncodedBatch,
+            device: bool = True) -> Tuple[PStatic, PState]:
+    """Host-side packing of the encoder output into kernel layout.
+    ``device=False`` keeps the planes as host numpy arrays (the native
+    C++ backend mutates them in place through ctypes)."""
     n = cluster.allocatable.shape[0]
     if n % LANES != 0:
         raise ValueError(f"padded node count {n} not a multiple of {LANES}")
@@ -173,15 +175,14 @@ def prepare(cluster: EncodedCluster, batch: EncodedBatch
     totals[:tn] = batch.term_counts[:, :v].sum(axis=1)
     planes[do["totals"]] = totals
 
+    put = jax.device_put if device else (lambda a: a)
     pstatic = PStatic(
-        ints=jax.device_put(_to_planes(ints, nb)),
-        f32s=jax.device_put(
-            _to_planes(batch.static_scores.astype(np.float32), nb)
-        ),
-        sc_meta=jax.device_put(sc_meta),
+        ints=put(_to_planes(ints, nb)),
+        f32s=put(_to_planes(batch.static_scores.astype(np.float32), nb)),
+        sc_meta=put(sc_meta),
         r=r, sc=scn, t=tn, u=u, v=v, nb=nb,
     )
-    pstate = PState(planes=jax.device_put(_to_planes(planes, nb)))
+    pstate = PState(planes=put(_to_planes(planes, nb)))
     return pstatic, pstate
 
 

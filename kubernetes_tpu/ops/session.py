@@ -75,6 +75,10 @@ def default_backend():
         from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
         return XlaPlanesBackend()
+    if choice == "cpp":
+        from kubernetes_tpu.ops.native_backend import CppBackend
+
+        return CppBackend()
     if choice == "pallas":
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
@@ -83,7 +87,12 @@ def default_backend():
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
         return PallasBackend()
-    # gpu/metal/cpu: Mosaic does not lower there — use the planes scan
+    # gpu/metal/cpu: Mosaic does not lower there. Prefer the native C++
+    # planes solver when the library builds, else the XLA planes scan.
+    from kubernetes_tpu.ops import native_backend
+
+    if native_backend.available():
+        return native_backend.CppBackend()
     from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
     return XlaPlanesBackend()
@@ -207,10 +216,13 @@ class SolverSession:
             chain = [self.backend]
         else:
             chain = []
-            if self.backend.name == "pallas" and _pallas_fits(batch):
-                chain.append(self.backend)
-            chain.append(self.backend if self.backend.name == "xla-planes"
-                         else XlaPlanesBackend())
+            if self.backend.name == "pallas":
+                if _pallas_fits(batch):
+                    chain.append(self.backend)
+            else:
+                chain.append(self.backend)       # cpp or planes scan
+            if self.backend.name != "xla-planes":
+                chain.append(XlaPlanesBackend())
             chain.append(XlaBackend())
         t0 = time.monotonic()
         for i, backend in enumerate(chain):
