@@ -84,35 +84,41 @@ class Histogram(_Metric):
     def __init__(self, name, help_text, label_names=(), buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_text, label_names)
         self.buckets = tuple(sorted(buckets))
-        self._counts: Dict[LabelValues, List[int]] = {}
-        self._sums: Dict[LabelValues, float] = {}
-        self._totals: Dict[LabelValues, int] = {}
+        # one dict lookup per observe: series = [counts list, sum, total]
+        # (observe runs ~10x per scheduled pod on the commit hot path)
+        self._series: Dict[LabelValues, list] = {}
+
+    def _get_series(self, key: LabelValues) -> list:
+        series = self._series.get(key)
+        if series is None:
+            series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            self._series[key] = series
+        return series
 
     def observe(self, value: float, *label_values: str) -> None:
         with self._lock:
-            key = tuple(label_values)
-            counts = self._counts.get(key)
-            if counts is None:
-                counts = [0] * (len(self.buckets) + 1)
-                self._counts[key] = counts
-            counts[bisect.bisect_left(self.buckets, value)] += 1
-            self._sums[key] = self._sums.get(key, 0.0) + value
-            self._totals[key] = self._totals.get(key, 0) + 1
+            series = self._get_series(label_values)
+            series[0][bisect.bisect_left(self.buckets, value)] += 1
+            series[1] += value
+            series[2] += 1
 
     def count(self, *label_values: str) -> int:
         with self._lock:
-            return self._totals.get(tuple(label_values), 0)
+            series = self._series.get(tuple(label_values))
+            return series[2] if series else 0
 
     def sum(self, *label_values: str) -> float:
         with self._lock:
-            return self._sums.get(tuple(label_values), 0.0)
+            series = self._series.get(tuple(label_values))
+            return series[1] if series else 0.0
 
     def quantile(self, q: float, *label_values: str) -> float:
         """Bucket-interpolated quantile (what the perf harness scrapes)."""
         with self._lock:
             key = tuple(label_values)
-            counts = self._counts.get(key)
-            total = self._totals.get(key, 0)
+            series = self._series.get(key)
+            counts = series[0] if series else None
+            total = series[2] if series else 0
         if not counts or total == 0:
             return 0.0
         target = q * total
@@ -126,8 +132,8 @@ class Histogram(_Metric):
     def collect(self):
         with self._lock:
             return [
-                (self.name, k, self._sums.get(k, 0.0), self._totals.get(k, 0))
-                for k in self._counts
+                (self.name, k, series[1], series[2])
+                for k, series in self._series.items()
             ]
 
 

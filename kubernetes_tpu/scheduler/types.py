@@ -112,15 +112,26 @@ def compute_pod_resource_request(pod: Pod, non_zero: bool = False) -> Resource:
     """max(sum(app containers), max(init containers)) + overhead
     (reference fit.go:148-165 computePodResourceRequest; non_zero variant
     applies the 100m/200Mi defaults from schedutil GetNonzeroRequests)."""
-    result = Resource()
-    for c in pod.spec.containers:
-        result.add(_container_request(c, non_zero))
-    init_max = Resource()
-    for c in pod.spec.init_containers:
-        init_max.set_max(_container_request(c, non_zero))
-    result.set_max(init_max)
-    if pod.spec.overhead:
-        result.add(Resource.from_resource_list(pod.spec.overhead))
+    spec = pod.spec
+    if len(spec.containers) == 1 and not spec.init_containers \
+            and not spec.overhead:
+        # single plain container — the overwhelmingly common shape; skip
+        # the aggregate scaffolding (this runs twice per pod on the
+        # queue-admission hot path)
+        result = _container_request(spec.containers[0], non_zero)
+    else:
+        result = Resource()
+        for c in spec.containers:
+            result.add(_container_request(c, non_zero))
+        init_max = Resource()
+        for c in spec.init_containers:
+            init_max.set_max(_container_request(c, non_zero))
+        result.set_max(init_max)
+        if spec.overhead:
+            result.add(Resource.from_resource_list(spec.overhead))
+    # a pod request never carries allowed_pod_number (single enforcement
+    # site for both paths)
+    result.allowed_pod_number = 0
     return result
 
 
