@@ -1,21 +1,25 @@
 """Multi-chip sharded solver: the node axis distributed over a device mesh.
 
 When 5k nodes x 30k pods exceeds one chip (or one chip's HBM bandwidth
-budget), the node axis of every per-node tensor shards across devices over
+budget), the node axis of every per-node plane shards across devices over
 ICI (the moral analog of tensor parallelism; SURVEY.md section 5
-"long-context" mapping), while the small topology-count state stays
-replicated with ``psum``'d deltas:
+"long-context" mapping). Uses the same gather-free per-node planes
+representation as the single-chip backends (``ops.pallas_solver``):
 
-- per-device: feasibility + scores for the local node shard (vector ops);
-- global argmax via ``pmax`` on (score, -global_index) pairs;
-- the winning device broadcasts the chosen node's topology codes via
-  ``psum`` (one-hot masked), so every replica applies identical count
-  updates — replicated state never diverges.
+- per device: feasibility + scores for the local node shard (dense
+  vector ops, no gathers);
+- global argmax via ``pmax`` on scores then ``pmin`` on candidate
+  global indices (lowest index wins ties, matching ``jnp.argmax``);
+- per-constraint domain minima via local min + ``pmin``;
+- the winning node's topology codes broadcast via ``psum`` of the
+  one-hot-masked code planes, so every shard applies its local slice of
+  the domain-count update and the small replicated state (per-term
+  totals) never diverges.
 
 A separate 2D phase (``batch`` x ``nodes``) computes the batched static
-feasibility/score tensors — the data-parallel analog — before the
-sequential commit; both run under one ``shard_map`` jit so XLA schedules
-ICI collectives, not host transfers.
+feasibility counts — the data-parallel analog — before the sequential
+commit; both run under one ``shard_map`` jit so XLA schedules ICI
+collectives, not host transfers.
 """
 
 from __future__ import annotations
@@ -26,17 +30,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from kubernetes_tpu.ops.encode import EncodedBatch, EncodedCluster
-from kubernetes_tpu.ops.solver import (
-    NEG_INF,
-    BIG,
-    SolverParams,
-    _PodIn,
-    _State,
-    _Static,
+from kubernetes_tpu.ops.pallas_solver import (
+    LANES,
+    _state_planes,
+    _static_planes,
+    prepare,
 )
+from kubernetes_tpu.ops.solver import BIG, NEG_INF, SolverParams, pack_podin
 
 
 def make_mesh(n_devices: Optional[int] = None, batch_axis: int = 1) -> Mesh:
@@ -48,256 +51,233 @@ def make_mesh(n_devices: Optional[int] = None, batch_axis: int = 1) -> Mesh:
     return Mesh(devices, axis_names=("batch", "nodes"))
 
 
-def _sharded_step(params: SolverParams, static: _Static,
-                  state: _State, pod: _PodIn):
-    """One scan step on a node shard. Mirrors ops.solver._step, with the
-    argmax and count updates turned into collectives."""
-    axis = "nodes"
-    n_local = static.allocatable.shape[0]
-    shard_index = jax.lax.axis_index(axis)
-    v = state.sc_counts.shape[1] - 1
-
-    fit = jnp.all(
-        state.requested + pod.request[None, :] <= static.allocatable, axis=1
-    )
-    fit &= state.pod_count < static.max_pods
-    static_ok = static.static_masks[pod.profile]
-
-    counts_at = jnp.take_along_axis(state.sc_counts, static.sc_codes, axis=1)
-    domain = static.sc_domain[pod.profile]
-    min_c = jnp.min(jnp.where(domain[:, :v], state.sc_counts[:, :v], BIG), axis=1)
-    min_c = jnp.where(jnp.any(domain[:, :v], axis=1), min_c, 0)
-    skew = counts_at + pod.pod_sc_match[:, None].astype(jnp.int32) - min_c[:, None]
-    missing = static.sc_codes >= v
-    active_hard = pod.pod_sc & static.sc_hard
-    spread_violation = jnp.any(
-        active_hard[:, None] & ((skew > static.sc_max_skew[:, None]) | missing),
-        axis=0,
-    )
-
-    tcounts_at = jnp.take_along_axis(state.term_counts, static.term_codes, axis=1)
-    towners_at = jnp.take_along_axis(state.term_owners, static.term_codes, axis=1)
-    t_missing = static.term_codes >= v
-    existing_anti_block = jnp.any(pod.match_by[:, None] & (towners_at > 0), axis=0)
-    own_anti_block = jnp.any(pod.own_anti[:, None] & (tcounts_at > 0), axis=0)
-    aff_here = (tcounts_at > 0) & ~t_missing
-    aff_sat = jnp.all(~pod.own_aff[:, None] | aff_here, axis=0)
-    totals = jnp.sum(state.term_counts[:, :v], axis=1)
-    no_any = jnp.all(~pod.own_aff | (totals == 0))
-    self_all = jnp.all(~pod.own_aff | pod.match_by)
-    has_aff = jnp.any(pod.own_aff)
-    aff_ok = jnp.where(has_aff, aff_sat | (no_any & self_all), True)
-
-    feasible = (
-        static.node_valid & static_ok & fit & ~spread_violation
-        & ~existing_anti_block & ~own_anti_block & aff_ok & pod.valid
-    )
-
-    alloc_cpu = jnp.maximum(static.allocatable[:, 0], 1).astype(jnp.float32)
-    alloc_mem = jnp.maximum(static.allocatable[:, 1], 1).astype(jnp.float32)
-    cpu_frac = (state.nonzero_requested[:, 0] + pod.nonzero_request[0]).astype(
-        jnp.float32
-    ) / alloc_cpu
-    mem_frac = (state.nonzero_requested[:, 1] + pod.nonzero_request[1]).astype(
-        jnp.float32
-    ) / alloc_mem
-    over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
-    balanced = jnp.where(over, 0.0, (1.0 - jnp.abs(cpu_frac - mem_frac)) * 100.0)
-    least = (
-        jnp.clip(1.0 - cpu_frac, 0.0, 1.0) + jnp.clip(1.0 - mem_frac, 0.0, 1.0)
-    ) * 50.0
-    active_soft = pod.pod_sc & ~static.sc_hard
-    soft_counts = jnp.sum(
-        jnp.where(active_soft[:, None], counts_at, 0), axis=0
-    ).astype(jnp.float32)
-    spread_score = jnp.where(
-        jnp.any(active_soft), 100.0 / (1.0 + soft_counts), 0.0
-    )
-    pref_score = jnp.sum(
-        pod.pref_weight[:, None] * tcounts_at.astype(jnp.float32), axis=0
-    )
-    score = (
-        params.balanced_weight * balanced
-        + params.least_weight * least
-        + params.spread_weight * spread_score
-        + params.affinity_weight * pref_score
-        + params.static_weight * static.static_scores[pod.profile]
-    )
-    score = jnp.where(feasible, score, NEG_INF)
-
-    # ---- global argmax over the sharded node axis --------------------
-    local_best = jnp.argmax(score)
-    local_score = score[local_best]
-    global_index = shard_index * n_local + local_best
-    # lexicographic (score, -index): highest score, lowest index wins
-    pair = (local_score, -global_index.astype(jnp.int32))
-    best_score = jax.lax.pmax(pair[0], axis)
-    # among shards holding best_score, pick the lowest global index
-    candidate_idx = jnp.where(local_score >= best_score, -pair[1], np.int32(2**30))
-    best_global = -jax.lax.pmax(-candidate_idx, axis)
-    found = best_score > NEG_INF / 2
-    chosen_global = jnp.where(found, best_global, -1)
-    valid = found & pod.valid
-
-    # local one-hot commit
-    local_chosen = chosen_global - shard_index * n_local
-    onehot = (jnp.arange(n_local) == local_chosen) & valid
-    inc = onehot.astype(jnp.int32)
-
-    # chosen node's topo codes, broadcast to every replica via psum
-    sc_chosen_code = jax.lax.psum(
-        jnp.sum(jnp.where(onehot[None, :], static.sc_codes, 0), axis=1), axis
-    )
-    term_chosen_code = jax.lax.psum(
-        jnp.sum(jnp.where(onehot[None, :], static.term_codes, 0), axis=1), axis
-    )
-    sc_chosen_code = jnp.where(valid, sc_chosen_code, v)
-    term_chosen_code = jnp.where(valid, term_chosen_code, v)
-
-    new_state = _State(
-        requested=state.requested + inc[:, None] * pod.request[None, :],
-        nonzero_requested=state.nonzero_requested
-        + inc[:, None] * pod.nonzero_request[None, :],
-        pod_count=state.pod_count + inc,
-        sc_counts=state.sc_counts.at[
-            jnp.arange(state.sc_counts.shape[0]), sc_chosen_code
-        ].add((pod.pod_sc_match & valid).astype(jnp.int32)),
-        term_counts=state.term_counts.at[
-            jnp.arange(state.term_counts.shape[0]), term_chosen_code
-        ].add((pod.match_by & valid).astype(jnp.int32)),
-        term_owners=state.term_owners.at[
-            jnp.arange(state.term_owners.shape[0]), term_chosen_code
-        ].add((pod.own_anti & valid).astype(jnp.int32)),
-    )
-    return new_state, chosen_global
-
-
-def _batched_static_feasibility(static: _Static, pods: _PodIn):
-    """2D-parallel precompute: the [B_local, N_local] static-mask x fit
-    tensor for this device's (batch, nodes) tile — the data-parallel
-    analog phase that exercises both mesh axes before the sequential
-    commit. Returned summed over nodes as a per-pod feasible-node count
-    (useful as an unschedulability early-signal)."""
-    fit = jnp.all(
-        pods.request[:, None, :] <= static.allocatable[None, :, :], axis=2
-    )
-    mask = static.static_masks[pods.profile]  # [B_local, N_local]
-    both = fit & mask & static.node_valid[None, :]
-    local = jnp.sum(both.astype(jnp.int32), axis=1)
-    return jax.lax.psum(local, "nodes")
-
-
 def solve_scan_sharded(
     cluster: EncodedCluster,
     batch: EncodedBatch,
     mesh: Mesh,
     params: SolverParams = SolverParams(),
 ):
-    """Sharded solve over `mesh` (axes ("batch","nodes")). Node-sharded
-    arrays are laid out with NamedSharding so jit moves them once; the
-    scan runs under shard_map with ICI collectives."""
+    """Sharded solve over `mesh` (axes ("batch","nodes")). Returns
+    (assignments [B] int32 global node indices, feasible_counts [B]).
+    Matches the single-chip solvers exactly (differential tests)."""
     from jax import shard_map
 
-    n_nodes_shards = mesh.shape["nodes"]
-    n = cluster.allocatable.shape[0]
-    if n % n_nodes_shards != 0:
-        raise ValueError(f"padded node count {n} not divisible by mesh nodes "
-                         f"axis {n_nodes_shards}")
-    v = batch.num_values
+    pstatic, pstate = prepare(cluster, batch, device=False)
+    r, sc, t, u, v = pstatic.r, pstatic.sc, pstatic.t, pstatic.u, pstatic.v
+    n = pstatic.nb * LANES
+    shards = mesh.shape["nodes"]
+    if n % shards != 0:
+        raise ValueError(
+            f"padded node count {n} not divisible by mesh nodes axis "
+            f"{shards}"
+        )
+    so, cs = _static_planes(r, sc, t, u)
+    do, cd = _state_planes(r, sc, t)
+    static2 = np.asarray(pstatic.ints).reshape(cs, n)
+    f32s2 = np.asarray(pstatic.f32s).reshape(u, n)
+    planes2 = np.asarray(pstate.planes).reshape(cd, n)
+    totals0 = planes2[do["totals"]][:t].copy()  # encoder pads t >= 1
+    pod_ints, pod_floats = pack_podin(batch)
+    # static per-(profile, constraint) domain existence: hoisted out of
+    # the scan so each step needs no pmax collective for it
+    has_dom = batch.sc_domain[:, :, :v].any(axis=2)     # [U, SC]
 
-    sc_codes = np.minimum(cluster.topo_codes[:, batch.sc_key_idx].T, v).astype(np.int32)
-    term_codes = np.minimum(cluster.topo_codes[:, batch.term_key_idx].T, v).astype(np.int32)
-    node_valid = np.zeros(n, dtype=bool)
-    node_valid[: cluster.num_real_nodes] = True
+    # pod-stream column offsets (pack_podin layout)
+    c_req, c_nonzero, c_profile, c_valid = 0, r, r + 2, r + 3
+    c_pod_sc, c_sc_match = r + 4, r + 4 + sc
+    c_match_by = r + 4 + 2 * sc
+    c_own_aff = r + 4 + 2 * sc + t
+    c_own_anti = r + 4 + 2 * sc + 2 * t
 
-    static = _Static(
-        allocatable=jnp.asarray(cluster.allocatable),
-        max_pods=jnp.asarray(cluster.max_pods),
-        static_masks=jnp.asarray(batch.static_masks),
-        static_scores=jnp.asarray(batch.static_scores),
-        sc_codes=jnp.asarray(sc_codes),
-        sc_max_skew=jnp.asarray(batch.sc_max_skew),
-        sc_hard=jnp.asarray(batch.sc_hard),
-        sc_domain=jnp.asarray(batch.sc_domain),
-        term_codes=jnp.asarray(term_codes),
-        node_valid=jnp.asarray(node_valid),
-    )
-    state = _State(
-        requested=jnp.asarray(cluster.requested),
-        nonzero_requested=jnp.asarray(cluster.nonzero_requested),
-        pod_count=jnp.asarray(cluster.pod_count),
-        sc_counts=jnp.asarray(batch.sc_counts),
-        term_counts=jnp.asarray(batch.term_counts),
-        term_owners=jnp.asarray(batch.term_owners),
-    )
-    b = batch.requests.shape[0]
-    valid = np.zeros(b, dtype=bool)
-    valid[: batch.num_real_pods] = True
-    valid &= ~batch.inexpressible
-    pods = _PodIn(
-        request=jnp.asarray(batch.requests),
-        nonzero_request=jnp.asarray(batch.nonzero_requests),
-        profile=jnp.asarray(batch.profile_idx),
-        valid=jnp.asarray(valid),
-        pod_sc=jnp.asarray(batch.pod_sc),
-        pod_sc_match=jnp.asarray(batch.pod_sc_match),
-        match_by=jnp.asarray(batch.match_by),
-        own_aff=jnp.asarray(batch.own_aff),
-        own_anti=jnp.asarray(batch.own_anti),
-        pref_weight=jnp.asarray(batch.pref_weight),
-    )
+    def _step(sc_meta, static_l, f32_l, has_dom_r, carry, pod):
+        state, totals = carry
+        row, pref_w = pod
+        n_local = static_l.shape[1]
+        shard_ix = jax.lax.axis_index("nodes")
+        gidx = shard_ix * n_local + jnp.arange(n_local, dtype=jnp.int32)
 
-    # shardings: node axis sharded; counts/pod streams replicated
+        node_valid = static_l[so["node_valid"]] > 0
+        alloc = static_l[so["alloc"]:so["alloc"] + r]
+        sc_codes = static_l[so["sc_codes"]:so["sc_codes"] + sc]
+        term_codes = static_l[so["term_codes"]:so["term_codes"] + t]
+        sc_missing = sc_codes >= v
+        t_missing = term_codes >= v
+        max_skew = sc_meta[0]
+        hard = sc_meta[1] > 0
+
+        pod_valid = row[c_valid] > 0
+        profile = row[c_profile]
+        req = row[c_req:c_req + r]
+        pod_sc = row[c_pod_sc:c_pod_sc + sc] > 0
+        sc_match = row[c_sc_match:c_sc_match + sc] > 0
+        match_by = row[c_match_by:c_match_by + t] > 0
+        own_aff = row[c_own_aff:c_own_aff + t] > 0
+        own_anti = row[c_own_anti:c_own_anti + t] > 0
+
+        requested = state[do["requested"]:do["requested"] + r]
+        fit = jnp.all(requested + req[:, None] <= alloc, axis=0)
+        fit &= state[do["pod_count"]] < static_l[so["max_pods"]]
+        static_ok = static_l[so["masks"] + profile] > 0
+
+        counts = state[do["sc_counts"]:do["sc_counts"] + sc]
+        dom = jax.lax.dynamic_slice_in_dim(
+            static_l, so["sc_domain"] + profile * sc, sc, axis=0
+        ) > 0
+        lmin = jnp.min(jnp.where(dom, counts, BIG), axis=1)
+        gmin = jax.lax.pmin(lmin, "nodes")
+        min_c = jnp.where(has_dom_r[profile], gmin, 0)
+        skew = counts + sc_match[:, None].astype(jnp.int32) - min_c[:, None]
+        active_hard = pod_sc & hard
+        spread_violation = jnp.any(
+            active_hard[:, None]
+            & ((skew > max_skew[:, None]) | sc_missing),
+            axis=0,
+        )
+
+        tcounts = state[do["term_counts"]:do["term_counts"] + t]
+        towners = state[do["term_owners"]:do["term_owners"] + t]
+        existing_anti = jnp.any(match_by[:, None] & (towners > 0), axis=0)
+        own_anti_block = jnp.any(own_anti[:, None] & (tcounts > 0), axis=0)
+        aff_here = (tcounts > 0) & ~t_missing
+        aff_sat = jnp.all(~own_aff[:, None] | aff_here, axis=0)
+        no_any = jnp.all(~own_aff | (totals == 0))
+        self_all = jnp.all(~own_aff | match_by)
+        has_aff = jnp.any(own_aff)
+        aff_ok = ~has_aff | aff_sat | (no_any & self_all)
+
+        feasible = (
+            node_valid & static_ok & fit & ~spread_violation
+            & ~existing_anti & ~own_anti_block & aff_ok & pod_valid
+        )
+
+        alloc_cpu = jnp.maximum(alloc[0], 1).astype(jnp.float32)
+        alloc_mem = jnp.maximum(alloc[1], 1).astype(jnp.float32)
+        nz = state[do["nonzero"]:do["nonzero"] + 2]
+        cpu_frac = (nz[0] + row[c_nonzero]).astype(jnp.float32) / alloc_cpu
+        mem_frac = (nz[1] + row[c_nonzero + 1]).astype(
+            jnp.float32
+        ) / alloc_mem
+        over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
+        balanced = jnp.where(
+            over, 0.0, (1.0 - jnp.abs(cpu_frac - mem_frac)) * 100.0
+        )
+        least = (
+            jnp.clip(1.0 - cpu_frac, 0.0, 1.0)
+            + jnp.clip(1.0 - mem_frac, 0.0, 1.0)
+        ) * 50.0
+        active_soft = pod_sc & ~hard
+        soft_counts = jnp.sum(
+            jnp.where(active_soft[:, None], counts, 0), axis=0
+        ).astype(jnp.float32)
+        spread_score = jnp.where(
+            jnp.any(active_soft), 100.0 / (1.0 + soft_counts), 0.0
+        )
+        pref_score = jnp.sum(
+            pref_w[:, None] * tcounts.astype(jnp.float32), axis=0
+        )
+        score = (
+            params.balanced_weight * balanced
+            + params.least_weight * least
+            + params.spread_weight * spread_score
+            + params.affinity_weight * pref_score
+            + params.static_weight * f32_l[profile]
+        )
+        score = jnp.where(feasible, score, NEG_INF)
+
+        # global argmax over the sharded node axis (lowest index on ties)
+        gmx = jax.lax.pmax(jnp.max(score), "nodes")
+        found = gmx > NEG_INF / 2
+        cand = jnp.where(feasible & (score >= gmx), gidx, BIG)
+        chosen = jax.lax.pmin(jnp.min(cand), "nodes")
+        valid = found & pod_valid
+        assignment = jnp.where(found, chosen, -1)
+
+        onehot = (gidx == chosen) & valid
+        inc = onehot.astype(jnp.int32)
+        valid_i = valid.astype(jnp.int32)
+        # winning node's codes, broadcast to every shard
+        sc_code_j = jax.lax.psum(
+            jnp.sum(jnp.where(onehot[None], sc_codes, 0), axis=1), "nodes"
+        )
+        t_code_j = jax.lax.psum(
+            jnp.sum(jnp.where(onehot[None], term_codes, 0), axis=1),
+            "nodes",
+        )
+        sc_inc = (sc_codes == sc_code_j[:, None]).astype(jnp.int32) \
+            * (sc_match.astype(jnp.int32) * valid_i)[:, None]
+        t_same = (term_codes == t_code_j[:, None]).astype(jnp.int32)
+        t_inc = t_same * (match_by.astype(jnp.int32) * valid_i)[:, None]
+        o_inc = t_same * (own_anti.astype(jnp.int32) * valid_i)[:, None]
+
+        new_state = jnp.concatenate([
+            requested + inc[None] * req[:, None],
+            nz + inc[None] * row[c_nonzero:c_nonzero + 2][:, None],
+            (state[do["pod_count"]] + inc)[None],
+            counts + sc_inc,
+            tcounts + t_inc,
+            towners + o_inc,
+            state[do["totals"]][None],
+        ])
+        new_totals = totals + (
+            match_by.astype(jnp.int32) * valid_i * (t_code_j < v)
+        )
+        return (new_state, new_totals), assignment
+
+    def _batched_static_feasibility(static_l, pods_ints_l):
+        """2D-parallel precompute: static-mask x fit counts for this
+        device's (batch, nodes) tile — the data-parallel analog phase.
+        Returns per-pod statically-feasible-node counts (psum over the
+        node axis), an unschedulability early-signal."""
+        alloc = static_l[so["alloc"]:so["alloc"] + r]       # [R, n_local]
+        node_ok = static_l[so["node_valid"]] > 0
+        reqs = pods_ints_l[:, c_req:c_req + r]              # [B_local, R]
+        fit = jnp.all(
+            reqs[:, :, None] <= alloc[None, :, :], axis=1
+        )                                                   # [B_local, n_local]
+        profiles = pods_ints_l[:, c_profile]
+        masks = (
+            static_l[so["masks"]:so["masks"] + u] > 0
+        )[profiles]                                         # [B_local, n_local]
+        both = fit & masks & node_ok[None, :]
+        return jax.lax.psum(
+            jnp.sum(both.astype(jnp.int32), axis=1), "nodes"
+        )
+
     node_sharded = P(None, "nodes")
-    static_specs = _Static(
-        allocatable=P("nodes", None),
-        max_pods=P("nodes"),
-        static_masks=node_sharded,
-        static_scores=node_sharded,
-        sc_codes=node_sharded,
-        sc_max_skew=P(),
-        sc_hard=P(),
-        sc_domain=P(),
-        term_codes=node_sharded,
-        node_valid=P("nodes"),
-    )
-    state_specs = _State(
-        requested=P("nodes", None),
-        nonzero_requested=P("nodes", None),
-        pod_count=P("nodes"),
-        sc_counts=P(),
-        term_counts=P(),
-        term_owners=P(),
-    )
-    pods_scan_specs = jax.tree.map(lambda _: P(), pods)
-    pods_batch_specs = _PodIn(
-        request=P("batch", None),
-        nonzero_request=P("batch", None),
-        profile=P("batch"),
-        valid=P("batch"),
-        pod_sc=P("batch", None),
-        pod_sc_match=P("batch", None),
-        match_by=P("batch", None),
-        own_aff=P("batch", None),
-        own_anti=P("batch", None),
-        pref_weight=P("batch", None),
-    )
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(static_specs, state_specs, pods_scan_specs, pods_batch_specs),
+        in_specs=(
+            P(),                 # sc_meta (replicated)
+            node_sharded,        # static planes
+            node_sharded,        # static f32 planes
+            node_sharded,        # state planes
+            P(),                 # totals (replicated)
+            P(),                 # pod ints (scan stream, replicated)
+            P(),                 # pod floats
+            P("batch", None),    # pod ints (batch-parallel phase)
+            P(),                 # has_dom [U, SC] (replicated)
+        ),
         out_specs=(P(), P("batch")),
         check_vma=False,
     )
-    def run(static_l, state_l, pods_scan, pods_batch):
-        feasible_counts = _batched_static_feasibility(static_l, pods_batch)
-        _, assignments = jax.lax.scan(
-            partial(_sharded_step, params, static_l), state_l, pods_scan
+    def run(sc_meta, static_l, f32_l, planes_l, totals_r, ints_r,
+            floats_r, pods_batch_i, has_dom_r):
+        feasible_counts = _batched_static_feasibility(static_l, pods_batch_i)
+        (_, _), assignments = jax.lax.scan(
+            partial(_step, sc_meta, static_l, f32_l, has_dom_r),
+            (planes_l, totals_r),
+            (ints_r, floats_r),
         )
         return assignments, feasible_counts
 
     with mesh:
-        jitted = jax.jit(run)
-        assignments, feasible_counts = jitted(static, state, pods, pods)
+        assignments, feasible_counts = jax.jit(run)(
+            jnp.asarray(pstatic.sc_meta), jnp.asarray(static2),
+            jnp.asarray(f32s2), jnp.asarray(planes2),
+            jnp.asarray(totals0), jnp.asarray(pod_ints),
+            jnp.asarray(pod_floats), jnp.asarray(pod_ints),
+            jnp.asarray(has_dom),
+        )
     return np.asarray(assignments), np.asarray(feasible_counts)
