@@ -54,7 +54,12 @@ class Quantity:
     __slots__ = ("nano",)
 
     def __init__(self, nano: int = 0):
-        self.nano = int(nano)
+        object.__setattr__(self, "nano", int(nano))
+
+    def __setattr__(self, name, value):
+        # instances are shared via the parse cache; in-place mutation
+        # would silently change every holder of the same request string
+        raise AttributeError("Quantity is immutable")
 
     # --- constructors -------------------------------------------------
     @classmethod
@@ -105,12 +110,31 @@ class Quantity:
         return f"{self.nano}n"
 
 
+_PARSE_CACHE: dict = {}
+_PARSE_CACHE_MAX = 4096
+
+
 def parse_quantity(s) -> Quantity:
     """Parse a quantity string (or int/float unit count) into a Quantity.
 
     Accepts the reference grammar's common forms: "100m", "2", "1.5",
     "64Mi", "2Gi", "1e3", "500". Raises ValueError on garbage.
+
+    String parses are memoized (bounded): workloads repeat a handful of
+    request strings across tens of thousands of pods, and Quantity is
+    immutable after construction, so sharing instances is safe.
     """
+    if type(s) is str:
+        q = _PARSE_CACHE.get(s)
+        if q is None:
+            q = _parse_quantity_uncached(s)
+            if len(_PARSE_CACHE) < _PARSE_CACHE_MAX:
+                _PARSE_CACHE[s] = q
+        return q
+    return _parse_quantity_uncached(s)
+
+
+def _parse_quantity_uncached(s) -> Quantity:
     if isinstance(s, Quantity):
         return s
     if isinstance(s, bool):

@@ -13,12 +13,29 @@ class Heap:
         key_func: Callable[[Any], str],
         less_func: Callable[[Any, Any], bool],
         metric_recorder=None,
+        sort_key: Callable[[Any], Any] = None,
     ):
         self._key = key_func
         self._less = less_func
+        # Optional total-order key. When set, the ordering key is computed
+        # ONCE per insert and sift comparisons become C-speed tuple
+        # compares instead of Python less-func calls — the less-func path
+        # dominated pod admission at tens of thousands of pods.
+        self._sort_key = sort_key
         self._items: List[Any] = []
+        self._okeys: List[Any] = []      # parallel to _items (sort_key mode)
         self._index: Dict[str, int] = {}
         self._metric = metric_recorder
+
+    def set_sort_key(self, sort_key: Callable[[Any], Any]) -> None:
+        """Install (or clear) the cached total-order key. Only valid on
+        an empty heap: existing items were sifted under the previous
+        ordering, and rebuilding keys without re-heapifying would corrupt
+        the heap property."""
+        if self._items:
+            raise ValueError("set_sort_key requires an empty heap")
+        self._sort_key = sort_key
+        self._okeys = []
 
     def __len__(self) -> int:
         return len(self._items)
@@ -42,10 +59,14 @@ class Heap:
         if key in self._index:
             i = self._index[key]
             self._items[i] = obj
+            if self._sort_key:
+                self._okeys[i] = self._sort_key(obj)
             self._sift_up(i)
             self._sift_down(i)
         else:
             self._items.append(obj)
+            if self._sort_key:
+                self._okeys.append(self._sort_key(obj))
             self._index[key] = len(self._items) - 1
             self._sift_up(len(self._items) - 1)
             if self._metric:
@@ -68,6 +89,8 @@ class Heap:
             return False
         self._swap(i, len(self._items) - 1)
         self._items.pop()
+        if self._sort_key:
+            self._okeys.pop()
         del self._index[key]
         if i < len(self._items):
             self._sift_up(i)
@@ -80,6 +103,7 @@ class Heap:
         """Remove and return every item (arbitrary order) in O(n)."""
         items = self._items
         self._items = []
+        self._okeys = []
         self._index = {}
         if self._metric:
             for _ in items:
@@ -91,6 +115,8 @@ class Heap:
         them already satisfying the heap property (a list sorted by the
         less-function does); no sifting is performed."""
         self._items = list(items_in_heap_order)
+        if self._sort_key:
+            self._okeys = [self._sort_key(o) for o in self._items]
         self._index = {self._key(o): i for i, o in enumerate(self._items)}
         if self._metric:
             for _ in self._items:
@@ -110,14 +136,23 @@ class Heap:
     def _swap(self, i: int, j: int) -> None:
         if i == j:
             return
-        self._items[i], self._items[j] = self._items[j], self._items[i]
-        self._index[self._key(self._items[i])] = i
-        self._index[self._key(self._items[j])] = j
+        items = self._items
+        items[i], items[j] = items[j], items[i]
+        if self._sort_key:
+            okeys = self._okeys
+            okeys[i], okeys[j] = okeys[j], okeys[i]
+        self._index[self._key(items[i])] = i
+        self._index[self._key(items[j])] = j
+
+    def _lt(self, i: int, j: int) -> bool:
+        if self._sort_key:
+            return self._okeys[i] < self._okeys[j]
+        return self._less(self._items[i], self._items[j])
 
     def _sift_up(self, i: int) -> None:
         while i > 0:
             parent = (i - 1) // 2
-            if self._less(self._items[i], self._items[parent]):
+            if self._lt(i, parent):
                 self._swap(i, parent)
                 i = parent
             else:
@@ -128,7 +163,7 @@ class Heap:
         while True:
             smallest = i
             for child in (2 * i + 1, 2 * i + 2):
-                if child < n and self._less(self._items[child], self._items[smallest]):
+                if child < n and self._lt(child, smallest):
                     smallest = child
             if smallest == i:
                 return

@@ -105,7 +105,8 @@ class SchedulingQueue(PodNominator):
         # configurator when available); enables the bulk C-sorted drain
         self.sort_key: Optional[Callable[[QueuedPodInfo], tuple]] = None
         self._backoff_q = Heap(
-            key, lambda a, b: self._backoff_time(a) < self._backoff_time(b)
+            key, lambda a, b: self._backoff_time(a) < self._backoff_time(b),
+            sort_key=self._backoff_time,
         )
         self._unschedulable_q: Dict[str, QueuedPodInfo] = {}
         self.scheduling_cycle = 0

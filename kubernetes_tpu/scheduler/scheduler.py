@@ -168,6 +168,8 @@ class Scheduler:
         any_profile = next(iter(sched.profiles.values()))
         queue._active_q._less = any_profile.queue_sort_less
         queue.sort_key = any_profile.queue_sort_key
+        if queue.sort_key is not None:
+            queue._active_q.set_sort_key(queue.sort_key)
         return sched
 
     # ------------------------------------------------------------------
