@@ -12,6 +12,7 @@ PreFilterExtensions RemovePod path, re-runs filters, then re-adds victims
 in priority order to minimize evictions (selectVictimsOnNode :600).
 """
 
+import random
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Pod
@@ -106,12 +107,40 @@ class DefaultPreemption(PostFilterPlugin):
             )
         ]
         pdbs = self.handle.client.list_pdbs()
+        # getOffsetAndNumCandidates (default_preemption.go:195): dry-run
+        # from a random offset, stopping once enough candidates are found
+        # (>= max(n * MinCandidateNodesPercentage%, ...Absolute)) — the
+        # adaptive-sampling analog for preemption; evaluating all nodes
+        # is both off-spec and quadratic under mass preemption
+        n = len(potential)
+        if n == 0:
+            return []
+        num_candidates = min(
+            max(
+                n * self.min_candidate_nodes_percentage // 100,
+                self.min_candidate_nodes_absolute,
+            ),
+            n,
+        )
+        offset = random.randrange(n)
         candidates = []
-        for ni in potential:
+        non_violating_found = False
+        for k in range(n):
+            ni = potential[(offset + k) % n]
             result = self._select_victims_on_node(state, pod, ni, pdbs)
             if result is not None:
                 victims, violations = result
-                candidates.append(_Candidate(ni.node.name, victims, violations))
+                candidates.append(
+                    _Candidate(ni.node.name, victims, violations)
+                )
+                if violations == 0:
+                    non_violating_found = True
+                # upstream only cancels the dry-run once a PDB-NON-
+                # violating candidate exists (dryRunPreemption keeps
+                # scanning otherwise), so a run of violating-only nodes
+                # after the offset cannot force a needless PDB break
+                if len(candidates) >= num_candidates and non_violating_found:
+                    break
         return candidates
 
     def _select_victims_on_node(

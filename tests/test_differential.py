@@ -1,0 +1,190 @@
+"""Workload-level differential ring (SURVEY.md section 4 carry-over):
+the same randomized workload through the serial host path and the TPU
+batch path must yield equivalent outcomes — identical bound-pod sets
+(both paths are serial-equivalent in queue order) and placements that
+satisfy every constraint — plus crash-recovery: a scheduler restart
+rebuilds all state from the store (the control plane's "checkpoint" is
+the API server; SURVEY.md section 5)."""
+
+import random
+import time
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.feature_gates import FeatureGates
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.sidecar import attach_batch_scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _random_cluster(rng, n_nodes):
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(
+            MakeNode().name(f"n{i}")
+            .label("topology.kubernetes.io/zone", f"z{i % 3}")
+            .label("tier", "gold" if i % 4 == 0 else "std")
+            .capacity({
+                "cpu": str(rng.choice([4, 8, 16])),
+                "memory": f"{rng.choice([8, 16, 32])}Gi",
+            }).obj()
+        )
+    return nodes
+
+
+def _random_pods(rng, count):
+    pods = []
+    for i in range(count):
+        w = (
+            MakePod().name(f"p{i}").uid(f"u{i}")
+            .label("app", f"a{i % 5}")
+            .req({
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([64, 128, 256])}Mi",
+            })
+        )
+        kind = rng.randrange(5)
+        if kind == 0:
+            w.spread_constraint(2, "topology.kubernetes.io/zone",
+                                "DoNotSchedule", {"app": f"a{i % 5}"})
+        elif kind == 1:
+            w.pod_anti_affinity("app", [f"a{i % 5}"],
+                                "kubernetes.io/hostname")
+        elif kind == 2:
+            w.node_selector({"tier": "gold"})
+        pods.append(w.obj())
+    return pods
+
+
+def _run(nodes, pods, use_batch):
+    store = ClusterStore()
+    for n in nodes:
+        store.add_node(n)
+    sched = Scheduler.create(
+        store, feature_gates=FeatureGates({"TPUBatchScheduler": use_batch})
+    )
+    bs = attach_batch_scheduler(sched, max_batch=32) if use_batch else None
+    sched.start()
+    for p in pods:
+        store.create_pod(p)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        sched.queue.flush_backoff_completed()
+        progressed = (
+            bs.run_batch(pop_timeout=0.0) if bs
+            else sched.schedule_one(pop_timeout=0.0)
+        )
+        if progressed:
+            continue
+        if sched.queue.num_active() == 0 and sched.queue.num_backoff() == 0:
+            break
+        time.sleep(0.01)
+    assert sched.wait_for_inflight_bindings()
+    bound = {
+        p.metadata.name: p.spec.node_name
+        for p in store.list_pods() if p.spec.node_name
+    }
+    sched.stop()
+    return bound, store
+
+
+def _assert_valid(bound, store):
+    """Every placement satisfies capacity, selectors, spread, and
+    anti-affinity — checked from first principles, independent of any
+    scheduler code path."""
+    nodes = {n.name: n for n in store.list_nodes()}
+    pods = {p.metadata.name: p for p in store.list_pods()}
+    cpu_used = {n: 0 for n in nodes}
+    for name, node_name in bound.items():
+        pod = pods[name]
+        cpu_used[node_name] += int(
+            pod.spec.containers[0].resources.requests["cpu"].milli_value()
+        )
+        sel = pod.spec.node_selector
+        for k, val in sel.items():
+            assert nodes[node_name].metadata.labels.get(k) == val, name
+    for n, used in cpu_used.items():
+        cap = int(nodes[n].status.allocatable["cpu"].milli_value())
+        assert used <= cap, f"{n}: {used} > {cap}"
+    # hostname anti-affinity: at most one pod per (app, node) among
+    # pods that declare it
+    seen = set()
+    for name, node_name in bound.items():
+        pod = pods[name]
+        aff = pod.spec.affinity
+        if aff is None or aff.pod_anti_affinity is None:
+            continue
+        key = (pod.metadata.labels.get("app"), node_name)
+        assert key not in seen, f"anti-affinity violated on {node_name}"
+        seen.add(key)
+
+
+class TestSerialBatchEquivalence:
+    def test_randomized_workloads(self):
+        for seed in (7, 23, 99):
+            rng = random.Random(seed)
+            nodes = _random_cluster(rng, 12)
+            pods = _random_pods(rng, 60)
+            serial_bound, serial_store = _run(nodes, pods, use_batch=False)
+            rng = random.Random(seed)
+            nodes = _random_cluster(rng, 12)
+            pods = _random_pods(rng, 60)
+            batch_bound, batch_store = _run(nodes, pods, use_batch=True)
+            # identical schedulability outcome pod-by-pod
+            assert set(serial_bound) == set(batch_bound), (
+                f"seed {seed}: bound sets differ: "
+                f"{set(serial_bound) ^ set(batch_bound)}"
+            )
+            _assert_valid(serial_bound, serial_store)
+            _assert_valid(batch_bound, batch_store)
+
+
+class TestCrashRecovery:
+    def test_scheduler_restart_resumes_from_store(self):
+        """Kill the scheduler mid-workload; a fresh instance rebuilds
+        cache/queue from the store (list+watch) and finishes. Nothing is
+        persisted locally — exactly the reference's recovery model."""
+        store = ClusterStore()
+        for i in range(6):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched1 = Scheduler.create(
+            store, feature_gates=FeatureGates({"TPUBatchScheduler": True})
+        )
+        bs1 = attach_batch_scheduler(sched1, max_batch=8)
+        sched1.start()
+        for i in range(40):
+            store.create_pod(
+                MakePod().name(f"p{i}").uid(f"u{i}").req({"cpu": "500m"}).obj()
+            )
+        # schedule a little, then crash (stop without draining)
+        bs1.run_batch(pop_timeout=0.1)
+        sched1.wait_for_inflight_bindings()
+        sched1.stop()
+        partial = sum(1 for p in store.list_pods() if p.spec.node_name)
+        assert 0 < partial < 40
+
+        sched2 = Scheduler.create(
+            store, feature_gates=FeatureGates({"TPUBatchScheduler": True})
+        )
+        bs2 = attach_batch_scheduler(sched2, max_batch=8)
+        sched2.start()  # replays store state: bound pods -> cache, rest -> queue
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched2.queue.flush_backoff_completed()
+            if bs2.run_batch(pop_timeout=0.0):
+                continue
+            if sched2.queue.num_active() == 0 and \
+                    sched2.queue.num_backoff() == 0:
+                break
+            time.sleep(0.01)
+        assert sched2.wait_for_inflight_bindings()
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 40
+        # capacity respected across the restart boundary (8 cpu, 500m)
+        per_node = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(c <= 16 for c in per_node.values())
+        sched2.stop()
