@@ -139,6 +139,15 @@ class SolverSession:
         # telemetry: how often the incremental path was taken
         self.incremental_hits = 0
         self.rebuilds = 0
+        # optional device profiling (SURVEY.md section 5: JAX profiler /
+        # xplane dumps per solve batch): KTPU_PROFILE_DIR starts a trace
+        # at the first non-warming solve and stops it after
+        # KTPU_PROFILE_BATCHES (default 5) solves
+        import os
+
+        self._profile_dir = os.environ.get("KTPU_PROFILE_DIR") or None
+        self._profile_left = int(os.environ.get("KTPU_PROFILE_BATCHES", "5"))
+        self._profiling = False
 
     # ------------------------------------------------------------------
     def invalidate(self) -> None:
@@ -175,6 +184,7 @@ class SolverSession:
         ``warming`` suppresses telemetry (metrics segments, rebuild
         counters) so JIT-compile time stays out of the measured series."""
         self._warming = warming
+        self._profile_tick()
         seq_before = self.sched.cache.mutation_seq
         if self._state is not None and seq_before == self._last_seq:
             t0 = time.monotonic()
@@ -248,6 +258,25 @@ class SolverSession:
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
         return out, cluster, seq_before
+
+    def _profile_tick(self) -> None:
+        if self._profile_dir is None or self._warming:
+            return
+        import jax
+
+        try:
+            if not self._profiling:
+                jax.profiler.start_trace(self._profile_dir)
+                self._profiling = True
+            elif self._profile_left <= 0:
+                jax.profiler.stop_trace()
+                self._profile_dir = None
+                _logger.info("solver profile trace written")
+                return
+            self._profile_left -= 1
+        except Exception:  # pragma: no cover — profiling must never break solves
+            _logger.exception("solver profiling failed; disabled")
+            self._profile_dir = None
 
     def _observe(self, segment: str, seconds: float) -> None:
         if self._warming:
