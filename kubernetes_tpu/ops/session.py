@@ -146,7 +146,15 @@ class SolverSession:
         import os
 
         self._profile_dir = os.environ.get("KTPU_PROFILE_DIR") or None
-        self._profile_left = int(os.environ.get("KTPU_PROFILE_BATCHES", "5"))
+        try:
+            self._profile_left = int(
+                os.environ.get("KTPU_PROFILE_BATCHES", "5")
+            )
+        except ValueError:
+            _logger.warning("invalid KTPU_PROFILE_BATCHES; profiling off")
+            self._profile_left = 0
+        if self._profile_left <= 0:
+            self._profile_dir = None
         self._profiling = False
 
     # ------------------------------------------------------------------
@@ -269,14 +277,27 @@ class SolverSession:
                 jax.profiler.start_trace(self._profile_dir)
                 self._profiling = True
             elif self._profile_left <= 0:
-                jax.profiler.stop_trace()
-                self._profile_dir = None
-                _logger.info("solver profile trace written")
+                self.finish_profiling()
                 return
             self._profile_left -= 1
         except Exception:  # pragma: no cover — profiling must never break solves
             _logger.exception("solver profiling failed; disabled")
             self._profile_dir = None
+
+    def finish_profiling(self) -> None:
+        """Stop and flush an in-flight profiler trace (also called from
+        the sidecar's shutdown so short runs still get their dump)."""
+        if not self._profiling:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+            _logger.info("solver profile trace written")
+        except Exception:  # pragma: no cover
+            _logger.exception("solver profile stop failed")
+        self._profiling = False
+        self._profile_dir = None
 
     def _observe(self, segment: str, seconds: float) -> None:
         if self._warming:

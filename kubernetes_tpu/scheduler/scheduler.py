@@ -216,6 +216,9 @@ class Scheduler:
         if self._watch_handle is not None:
             self._watch_handle.stop()
             self._watch_handle = None
+        if self.batch_scheduler is not None:
+            # flush an in-flight profiler trace on short runs
+            self.batch_scheduler.session.finish_profiling()
         self._bind_pool.shutdown(wait=False)
 
     def wait_for_inflight_bindings(self, timeout: float = 30.0) -> bool:
