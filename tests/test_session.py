@@ -170,3 +170,28 @@ class TestIncrementalSession:
         bound = [p for p in store.list_pods() if p.spec.node_name]
         assert len(bound) == 20
         sched.stop()
+
+    def test_wide_term_space_falls_back_to_legacy_backend(self):
+        """More tracked anti-affinity terms than padded nodes exceeds the
+        planes layout's totals plane; the solve chain must demote to the
+        legacy backend and still schedule everything."""
+        store = ClusterStore()
+        for i in range(8):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "64", "memory": "64Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store, max_batch=256)
+        # 140 distinct groups > 128 padded nodes
+        for i in range(140):
+            store.create_pod(
+                MakePod().name(f"p{i}").uid(f"u{i}")
+                .label("g", f"g{i}").req({"cpu": "100m"})
+                .pod_anti_affinity("g", [f"g{i}"], "kubernetes.io/hostname")
+                .obj()
+            )
+        drain(sched, bs, timeout=120)
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 140
+        assert bs.session._active.name == "xla-legacy"
+        sched.stop()
