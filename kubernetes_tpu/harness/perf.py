@@ -119,24 +119,40 @@ def run_workload(
     bs = attach_batch_scheduler(sched, max_batch=max_batch) if use_batch else None
     sched.start()
 
-    def pump_until_quiescent(deadline: float) -> None:
-        """Drive scheduling until every pending pod is either bound or has
-        been tried and parked unschedulable (the active/backoff queues are
-        drained and no bindings are in flight). This tracks pods deleted
-        mid-run by preemption — a fixed bound-count target would not."""
+    def pump_until_quiescent(deadline: float, wait_names=None) -> None:
+        """Drive scheduling until done. With ``wait_names`` (the
+        reference's waitForPodsScheduled: an op waits for ITS pods to be
+        scheduled), done = every named pod bound — robust both to pods
+        from earlier ops that legitimately pend (Unschedulable's
+        impossible pods) and to mid-run victim deletion by preemption
+        (victims are other ops' pods). Without names, done = full
+        quiescence (queues drained, no bindings in flight). The store
+        scan runs at most once per pump iteration, after progress or
+        when idle — not in a tight loop against the bind path's lock."""
+        def op_done() -> bool:
+            bound = sum(
+                1 for p in store.list_pods()
+                if p.spec.node_name and p.metadata.name in wait_names
+            )
+            return bound >= len(wait_names)
+
         while time.monotonic() < deadline:
             sched.queue.flush_backoff_completed()
             if bs is not None:
                 progressed = bs.run_batch(pop_timeout=0.01)
             else:
                 progressed = sched.schedule_one(pop_timeout=0.01)
+            if wait_names is not None and op_done():
+                return
             if progressed:
                 continue
             if sched.queue.pending_active_count() == 0:
                 # async bind failures re-queue; settle them, then re-check
                 sched.wait_for_inflight_bindings(timeout=10.0)
                 sched.queue.flush_backoff_completed()
-                if sched.queue.pending_active_count() == 0:
+                if sched.queue.pending_active_count() == 0 and (
+                    wait_names is None or op_done()
+                ):
                     return
             time.sleep(0.005)
         raise TimeoutError(
@@ -181,13 +197,21 @@ def run_workload(
                     measure_start = time.monotonic()
                     measured_pods = op["count"]
                     collector.start()
+                op_names = set()
                 for i in range(op["count"]):
-                    store.create_pod(Pod.from_dict(template(offset + i)))
+                    pod = Pod.from_dict(template(offset + i))
+                    op_names.add(pod.metadata.name)
+                    store.create_pod(pod)
                     created_pods += 1
                 if progress:
                     progress(f"{name}: {created_pods} pods created")
                 if not op.get("skipWaitToCompletion", False):
-                    pump_until_quiescent(time.monotonic() + wait_timeout)
+                    # an op waits for ITS pods (scheduler_perf
+                    # waitForPodsScheduled), not global quiescence
+                    pump_until_quiescent(
+                        time.monotonic() + wait_timeout,
+                        wait_names=op_names,
+                    )
             elif opcode == "barrier":
                 pump_until_quiescent(time.monotonic() + wait_timeout)
             else:

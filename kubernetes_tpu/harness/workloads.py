@@ -82,13 +82,15 @@ def make_workload(name: str, nodes: int, init_pods: int, measure_pods: int) -> L
     return builder(nodes, init_pods, measure_pods)
 
 
-def _pods_op(count: int, pod_fn, collect: bool = False, offset: int = 0) -> dict:
+def _pods_op(count: int, pod_fn, collect: bool = False, offset: int = 0,
+             skip_wait: bool = False) -> dict:
     return {
         "opcode": "createPods",
         "count": count,
         "podTemplate": pod_fn,
         "collectMetrics": collect,
         "offset": offset,
+        "skipWaitToCompletion": skip_wait,
     }
 
 
@@ -299,7 +301,9 @@ def unschedulable(nodes, init_pods, measure_pods):
 
     return [
         _nodes_op(nodes),
-        _pods_op(init_pods, impossible),
+        # the impossible pods stay pending for the whole run (the
+        # reference config marks this op skipWaitToCompletion)
+        _pods_op(init_pods, impossible, skip_wait=True),
         _pods_op(measure_pods, lambda i: basic_pod(i), collect=True,
                  offset=init_pods),
     ]

@@ -133,6 +133,12 @@ class SolverSession:
         self._cluster: Optional[EncodedCluster] = None
         self._static = None   # device-resident solve-invariant arrays
         self._state = None    # device-resident dynamic state (carried)
+        # host-side static predicate masks + the last batch's per-pod
+        # profile indices: lets the sidecar synthesize per-node filter
+        # statuses for device-declined pods without a serial re-run
+        self._static_masks_host = None   # [U, N] bool
+        self.last_profile_idx = None     # [B] int32
+        self.last_inexpressible = None   # [B] bool
         self._last_seq: int = -1
         self._poisoned = False
         self._warming = False
@@ -199,6 +205,8 @@ class SolverSession:
             pb = self._encoder.encode_pods_only(pods, self.max_batch)
             if pb is not None and pb.requests.shape[1] == \
                     self._cluster.allocatable.shape[1]:
+                self.last_profile_idx = pb.profile_idx
+                self.last_inexpressible = pb.inexpressible
                 ints, floats = pack_podin(pb)
                 self._observe("encode", time.monotonic() - t0)
                 t0 = time.monotonic()
@@ -222,6 +230,9 @@ class SolverSession:
         )
         cluster, batch = self._encoder.encode(pods, pad_pods=self.max_batch)
         self._cluster = cluster
+        self._static_masks_host = batch.static_masks
+        self.last_profile_idx = batch.profile_idx
+        self.last_inexpressible = batch.inexpressible
         ints, floats = pack_podin(batch)
         self._observe("encode", time.monotonic() - t0)
         from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
@@ -266,6 +277,22 @@ class SolverSession:
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
         return out, cluster, seq_before
+
+    def static_mask_for(self, batch_index: int):
+        """Host-side static predicate mask ([num_real_nodes] bool) for the
+        given pod of the LAST solved batch, or None when unavailable.
+        False = the node fails a node-static predicate (selector/affinity,
+        nodeName, taints, unschedulable) — UnschedulableAndUnresolvable in
+        reference terms; True = only dynamic predicates failed."""
+        if (
+            self._static_masks_host is None
+            or self.last_profile_idx is None
+            or self._cluster is None
+            or batch_index >= len(self.last_profile_idx)
+        ):
+            return None
+        u = self.last_profile_idx[batch_index]
+        return self._static_masks_host[u][: self._cluster.num_real_nodes]
 
     def _profile_tick(self) -> None:
         if self._profile_dir is None or self._warming:

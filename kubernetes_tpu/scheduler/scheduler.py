@@ -296,6 +296,21 @@ class Scheduler:
         self.commit_assignment(fwk, state, qpi, result, pod_scheduling_cycle,
                                start)
 
+    def fail_unschedulable(self, fwk: Framework, qpi: QueuedPodInfo,
+                           fit_err: "fw.FitError", cycle: int) -> None:
+        """Record an unschedulable outcome decided OUTSIDE the serial
+        algorithm (the batch solver's declined pods): same PostFilter/
+        preemption + requeue flow as the serial FitError branch, without
+        re-running the full filter chain the device already evaluated.
+        PreFilter still runs: preemption's dry-run re-executes Filter
+        plugins against the CycleState, which must carry their
+        PreFilter-computed data."""
+        state = CycleState()
+        if fwk.has_post_filter_plugins():
+            fwk.run_pre_filter_plugins(state, qpi.pod)
+        self._handle_fit_error(fwk, state, qpi, fit_err, cycle)
+        self.metrics.schedule_attempts.inc("unschedulable", fwk.profile_name)
+
     def commit_assignment(
         self,
         fwk: Framework,

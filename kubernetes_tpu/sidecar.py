@@ -40,6 +40,10 @@ from kubernetes_tpu.scheduler.types import QueuedPodInfo
 
 
 class TPUBatchScheduler:
+    # up to this many device-declined pods per batch take the serial
+    # path (exact statuses/messages); above it, mass-decline fast path
+    DECLINED_SERIAL_LIMIT = 32
+
     def __init__(
         self,
         scheduler: Scheduler,
@@ -189,11 +193,12 @@ class TPUBatchScheduler:
 
         t0 = time.monotonic()
         committed = 0
-        for (qpi, cycle), assignment in zip(batchable, assignments):
+        declined: List[tuple] = []  # (batch index, qpi, cycle)
+        for bi, ((qpi, cycle), assignment) in enumerate(
+            zip(batchable, assignments)
+        ):
             if assignment < 0:
-                # device says unschedulable (or inexpressible): the serial
-                # path supplies exact statuses + preemption
-                serial.append(qpi)
+                declined.append((bi, qpi, cycle))
                 continue
             node_name = cluster.node_names[assignment]
             if self.validate and not self._host_validates(fwk, qpi, node_name):
@@ -213,10 +218,79 @@ class TPUBatchScheduler:
             else:
                 # committed on device, rejected on host: mirrors diverged
                 self.session.invalidate()
+        # Declined pods: with a FEW, re-run the serial path for its exact
+        # per-plugin statuses and event messages. Under MASS decline
+        # (e.g. thousands of impossible pods) the serial re-run costs
+        # ~O(nodes) per pod for information the device already computed,
+        # so fail directly with statuses synthesized from the static
+        # masks — preemption still runs via PostFilter and correctly
+        # prunes static-infeasible nodes.
+        if len(declined) <= self.DECLINED_SERIAL_LIMIT:
+            serial.extend(qpi for _, qpi, _ in declined)
+        else:
+            # statuses depend only on the pod's static profile: share one
+            # (read-only) map per profile instead of building a
+            # nodes-sized dict per declined pod
+            statuses_by_profile: dict = {}
+            inexpressible = self.session.last_inexpressible
+            for bi, qpi, cycle in declined:
+                # an inexpressible pod's -1 is NOT a device verdict (the
+                # tensor model simply can't express it) — it keeps the
+                # documented serial-fallback contract even here
+                if inexpressible is not None and bi < len(inexpressible)                         and inexpressible[bi]:
+                    serial.append(qpi)
+                elif not self._fail_declined(fwk, qpi, cycle, cluster, bi,
+                                             statuses_by_profile):
+                    serial.append(qpi)
         sched.metrics.batch_solve_duration.observe(
             time.monotonic() - t0, "commit"
         )
         return committed, seq_before
+
+    # shared (read-only) status instances for synthesized fit errors
+    _STATUS_STATIC = None
+    _STATUS_DYNAMIC = None
+
+    def _fail_declined(self, fwk, qpi: QueuedPodInfo, cycle: int,
+                       cluster, batch_index: int,
+                       statuses_by_profile: dict) -> bool:
+        """Mark a device-declined pod unschedulable without the serial
+        re-run. Returns False when the static context is unavailable
+        (caller then uses the serial path)."""
+        from kubernetes_tpu.scheduler.framework import interface as fw_iface
+
+        profiles = self.session.last_profile_idx
+        if profiles is None or batch_index >= len(profiles):
+            return False
+        ui = int(profiles[batch_index])
+        statuses = statuses_by_profile.get(ui)
+        if statuses is None:
+            mask = self.session.static_mask_for(batch_index)
+            if mask is None:
+                return False
+            cls = TPUBatchScheduler
+            if cls._STATUS_STATIC is None:
+                cls._STATUS_STATIC = fw_iface.Status(
+                    fw_iface.UNSCHEDULABLE_AND_UNRESOLVABLE,
+                    "node(s) didn't satisfy the pod's node-static predicates",
+                )
+                cls._STATUS_DYNAMIC = fw_iface.Status(
+                    fw_iface.UNSCHEDULABLE,
+                    "node(s) had insufficient resources or violated "
+                    "topology/affinity constraints",
+                )
+            statuses = {
+                name: (cls._STATUS_DYNAMIC if ok else cls._STATUS_STATIC)
+                for name, ok in zip(cluster.node_names, mask)
+            }
+            statuses_by_profile[ui] = statuses
+        fit_err = fw_iface.FitError(
+            pod=qpi.pod,
+            num_all_nodes=cluster.num_real_nodes,
+            filtered_nodes_statuses=statuses,
+        )
+        self.sched.fail_unschedulable(fwk, qpi, fit_err, cycle)
+        return True
 
     def _host_validates(self, fwk, qpi: QueuedPodInfo, node_name: str) -> bool:
         from kubernetes_tpu.scheduler.framework import interface as fw_iface
