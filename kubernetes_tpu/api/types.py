@@ -558,6 +558,7 @@ class PersistentVolume:
     node_affinity: Optional[NodeSelector] = None
     claim_ref: Optional[str] = None  # "namespace/name" of bound PVC
     phase: str = "Available"
+    csi_driver: str = ""  # CSI driver name when CSI-provisioned
 
     @property
     def name(self) -> str:

@@ -178,6 +178,8 @@ def run_workload(
                 offset = op.get("offset", 0)
                 collect = op.get("collectMetrics", False)
                 if collect and bs is not None:
+                    from kubernetes_tpu.ops.encode import is_host_only
+
                     # compile/cache-load the solver outside the measured
                     # window (JIT warm-up is setup, like the reference's
                     # informer warm-up before scheduler_perf collects).
@@ -186,10 +188,14 @@ def run_workload(
                     # term/profile space, and workload templates commonly
                     # cycle through modulo-k groups (one pod would warm a
                     # 1-term shape while the real batches carry k terms).
-                    warm = bs.warmup(sample_pods=[
+                    samples = [
                         Pod.from_dict(template(offset + i))
                         for i in range(min(200, op["count"]))
-                    ])
+                    ]
+                    # host-only pods (PVCs, host ports) never take the
+                    # batch path — don't compile device shapes for them
+                    samples = [p for p in samples if not is_host_only(p)]
+                    warm = bs.warmup(sample_pods=samples) if samples else 0.0
                     if progress and warm > 0.05:
                         progress(f"{name}: solver warmup {warm:.1f}s")
                 if collect:
@@ -212,6 +218,8 @@ def run_workload(
                         time.monotonic() + wait_timeout,
                         wait_names=op_names,
                     )
+            elif opcode == "setup":
+                op["fn"](store)
             elif opcode == "barrier":
                 pump_until_quiescent(time.monotonic() + wait_timeout)
             else:

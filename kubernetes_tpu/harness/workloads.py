@@ -340,6 +340,121 @@ def scheduling_secrets(nodes, init_pods, measure_pods):
     return scheduling_basic(nodes, init_pods, measure_pods)
 
 
+def _pvc_pod(i: int, claim: str, cpu: str = "500m") -> dict:
+    p = basic_pod(i, cpu=cpu)
+    p["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}
+    ]
+    return p
+
+
+def _volumes_setup(count: int, storage_class: str, binding_mode: str,
+                   provisioner: str = "kubernetes.io/fake",
+                   csi_driver: str = "", prebound: bool = True,
+                   offset: int = 0):
+    """Create a StorageClass plus a 1:1 PV/PVC pair per pod (the
+    reference pre-binds them via StartFakePVController,
+    test/integration/util/util.go:109). ``csi_driver`` marks the PVs as
+    CSI-provisioned so NodeVolumeLimits counts them against CSINode
+    attach limits."""
+    def setup(store):
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import (
+            ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+            StorageClass,
+        )
+
+        store.add_storage_class(StorageClass(
+            metadata=ObjectMeta(name=storage_class),
+            provisioner=provisioner,
+            volume_binding_mode=binding_mode,
+        ))
+        for i in range(offset, offset + count):
+            claim = f"claim-{i}"
+            store.add_pv(PersistentVolume(
+                metadata=ObjectMeta(name=f"pv-{i}"),
+                capacity={"storage": parse_quantity("1Gi")},
+                storage_class_name=storage_class,
+                claim_ref=f"default/{claim}" if prebound else None,
+                phase="Bound" if prebound else "Available",
+                csi_driver=csi_driver,
+            ))
+            store.add_pvc(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=claim, namespace="default"),
+                storage_class_name=storage_class,
+                requests={"storage": parse_quantity("1Gi")},
+                volume_name=f"pv-{i}" if prebound else "",
+                phase="Bound" if prebound else "Pending",
+            ))
+    return {"opcode": "setup", "fn": setup}
+
+
+def _pv_workload(storage_class: str, provisioner: str, csi_driver: str = "",
+                 extra_setup=None):
+    """Shared shape of the three PV scheduling workloads (they differ
+    only in storage class, provisioner, and CSI-specific setup)."""
+    def build(nodes, init_pods, measure_pods):
+        ops = [_nodes_op(nodes)]
+        if extra_setup is not None:
+            ops.append({"opcode": "setup", "fn": extra_setup(nodes)})
+        ops += [
+            _volumes_setup(measure_pods, storage_class, "Immediate",
+                           provisioner=provisioner, csi_driver=csi_driver,
+                           offset=init_pods),
+            _pods_op(init_pods, lambda i: basic_pod(i)),
+            _barrier(),
+            _pods_op(measure_pods, lambda i: _pvc_pod(i, f"claim-{i}"),
+                     collect=True, offset=init_pods),
+        ]
+        return ops
+    return build
+
+
+def _csi_nodes_setup(nodes):
+    def setup(store):
+        from kubernetes_tpu.api.types import CSINode, CSINodeDriver, ObjectMeta
+
+        for i in range(nodes):
+            store.add_csi_node(CSINode(
+                metadata=ObjectMeta(name=f"node-{i}"),
+                drivers=[CSINodeDriver(
+                    name="csi.fake.driver", node_id=f"node-{i}",
+                    allocatable_count=39,
+                )],
+            ))
+    return setup
+
+
+# SchedulingInTreePVs: pre-bound in-tree PV/PVC pairs.
+scheduling_in_tree_pvs = _pv_workload("intree-sc", "kubernetes.io/fake")
+# SchedulingMigratedInTreePVs: the same pairs served through the
+# CSI-migration path (PVs carry the CSI driver name).
+scheduling_migrated_in_tree_pvs = _pv_workload(
+    "migrated-sc", "pd.csi.storage.gke.io",
+    csi_driver="pd.csi.storage.gke.io",
+)
+# SchedulingCSIPVs: CSI volumes counted against CSINode attach limits.
+scheduling_csi_pvs = _pv_workload(
+    "csi-sc", "csi.fake.driver", csi_driver="csi.fake.driver",
+    extra_setup=_csi_nodes_setup,
+)
+
+
+def preemption_pvs(nodes, init_pods, measure_pods):
+    """Preemption where the preempting pods carry PVCs (PreemptionPVs):
+    victims evicted AND volumes bound in the same flow."""
+    return [
+        _nodes_op(nodes, cpu="4", memory="8Gi"),
+        _volumes_setup(measure_pods, "preempt-sc", "Immediate",
+                       offset=init_pods),
+        _pods_op(init_pods, lambda i: _prio(basic_pod(i, cpu="3"), 1)),
+        _barrier(),
+        _pods_op(measure_pods,
+                 lambda i: _prio(_pvc_pod(i, f"claim-{i}", cpu="3"), 100),
+                 collect=True, offset=init_pods),
+    ]
+
+
 WORKLOADS = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
@@ -354,4 +469,8 @@ WORKLOADS = {
     "Preemption": preemption,
     "Unschedulable": unschedulable,
     "GangScheduling": gang_scheduling,
+    "SchedulingInTreePVs": scheduling_in_tree_pvs,
+    "SchedulingMigratedInTreePVs": scheduling_migrated_in_tree_pvs,
+    "SchedulingCSIPVs": scheduling_csi_pvs,
+    "PreemptionPVs": preemption_pvs,
 }
