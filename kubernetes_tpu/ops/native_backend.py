@@ -41,6 +41,14 @@ class CppBackend:
     def prepare(self, cluster, batch):
         return prepare(cluster, batch, device=False)
 
+    def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
+        """The native solver is synchronous; lazy == eager here."""
+        return self.solve(params, pstatic, pstate, pod_ints, pod_floats)
+
+    @staticmethod
+    def materialize(handle):
+        return handle
+
     def solve(self, params: SolverParams, pstatic, pstate, pod_ints,
               pod_floats):
         planes = pstate.planes  # [CD, NB, 128] int32, C-contiguous

@@ -651,13 +651,25 @@ class XlaPlanesBackend:
     def prepare(self, cluster, batch):
         return prepare(cluster, batch)
 
-    def solve(self, params, pstatic, pstate, pod_ints, pod_floats):
+    def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
+        """Dispatch the solve; the returned assignments handle is a
+        device array the caller materializes later (jax dispatch is
+        async, so host work can overlap the device solve)."""
         new_planes, assignments = _xla_planes_solve(
             params, pstatic.r, pstatic.sc, pstatic.t, pstatic.u,
             pstatic.v, pstatic.sc_meta, pstatic.ints, pstatic.f32s,
             pstate.planes, jnp.asarray(pod_ints), jnp.asarray(pod_floats),
         )
-        return np.asarray(assignments), PState(planes=new_planes)
+        return assignments, PState(planes=new_planes)
+
+    @staticmethod
+    def materialize(handle):
+        return np.asarray(handle)
+
+    def solve(self, params, pstatic, pstate, pod_ints, pod_floats):
+        h, state = self.solve_lazy(params, pstatic, pstate, pod_ints,
+                                   pod_floats)
+        return self.materialize(h), state
 
 
 class PallasBackend:
@@ -671,10 +683,20 @@ class PallasBackend:
     def prepare(self, cluster, batch):
         return prepare(cluster, batch)
 
-    def solve(self, params, pstatic, pstate, pod_ints, pod_floats):
+    def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
+        """Async-dispatched solve; materialize the handle later."""
         assignments, new_state = _run(
             params, pstatic, pstate,
             jnp.asarray(pod_ints), jnp.asarray(pod_floats),
             self.interpret,
         )
-        return np.asarray(assignments)[:, 0], new_state
+        return assignments, new_state
+
+    @staticmethod
+    def materialize(handle):
+        return np.asarray(handle)[:, 0]
+
+    def solve(self, params, pstatic, pstate, pod_ints, pod_floats):
+        h, state = self.solve_lazy(params, pstatic, pstate, pod_ints,
+                                   pod_floats)
+        return self.materialize(h), state

@@ -55,11 +55,20 @@ class XlaBackend:
         return (build_static(cluster, batch, device=True),
                 build_state(cluster, batch, device=True))
 
-    def solve(self, params, static, state, pod_ints, pod_floats):
+    def solve_lazy(self, params, static, state, pod_ints, pod_floats):
         new_state, assignments = _solve_packed(
             static, state, pod_ints, pod_floats, params
         )
-        return np.asarray(assignments), new_state
+        return assignments, new_state
+
+    @staticmethod
+    def materialize(handle):
+        return np.asarray(handle)
+
+    def solve(self, params, static, state, pod_ints, pod_floats):
+        h, new_state = self.solve_lazy(params, static, state, pod_ints,
+                                       pod_floats)
+        return self.materialize(h), new_state
 
 
 def default_backend():
@@ -142,6 +151,9 @@ class SolverSession:
         self._last_seq: int = -1
         self._poisoned = False
         self._warming = False
+        # materializer for the LAST lazy solve's handle (None when the
+        # result was returned eagerly, e.g. the rebuild path)
+        self.last_materializer = None
         # telemetry: how often the incremental path was taken
         self.incremental_hits = 0
         self.rebuilds = 0
@@ -172,6 +184,16 @@ class SolverSession:
         self._last_seq = -1
         self._poisoned = True
 
+    def mirror_current(self) -> bool:
+        """True when the device mirror is still consistent with the host
+        cache RIGHT NOW (no unsanctioned mutations since it was last
+        validated). The pipelined sidecar checks this before committing
+        a batch solved one cycle earlier."""
+        return (
+            not self._poisoned
+            and self._last_seq == self.sched.cache.mutation_seq
+        )
+
     def note_committed(self, expected_mutations: int, seq_before: int) -> None:
         """Called by the sidecar after committing a batch: the session
         stays valid only if the mirror was valid going INTO this batch
@@ -190,13 +212,20 @@ class SolverSession:
             self._last_seq = -1
 
     # ------------------------------------------------------------------
-    def solve(self, pods: List, warming: bool = False
-              ) -> Tuple[np.ndarray, EncodedCluster, int]:
-        """Solve one batch. Returns (assignments [B], cluster,
-        seq_before) where assignments map batch index → node index in
+    def solve(self, pods: List, warming: bool = False, lazy: bool = False,
+              incremental_only: bool = False
+              ) -> Optional[Tuple[object, EncodedCluster, int]]:
+        """Solve one batch. Returns (assignments, cluster, seq_before)
+        where assignments map batch index → node index in
         ``cluster.node_names`` (-1 = unschedulable on device).
         ``warming`` suppresses telemetry (metrics segments, rebuild
-        counters) so JIT-compile time stays out of the measured series."""
+        counters) so JIT-compile time stays out of the measured series.
+        With ``lazy`` the assignments are an opaque handle — pass it to
+        ``materialize`` (captured via ``last_materializer``) later, so
+        host work overlaps the asynchronously-dispatched device solve.
+        With ``incremental_only`` the call returns None instead of
+        rebuilding (the pipelined caller must commit its in-flight batch
+        before a rebuild, or the fresh snapshot would miss it)."""
         self._warming = warming
         self._profile_tick()
         seq_before = self.sched.cache.mutation_seq
@@ -210,13 +239,22 @@ class SolverSession:
                 ints, floats = pack_podin(pb)
                 self._observe("encode", time.monotonic() - t0)
                 t0 = time.monotonic()
-                out, self._state = self._active.solve(
+                handle, self._state = self._active.solve_lazy(
                     self.params, self._static, self._state, ints, floats
                 )
+                if lazy:
+                    self.last_materializer = self._active.materialize
+                else:
+                    handle = self._active.materialize(handle)
+                    self.last_materializer = None
                 self._observe("device", time.monotonic() - t0)
                 if not self._warming:
                     self.incremental_hits += 1
-                return out, self._cluster, seq_before
+                return handle, self._cluster, seq_before
+        if incremental_only:
+            return None
+        # the rebuild path always solves eagerly (rebuilds are rare and
+        # the caller just committed any in-flight batch anyway)
         return self._rebuild_and_solve(pods, seq_before)
 
     def _rebuild_and_solve(self, pods: List, seq_before: int):
@@ -262,6 +300,7 @@ class SolverSession:
                     self.params, self._static, state, ints, floats
                 )
                 self._active = backend
+                self.last_materializer = None  # already materialized
                 break
             except Exception:
                 if i == len(chain) - 1:
@@ -277,6 +316,12 @@ class SolverSession:
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
         return out, cluster, seq_before
+
+    @property
+    def static_masks_host(self):
+        """Host copy of the current epoch's [U, N] static predicate
+        masks (None before the first rebuild)."""
+        return self._static_masks_host
 
     def static_mask_for(self, batch_index: int):
         """Host-side static predicate mask ([num_real_nodes] bool) for the

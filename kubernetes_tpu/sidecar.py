@@ -60,6 +60,9 @@ class TPUBatchScheduler:
         # device-resident state mirror, carried across batches
         self.session = SolverSession(scheduler, params=params,
                                      max_batch=max_batch)
+        # one solved-but-uncommitted batch (pipelining: the host commits
+        # batch k while the device solves batch k+1)
+        self._pending: Optional[dict] = None
 
     # ------------------------------------------------------------------
     def _drain(self, pop_timeout: Optional[float]):
@@ -74,16 +77,33 @@ class TPUBatchScheduler:
         return [(qpi, first_cycle + i) for i, qpi in enumerate(items)]
 
     def run_batch(self, pop_timeout: Optional[float] = 0.2) -> int:
-        """One batch cycle. Returns the number of pods processed."""
+        """One pump cycle, PIPELINED: dispatch this cycle's solve (jax
+        dispatch is async), then commit the PREVIOUS cycle's solved batch
+        while the device crunches the new one. A solved batch is held at
+        most one cycle and commits immediately when the queue is empty,
+        so single-shot callers see their pods bound in the same call.
+        Returns the number of pods worked on this cycle."""
         sched = self.sched
-        qpis = self._drain(pop_timeout)
-        if not qpis:
-            return 0
-        start = time.monotonic()
+        prev = self._pending
+        self._pending = None
 
-        # partition: batchable vs serial-fallback
+        # a pending batch solved against a mirror that has since
+        # diverged (external events, failed commits) is suspect: its
+        # assignments are discarded and its pods RE-SOLVED this cycle
+        # (the solve below rebuilds from a fresh snapshot), keeping
+        # them on the batch path instead of serializing up to
+        # max_batch pods
         batchable: List[tuple] = []
         serial: List[QueuedPodInfo] = []
+        if prev is not None and not self.session.mirror_current():
+            batchable = list(prev["batchable"])
+            prev = None
+            qpis = []
+        else:
+            qpis = self._drain(0.0 if prev is not None else pop_timeout)
+        processed = len(qpis) + len(batchable)
+
+        # partition: batchable vs serial-fallback
         for qpi, cycle in qpis:
             pod = qpi.pod
             fwk = sched.profiles.get(pod.spec.scheduler_name)
@@ -97,12 +117,41 @@ class TPUBatchScheduler:
                 batchable.append((qpi, cycle))
 
         committed = 0
-        seq_before = sched.cache.mutation_seq
+        seq_anchor = sched.cache.mutation_seq
         if batchable:
             try:
-                committed, seq_before = self._solve_and_commit(
-                    batchable, serial, start
+                res = self.session.solve(
+                    [q.pod for q, _ in batchable], lazy=True,
+                    incremental_only=prev is not None,
                 )
+                if res is None:
+                    # this solve needs a full rebuild, whose snapshot
+                    # must include the in-flight batch: commit it first
+                    # and settle the mutation accounting BEFORE the
+                    # rebuild re-anchors the mirror (no overlap this
+                    # cycle — rebuilds are rare)
+                    c = self._commit_pending_safe(prev, serial)
+                    self.session.note_committed(c, seq_anchor)
+                    processed += len(prev["batchable"])
+                    prev = None
+                    seq_anchor = sched.cache.mutation_seq
+                    res = self.session.solve(
+                        [q.pod for q, _ in batchable], lazy=True
+                    )
+                handle, cluster, _ = res
+                self._pending = {
+                    "batchable": batchable,
+                    "handle": handle,
+                    "materializer": self.session.last_materializer,
+                    "cluster": cluster,
+                    "profiles": self.session.last_profile_idx,
+                    "inexpressible": self.session.last_inexpressible,
+                    # static masks for THIS batch's profiles — the
+                    # session's live fields may describe a newer batch
+                    # by the time this one commits
+                    "masks": self.session.static_masks_host,
+                    "start": time.monotonic(),
+                }
             except Exception:  # noqa: BLE001 — popped pods must not be lost
                 _logger.exception(
                     "batch solve failed; %d pods fall back to the serial path",
@@ -111,6 +160,28 @@ class TPUBatchScheduler:
                 self.session.invalidate()
                 serial.extend(q for q, _ in batchable)
 
+        # commit the previous cycle's batch while the device solves
+        if prev is not None:
+            committed += self._commit_pending_safe(prev, serial)
+            processed += len(prev["batchable"])
+
+        # nothing else queued: no overlap to win — commit the fresh
+        # solve in the same call (also the single-shot caller contract)
+        if self._pending is not None and sched.queue.num_active() == 0:
+            pending = self._pending
+            self._pending = None
+            committed += self._commit_pending_safe(pending, serial)
+
+        self._run_serial(serial)
+        # session validity: exactly one cache mutation (the assume) per
+        # committed pod since the commit phase began — serial binds,
+        # failed binds, or external events show up as extra mutations
+        # and invalidate the mirror
+        self.session.note_committed(committed, seq_anchor)
+        return processed
+
+    def _run_serial(self, serial: List[QueuedPodInfo]) -> None:
+        sched = self.sched
         seen = set()
         for qpi in serial:
             if qpi.pod.full_name() in seen:
@@ -121,11 +192,6 @@ class TPUBatchScheduler:
             if sched.skip_pod_schedule(fwk, qpi.pod):
                 continue
             sched.schedule_pod_serial(fwk, qpi)
-        # session validity: exactly one cache mutation (the assume) per
-        # committed pod — serial binds, failed binds, or external events
-        # in between show up as extra mutations and invalidate the mirror
-        self.session.note_committed(committed, seq_before)
-        return len(qpis)
 
     def warmup(self, sample_pods: Optional[List] = None) -> float:
         """Compile (or cache-load) the solver for this cluster's shapes by
@@ -179,17 +245,36 @@ class TPUBatchScheduler:
             ext.is_interested(pod) for ext in self.sched.algorithm.extenders
         )
 
+    def _commit_pending_safe(self, pending: dict,
+                             serial: List[QueuedPodInfo]) -> int:
+        """_commit_pending, but a failure (e.g. an async device error
+        surfacing at materialization) must not lose popped pods: they
+        fall back to the serial path (already-assumed ones are skipped
+        there by skip_pod_schedule)."""
+        try:
+            return self._commit_pending(pending, serial)
+        except Exception:  # noqa: BLE001
+            _logger.exception(
+                "batch commit failed; %d pods fall back to the serial path",
+                len(pending["batchable"]),
+            )
+            self.session.invalidate()
+            serial.extend(q for q, _ in pending["batchable"])
+            return 0
+
     # ------------------------------------------------------------------
-    def _solve_and_commit(self, batchable: List[tuple],
-                          serial: List[QueuedPodInfo], start: float):
-        """Returns (committed_count, seq_before) for session accounting."""
+    def _commit_pending(self, pending: dict,
+                        serial: List[QueuedPodInfo]) -> int:
+        """Materialize and commit one solved batch. Returns the number
+        of successfully committed pods; declined/rejected pods are
+        appended to ``serial`` or failed directly (mass decline)."""
         sched = self.sched
         fwk = sched.profiles["default-scheduler"]
-
-        # the session records the disjoint "encode" and "device" segments
-        assignments, cluster, seq_before = self.session.solve(
-            [q.pod for q, _ in batchable]
-        )
+        batchable = pending["batchable"]
+        cluster = pending["cluster"]
+        start = pending["start"]
+        mat = pending["materializer"] or (lambda h: h)
+        assignments = mat(pending["handle"])
 
         t0 = time.monotonic()
         committed = 0
@@ -232,42 +317,46 @@ class TPUBatchScheduler:
             # (read-only) map per profile instead of building a
             # nodes-sized dict per declined pod
             statuses_by_profile: dict = {}
-            inexpressible = self.session.last_inexpressible
+            inexpressible = pending["inexpressible"]
             for bi, qpi, cycle in declined:
                 # an inexpressible pod's -1 is NOT a device verdict (the
                 # tensor model simply can't express it) — it keeps the
                 # documented serial-fallback contract even here
-                if inexpressible is not None and bi < len(inexpressible)                         and inexpressible[bi]:
+                if inexpressible is not None and bi < len(inexpressible) \
+                        and inexpressible[bi]:
                     serial.append(qpi)
                 elif not self._fail_declined(fwk, qpi, cycle, cluster, bi,
+                                             pending["profiles"],
+                                             pending["masks"],
                                              statuses_by_profile):
                     serial.append(qpi)
         sched.metrics.batch_solve_duration.observe(
             time.monotonic() - t0, "commit"
         )
-        return committed, seq_before
+        return committed
 
     # shared (read-only) status instances for synthesized fit errors
     _STATUS_STATIC = None
     _STATUS_DYNAMIC = None
 
     def _fail_declined(self, fwk, qpi: QueuedPodInfo, cycle: int,
-                       cluster, batch_index: int,
+                       cluster, batch_index: int, profiles, masks,
                        statuses_by_profile: dict) -> bool:
         """Mark a device-declined pod unschedulable without the serial
         re-run. Returns False when the static context is unavailable
-        (caller then uses the serial path)."""
+        (caller then uses the serial path). ``profiles`` is the solved
+        batch's per-pod profile index array, captured at solve time (the
+        session's live fields may already describe a NEWER batch)."""
         from kubernetes_tpu.scheduler.framework import interface as fw_iface
 
-        profiles = self.session.last_profile_idx
         if profiles is None or batch_index >= len(profiles):
             return False
         ui = int(profiles[batch_index])
         statuses = statuses_by_profile.get(ui)
         if statuses is None:
-            mask = self.session.static_mask_for(batch_index)
-            if mask is None:
+            if masks is None or ui >= len(masks):
                 return False
+            mask = masks[ui][: cluster.num_real_nodes]
             cls = TPUBatchScheduler
             if cls._STATUS_STATIC is None:
                 cls._STATUS_STATIC = fw_iface.Status(

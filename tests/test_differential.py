@@ -158,8 +158,12 @@ class TestCrashRecovery:
             store.create_pod(
                 MakePod().name(f"p{i}").uid(f"u{i}").req({"cpu": "500m"}).obj()
             )
-        # schedule a little, then crash (stop without draining)
+        # schedule a little, then crash (stop without draining). Two
+        # cycles: the pipelined batch path solves on the first and
+        # commits on the second — and a batch still in flight at crash
+        # time must be recoverable from the store regardless.
         bs1.run_batch(pop_timeout=0.1)
+        bs1.run_batch(pop_timeout=0.0)
         sched1.wait_for_inflight_bindings()
         sched1.stop()
         partial = sum(1 for p in store.list_pods() if p.spec.node_name)
