@@ -195,3 +195,81 @@ class TestIncrementalSession:
         assert len(bound) == 140
         assert bs.session._active.name == "xla-legacy"
         sched.stop()
+
+
+class TestPipelinedBatches:
+    def test_stale_pending_is_resolved_not_serialized(self):
+        """A held batch whose mirror diverges (external node add between
+        its solve and commit) must be re-solved against a fresh snapshot
+        and still bind everything correctly."""
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store, max_batch=8)
+        for i in range(24):
+            store.create_pod(MakePod().name(f"p{i}").req({"cpu": "1"}).obj())
+        # first cycle: solves 8, holds them pending (queue still has 16)
+        bs.run_batch(pop_timeout=0.1)
+        assert bs._pending is not None
+        # external mutation while the batch is in flight
+        store.add_node(
+            MakeNode().name("late").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+        )
+        drain(sched, bs)
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 24
+        per_node = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(c <= 8 for c in per_node.values())
+        sched.stop()
+
+    def test_rebuild_mid_pipeline_commits_in_flight_batch_first(self):
+        """A second wave introducing a NEW constraint space while a batch
+        is pending forces a rebuild; the in-flight batch must commit
+        before the rebuild so the fresh snapshot includes it (no
+        double-placement / overcommit)."""
+        store = ClusterStore()
+        for i in range(6):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .label("topology.kubernetes.io/zone", f"z{i % 3}")
+                .capacity({"cpu": "4", "memory": "8Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store, max_batch=8)
+        # wave 1: plain pods (fills the pipeline)
+        for i in range(16):
+            store.create_pod(MakePod().name(f"a{i}").req({"cpu": "1"}).obj())
+        bs.run_batch(pop_timeout=0.1)    # solve 8, hold pending
+        # wave 2: spread-constrained pods -> new tracked constraint ->
+        # encode space mismatch -> rebuild path
+        for i in range(12):
+            store.create_pod(
+                MakePod().name(f"s{i}").uid(f"su{i}")
+                .label("app", "web").req({"cpu": "500m"})
+                .spread_constraint(1, "topology.kubernetes.io/zone",
+                                   "DoNotSchedule", {"app": "web"}).obj()
+            )
+        drain(sched, bs)
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 28
+        # capacity must hold INCLUDING the batch that was in flight at
+        # rebuild time (4 cpu/node: 4x1cpu 'a' pods or mixes)
+        cpu_on = {}
+        for p in bound:
+            m = 1000 if p.metadata.name.startswith("a") else 500
+            cpu_on[p.spec.node_name] = cpu_on.get(p.spec.node_name, 0) + m
+        assert all(v <= 4000 for v in cpu_on.values()), cpu_on
+        # spread invariant for wave 2
+        zone_of = {n.name: n.metadata.labels["topology.kubernetes.io/zone"]
+                   for n in store.list_nodes()}
+        counts = {}
+        for p in bound:
+            if p.metadata.name.startswith("s"):
+                z = zone_of[p.spec.node_name]
+                counts[z] = counts.get(z, 0) + 1
+        assert max(counts.values()) - min(counts.values()) <= 1
+        sched.stop()
