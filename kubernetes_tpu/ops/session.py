@@ -115,6 +115,10 @@ PALLAS_MAX_SC = 8
 PALLAS_MAX_TERMS = 8
 PALLAS_MAX_PROFILES = 8
 
+# rebuilds a demoted backend must survive before the preferred backend
+# is retried (transient tunnel errors must not demote forever)
+DEMOTION_RETRY_REBUILDS = 3
+
 
 def _pallas_fits(batch) -> bool:
     return (
@@ -138,6 +142,12 @@ class SolverSession:
         # backend actually used for the current epoch (a wide constraint
         # space demotes pallas to the scan for that epoch only)
         self._active = self.backend
+        # demotion is NOT permanent: a transient runtime error (TPU-tunnel
+        # flake) looks the same as a compile failure from here, so after
+        # DEMOTION_RETRY_REBUILDS successful rebuilds on the demoted
+        # backend the preferred one gets another chance
+        self._preferred = self.backend
+        self._demote_cooldown = 0
         self._encoder: Optional[BatchEncoder] = None
         self._cluster: Optional[EncodedCluster] = None
         self._static = None   # device-resident solve-invariant arrays
@@ -275,6 +285,14 @@ class SolverSession:
         self._observe("encode", time.monotonic() - t0)
         from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
+        # a demoted backend earns retries of the preferred one: transient
+        # device errors (tunnel flakes) must not pin the session to a
+        # slower backend for its whole lifetime
+        if self.backend is not self._preferred:
+            self._demote_cooldown -= 1
+            if self._demote_cooldown <= 0:
+                self.backend = self._preferred
+
         # solve chain (clean-fallback contract, like an IsIgnorable
         # extender): preferred backend when the space fits it, then the
         # gather-free planes scan, then the legacy scan — which has no
@@ -310,8 +328,11 @@ class SolverSession:
                     backend.name, chain[i + 1].name,
                 )
                 if backend is self.backend:
-                    # don't re-pay a failing compile on every rebuild
+                    # don't re-pay a failing compile on every rebuild —
+                    # but retry the preferred backend after a few
+                    # successful rebuilds (the failure may be transient)
                     self.backend = chain[i + 1]
+                    self._demote_cooldown = DEMOTION_RETRY_REBUILDS
         self._observe("device", time.monotonic() - t0)
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before

@@ -195,7 +195,13 @@ class PodInfo:
         through queue → cache → encoder, so cache the PodInfo on the pod.
         The identity check guards against ``copy.copy`` propagating the
         memo to a new pod revision (the copied ``__dict__`` aliases it):
-        a hit requires the cached parse to belong to THIS object."""
+        a hit requires the cached parse to belong to THIS object.
+
+        CONTRACT: Pod objects are immutable once stored — every revision
+        is a fresh object (the store's copy-on-write updates, matching
+        the reference's serialize-over-the-wire boundary). A caller that
+        mutates a stored Pod's labels/containers in place would read a
+        stale parse here; don't."""
         pi = pod.__dict__.get("_pod_info")
         if pi is None or pi.pod is not pod:
             pi = cls(pod)

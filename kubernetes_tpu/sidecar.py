@@ -36,7 +36,7 @@ from kubernetes_tpu.ops.solver import SolverParams
 from kubernetes_tpu.scheduler.core import ScheduleResult
 from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
 from kubernetes_tpu.scheduler.scheduler import Scheduler
-from kubernetes_tpu.scheduler.types import QueuedPodInfo
+from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo
 
 
 class TPUBatchScheduler:
@@ -92,16 +92,27 @@ class TPUBatchScheduler:
         # assignments are discarded and its pods RE-SOLVED this cycle
         # (the solve below rebuilds from a fresh snapshot), keeping
         # them on the batch path instead of serializing up to
-        # max_batch pods
+        # max_batch pods. Carried-over pods go back through the SAME
+        # partition as freshly drained ones, against the live store
+        # object — the divergence that discarded the batch may be the
+        # pod itself being deleted or updated (e.g. gaining a PVC)
+        # while its batch was in flight.
         batchable: List[tuple] = []
         serial: List[QueuedPodInfo] = []
         if prev is not None and not self.session.mirror_current():
-            batchable = list(prev["batchable"])
-            prev = None
             qpis = []
+            for qpi, cycle in prev["batchable"]:
+                pod = qpi.pod
+                live = sched.client.get_pod(pod.namespace, pod.name)
+                if live is None or live.uid != pod.uid:
+                    continue  # deleted (and maybe recreated) in flight
+                if live is not pod:
+                    qpi.pod_info = PodInfo.of(live)
+                qpis.append((qpi, cycle))
+            prev = None
         else:
             qpis = self._drain(0.0 if prev is not None else pop_timeout)
-        processed = len(qpis) + len(batchable)
+        processed = len(qpis)
 
         # partition: batchable vs serial-fallback
         for qpi, cycle in qpis:
@@ -179,6 +190,15 @@ class TPUBatchScheduler:
         # and invalidate the mirror
         self.session.note_committed(committed, seq_anchor)
         return processed
+
+    def flush(self) -> int:
+        """Commit any held solved-but-uncommitted batch (the pipelining
+        tail): a run that stops pumping mid-stream must not strand popped
+        pods in ``_pending``. Returns the number of pods processed."""
+        total = 0
+        while self._pending is not None:
+            total += self.run_batch(pop_timeout=0.0)
+        return total
 
     def _run_serial(self, serial: List[QueuedPodInfo]) -> None:
         sched = self.sched

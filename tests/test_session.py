@@ -273,3 +273,105 @@ class TestPipelinedBatches:
                 counts[z] = counts.get(z, 0) + 1
         assert max(counts.values()) - min(counts.values()) <= 1
         sched.stop()
+
+
+class TestCarriedBatchRepartition:
+    def test_pod_deleted_in_flight_is_dropped_on_carry(self):
+        """ADVICE r1: a pod deleted while its solved batch was in flight
+        must be dropped when the discarded batch's pods are carried over,
+        not re-committed from a stale QueuedPodInfo."""
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "16Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store, max_batch=8)
+        for i in range(16):
+            store.create_pod(MakePod().name(f"p{i}").uid(f"u{i}")
+                             .req({"cpu": "1"}).obj())
+        bs.run_batch(pop_timeout=0.1)      # solve 8, hold pending
+        assert bs._pending is not None
+        victim = bs._pending["batchable"][0][0].pod
+        store.delete_pod(victim.namespace, victim.name)
+        # external cache mutation -> mirror diverges -> batch discarded,
+        # pods carried over through the fresh partition
+        store.add_node(
+            MakeNode().name("late").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+        )
+        drain(sched, bs)
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 15
+        assert victim.metadata.name not in {p.metadata.name for p in bound}
+        sched.stop()
+
+
+class _FlakyBackend:
+    """Delegating backend that fails its first N prepare() calls —
+    models a transient TPU-tunnel error during rebuild."""
+
+    name = "flaky"
+
+    def __init__(self, inner, fail_times):
+        self.inner = inner
+        self.fails_left = fail_times
+        self.attempts = 0
+
+    def prepare(self, cluster, batch):
+        self.attempts += 1
+        if self.fails_left > 0:
+            self.fails_left -= 1
+            raise RuntimeError("transient tunnel flake")
+        return self.inner.prepare(cluster, batch)
+
+    def solve(self, *a):
+        return self.inner.solve(*a)
+
+    def solve_lazy(self, *a):
+        return self.inner.solve_lazy(*a)
+
+    def materialize(self, h):
+        return self.inner.materialize(h)
+
+
+class TestDemotionRetry:
+    def test_transient_failure_does_not_demote_forever(self):
+        """ADVICE r1: a backend demoted by a (possibly transient) error
+        must be retried after DEMOTION_RETRY_REBUILDS successful rebuilds
+        instead of staying demoted for the session's lifetime."""
+        from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
+        from kubernetes_tpu.ops.session import DEMOTION_RETRY_REBUILDS
+
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "64", "memory": "64Gi"}).obj()
+            )
+        sched, bs = make_batch_scheduler(store, max_batch=4)
+        flaky = _FlakyBackend(XlaPlanesBackend(), fail_times=1)
+        bs.session.backend = flaky
+        bs.session._preferred = flaky
+
+        n = 0
+
+        def pump_one_rebuild():
+            nonlocal n
+            bs.session.invalidate()          # force a rebuild next batch
+            for i in range(4):
+                store.create_pod(MakePod().name(f"w{n}-{i}").uid(f"wu{n}-{i}")
+                                 .req({"cpu": "100m"}).obj())
+            n += 1
+            drain(sched, bs)
+
+        pump_one_rebuild()                   # rebuild 1: flaky fails, demoted
+        assert bs.session.backend.name != "flaky"
+        for _ in range(DEMOTION_RETRY_REBUILDS):
+            pump_one_rebuild()               # cooldown ticks down
+        # preferred backend retried and (flake over) sticks
+        assert bs.session.backend is flaky
+        assert bs.session._active is flaky
+        assert flaky.attempts >= 2
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 4 * (1 + DEMOTION_RETRY_REBUILDS)
+        sched.stop()
