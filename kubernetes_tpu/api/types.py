@@ -33,6 +33,8 @@ PENDING, RUNNING, SUCCEEDED, FAILED = "Pending", "Running", "Succeeded", "Failed
 
 _uid_counter = itertools.count(1)
 
+_EMPTY: Mapping = {}  # shared empty mapping for absent-key fast paths
+
 
 def shallow_copy(obj):
     """Fast shallow copy for API dataclasses. ``copy.copy`` routes
@@ -62,14 +64,20 @@ class ObjectMeta:
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "ObjectMeta":
-        return cls(
-            name=d.get("name", ""),
-            namespace=d.get("namespace", "default"),
-            uid=d.get("uid") or new_uid(),
-            labels=dict(d.get("labels") or {}),
-            annotations=dict(d.get("annotations") or {}),
-            owner_references=list(d.get("ownerReferences") or []),
-        )
+        # hot path (one per admitted object): direct construction, no
+        # dataclass kwarg processing
+        m = object.__new__(cls)
+        g = d.get
+        m.name = g("name", "")
+        m.namespace = g("namespace", "default")
+        m.uid = g("uid") or new_uid()
+        m.labels = dict(g("labels") or ())
+        m.annotations = dict(g("annotations") or ())
+        m.resource_version = ""
+        m.creation_timestamp = 0.0
+        m.deletion_timestamp = None
+        m.owner_references = list(g("ownerReferences") or ())
+        return m
 
 
 def _parse_resource_list(d: Optional[Mapping]) -> Dict[str, Quantity]:
@@ -100,11 +108,16 @@ class ResourceRequirements:
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "ResourceRequirements":
-        d = d or {}
-        return cls(
-            requests=_parse_resource_list(d.get("requests")),
-            limits=_parse_resource_list(d.get("limits")),
-        )
+        r = object.__new__(cls)
+        if d:
+            req = d.get("requests")
+            lim = d.get("limits")
+            r.requests = _parse_resource_list(req) if req else {}
+            r.limits = _parse_resource_list(lim) if lim else {}
+        else:
+            r.requests = {}
+            r.limits = {}
+        return r
 
 
 @dataclass
@@ -116,12 +129,14 @@ class Container:
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Container":
-        return cls(
-            name=d.get("name", ""),
-            image=d.get("image", ""),
-            resources=ResourceRequirements.from_dict(d.get("resources")),
-            ports=[ContainerPort.from_dict(p) for p in (d.get("ports") or [])],
-        )
+        c = object.__new__(cls)
+        g = d.get
+        c.name = g("name", "")
+        c.image = g("image", "")
+        c.resources = ResourceRequirements.from_dict(g("resources"))
+        ports = g("ports")
+        c.ports = [ContainerPort.from_dict(p) for p in ports] if ports else []
+        return c
 
 
 @dataclass
@@ -384,28 +399,42 @@ class PodSpec:
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "PodSpec":
-        return cls(
-            containers=[Container.from_dict(c) for c in (d.get("containers") or [])],
-            init_containers=[
-                Container.from_dict(c) for c in (d.get("initContainers") or [])
-            ],
-            overhead=_parse_resource_list(d.get("overhead")),
-            node_name=d.get("nodeName", ""),
-            node_selector=dict(d.get("nodeSelector") or {}),
-            affinity=Affinity.from_dict(d.get("affinity")),
-            tolerations=[Toleration.from_dict(t) for t in (d.get("tolerations") or [])],
-            scheduler_name=d.get("schedulerName") or "default-scheduler",
-            priority=d.get("priority"),
-            priority_class_name=d.get("priorityClassName", ""),
-            preemption_policy=d.get("preemptionPolicy") or "PreemptLowerPriority",
-            topology_spread_constraints=[
-                TopologySpreadConstraint.from_dict(t)
-                for t in (d.get("topologySpreadConstraints") or [])
-            ],
-            volumes=[Volume.from_dict(v) for v in (d.get("volumes") or [])],
-            host_network=bool(d.get("hostNetwork")),
-            restart_policy=d.get("restartPolicy") or "Always",
+        # hot path (one per admitted pod): direct construction with
+        # absent-key fast paths instead of dataclass kwarg processing
+        s = object.__new__(cls)
+        g = d.get
+        containers = g("containers")
+        s.containers = (
+            [Container.from_dict(c) for c in containers] if containers else []
         )
+        ic = g("initContainers")
+        s.init_containers = (
+            [Container.from_dict(c) for c in ic] if ic else []
+        )
+        ov = g("overhead")
+        s.overhead = _parse_resource_list(ov) if ov else {}
+        s.node_name = g("nodeName", "")
+        ns = g("nodeSelector")
+        s.node_selector = dict(ns) if ns else {}
+        aff = g("affinity")
+        s.affinity = Affinity.from_dict(aff) if aff else None
+        tol = g("tolerations")
+        s.tolerations = (
+            [Toleration.from_dict(t) for t in tol] if tol else []
+        )
+        s.scheduler_name = g("schedulerName") or "default-scheduler"
+        s.priority = g("priority")
+        s.priority_class_name = g("priorityClassName", "")
+        s.preemption_policy = g("preemptionPolicy") or "PreemptLowerPriority"
+        tsc = g("topologySpreadConstraints")
+        s.topology_spread_constraints = (
+            [TopologySpreadConstraint.from_dict(t) for t in tsc] if tsc else []
+        )
+        vols = g("volumes")
+        s.volumes = [Volume.from_dict(v) for v in vols] if vols else []
+        s.host_network = bool(g("hostNetwork"))
+        s.restart_policy = g("restartPolicy") or "Always"
+        return s
 
 
 @dataclass
@@ -427,11 +456,14 @@ class PodStatus:
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
-        d = d or {}
-        return cls(
-            phase=d.get("phase", PENDING),
-            nominated_node_name=d.get("nominatedNodeName", ""),
-        )
+        st = object.__new__(cls)
+        st.phase = d.get("phase", PENDING) if d else PENDING
+        st.conditions = []
+        st.nominated_node_name = d.get("nominatedNodeName", "") if d else ""
+        st.pod_ip = ""
+        st.host_ip = ""
+        st.start_time = 0.0
+        return st
 
 
 @dataclass
@@ -461,11 +493,11 @@ class Pod:
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Pod":
-        return cls(
-            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
-            spec=PodSpec.from_dict(d.get("spec") or {}),
-            status=PodStatus.from_dict(d.get("status")),
-        )
+        p = object.__new__(cls)
+        p.metadata = ObjectMeta.from_dict(d.get("metadata") or _EMPTY)
+        p.spec = PodSpec.from_dict(d.get("spec") or _EMPTY)
+        p.status = PodStatus.from_dict(d.get("status"))
+        return p
 
 
 @dataclass

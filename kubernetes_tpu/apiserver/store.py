@@ -57,9 +57,14 @@ class Event:
 
 
 class WatchHandle:
-    def __init__(self, store: "ClusterStore", fn: Callable[[Event], None]):
+    def __init__(self, store: "ClusterStore", fn: Callable[[Event], None],
+                 batch_fn: Optional[Callable[[List[Event]], None]] = None):
         self._store = store
         self.fn = fn
+        # optional bulk delivery: a watcher that can absorb a whole
+        # event batch under one of ITS locks registers batch_fn; the
+        # store's bulk mutators then deliver one call instead of N
+        self.batch_fn = batch_fn
 
     def stop(self) -> None:
         self._store._remove_watch(self)
@@ -106,9 +111,25 @@ class ClusterStore:
         for w in list(self._watches):
             w.fn(event)
 
-    def watch(self, fn: Callable[[Event], None]) -> WatchHandle:
+    def _dispatch_many(self, events: List[Event]) -> None:
+        """Deliver a batch of events, preserving per-watcher ordering.
+        Watchers that registered a batch_fn get ONE call (they fan the
+        batch out under a single lock acquisition on their side); plain
+        watchers see the same events one by one."""
+        if not events:
+            return
+        for w in list(self._watches):
+            if w.batch_fn is not None:
+                w.batch_fn(events)
+            else:
+                for e in events:
+                    w.fn(e)
+
+    def watch(self, fn: Callable[[Event], None],
+              batch_fn: Optional[Callable[[List[Event]], None]] = None
+              ) -> WatchHandle:
         with self._lock:
-            h = WatchHandle(self, fn)
+            h = WatchHandle(self, fn, batch_fn)
             self._watches.append(h)
             return h
 
@@ -130,6 +151,69 @@ class ClusterStore:
             self._pods[key] = pod
             self._dispatch(Event(ADDED, "Pod", pod))
             return pod
+
+    def create_pods(self, pods: List[Pod]) -> List[Pod]:
+        """Bulk pod admission: one lock acquisition and one batched watch
+        delivery for N creates. Each pod still gets its own resource
+        version and its own ADDED event — only the locking/dispatch
+        overhead is amortized (the 5000-QPS per-request discipline of the
+        reference harness, `util.go:63-68`, is an artifact of its HTTP
+        client, not a semantic requirement)."""
+        events: List[Event] = []
+        with self._lock:
+            # validate the whole batch before mutating anything: a mid-
+            # batch duplicate must not leave inserted-but-never-announced
+            # pods behind (watchers see all of the batch or none of it)
+            seen = set()
+            for pod in pods:
+                key = pod.full_name()
+                if key in self._pods or key in seen:
+                    raise ValueError(f"pod {key} already exists")
+                seen.add(key)
+            now = time.time()
+            for pod in pods:
+                if not pod.metadata.creation_timestamp:
+                    pod.metadata.creation_timestamp = now
+                pod.metadata.resource_version = self._next_rv()
+                self._pods[pod.full_name()] = pod
+                events.append(Event(ADDED, "Pod", pod))
+            self._dispatch_many(events)
+        return pods
+
+    def bind_many(
+        self, bindings: List[Tuple[str, str, str, str]]
+    ) -> List[Optional[Exception]]:
+        """Bulk Binding subresource: one lock + one batched watch delivery
+        for N (namespace, name, uid, node_name) bindings. Per-pod failures
+        (missing pod, uid mismatch, already bound) are returned
+        positionally instead of aborting the batch — each binding is its
+        own transaction, exactly as N sequential ``bind`` calls."""
+        errors: List[Optional[Exception]] = [None] * len(bindings)
+        events: List[Event] = []
+        with self._lock:
+            for i, (namespace, name, uid, node_name) in enumerate(bindings):
+                key = f"{namespace}/{name}"
+                pod = self._pods.get(key)
+                if pod is None:
+                    errors[i] = KeyError(f"pod {key} not found")
+                    continue
+                if uid and pod.uid != uid:
+                    errors[i] = ValueError(f"pod {key} uid mismatch")
+                    continue
+                if pod.spec.node_name and pod.spec.node_name != node_name:
+                    errors[i] = ValueError(
+                        f"pod {key} is already assigned to node "
+                        f"{pod.spec.node_name!r}")
+                    continue
+                new_pod = shallow_copy(pod)
+                new_pod.spec = shallow_copy(pod.spec)
+                new_pod.spec.node_name = node_name
+                new_pod.metadata = shallow_copy(pod.metadata)
+                new_pod.metadata.resource_version = self._next_rv()
+                self._pods[key] = new_pod
+                events.append(Event(MODIFIED, "Pod", new_pod, pod))
+            self._dispatch_many(events)
+        return errors
 
     def update_pod(self, pod: Pod) -> Pod:
         with self._lock:

@@ -113,6 +113,9 @@ def run_workload(
     progress: Optional[Callable[[str], None]] = None,
 ) -> BenchmarkResult:
     """Execute one workload (scheduler_perf_test.go:309 runWorkload)."""
+    from kubernetes_tpu.utils.gctune import tune_for_throughput
+
+    tune_for_throughput()
     store = ClusterStore()
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
     sched = Scheduler.create(store, feature_gates=gates)
@@ -204,11 +207,15 @@ def run_workload(
                     measured_pods = op["count"]
                     collector.start()
                 op_names = set()
-                for i in range(op["count"]):
-                    pod = Pod.from_dict(template(offset + i))
-                    op_names.add(pod.metadata.name)
-                    store.create_pod(pod)
-                    created_pods += 1
+                new_pods = [
+                    Pod.from_dict(template(offset + i))
+                    for i in range(op["count"])
+                ]
+                op_names.update(p.metadata.name for p in new_pods)
+                # bulk admission: one store lock + one batched watch
+                # delivery (queue.add_many) for the whole op
+                store.create_pods(new_pods)
+                created_pods += len(new_pods)
                 if progress:
                     progress(f"{name}: {created_pods} pods created")
                 if not op.get("skipWaitToCompletion", False):

@@ -115,8 +115,8 @@ class SchedulerMetrics:
             seconds, point, status, profile
         )
 
-    def pods_added(self, queue: str, event: str) -> None:
-        self.queue_incoming_pods.inc(queue, event)
+    def pods_added(self, queue: str, event: str, amount: float = 1.0) -> None:
+        self.queue_incoming_pods.inc(queue, event, amount=amount)
 
     def pods_moved(self, event: str) -> None:
         self.queue_incoming_pods.inc("active_or_backoff", event)

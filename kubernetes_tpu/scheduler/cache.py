@@ -167,6 +167,67 @@ class SchedulerCache:
             self._pod_states[key] = _PodState(pod)
             self._assumed_pods.add(key)
 
+    def assume_pods(self, pods: List[Pod]) -> List[Optional[str]]:
+        """Bulk assume under ONE lock (the batch commit path). Returns a
+        positional list of error messages (None = assumed). Semantics are
+        exactly N sequential ``assume_pod`` calls: one mutation_seq bump
+        per successful assume, per-pod already-cached failures."""
+        errors: List[Optional[str]] = [None] * len(pods)
+        with self._lock:
+            for i, pod in enumerate(pods):
+                key = get_pod_key(pod)
+                if key in self._pod_states:
+                    errors[i] = (
+                        f"pod {key} is in the cache, so can't be assumed"
+                    )
+                    continue
+                self._mutation_seq += 1
+                self._add_pod_locked(pod)
+                self._pod_states[key] = _PodState(pod)
+                self._assumed_pods.add(key)
+        return errors
+
+    def add_pods(self, pods: List[Pod]) -> None:
+        """Bulk informer-confirmed adds under one lock (the batched
+        bind-transition delivery): same per-pod semantics as add_pod."""
+        with self._lock:
+            for pod in pods:
+                self._add_pod_confirmed_locked(pod)
+
+    def _add_pod_confirmed_locked(self, pod: Pod) -> None:
+        key = get_pod_key(pod)
+        if key in self._assumed_pods:
+            state = self._pod_states[key]
+            if state.pod.spec.node_name != pod.spec.node_name:
+                # scheduler result differs from api truth: relocate
+                self._mutation_seq += 1
+                self._remove_pod_locked(state.pod)
+                self._add_pod_locked(pod)
+            self._assumed_pods.discard(key)
+            self._pod_states[key] = _PodState(pod)
+        elif key in self._pod_states:
+            # duplicate add: treat as update
+            self._mutation_seq += 1
+            self._update_pod_locked(self._pod_states[key].pod, pod)
+            self._pod_states[key] = _PodState(pod)
+        else:
+            self._mutation_seq += 1
+            self._add_pod_locked(pod)
+            self._pod_states[key] = _PodState(pod)
+
+    def finish_binding_many(self, pods: List[Pod],
+                            now: Optional[float] = None) -> None:
+        """Bulk finish_binding under one lock: starts the assumed-pod TTL
+        for every pod in the committed batch."""
+        deadline = (now if now is not None else self._now()) + self._ttl
+        with self._lock:
+            for pod in pods:
+                key = get_pod_key(pod)
+                state = self._pod_states.get(key)
+                if state is not None and key in self._assumed_pods:
+                    state.binding_finished = True
+                    state.deadline = deadline
+
     def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:
         key = get_pod_key(pod)
         with self._lock:
@@ -187,26 +248,8 @@ class SchedulerCache:
 
     def add_pod(self, pod: Pod) -> None:
         """Informer-confirmed pod add (cache.go AddPod)."""
-        key = get_pod_key(pod)
         with self._lock:
-            if key in self._assumed_pods:
-                state = self._pod_states[key]
-                if state.pod.spec.node_name != pod.spec.node_name:
-                    # scheduler result differs from api truth: relocate
-                    self._mutation_seq += 1
-                    self._remove_pod_locked(state.pod)
-                    self._add_pod_locked(pod)
-                self._assumed_pods.discard(key)
-                self._pod_states[key] = _PodState(pod)
-            elif key in self._pod_states:
-                # duplicate add: treat as update
-                self._mutation_seq += 1
-                self._update_pod_locked(self._pod_states[key].pod, pod)
-                self._pod_states[key] = _PodState(pod)
-            else:
-                self._mutation_seq += 1
-                self._add_pod_locked(pod)
-                self._pod_states[key] = _PodState(pod)
+            self._add_pod_confirmed_locked(pod)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         key = get_pod_key(old)

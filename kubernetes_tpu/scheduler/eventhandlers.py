@@ -30,6 +30,54 @@ class EventHandlers:
         return pod.spec.scheduler_name in self.sched.profiles
 
     # ------------------------------------------------------------------
+    def handle_many(self, events) -> None:
+        """Batched watch delivery (the store's ``_dispatch_many``): runs
+        of homogeneous pod events collapse to one lock acquisition on the
+        cache/queue side, while ordering relative to any other event kind
+        is preserved by flushing the pending run first. The two runs that
+        matter at throughput scale are bind transitions (commit) and
+        unassigned adds (admission)."""
+        sched = self.sched
+        bind_run = []   # Pods newly assigned (MODIFIED, old unassigned)
+        add_run = []    # unassigned schedulable ADDED pods
+
+        def flush():
+            if bind_run:
+                sched.cache.add_pods(bind_run)
+                sched.queue.delete_many(bind_run)
+                sched.queue.assigned_pods_updated(bind_run)
+                bind_run.clear()
+            if add_run:
+                sched.queue.add_many(add_run)
+                add_run.clear()
+
+        for event in events:
+            if event.kind == "Pod":
+                pod = event.obj
+                if (
+                    event.type == MODIFIED
+                    and assigned(pod)
+                    and event.old_obj is not None
+                    and not assigned(event.old_obj)
+                ):
+                    if add_run:
+                        flush()
+                    bind_run.append(pod)
+                    continue
+                if (
+                    event.type == ADDED
+                    and not assigned(pod)
+                    and schedulable(pod)
+                    and self.responsible_for(pod)
+                ):
+                    if bind_run:
+                        flush()
+                    add_run.append(pod)
+                    continue
+            flush()
+            self.handle(event)
+        flush()
+
     def handle(self, event: Event) -> None:
         kind = event.kind
         if kind == "Pod":

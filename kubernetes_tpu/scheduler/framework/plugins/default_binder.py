@@ -24,3 +24,21 @@ class DefaultBinder(BindPlugin):
         except Exception as e:  # surface as Error status like the reference
             return Status(1, str(e))
         return None
+
+    def bind_many(self, states, pods, node_names) -> list:
+        """Bulk Binding for the batch commit path: one ``bind_many`` call
+        to the store (one lock + one batched watch delivery) instead of
+        N round-trips. Each binding remains its own transaction; per-pod
+        failures come back positionally as Error statuses."""
+        try:
+            errors = self.handle.client.bind_many([
+                (p.namespace, p.name, p.uid, node)
+                for p, node in zip(pods, node_names)
+            ])
+        except Exception as e:  # noqa: BLE001 — batch-level failure (e.g.
+            # a watcher raising during the synchronous dispatch) must
+            # surface as per-pod Error statuses, like serial bind's
+            # try/except, so the caller unwinds instead of stranding
+            # assumed pods
+            return [Status(1, str(e))] * len(pods)
+        return [None if e is None else Status(1, str(e)) for e in errors]

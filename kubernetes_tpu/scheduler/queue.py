@@ -145,6 +145,52 @@ class SchedulingQueue(PodNominator):
                 self._metrics.pods_added("active", "PodAdd")
             self._cond.notify_all()
 
+    def add_many(self, pods: List[Pod]) -> None:
+        """Bulk add under ONE lock + one wakeup (the batched-admission
+        delivery path). Per-pod semantics identical to ``add``."""
+        if not pods:
+            return
+        with self._cond:
+            for pod in pods:
+                qpi = self._new_queued_pod_info(pod)
+                self._active_q.add(qpi)
+                key = get_pod_key(pod)
+                self._unschedulable_q.pop(key, None)
+                self._backoff_q.delete_by_key(key)
+                self.add_nominated_pod(pod)
+            if self._metrics:
+                self._metrics.pods_added("active", "PodAdd", amount=len(pods))
+            self._cond.notify_all()
+
+    def delete_many(self, pods: List[Pod]) -> None:
+        """Bulk delete under one lock (batched bind-transition delivery)."""
+        if not pods:
+            return
+        with self._cond:
+            for pod in pods:
+                key = get_pod_key(pod)
+                self.delete_nominated_pod_if_exists(pod)
+                self._active_q.delete_by_key(key)
+                self._backoff_q.delete_by_key(key)
+                self._unschedulable_q.pop(key, None)
+
+    def assigned_pods_updated(self, pods: List[Pod]) -> None:
+        """Bulk affinity-wakeup scan under one lock: same per-pod
+        semantics as N assigned_pod_updated calls (each assigned pod is
+        matched against the unschedulable pods' affinity terms)."""
+        with self._cond:
+            if not self._unschedulable_q:
+                # the serial path's _move_pods_locked updates the move-
+                # request cycle even when nothing moves; the race
+                # protocol (scheduling_queue.go:317) depends on it
+                self._move_request_cycle = self.scheduling_cycle
+                return
+            for pod in pods:
+                self._move_pods_locked(
+                    self._unschedulable_pods_with_matching_affinity(pod),
+                    "AssignedPodUpdate",
+                )
+
     def _new_queued_pod_info(self, pod: Pod) -> QueuedPodInfo:
         # carry attempts across queue hops if known
         key = get_pod_key(pod)

@@ -177,7 +177,10 @@ class Scheduler:
         """Wire event handlers and start background machinery (the
         informer-start + queue.Run portion of Run, scheduler.go:311)."""
         if self._watch_handle is None:
-            self._watch_handle = self.client.watch(self.event_handlers.handle)
+            self._watch_handle = self.client.watch(
+                self.event_handlers.handle,
+                batch_fn=self.event_handlers.handle_many,
+            )
         # replay current state (the initial List of ListAndWatch)
         for node in self.client.list_nodes():
             self.cache.add_node(node)
@@ -373,6 +376,157 @@ class Scheduler:
                 pod_scheduling_cycle, start,
             )
         return False
+
+    def commit_assignments_bulk(
+        self, fwk: Framework, commits: List[tuple]
+    ) -> tuple:
+        """Commit a whole solved batch: the semantics of N
+        ``commit_assignment(..., sync_bind=True)`` calls with the
+        per-pod O(lock + dispatch) overheads amortized — bulk assume
+        (one cache lock), bulk bind (one store lock + one batched watch
+        delivery), bulk finish-binding. Every per-pod framework hook
+        (Reserve, Permit, WaitOnPermit, PreBind, PostBind) still runs
+        per pod in order; pods whose Permit returns WAIT drop to the
+        async binding cycle exactly as in the serial path.
+
+        ``commits``: list of (qpi, result, cycle, start). Returns
+        (committed, failed) where failed counts pods that were assumed
+        but then rejected host-side (the caller's device-mirror
+        accounting needs to know)."""
+        # --- assume (bulk): share the queue's parse via PodInfo.derived
+        prepared: List[tuple] = []
+        assumed_pods: List[Pod] = []
+        for qpi, result, cycle, start in commits:
+            pod = qpi.pod
+            assumed = shallow_copy(pod)
+            assumed.spec = shallow_copy(pod.spec)
+            assumed.spec.node_name = result.suggested_host
+            PodInfo.derived(assumed, qpi.pod_info)
+            prepared.append((qpi, result, cycle, start, assumed))
+            assumed_pods.append(assumed)
+        errors = self.cache.assume_pods(assumed_pods)
+        live: List[tuple] = []
+        for item, err in zip(prepared, errors):
+            if err is None:
+                live.append(item)
+                self.queue.delete_nominated_pod_if_exists(item[0].pod)
+            else:
+                self._record_failure(fwk, item[0], ValueError(err),
+                                     "SchedulerError", "", item[2])
+        failed = len(prepared) - len(live)
+
+        # --- Reserve + Permit (per-pod hook contract)
+        has_reserve = bool(fwk.reserve_plugins)
+        has_permit = bool(fwk.permit_plugins)
+        has_pre_bind = bool(fwk.pre_bind_plugins)
+        has_post_bind = bool(fwk.post_bind_plugins)
+        sync_items: List[tuple] = []   # (qpi, result, cycle, start, assumed, state)
+        for qpi, result, cycle, start, assumed in live:
+            state = CycleState()
+            if has_reserve:
+                status = fwk.run_reserve_plugins_reserve(
+                    state, assumed, result.suggested_host)
+                if not fw.Status.is_ok(status):
+                    self._forget_and_fail(fwk, state, qpi, assumed, result,
+                                          status.as_error(), cycle)
+                    failed += 1
+                    continue
+            if has_permit:
+                status = fwk.run_permit_plugins(state, assumed,
+                                                result.suggested_host)
+                if status is not None and status.code not in (fw.SUCCESS,
+                                                              fw.WAIT):
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, status.as_error(),
+                                                cycle)
+                    failed += 1
+                    continue
+                if status is not None and status.code == fw.WAIT:
+                    # gang/permit-parked pods bind asynchronously
+                    with self._inflight_lock:
+                        self._inflight_bindings += 1
+                    self.metrics.goroutines.inc("binding")
+                    self._bind_pool.submit(
+                        self._binding_cycle, fwk, state, qpi, assumed,
+                        result, cycle, start,
+                    )
+                    continue
+            sync_items.append((qpi, result, cycle, start, assumed, state))
+
+        # --- PreBind (per pod), then bulk Bind
+        bindable: List[tuple] = []
+        for qpi, result, cycle, start, assumed, state in sync_items:
+            if has_permit:
+                # permit returned SUCCESS; WaitOnPermit is then a cheap
+                # no-waiting-pod lookup, kept for hook-order parity
+                status = fwk.wait_on_permit(assumed)
+                if not fw.Status.is_ok(status):
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, status.as_error(),
+                                                cycle)
+                    failed += 1
+                    continue
+            if has_pre_bind:
+                status = fwk.run_pre_bind_plugins(state, assumed,
+                                                  result.suggested_host)
+                if not fw.Status.is_ok(status):
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, status.as_error(),
+                                                cycle)
+                    failed += 1
+                    continue
+            bindable.append((qpi, result, cycle, start, assumed, state))
+
+        # extender binders (rare) take the per-pod path; the rest bind
+        # in one bulk call
+        ext_binders = [e for e in self.algorithm.extenders if e.is_binder()]
+        bulk: List[tuple] = []
+        committed = 0
+        for item in bindable:
+            qpi, result, cycle, start, assumed, state = item
+            if ext_binders and any(e.is_interested(assumed)
+                                   for e in ext_binders):
+                err = self._bind(fwk, state, assumed, result.suggested_host)
+                if err is not None:
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, err, cycle)
+                    failed += 1
+                else:
+                    self._observe_scheduled(fwk, qpi, start)
+                    committed += 1
+            else:
+                bulk.append(item)
+        if bulk:
+            statuses = fwk.run_bind_plugins_bulk(
+                [i[5] for i in bulk], [i[4] for i in bulk],
+                [i[1].suggested_host for i in bulk],
+            )
+            bound: List[Pod] = []
+            for item, status in zip(bulk, statuses):
+                qpi, result, cycle, start, assumed, state = item
+                if not fw.Status.is_ok(status):
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, status.as_error(),
+                                                cycle)
+                    failed += 1
+                    continue
+                bound.append(assumed)
+                if has_post_bind:
+                    fwk.run_post_bind_plugins(state, assumed,
+                                              result.suggested_host)
+                self._observe_scheduled(fwk, qpi, start)
+                committed += 1
+            self.cache.finish_binding_many(bound)
+        return committed, failed
+
+    def _observe_scheduled(self, fwk: Framework, qpi: QueuedPodInfo,
+                           start: float) -> None:
+        now = time.monotonic()
+        self.metrics.e2e_scheduling_duration.observe(now - start, "scheduled")
+        self.metrics.schedule_attempts.inc("scheduled", fwk.profile_name)
+        self.metrics.pod_scheduling_attempts.observe(qpi.attempts)
+        self.metrics.pod_scheduling_duration.observe(
+            now - qpi.initial_attempt_timestamp, str(qpi.attempts))
 
     # ------------------------------------------------------------------
     def _binding_cycle(

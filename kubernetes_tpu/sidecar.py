@@ -299,6 +299,7 @@ class TPUBatchScheduler:
         t0 = time.monotonic()
         committed = 0
         declined: List[tuple] = []  # (batch index, qpi, cycle)
+        commits: List[tuple] = []   # (qpi, result, cycle, start)
         for bi, ((qpi, cycle), assignment) in enumerate(
             zip(batchable, assignments)
         ):
@@ -316,11 +317,10 @@ class TPUBatchScheduler:
                 evaluated_nodes=cluster.num_real_nodes,
                 feasible_nodes=1,
             )
-            state = CycleState()
-            if sched.commit_assignment(fwk, state, qpi, result, cycle, start,
-                                       sync_bind=True):
-                committed += 1
-            else:
+            commits.append((qpi, result, cycle, start))
+        if commits:
+            committed, failed = sched.commit_assignments_bulk(fwk, commits)
+            if failed:
                 # committed on device, rejected on host: mirrors diverged
                 self.session.invalidate()
         # Declined pods: with a FEW, re-run the serial path for its exact
