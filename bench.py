@@ -5,11 +5,16 @@ Measures the headline metric from BASELINE.json — pods scheduled/sec at
 serial path measured on the same cluster (the stock-scheduler stand-in;
 BASELINE.md: "absolute reference numbers must be measured, not cited").
 
-Prints ONE JSON line:
+Default prints ONE JSON line (the driver contract):
     {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
 
 Options (all optional):
     --config {1..5}   BASELINE.json config to run (default: headline 5k/30k)
+    --all             run the whole matrix (configs 1-5 + Preemption,
+                      Unschedulable, Mixed, PV families at 5k nodes);
+                      one JSON line PER workload, headline line LAST
+                      (reference emits per-workload DataItems,
+                      scheduler_perf/util.go:101-129)
     --quick           small scale smoke (CI-sized)
     --skip-serial     reuse the last recorded serial baseline
 """
@@ -25,7 +30,9 @@ from kubernetes_tpu.harness import make_workload, run_workload
 
 # measured host-serial baselines (pods/s), updated by full runs
 RECORDED_SERIAL_BASELINE = {
-    "default": 40.0,   # 5k nodes, python serial path, measured 2026-07-30
+    # 5k nodes, python serial path; re-measured 2026-07-30 after the
+    # round-2 host-path work (bulk admission + from_dict + GC tuning)
+    "default": 61.7,
 }
 
 CONFIGS = {
@@ -38,18 +45,103 @@ CONFIGS = {
     "headline": ("SchedulingBasic", 5000, 0, 30000),
 }
 
+# the --all matrix: the five BASELINE configs plus the families VERDICT
+# r1 called out as unmeasured (Preemption, Unschedulable, Mixed, PVs)
+EXTRA_MATRIX = {
+    "preemption": ("Preemption", 5000, 20000, 5000),
+    "unschedulable": ("Unschedulable", 5000, 0, 10000),
+    "mixed": ("MixedSchedulingBasePod", 5000, 1000, 30000),
+    "csipvs": ("SchedulingCSIPVs", 1000, 0, 5000),
+}
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def run_one(key: str, name: str, nodes: int, init_pods: int,
+            measure_pods: int, serial_rate: float) -> dict:
+    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                        measure_pods=measure_pods)
+    t0 = time.time()
+    batch = run_workload(f"{name}/batch", ops, use_batch=True,
+                         max_batch=min(measure_pods, 8192),
+                         wait_timeout=1200, progress=log)
+    log(f"[{key}] batch: {batch.pods_per_second:.1f} pods/s "
+        f"(wall {time.time() - t0:.1f}s, p99 latency "
+        f"{batch.metrics.get('Perc99', 0):.0f}ms)")
+    return {
+        "metric": f"pods_scheduled_per_sec[{name} {nodes}nodes/"
+                  f"{measure_pods}pods, TPU batch path]",
+        "value": round(batch.pods_per_second, 1),
+        "unit": "pods/s",
+        "p99_latency_ms": round(batch.metrics.get("Perc99", 0)),
+        "vs_baseline": round(
+            batch.pods_per_second / serial_rate, 2
+        ) if serial_rate > 0 else 0.0,
+    }
+
+
+def measure_serial(name: str, nodes: int, measure_pods: int,
+                   serial_pods: int) -> float:
+    serial_pods = min(serial_pods, measure_pods)
+    ops = make_workload(name, nodes=nodes, init_pods=0,
+                        measure_pods=serial_pods)
+    t0 = time.time()
+    serial = run_workload(f"{name}/serial", ops, use_batch=False,
+                          wait_timeout=600, progress=log)
+    log(f"serial baseline: {serial.pods_per_second:.1f} pods/s "
+        f"({serial_pods} pods, wall {time.time() - t0:.1f}s)")
+    return serial.pods_per_second
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="headline", choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--skip-serial", action="store_true")
     ap.add_argument("--serial-pods", type=int, default=300)
     args = ap.parse_args()
+
+    if args.all:
+        # ONE serial denominator for the whole matrix — the headline
+        # SchedulingBasic serial rate (each row notes this explicitly;
+        # --config N standalone instead measures that workload's own
+        # serial rate, so the ratios are labeled to stay comparable)
+        serial_rate = RECORDED_SERIAL_BASELINE["default"]
+        if not args.skip_serial:
+            name, nodes, _, measure_pods = CONFIGS["headline"]
+            if args.quick:
+                nodes, measure_pods = 200, 1000
+            serial_rate = measure_serial(name, nodes, measure_pods,
+                                         args.serial_pods)
+        matrix = {k: CONFIGS[k] for k in ("1", "2", "3", "4", "5")}
+        matrix.update(EXTRA_MATRIX)
+        # headline LAST: the driver records the final JSON line
+        matrix["headline"] = CONFIGS["headline"]
+        for key, (name, nodes, init_pods, measure_pods) in matrix.items():
+            if args.quick:
+                nodes, init_pods, measure_pods = (
+                    200, min(init_pods, 200), 1000,
+                )
+            try:
+                row = run_one(key, name, nodes, init_pods,
+                              measure_pods, serial_rate)
+            except Exception as e:  # noqa: BLE001 — one workload failing
+                # must not lose the rest of the matrix (nor leave a
+                # non-headline line last)
+                log(f"[{key}] FAILED: {e}")
+                row = {
+                    "metric": f"pods_scheduled_per_sec[{name} {nodes}nodes/"
+                              f"{measure_pods}pods, TPU batch path]",
+                    "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                    "error": str(e),
+                }
+            if key != "headline":
+                row["baseline"] = "SchedulingBasic 5k-node serial rate"
+            print(json.dumps(row), flush=True)
+        return
 
     name, nodes, init_pods, measure_pods = CONFIGS[args.config]
     if args.quick:
@@ -60,39 +152,11 @@ def main() -> None:
         serial_rate = RECORDED_SERIAL_BASELINE["default"]
         log(f"serial baseline (recorded): {serial_rate:.1f} pods/s")
     else:
-        serial_pods = min(args.serial_pods, measure_pods)
-        ops = make_workload(name, nodes=nodes, init_pods=0,
-                            measure_pods=serial_pods)
-        t0 = time.time()
-        serial = run_workload(f"{name}/serial", ops, use_batch=False,
-                              wait_timeout=600, progress=log)
-        serial_rate = serial.pods_per_second
-        log(f"serial baseline: {serial_rate:.1f} pods/s "
-            f"({serial_pods} pods, wall {time.time() - t0:.1f}s)")
+        serial_rate = measure_serial(name, nodes, measure_pods,
+                                     args.serial_pods)
 
-    # --- TPU batch path --------------------------------------------------
-    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
-                        measure_pods=measure_pods)
-    t0 = time.time()
-    # chunked batches: early chunks bind while later pods are still
-    # queued, keeping p99 schedule-latency bounded at high throughput
-    batch = run_workload(f"{name}/batch", ops, use_batch=True,
-                         max_batch=min(measure_pods, 8192),
-                         wait_timeout=1200, progress=log)
-    log(f"batch: {batch.pods_per_second:.1f} pods/s "
-        f"(wall {time.time() - t0:.1f}s, p99 latency "
-        f"{batch.metrics.get('Perc99', 0):.0f}ms)")
-
-    result = {
-        "metric": f"pods_scheduled_per_sec[{name} {nodes}nodes/"
-                  f"{measure_pods}pods, TPU batch path]",
-        "value": round(batch.pods_per_second, 1),
-        "unit": "pods/s",
-        "vs_baseline": round(
-            batch.pods_per_second / serial_rate, 2
-        ) if serial_rate > 0 else 0.0,
-    }
-    print(json.dumps(result), flush=True)
+    print(json.dumps(run_one(args.config, name, nodes, init_pods,
+                             measure_pods, serial_rate)), flush=True)
 
 
 if __name__ == "__main__":

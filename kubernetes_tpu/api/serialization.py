@@ -48,6 +48,7 @@ SCHEME: Dict[str, type] = {
         "StorageClass",
         "CSINode",
         "PodDisruptionBudget",
+        "Event",
     )
 }
 

@@ -801,3 +801,45 @@ class CSINodeDriver:
 class CSINode:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     drivers: List[CSINodeDriver] = field(default_factory=list)
+
+
+@dataclass
+class ObjectReference:
+    """Reference to another API object (core/v1 ObjectReference — the
+    Event's involvedObject)."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+def object_reference(obj) -> "ObjectReference":
+    return ObjectReference(
+        kind=type(obj).__name__,
+        namespace=getattr(obj.metadata, "namespace", ""),
+        name=obj.metadata.name,
+        uid=obj.metadata.uid,
+    )
+
+
+@dataclass
+class Event:
+    """Kubernetes Event (core/v1 Event): the operator's primary debugging
+    surface. The reference scheduler records FailedScheduling on every
+    schedule failure (pkg/scheduler/scheduler.go:331 via
+    recordSchedulingFailure), Scheduled on every bind, and Preempted on
+    every eviction (pkg/scheduler/framework/plugins/defaultpreemption/
+    default_preemption.go:698). Correlated occurrences aggregate into one
+    object with a bumped ``count`` (client-side, like client-go
+    tools/record)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"           # Normal | Warning
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    source_component: str = ""

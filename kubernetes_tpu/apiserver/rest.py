@@ -61,6 +61,7 @@ PLURALS: Dict[str, str] = {
     "storageclasses": "StorageClass",
     "csinodes": "CSINode",
     "poddisruptionbudgets": "PodDisruptionBudget",
+    "events": "Event",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 

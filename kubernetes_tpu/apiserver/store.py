@@ -27,6 +27,7 @@ from kubernetes_tpu.api.types import (
     shallow_copy,
     Deployment,
     Endpoints,
+    Event as ApiEvent,
     Job,
     Node,
     PersistentVolume,
@@ -99,6 +100,10 @@ class ClusterStore:
         self._daemon_sets: Dict[str, DaemonSet] = {}
         self._jobs: Dict[str, Job] = {}
         self._leases: Dict[str, _Lease] = {}
+        self._api_events: Dict[str, ApiEvent] = {}
+        # Event objects expire (reference: etcd lease TTL on events,
+        # --event-ttl=1h on the apiserver)
+        self.event_ttl = 3600.0
         self._watches: List[WatchHandle] = []
         self._assumed_pvs: Dict[str, str] = {}  # pv name -> pvc key (Reserve)
 
@@ -545,7 +550,41 @@ class ClusterStore:
         "StorageClass": ("_storage_classes", False),
         "CSINode": ("_csi_nodes", False),
         "PodDisruptionBudget": ("_pdbs", True),
+        "Event": ("_api_events", True),
     }
+
+    # ------------------------------------------------------------------
+    # Event objects (the operator's debugging surface)
+    def list_events(self, namespace: Optional[str] = None,
+                    involved_name: Optional[str] = None):
+        with self._lock:
+            out = []
+            for ev in self._api_events.values():
+                if namespace is not None and ev.metadata.namespace != namespace:
+                    continue
+                if involved_name is not None and \
+                        ev.involved_object.name != involved_name:
+                    continue
+                out.append(ev)
+            return out
+
+    def prune_expired_events(self, now: Optional[float] = None) -> int:
+        """Drop Event objects past their TTL (reference --event-ttl).
+        Called periodically by the EventRecorder's flush loop."""
+        now = now if now is not None else time.time()
+        removed = 0
+        with self._lock:
+            stale = [
+                key for key, ev in self._api_events.items()
+                if now - (ev.last_timestamp or ev.metadata.creation_timestamp)
+                > self.event_ttl
+            ]
+            for key in stale:
+                old = self._api_events.pop(key)
+                old.metadata.resource_version = self._next_rv()
+                self._dispatch(Event(DELETED, "Event", old))
+                removed += 1
+        return removed
 
     def _table_key(self, kind: str, namespace: str, name: str):
         attr, namespaced = self._KIND_TABLES[kind]

@@ -49,6 +49,7 @@ _KIND_ALIASES = {
     "csinode": "CSINode", "csinodes": "CSINode",
     "pdb": "PodDisruptionBudget", "poddisruptionbudget": "PodDisruptionBudget",
     "poddisruptionbudgets": "PodDisruptionBudget",
+    "ev": "Event", "event": "Event", "events": "Event",
 }
 
 
@@ -110,11 +111,26 @@ def _generic_row(obj, wide: bool):
     return [obj.metadata.name, _age(obj.metadata)]
 
 
+def _event_row(e, wide: bool):
+    last = e.last_timestamp or e.metadata.creation_timestamp
+    s = int(max(0, time.time() - last))
+    age = f"{s}s" if s < 120 else f"{s // 60}m"
+    obj = f"{e.involved_object.kind.lower()}/{e.involved_object.name}"
+    row = [age, e.type, e.reason, obj, e.message]
+    if wide:
+        row.insert(4, e.source_component)
+        row.append(str(e.count))
+    return row
+
+
 _ROWS = {
     "Pod": (["NAME", "READY", "STATUS", "AGE"],
             ["NAME", "READY", "STATUS", "AGE", "IP", "NODE"], _pod_row),
     "Node": (["NAME", "STATUS", "AGE"],
              ["NAME", "STATUS", "AGE", "CPU", "MEMORY"], _node_row),
+    "Event": (["LAST SEEN", "TYPE", "REASON", "OBJECT", "MESSAGE"],
+              ["LAST SEEN", "TYPE", "REASON", "OBJECT", "SOURCE",
+               "MESSAGE", "COUNT"], _event_row),
 }
 
 
@@ -161,6 +177,22 @@ class Kubectl:
 
         print(yaml.safe_dump(doc, sort_keys=False, default_flow_style=False),
               file=self.out)
+        # Events section (reference kubectl describe: related events last)
+        if kind != "Event":
+            try:
+                events, _ = self.client.list("Event", namespace)
+            except Exception:  # noqa: BLE001 — older servers without events
+                events = []
+            related = [
+                e for e in events
+                if e.involved_object.kind == kind
+                and e.involved_object.name == name
+            ]
+            if related:
+                print("Events:", file=self.out)
+                _table(["TYPE", "REASON", "MESSAGE", "COUNT"],
+                       [[e.type, e.reason, e.message, str(e.count)]
+                        for e in related], self.out)
         return 0
 
     def _load_manifests(self, path: str) -> List[Any]:

@@ -1,0 +1,154 @@
+"""EventRecorder: async, aggregating event recording.
+
+Behavioral equivalent of the reference's client-go ``tools/record``
+(EventBroadcaster + recorderImpl, used by the scheduler at
+``pkg/scheduler/scheduler.go:331,423`` and preemption at
+``default_preemption.go:698``): hot paths enqueue and return immediately;
+a background flush thread writes Event objects through the store.
+Correlated occurrences (same object + type + reason + message) aggregate
+into a single Event with a bumped ``count`` — the reference's
+EventAggregator/eventLogger correlation — and the queue is bounded, so a
+misbehaving hot loop degrades to dropped events rather than back-pressure
+(the broadcaster's full-channel drop, i.e. event-spam protection).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+from kubernetes_tpu.api.types import Event, ObjectMeta, object_reference
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+_PRUNE_INTERVAL = 60.0
+
+
+class EventRecorder:
+    def __init__(self, client, component: str, queue_cap: int = 8192,
+                 flush_interval: float = 0.2):
+        self.client = client
+        self.component = component
+        self._queue: deque = deque()
+        self._cap = queue_cap
+        self._flush_interval = flush_interval
+        self.dropped = 0
+        # correlation cache: key -> Event name in the store
+        self._correlated: dict = {}
+        self._lock = threading.Lock()
+        # serializes whole flush passes: external flush_now callers
+        # (tests, shutdown) race the background loop otherwise, and
+        # _write's correlation cache is not safe under two writers
+        self._flush_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._seq = 0
+        self._last_prune = 0.0
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        """Record an event against ``obj``. Non-blocking: enqueues for the
+        flush thread (recorderImpl.Event → broadcaster channel)."""
+        with self._lock:
+            if len(self._queue) >= self._cap:
+                self.dropped += 1   # full channel: drop, never block
+                return
+            self._queue.append(
+                (object_reference(obj), event_type, reason, message,
+                 time.time())
+            )
+        self._wake.set()
+
+    def eventf(self, obj, event_type: str, reason: str,
+               fmt: str, *args) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"events-{self.component}"
+        )
+        self._thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if flush:
+            self.flush_now()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self._flush_interval)
+            self._wake.clear()
+            try:
+                with self._flush_lock:
+                    self._flush_locked()
+            except Exception:  # pragma: no cover — recording must never
+                pass           # take down the component
+
+    # ------------------------------------------------------------------
+    def flush_now(self) -> int:
+        """Drain the queue synchronously (tests and shutdown)."""
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> int:
+        with self._lock:
+            items = list(self._queue)
+            self._queue.clear()
+        for ref, etype, reason, message, ts in items:
+            self._write(ref, etype, reason, message, ts)
+        now = time.time()
+        if items and now - self._last_prune > _PRUNE_INTERVAL:
+            self._last_prune = now
+            prune = getattr(self.client, "prune_expired_events", None)
+            if prune is not None:
+                prune(now)
+        return len(items)
+
+    def _write(self, ref, etype: str, reason: str, message: str,
+               ts: float) -> None:
+        # cluster-scoped objects have no namespace; their events live in
+        # "default" — the SAME namespace for create and re-lookup, or
+        # aggregation silently never hits
+        ns = ref.namespace or "default"
+        key: Tuple = (ref.kind, ns, ref.name, ref.uid, etype,
+                      reason, message)
+        name = self._correlated.get(key)
+        if name is not None:
+            existing = self.client.get_object("Event", ns, name)
+            if existing is not None and existing.involved_object.uid == ref.uid:
+                existing.count += 1
+                existing.last_timestamp = ts
+                self.client.update_object("Event", existing)
+                return
+            del self._correlated[key]
+        self._seq += 1
+        name = f"{ref.name}.{int(ts * 1e6):x}.{self._seq:x}"
+        ev = Event(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            involved_object=ref,
+            reason=reason,
+            message=message,
+            type=etype,
+            count=1,
+            first_timestamp=ts,
+            last_timestamp=ts,
+            source_component=self.component,
+        )
+        try:
+            self.client.create_object("Event", ev)
+            self._correlated[key] = name
+            if len(self._correlated) > 4096:   # bounded correlation cache
+                self._correlated.pop(next(iter(self._correlated)))
+        except ValueError:
+            pass  # name collision: drop (unique enough in practice)

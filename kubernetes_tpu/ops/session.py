@@ -223,7 +223,7 @@ class SolverSession:
 
     # ------------------------------------------------------------------
     def solve(self, pods: List, warming: bool = False, lazy: bool = False,
-              incremental_only: bool = False
+              incremental_only: bool = False, pad_to: Optional[int] = None,
               ) -> Optional[Tuple[object, EncodedCluster, int]]:
         """Solve one batch. Returns (assignments, cluster, seq_before)
         where assignments map batch index → node index in
@@ -235,13 +235,18 @@ class SolverSession:
         host work overlaps the asynchronously-dispatched device solve.
         With ``incremental_only`` the call returns None instead of
         rebuilding (the pipelined caller must commit its in-flight batch
-        before a rebuild, or the fresh snapshot would miss it)."""
+        before a rebuild, or the fresh snapshot would miss it).
+        ``pad_to`` overrides the padded batch shape (the sidecar's
+        latency-budget chunking: the scan length — and so the per-batch
+        device latency — is the PADDED size, not the real pod count;
+        each distinct pad size is its own compiled executable)."""
         self._warming = warming
         self._profile_tick()
+        pad = pad_to or self.max_batch
         seq_before = self.sched.cache.mutation_seq
         if self._state is not None and seq_before == self._last_seq:
             t0 = time.monotonic()
-            pb = self._encoder.encode_pods_only(pods, self.max_batch)
+            pb = self._encoder.encode_pods_only(pods, pad)
             if pb is not None and pb.requests.shape[1] == \
                     self._cluster.allocatable.shape[1]:
                 self.last_profile_idx = pb.profile_idx
@@ -265,9 +270,10 @@ class SolverSession:
             return None
         # the rebuild path always solves eagerly (rebuilds are rare and
         # the caller just committed any in-flight batch anyway)
-        return self._rebuild_and_solve(pods, seq_before)
+        return self._rebuild_and_solve(pods, seq_before, pad)
 
-    def _rebuild_and_solve(self, pods: List, seq_before: int):
+    def _rebuild_and_solve(self, pods: List, seq_before: int,
+                           pad: Optional[int] = None):
         if not self._warming:
             self.rebuilds += 1
         self._poisoned = False
@@ -276,7 +282,9 @@ class SolverSession:
         self._encoder = BatchEncoder(
             self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes
         )
-        cluster, batch = self._encoder.encode(pods, pad_pods=self.max_batch)
+        cluster, batch = self._encoder.encode(
+            pods, pad_pods=pad or self.max_batch
+        )
         self._cluster = cluster
         self._static_masks_host = batch.static_masks
         self.last_profile_idx = batch.profile_idx

@@ -174,6 +174,12 @@ class Framework:
         return getattr(self.deps, "feature_gates", None)
 
     @property
+    def event_recorder(self):
+        """The profile's EventRecorder (reference Handle.EventRecorder);
+        None when the deps bundle doesn't provide one (unit tests)."""
+        return getattr(self.deps, "event_recorder", None)
+
+    @property
     def extenders(self):
         return getattr(self.deps, "extenders", ())
 

@@ -69,6 +69,11 @@ class _Deps:
     def extenders(self):
         return self._scheduler.algorithm.extenders
 
+    @property
+    def event_recorder(self):
+        """The profile's EventRecorder (reference Handle.EventRecorder)."""
+        return self._scheduler.recorder
+
 
 class Scheduler:
     def __init__(
@@ -99,6 +104,9 @@ class Scheduler:
         self.batch_scheduler = None  # set by kubernetes_tpu.sidecar when gated on
         self._watch_handle = None
         self.event_handlers = EventHandlers(self)
+        from kubernetes_tpu.client.events import EventRecorder
+
+        self.recorder = EventRecorder(client, "default-scheduler")
 
     # ------------------------------------------------------------------
     @classmethod
@@ -191,6 +199,7 @@ class Scheduler:
                 self.queue.add(pod)
         self.cache.run()
         self.queue.run()
+        self.recorder.start()
 
     def run(self) -> threading.Thread:
         """Run the scheduling loop in a thread; returns it."""
@@ -222,6 +231,7 @@ class Scheduler:
         if self.batch_scheduler is not None:
             # flush an in-flight profiler trace on short runs
             self.batch_scheduler.session.finish_profiling()
+        self.recorder.stop()
         self._bind_pool.shutdown(wait=False)
 
     def wait_for_inflight_bindings(self, timeout: float = 30.0) -> bool:
@@ -492,7 +502,8 @@ class Scheduler:
                                                 result, err, cycle)
                     failed += 1
                 else:
-                    self._observe_scheduled(fwk, qpi, start)
+                    self._observe_scheduled(fwk, qpi, start,
+                                            result.suggested_host)
                     committed += 1
             else:
                 bulk.append(item)
@@ -514,19 +525,26 @@ class Scheduler:
                 if has_post_bind:
                     fwk.run_post_bind_plugins(state, assumed,
                                               result.suggested_host)
-                self._observe_scheduled(fwk, qpi, start)
+                self._observe_scheduled(fwk, qpi, start,
+                                        result.suggested_host)
                 committed += 1
             self.cache.finish_binding_many(bound)
         return committed, failed
 
     def _observe_scheduled(self, fwk: Framework, qpi: QueuedPodInfo,
-                           start: float) -> None:
+                           start: float, node_name: str = "") -> None:
         now = time.monotonic()
         self.metrics.e2e_scheduling_duration.observe(now - start, "scheduled")
         self.metrics.schedule_attempts.inc("scheduled", fwk.profile_name)
         self.metrics.pod_scheduling_attempts.observe(qpi.attempts)
         self.metrics.pod_scheduling_duration.observe(
             now - qpi.initial_attempt_timestamp, str(qpi.attempts))
+        pod = qpi.pod
+        self.recorder.event(
+            pod, "Normal", "Scheduled",
+            f"Successfully assigned {pod.namespace}/{pod.name} to "
+            f"{node_name}",
+        )
 
     # ------------------------------------------------------------------
     def _binding_cycle(
@@ -557,14 +575,7 @@ class Scheduler:
                                             err, cycle)
                 return False
             fwk.run_post_bind_plugins(state, assumed_pod, result.suggested_host)
-            elapsed = time.monotonic() - start
-            self.metrics.e2e_scheduling_duration.observe(elapsed, "scheduled")
-            self.metrics.schedule_attempts.inc("scheduled", fwk.profile_name)
-            self.metrics.pod_scheduling_attempts.observe(qpi.attempts)
-            self.metrics.pod_scheduling_duration.observe(
-                time.monotonic() - qpi.initial_attempt_timestamp,
-                str(qpi.attempts),
-            )
+            self._observe_scheduled(fwk, qpi, start, result.suggested_host)
             return True
         finally:
             self.metrics.goroutines.dec("binding")
@@ -632,6 +643,9 @@ class Scheduler:
         """recordSchedulingFailure (scheduler.go:319) +
         MakeDefaultErrorFunc (factory.go:316-362)."""
         pod = qpi.pod
+        # the operator-facing record (scheduler.go:331 recordSchedulingFailure
+        # → FailedScheduling event)
+        self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
         self.client.patch_pod_condition(
             pod.namespace, pod.name,
             PodCondition("PodScheduled", "False", reason, str(err)),

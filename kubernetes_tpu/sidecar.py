@@ -43,6 +43,12 @@ class TPUBatchScheduler:
     # up to this many device-declined pods per batch take the serial
     # path (exact statuses/messages); above it, mass-decline fast path
     DECLINED_SERIAL_LIMIT = 32
+    # p99 schedule-latency budget: a pod's latency is roughly one batch
+    # cycle (solve + commit), so the drain/pad size adapts to keep each
+    # cycle under this (BASELINE.json's p99 target is 2s; budgeting
+    # below it leaves headroom for tunnel variance)
+    LATENCY_BUDGET_S = 1.5
+    MIN_CHUNK = 512
 
     def __init__(
         self,
@@ -63,6 +69,12 @@ class TPUBatchScheduler:
         # one solved-but-uncommitted batch (pipelining: the host commits
         # batch k while the device solves batch k+1)
         self._pending: Optional[dict] = None
+        # latency-budget chunking: drain/pad size, halved (power-of-2
+        # buckets — each bucket is one compiled executable) whenever a
+        # batch cycle overruns the budget. Wide-term workloads that
+        # solve slowly get small low-latency batches; fast ones keep
+        # the full pipeline width.
+        self._chunk = max_batch
 
     # ------------------------------------------------------------------
     def _drain(self, pop_timeout: Optional[float]):
@@ -72,9 +84,33 @@ class TPUBatchScheduler:
         popped in, scheduling_queue.go:317) — pop_batch consumes one
         cycle per pod, so cycles are reconstructed from the final value."""
         items, first_cycle = self.sched.queue.pop_batch(
-            self.max_batch, timeout=pop_timeout
+            self._chunk, timeout=pop_timeout
         )
         return [(qpi, first_cycle + i) for i, qpi in enumerate(items)]
+
+    def _tune_chunk(self, padded_pods: int, cycle_seconds: float) -> None:
+        """Latency-budget chunk sizing, called after each committed
+        batch: per-pod cost × chunk must stay under the p99 budget.
+        Cost is divided by the PADDED batch size — device latency scales
+        with the compiled scan length, so a sparsely-filled drain must
+        not read as slow and collapse the chunk. Movement is one
+        power-of-2 bucket per batch in either direction: each bucket is
+        its own compiled executable, and a single outlier cycle (e.g.
+        one absorbing a compile) must not trigger a cascade of unwarmed
+        shapes mid-run."""
+        if padded_pods <= 0 or cycle_seconds <= 0:
+            return
+        per_pod = cycle_seconds / padded_pods
+        target = int(0.7 * self.LATENCY_BUDGET_S / max(per_pod, 1e-9))
+        if target < self._chunk and self._chunk > self.MIN_CHUNK:
+            new = self._chunk // 2
+        elif target >= 2 * self._chunk and self._chunk < self.max_batch:
+            new = self._chunk * 2
+        else:
+            return
+        # MIN_CHUNK floors the bucket — but never above max_batch
+        # (tests and small deployments run with tiny max_batch)
+        self._chunk = min(self.max_batch, max(self.MIN_CHUNK, new))
 
     def run_batch(self, pop_timeout: Optional[float] = 0.2) -> int:
         """One pump cycle, PIPELINED: dispatch this cycle's solve (jax
@@ -134,6 +170,7 @@ class TPUBatchScheduler:
                 res = self.session.solve(
                     [q.pod for q, _ in batchable], lazy=True,
                     incremental_only=prev is not None,
+                    pad_to=self._chunk,
                 )
                 if res is None:
                     # this solve needs a full rebuild, whose snapshot
@@ -147,7 +184,8 @@ class TPUBatchScheduler:
                     prev = None
                     seq_anchor = sched.cache.mutation_seq
                     res = self.session.solve(
-                        [q.pod for q, _ in batchable], lazy=True
+                        [q.pod for q, _ in batchable], lazy=True,
+                        pad_to=self._chunk,
                     )
                 handle, cluster, _ = res
                 self._pending = {
@@ -162,6 +200,7 @@ class TPUBatchScheduler:
                     # by the time this one commits
                     "masks": self.session.static_masks_host,
                     "start": time.monotonic(),
+                    "pad": self._chunk,
                 }
             except Exception:  # noqa: BLE001 — popped pods must not be lost
                 _logger.exception(
@@ -253,6 +292,22 @@ class TPUBatchScheduler:
             # shapes; then invalidate — warmup pods were solved into the
             # device mirror but never committed on the host
             self.session.solve(pods, warming=True)
+            # timed second solve (now cache-hot) estimates the per-pod
+            # device rate so the latency-budget chunk is chosen — and
+            # its executable compiled — BEFORE the measured phase
+            t1 = time.monotonic()
+            self.session.solve(pods, warming=True)
+            est = time.monotonic() - t1
+            # cost scales with the padded size; step until the bucket is
+            # stable (runtime tuning moves one bucket per batch, but
+            # warmup is free to settle immediately)
+            per_pod = est / self.max_batch
+            prev = None
+            while prev != self._chunk:
+                prev = self._chunk
+                self._tune_chunk(self._chunk, per_pod * self._chunk)
+            if self._chunk != self.max_batch:
+                self.session.solve(pods, warming=True, pad_to=self._chunk)
             self.session.invalidate()
         except Exception:
             _logger.exception("solver warmup failed (continuing cold)")
@@ -350,9 +405,9 @@ class TPUBatchScheduler:
                                              pending["masks"],
                                              statuses_by_profile):
                     serial.append(qpi)
-        sched.metrics.batch_solve_duration.observe(
-            time.monotonic() - t0, "commit"
-        )
+        now = time.monotonic()
+        sched.metrics.batch_solve_duration.observe(now - t0, "commit")
+        self._tune_chunk(pending.get("pad", self.max_batch), now - start)
         return committed
 
     # shared (read-only) status instances for synthesized fit errors

@@ -110,3 +110,93 @@ def test_xla_planes_backend_matches_scan(mixed):
     ints, floats = pack_podin(batch)
     got, _ = backend.solve(SolverParams(), pstatic, pstate, ints, floats)
     np.testing.assert_array_equal(ref, got)
+
+
+def _wide_term_problem(n_nodes=16, n_pods=48, groups=20, preferred=False):
+    """Config-4-shaped workload: many anti-affinity groups (T >=
+    SPARSE_MIN_T tracked terms), each pod referencing exactly one."""
+    nodes = [
+        MakeNode().name(f"n{i}")
+        .label("topology.kubernetes.io/zone", f"z{i % 4}")
+        .capacity({"cpu": "64", "memory": "64Gi"}).obj()
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_pods):
+        g = f"g{i % groups}"
+        w = (MakePod().name(f"p{i}").uid(f"pu{i}").label("grp", g)
+             .req({"cpu": "100m", "memory": "64Mi"}))
+        if preferred and i % 2 == 0:
+            w.preferred_pod_affinity(3, "grp", [g],
+                                     "topology.kubernetes.io/zone")
+        else:
+            w.pod_anti_affinity("grp", [g], "kubernetes.io/hostname")
+        pods.append(w.obj())
+    snap = new_snapshot([], nodes)
+    enc = BatchEncoder(snap, pad_nodes=128)
+    return enc.encode(pods, pad_pods=64)
+
+
+@pytest.mark.parametrize("preferred", [False, True])
+def test_sparse_term_slots_match_scan(preferred):
+    """The sparse term-slot scan (wide-T fast path) must match the
+    legacy scan exactly — same assignments, same carried state."""
+    cluster, batch = _wide_term_problem(preferred=preferred)
+    t = batch.term_counts.shape[0]
+    assert t >= ps.SPARSE_MIN_T, f"problem too narrow (T={t}) to hit sparse"
+    ref = solve_scan(cluster, batch, SolverParams())
+    backend = ps.XlaPlanesBackend()
+    pstatic, pstate = backend.prepare(cluster, batch)
+    ints, floats = pack_podin(batch)
+    # make sure the sparse packer actually applies to this problem
+    assert ps.pack_sparse_slots(ints, floats, pstatic.r, pstatic.sc,
+                                t) is not None
+    got, state = backend.solve(SolverParams(), pstatic, pstate, ints, floats)
+    np.testing.assert_array_equal(ref, got)
+    # carried state must equal the dense path's carried state
+    pstatic2, pstate2 = backend.prepare(cluster, batch)
+    dense_planes, _ = ps._xla_planes_solve(
+        SolverParams(), pstatic2.r, pstatic2.sc, pstatic2.t, pstatic2.u,
+        pstatic2.v, pstatic2.sc_meta, pstatic2.ints, pstatic2.f32s,
+        pstate2.planes, ints, floats,
+    )
+    np.testing.assert_array_equal(np.asarray(dense_planes),
+                                  np.asarray(state.planes))
+
+
+def test_sparse_overflow_falls_back_dense():
+    """A batch containing a pod that references more than SPARSE_K terms
+    must solve END-TO-END on the dense path (pack_sparse_slots declines,
+    solve_lazy falls through) and still match the legacy scan."""
+    nodes = [
+        MakeNode().name(f"n{i}")
+        .capacity({"cpu": "64", "memory": "64Gi"}).obj()
+        for i in range(8)
+    ]
+    pods = []
+    for i in range(24):
+        w = (MakePod().name(f"p{i}").uid(f"pu{i}")
+             .label("grp", f"g{i % 16}").req({"cpu": "100m"}))
+        if i == 0:
+            # one pod owning SPARSE_K+1 distinct anti-affinity terms
+            for j in range(ps.SPARSE_K + 1):
+                w.label(f"multi{j}", "x")
+                w.pod_anti_affinity(f"multi{j}", ["x"],
+                                    "kubernetes.io/hostname")
+        else:
+            w.pod_anti_affinity("grp", [f"g{i % 16}"],
+                                "kubernetes.io/hostname")
+        pods.append(w.obj())
+    snap = new_snapshot([], nodes)
+    enc = BatchEncoder(snap, pad_nodes=128)
+    cluster, batch = enc.encode(pods, pad_pods=32)
+    t = batch.term_counts.shape[0]
+    assert t >= ps.SPARSE_MIN_T
+    ints, floats = pack_podin(batch)
+    r, sc = cluster.allocatable.shape[1], batch.sc_counts.shape[0]
+    assert ps.pack_sparse_slots(ints, floats, r, sc, t) is None
+    ref = solve_scan(cluster, batch, SolverParams())
+    backend = ps.XlaPlanesBackend()
+    pstatic, pstate = backend.prepare(cluster, batch)
+    got, _ = backend.solve(SolverParams(), pstatic, pstate, ints, floats)
+    np.testing.assert_array_equal(ref, got)
