@@ -768,10 +768,32 @@ class Job:
 
 
 @dataclass
+class PodDisruptionBudgetStatus:
+    """policy/v1beta1 PodDisruptionBudgetStatus: maintained by the
+    disruption controller (reference ``pkg/controller/disruption/``),
+    consumed LIVE by preemption's PDB-violation split."""
+
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
 class PodDisruptionBudget:
+    """policy/v1beta1 PodDisruptionBudget. Spec carries exactly one of
+    ``min_available`` / ``max_unavailable`` (int count or "N%" string);
+    ``status.disruptions_allowed`` is what eviction/preemption consults
+    — the SPEC alone says nothing about how many disruptions are safe
+    right now."""
+
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     label_selector: Optional[LabelSelector] = None
-    disruptions_allowed: int = 0
+    min_available: Optional[object] = None     # int or "N%"
+    max_unavailable: Optional[object] = None   # int or "N%"
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus
+    )
 
     @property
     def name(self) -> str:
@@ -780,6 +802,10 @@ class PodDisruptionBudget:
     @property
     def namespace(self) -> str:
         return self.metadata.namespace
+
+    @property
+    def disruptions_allowed(self) -> int:
+        return self.status.disruptions_allowed
 
     @property
     def selector(self):

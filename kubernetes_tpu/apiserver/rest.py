@@ -70,6 +70,77 @@ class Forbidden(Exception):
     pass
 
 
+def resources_metrics_text(store: ClusterStore) -> str:
+    """The /metrics/resources exposition (reference
+    ``pkg/scheduler/metrics/resources/resources.go`` podResourceCollector):
+    kube_pod_resource_request / kube_pod_resource_limit gauges with
+    {namespace, pod, node, resource, unit} labels, aggregated with the
+    scheduler's own request math (max(sum(containers), init) + overhead)
+    so operators see demand exactly as scheduling sees it."""
+    from kubernetes_tpu.scheduler.types import compute_pod_resource_request
+
+    unit_of = {"cpu": "cores", "memory": "bytes",
+               "ephemeral-storage": "bytes"}
+    lines = [
+        "# HELP kube_pod_resource_request Resources requested by workloads "
+        "on the cluster, broken down by pod.",
+        "# TYPE kube_pod_resource_request gauge",
+    ]
+    limits_lines = [
+        "# HELP kube_pod_resource_limit Resources limit for workloads on "
+        "the cluster, broken down by pod.",
+        "# TYPE kube_pod_resource_limit gauge",
+    ]
+
+    def fmt(value) -> str:
+        # full precision: {:g} truncates to 6 significant digits, which
+        # corrupts byte-valued gauges (16Gi would round off by ~31KB)
+        if float(value) == int(value):
+            return str(int(value))
+        return repr(float(value))
+
+    def emit(out, metric, pod, resource, value):
+        unit = unit_of.get(resource, "integer")
+        out.append(
+            f'{metric}{{namespace="{pod.namespace}",pod="{pod.name}",'
+            f'node="{pod.spec.node_name}",resource="{resource}",'
+            f'unit="{unit}"}} {fmt(value)}'
+        )
+
+    def pod_limits(pod):
+        """Aggregate limits with the same shape as requests:
+        max(sum(app containers), max(init containers)) per resource."""
+        total: Dict[str, float] = {}
+        for c in pod.spec.containers:
+            for name, qty in c.resources.limits.items():
+                v = qty.milli_value() / 1000.0 if name == "cpu" \
+                    else qty.value()
+                total[name] = total.get(name, 0) + v
+        for c in pod.spec.init_containers:
+            for name, qty in c.resources.limits.items():
+                v = qty.milli_value() / 1000.0 if name == "cpu" \
+                    else qty.value()
+                total[name] = max(total.get(name, 0), v)
+        return total
+
+    for pod in store.list_pods():
+        req = compute_pod_resource_request(pod)
+        if req.milli_cpu:
+            emit(lines, "kube_pod_resource_request", pod, "cpu",
+                 req.milli_cpu / 1000.0)
+        if req.memory:
+            emit(lines, "kube_pod_resource_request", pod, "memory",
+                 req.memory)
+        if req.ephemeral_storage:
+            emit(lines, "kube_pod_resource_request", pod,
+                 "ephemeral-storage", req.ephemeral_storage)
+        for name, v in req.scalar_resources.items():
+            emit(lines, "kube_pod_resource_request", pod, name, v)
+        for name, v in pod_limits(pod).items():
+            emit(limits_lines, "kube_pod_resource_limit", pod, name, v)
+    return "\n".join(lines + limits_lines) + "\n"
+
+
 Authorizer = Callable[[str, str, str, str], bool]  # (user, verb, kind, ns)
 
 
@@ -160,6 +231,17 @@ class _Handler(BaseHTTPRequestHandler):
         if u.path == "/metrics":
             text = self.server.metrics_text()
             body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/metrics/resources":
+            # reference cmd/kube-scheduler/app/server.go:243 +
+            # pkg/scheduler/metrics/resources: per-pod resource
+            # requests/limits as kube_pod_resource_* gauges
+            body = resources_metrics_text(self.server.store).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))

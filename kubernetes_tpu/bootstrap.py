@@ -47,7 +47,12 @@ class Cluster:
         )
         self.controller_manager.start()
         self.scheduler = Scheduler.create(self.store)
-        self.scheduler.run()
+        if leader_elect:
+            # leader_elect covers the scheduler too, not just the
+            # controller manager (reference server.go:199-208)
+            self.scheduler.run_with_leader_election()
+        else:
+            self.scheduler.run()
 
     def phase_bootstrap_token(self) -> str:
         """Mint a join token, registered with the apiserver's authn

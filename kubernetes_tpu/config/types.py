@@ -228,4 +228,29 @@ class KubeSchedulerConfiguration:
         for p in self.profiles:
             if not p.scheduler_name:
                 errs.append("schedulerName cannot be empty")
+            if p.plugins is not None:
+                for point in EXTENSION_POINTS:
+                    for e in p.plugins.get(point).enabled:
+                        if not e.name:
+                            errs.append(
+                                f"{p.scheduler_name}: {point} plugin "
+                                "name cannot be empty")
+                        if point == "score" and not 0 <= e.weight <= 100:
+                            # framework MaxTotalScoreWeight discipline
+                            # (apis/config/validation)
+                            errs.append(
+                                f"{p.scheduler_name}: score plugin "
+                                f"{e.name!r} weight {e.weight} not in "
+                                "[0,100]")
+        binders = 0
+        for ext in self.extenders:
+            if not ext.url_prefix and ext.implementation is None:
+                errs.append("extender urlPrefix cannot be empty")
+            if ext.weight <= 0:
+                errs.append("extender weight must be positive")
+            if ext.bind_verb:
+                binders += 1
+        if binders > 1:
+            # v1beta1 validation: only one extender may be the binder
+            errs.append("only one extender can implement bind")
         return errs

@@ -21,6 +21,7 @@ from kubernetes_tpu.client import (
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.job import JobController
@@ -46,6 +47,7 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "garbagecollector": GarbageCollector,
         "nodelifecycle": NodeLifecycleController,
         "persistentvolume-binder": PersistentVolumeController,
+        "disruption": DisruptionController,
     }
 
 

@@ -1,0 +1,165 @@
+"""Disruption controller: maintains PodDisruptionBudget status.
+
+Behavioral equivalent of the reference's ``pkg/controller/disruption/
+disruption.go`` (DisruptionController.trySync → updatePdbStatus): for
+every PDB, count the currently-healthy matching pods, derive the desired
+healthy count from ``minAvailable`` / ``maxUnavailable`` (absolute or
+percentage — percentages resolve against the expected pod count taken
+from the owning controllers' desired replicas, reference
+``getExpectedPodCount``/``getExpectedScale``), and publish
+``status.disruptionsAllowed = currentHealthy − desiredHealthy`` — the
+number the eviction API and scheduler preemption consult live.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from kubernetes_tpu.api.types import Pod, PodDisruptionBudgetStatus, shallow_copy
+from kubernetes_tpu.controllers.base import Controller, controller_of, split_key
+
+
+def _parse_percent(value) -> float:
+    """"30%" -> 0.30 (raises on malformed)."""
+    return float(str(value).rstrip("%")) / 100.0
+
+
+def _is_percent(value) -> bool:
+    return isinstance(value, str) and value.endswith("%")
+
+
+class DisruptionController(Controller):
+    name = "disruption"
+
+    def register(self) -> None:
+        self.factory.informer_for("PodDisruptionBudget").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: (self._pod_changed(old),
+                                        self._pod_changed(new)),
+            on_delete=self._pod_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+        self.pdb_lister = self.factory.lister_for("PodDisruptionBudget")
+
+    def _pod_changed(self, pod: Pod) -> None:
+        # reference getPdbForPod: re-sync every PDB whose selector
+        # matches the changed pod
+        for pdb in self.pdb_lister.by_namespace(pod.namespace):
+            if pdb.selector.matches(pod.metadata.labels):
+                self.enqueue(pdb)
+
+    # ------------------------------------------------------------------
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        pdb = self.store.get_object("PodDisruptionBudget", ns, name)
+        if pdb is None:
+            return
+        pods = [
+            p for p in self.pod_lister.by_namespace(ns)
+            if pdb.selector.matches(p.metadata.labels)
+        ]
+        current_healthy = sum(1 for p in pods if self._healthy(p))
+        expected, desired = self._expected_and_desired(pdb, pods)
+        if expected is None:
+            # fail CLOSED (reference getExpectedScale error -> failSafe
+            # sets DisruptionsAllowed=0): an unresolvable owner must
+            # block disruptions, never inflate the budget
+            expected, desired = len(pods), current_healthy
+        allowed = max(0, current_healthy - desired)
+        new_status = PodDisruptionBudgetStatus(
+            disruptions_allowed=allowed,
+            current_healthy=current_healthy,
+            desired_healthy=desired,
+            expected_pods=expected,
+        )
+        if new_status == pdb.status:
+            return
+        updated = shallow_copy(pdb)
+        updated.metadata = shallow_copy(pdb.metadata)
+        updated.status = new_status
+        self.store.update_object("PodDisruptionBudget", updated)
+
+    @staticmethod
+    def _healthy(pod: Pod) -> bool:
+        """Reference counts pods with Ready condition; in this harness a
+        bound, non-terminating pod is the running/ready analog
+        (scheduler_perf semantics: binding is the finish line)."""
+        return bool(pod.spec.node_name) and \
+            pod.metadata.deletion_timestamp is None
+
+    def _expected_and_desired(self, pdb, pods: List[Pod]):
+        """(expectedPods, desiredHealthy) — disruption.go
+        getExpectedPodCount: percentages (and maxUnavailable) resolve
+        against the owning controllers' desired scale; absolute
+        minAvailable uses the matching-pod count."""
+        if pdb.max_unavailable is not None or (
+            pdb.min_available is not None and _is_percent(pdb.min_available)
+        ):
+            expected = self._expected_scale(pods)
+            if expected is None:
+                return None, None
+        else:
+            expected = len(pods)
+        if pdb.max_unavailable is not None:
+            mu = pdb.max_unavailable
+            unavail = (
+                math.floor(_parse_percent(mu) * expected)
+                if _is_percent(mu) else int(mu)
+            )
+            return expected, max(0, expected - unavail)
+        if pdb.min_available is None:
+            return expected, 0
+        ma = pdb.min_available
+        if _is_percent(ma):
+            return expected, math.ceil(_parse_percent(ma) * expected)
+        return expected, int(ma)
+
+    def _expected_scale(self, pods: List[Pod]):
+        """Sum of the owning workload controllers' desired replicas;
+        bare pods count themselves. Returns None when any owner cannot
+        be resolved — the caller fails CLOSED (disruption.go
+        getExpectedScale returns an error there)."""
+        seen = set()
+        total = 0
+        bare = 0
+        for pod in pods:
+            ref = controller_of(pod)
+            if ref is None:
+                bare += 1
+                continue
+            uid = ref.get("uid")
+            if uid in seen:
+                continue
+            seen.add(uid)
+            owner = self._find_owner(ref, pod.namespace)
+            if owner is None:
+                return None
+            total += owner
+        return total + bare
+
+    def _find_owner(self, ref: dict, namespace: str):
+        kind = ref.get("kind")
+        name = ref.get("name")
+        getters = {
+            "ReplicaSet": self.store.get_replica_set,
+            "Job": self.store.get_job,
+        }
+        if kind in getters:
+            obj = getters[kind](namespace, name)
+        else:
+            try:
+                obj = self.store.get_object(kind, namespace, name)
+            except KeyError:
+                return None
+        if obj is None:
+            return None
+        replicas = getattr(getattr(obj, "spec", None), "replicas", None)
+        if replicas is None:
+            replicas = getattr(obj, "replicas", None)
+        return int(replicas) if replicas is not None else None

@@ -208,6 +208,51 @@ class Scheduler:
         t.start()
         return t
 
+    def run_with_leader_election(self, identity: str = "scheduler-0",
+                                 lease_name: str = "kube-scheduler",
+                                 clock=None, lease_duration: float = 15.0,
+                                 renew_deadline: float = 10.0,
+                                 retry_period: float = 2.0):
+        """HA wiring (cmd/kube-scheduler/app/server.go:199-208): informers
+        start and caches sync BEFORE the election (a standby keeps warm
+        state); only the lease holder runs the scheduling loop; losing
+        the lease stops this scheduler for good — the reference
+        ``klog.Fatalf``s there (server.go:205), because a deposed leader
+        must never keep binding against a store another instance now
+        owns. Returns the LeaderElector (``.is_leader`` for observers).
+        """
+        from kubernetes_tpu.client.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+
+        self.start()
+        self.lost_lease = False
+
+        def on_started() -> None:
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"scheduleOne-{identity}").start()
+
+        def on_stopped() -> None:
+            # fatal-style: no re-acquire, no second loop
+            self.elector.stop()
+            if not self._stop.is_set():
+                self.lost_lease = True
+                self.stop()
+
+        cfg = LeaderElectionConfig(
+            lock_name=lease_name,
+            identity=identity,
+            lease_duration=lease_duration,
+            renew_deadline=renew_deadline,
+            retry_period=retry_period,
+            on_started_leading=on_started,
+            on_stopped_leading=on_stopped,
+        )
+        self.elector = LeaderElector(self.client, cfg, clock=clock)
+        self.elector.run_in_thread()
+        return self.elector
+
     def _loop(self) -> None:
         import logging
 

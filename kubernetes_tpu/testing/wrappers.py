@@ -238,9 +238,11 @@ class PodWrapper:
         )
         return self
 
-    def owner_reference(self, kind: str, name: str, uid: str = "") -> "PodWrapper":
+    def owner_reference(self, kind: str, name: str, uid: str = "",
+                        controller: bool = True) -> "PodWrapper":
         self.pod.metadata.owner_references.append(
-            {"kind": kind, "name": name, "uid": uid or f"{kind}-{name}"}
+            {"kind": kind, "name": name, "uid": uid or f"{kind}-{name}",
+             "controller": controller}
         )
         return self
 

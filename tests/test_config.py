@@ -1,0 +1,141 @@
+"""Config-compat tests: legacy Policy translation (factory.go:207-296 +
+legacy_registry.go), v1beta1 validation depth, /metrics/resources."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.policy import PolicyError, load_policy, policy_to_config
+from kubernetes_tpu.config.types import KubeSchedulerConfiguration
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+class TestPolicyTranslation:
+    def test_policy_predicates_and_priorities_map_to_plugins(self):
+        cfg = load_policy(json.dumps({
+            "kind": "Policy", "apiVersion": "v1",
+            "predicates": [
+                {"name": "PodFitsResources"},
+                {"name": "PodFitsHostPorts"},
+                {"name": "MatchNodeSelector"},
+            ],
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 2},
+                {"name": "BalancedResourceAllocation", "weight": 1},
+            ],
+        }))
+        sched = Scheduler.create(ClusterStore(), config=cfg)
+        fwk = sched.profiles["default-scheduler"]
+        plugins = fwk.list_plugins()
+        assert "NodeResourcesFit" in plugins["filter"]
+        assert "NodePorts" in plugins["filter"]
+        assert "NodeAffinity" in plugins["filter"]
+        # NOT the provider defaults: policy replaces them
+        assert "PodTopologySpread" not in plugins["filter"]
+        assert set(plugins["score"]) == {
+            "NodeResourcesLeastAllocated",
+            "NodeResourcesBalancedAllocation",
+        }
+        # mandatory wiring survives
+        assert plugins["queue_sort"] == ["PrioritySort"]
+        assert plugins["bind"] == ["DefaultBinder"]
+        assert plugins["post_filter"] == ["DefaultPreemption"]
+
+    def test_policy_score_weight_carries(self):
+        cfg = policy_to_config({
+            "predicates": [{"name": "PodFitsResources"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 5}],
+        })
+        prof = cfg.profiles[0]
+        entry = next(e for e in prof.plugins.score.enabled
+                     if e.name == "NodeResourcesLeastAllocated")
+        assert entry.weight == 5
+
+    def test_policy_nil_lists_use_defaults(self):
+        cfg = policy_to_config({})
+        prof = cfg.profiles[0]
+        filters = {e.name for e in prof.plugins.filter.enabled}
+        assert "NodeResourcesFit" in filters
+        assert "InterPodAffinity" in filters
+        scores = {e.name for e in prof.plugins.score.enabled}
+        assert "NodeResourcesLeastAllocated" in scores
+
+    def test_policy_end_to_end_schedules(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1")
+                       .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        cfg = policy_to_config({
+            "predicates": [{"name": "PodFitsResources"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        })
+        sched = Scheduler.create(store, config=cfg)
+        sched.start()
+        store.create_pod(MakePod().name("p").uid("u").req({"cpu": "1"}).obj())
+        for _ in range(20):
+            sched.queue.flush_backoff_completed()
+            if not sched.schedule_one(pop_timeout=0.0):
+                break
+        sched.wait_for_inflight_bindings()
+        sched.stop()
+        assert store.get_pod("default", "p").spec.node_name == "n1"
+
+    def test_policy_errors(self):
+        with pytest.raises(PolicyError):
+            policy_to_config({"predicates": [{"name": "NoSuchPredicate"}]})
+        with pytest.raises(PolicyError):
+            policy_to_config({"hardPodAffinitySymmetricWeight": 1000})
+        with pytest.raises(PolicyError):
+            load_policy("{not json")
+
+
+class TestValidationDepth:
+    def test_score_weight_bounds(self):
+        cfg = KubeSchedulerConfiguration.from_dict({
+            "profiles": [{
+                "schedulerName": "default-scheduler",
+                "plugins": {"score": {"enabled": [
+                    {"name": "NodeResourcesLeastAllocated", "weight": 500},
+                ]}},
+            }],
+        })
+        assert any("not in [0,100]" in e for e in cfg.validate())
+
+    def test_single_binder_extender(self):
+        cfg = KubeSchedulerConfiguration.from_dict({
+            "extenders": [
+                {"urlPrefix": "http://a", "bindVerb": "bind"},
+                {"urlPrefix": "http://b", "bindVerb": "bind"},
+            ],
+        })
+        assert any("one extender" in e for e in cfg.validate())
+
+    def test_empty_url_prefix(self):
+        cfg = KubeSchedulerConfiguration.from_dict({
+            "extenders": [{"bindVerb": "bind"}],
+        })
+        assert any("urlPrefix" in e for e in cfg.validate())
+
+
+class TestMetricsResources:
+    def test_endpoint_exposes_pod_requests(self):
+        from kubernetes_tpu.apiserver.rest import APIServer
+
+        store = ClusterStore()
+        store.create_pod(
+            MakePod().name("p1").uid("u1").node("n1")
+            .req({"cpu": "500m", "memory": "256Mi"}).obj())
+        server = APIServer(store).start()
+        try:
+            with urllib.request.urlopen(
+                server.url + "/metrics/resources"
+            ) as resp:
+                text = resp.read().decode()
+        finally:
+            server.shutdown()
+        assert "kube_pod_resource_request" in text
+        assert 'pod="p1"' in text and 'resource="cpu"' in text
+        assert 'unit="cores"} 0.5' in text
+        assert 'resource="memory"' in text

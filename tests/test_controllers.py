@@ -18,7 +18,7 @@ from kubernetes_tpu.api.types import (
 from kubernetes_tpu.apiserver.store import ClusterStore
 from kubernetes_tpu.controllers import ControllerManager, new_controller_initializers
 from kubernetes_tpu.scheduler.scheduler import Scheduler
-from kubernetes_tpu.testing import MakeNode
+from kubernetes_tpu.testing import MakeNode, MakePod
 
 
 def _wait(cond, timeout=10.0, msg="condition"):
@@ -367,4 +367,130 @@ def test_pv_binder_binds_immediate_claims():
         time.sleep(0.3)
         assert store.get_pvc("default", "claim-2").phase == "Pending"
     finally:
+        cm.stop()
+
+
+def test_disruption_controller_maintains_pdb_status():
+    """pkg/controller/disruption: status.disruptionsAllowed =
+    currentHealthy - desiredHealthy, percentages against owner scale."""
+    from kubernetes_tpu.api.types import PodDisruptionBudget
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["disruption"])
+    cm.start()
+    try:
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+            min_available=2,
+        )
+        pdb.metadata.name = "db-pdb"
+        store.add_pdb(pdb)
+        # three bound (healthy) pods + one pending
+        for i in range(3):
+            store.create_pod(MakePod().name(f"db-{i}").uid(f"dbu{i}")
+                             .label("app", "db").node(f"n{i}").obj())
+        store.create_pod(MakePod().name("db-pending").uid("dbu-p")
+                         .label("app", "db").obj())
+        _wait(lambda: store.get_object(
+            "PodDisruptionBudget", "default", "db-pdb"
+        ).status.disruptions_allowed == 1, msg="allowed=1 (3 healthy - 2)")
+        got = store.get_object("PodDisruptionBudget", "default", "db-pdb")
+        assert got.status.current_healthy == 3
+        assert got.status.desired_healthy == 2
+        assert got.status.expected_pods == 4
+
+        # one healthy pod deleted -> no disruptions left
+        store.delete_pod("default", "db-0")
+        _wait(lambda: store.get_object(
+            "PodDisruptionBudget", "default", "db-pdb"
+        ).status.disruptions_allowed == 0, msg="allowed drops to 0")
+    finally:
+        cm.stop()
+
+
+def test_disruption_controller_percentage_against_owner_scale():
+    from kubernetes_tpu.api.types import PodDisruptionBudget
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["disruption"])
+    cm.start()
+    try:
+        rs = _rs("web", 4, labels={"app": "web"})
+        rs.metadata.uid = "rs-uid"
+        store.add_replica_set(rs)
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+            max_unavailable="50%",
+        )
+        pdb.metadata.name = "web-pdb"
+        store.add_pdb(pdb)
+        # only 3 of the 4 desired replicas exist and are bound
+        for i in range(3):
+            store.create_pod(
+                MakePod().name(f"web-{i}").uid(f"wu{i}")
+                .label("app", "web").node(f"n{i}")
+                .owner_reference("ReplicaSet", "web", "rs-uid").obj())
+        # expected=4 (owner scale), maxUnavailable 50% -> desired=2,
+        # healthy=3 -> allowed=1
+        _wait(lambda: store.get_object(
+            "PodDisruptionBudget", "default", "web-pdb"
+        ).status.disruptions_allowed == 1, msg="allowed=1")
+        got = store.get_object("PodDisruptionBudget", "default", "web-pdb")
+        assert got.status.expected_pods == 4
+        assert got.status.desired_healthy == 2
+    finally:
+        cm.stop()
+
+
+def test_preemption_blocked_by_live_pdb_status():
+    """Preemption must consume the disruption controller's LIVE
+    status.disruptionsAllowed: victims under an exhausted PDB are last
+    resort (reference filterPodsWithPDBViolation ordering)."""
+    from kubernetes_tpu.api.types import PodDisruptionBudget
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["disruption"])
+    cm.start()
+    sched = Scheduler.create(store)
+    sched.start()
+    try:
+        store.add_node(MakeNode().name("n1")
+                       .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        # two low-priority pods fill the node: one PDB-protected, one not
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "prot"}),
+            min_available=1,
+        )
+        pdb.metadata.name = "prot-pdb"
+        store.add_pdb(pdb)
+        store.create_pod(MakePod().name("protected").uid("u-prot")
+                         .label("app", "prot").priority(0)
+                         .req({"cpu": "2"}).obj())
+        store.create_pod(MakePod().name("fair-game").uid("u-fair")
+                         .priority(0).req({"cpu": "2"}).obj())
+        for _ in range(50):
+            sched.queue.flush_backoff_completed()
+            if not sched.schedule_one(pop_timeout=0.0):
+                break
+        sched.wait_for_inflight_bindings()
+        # disruption controller observes both bound; protected PDB has
+        # minAvailable=1 over 1 healthy pod -> allowed=0
+        _wait(lambda: store.get_object(
+            "PodDisruptionBudget", "default", "prot-pdb"
+        ).status.disruptions_allowed == 0, msg="pdb exhausted")
+
+        store.create_pod(MakePod().name("vip").uid("u-vip")
+                         .priority(1000).req({"cpu": "2"}).obj())
+        for _ in range(50):
+            sched.queue.flush_backoff_completed()
+            if not sched.schedule_one(pop_timeout=0.0):
+                break
+        sched.wait_for_inflight_bindings()
+        _wait(lambda: store.get_pod("default", "fair-game") is None,
+              msg="non-protected victim evicted")
+        assert store.get_pod("default", "protected") is not None
+    finally:
+        sched.stop()
         cm.stop()

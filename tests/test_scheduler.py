@@ -269,3 +269,88 @@ class TestMultiProfile:
         # not our pod: untouched
         assert store.get_pod("default", "q").spec.node_name == ""
         sched.stop()
+
+
+class TestSchedulerLeaderElection:
+    """HA wiring (reference cmd/kube-scheduler/app/server.go:199-208):
+    only the lease holder schedules; a deposed leader stops for good;
+    two instances never double-bind."""
+
+    def test_only_leader_schedules_and_failover(self):
+        import time as _time
+
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1")
+                       .capacity({"cpu": "64", "memory": "64Gi"}).obj())
+        a = Scheduler.create(store)
+        b = Scheduler.create(store)
+        ea = a.run_with_leader_election(
+            identity="sched-a", lease_duration=0.5, renew_deadline=0.4,
+            retry_period=0.05)
+        _time.sleep(0.2)  # a acquires first
+        eb = b.run_with_leader_election(
+            identity="sched-b", lease_duration=0.5, renew_deadline=0.4,
+            retry_period=0.05)
+        _time.sleep(0.2)
+        assert ea.is_leader and not eb.is_leader
+
+        for i in range(8):
+            store.create_pod(MakePod().name(f"w1-{i}").uid(f"w1u{i}")
+                             .req({"cpu": "100m"}).obj())
+        deadline = _time.time() + 15
+        while _time.time() < deadline and any(
+            not p.spec.node_name for p in store.list_pods()
+        ):
+            _time.sleep(0.05)
+        assert all(p.spec.node_name for p in store.list_pods())
+        # only A attempted/bound them
+        assert b.metrics.schedule_attempts.get(
+            "scheduled", "default-scheduler") == 0
+
+        # leader dies: lease expires, B takes over; A must not come back
+        ea.stop()
+        a.stop()
+        deadline = _time.time() + 10
+        while _time.time() < deadline and not eb.is_leader:
+            _time.sleep(0.05)
+        assert eb.is_leader
+        for i in range(8):
+            store.create_pod(MakePod().name(f"w2-{i}").uid(f"w2u{i}")
+                             .req({"cpu": "100m"}).obj())
+        deadline = _time.time() + 15
+        while _time.time() < deadline and any(
+            not p.spec.node_name for p in store.list_pods()
+        ):
+            _time.sleep(0.05)
+        assert all(p.spec.node_name for p in store.list_pods())
+        assert b.metrics.schedule_attempts.get(
+            "scheduled", "default-scheduler") == 8
+        b.stop()
+        eb.stop()
+
+    def test_lost_lease_is_fatal(self):
+        import time as _time
+
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+        store = ClusterStore()
+        s = Scheduler.create(store)
+        es = s.run_with_leader_election(
+            identity="sched-x", lease_duration=0.4, renew_deadline=0.3,
+            retry_period=0.05)
+        _time.sleep(0.2)
+        assert es.is_leader
+        # usurp the lease (another instance force-acquires far in the
+        # future so renewal fails)
+        store.try_acquire_or_renew("kube-scheduler", "usurper",
+                                   _time.monotonic() + 3600, 3600)
+        deadline = _time.time() + 10
+        while _time.time() < deadline and not s.lost_lease:
+            _time.sleep(0.05)
+        assert s.lost_lease
+        assert s._stop.is_set()  # fatal-style stop
