@@ -64,8 +64,11 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
     ops = make_workload(name, nodes=nodes, init_pods=init_pods,
                         measure_pods=measure_pods)
     t0 = time.time()
+    # 4096 measured within noise of 8192 on throughput (solve/commit
+    # overlap hides the extra cycles) while halving the per-cycle p99
+    # contribution — and the p99 budget is part of the headline metric
     batch = run_workload(f"{name}/batch", ops, use_batch=True,
-                         max_batch=min(measure_pods, 8192),
+                         max_batch=min(measure_pods, 4096),
                          wait_timeout=1200, progress=log)
     log(f"[{key}] batch: {batch.pods_per_second:.1f} pods/s "
         f"(wall {time.time() - t0:.1f}s, p99 latency "

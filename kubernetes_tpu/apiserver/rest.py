@@ -109,7 +109,9 @@ def resources_metrics_text(store: ClusterStore) -> str:
 
     def pod_limits(pod):
         """Aggregate limits with the same shape as requests:
-        max(sum(app containers), max(init containers)) per resource."""
+        max(sum(app containers), max(init containers)) + overhead per
+        resource (the reference podResourceCollector adds spec.overhead
+        to limits as well as requests)."""
         total: Dict[str, float] = {}
         for c in pod.spec.containers:
             for name, qty in c.resources.limits.items():
@@ -121,6 +123,13 @@ def resources_metrics_text(store: ClusterStore) -> str:
                 v = qty.milli_value() / 1000.0 if name == "cpu" \
                     else qty.value()
                 total[name] = max(total.get(name, 0), v)
+        for name, qty in (pod.spec.overhead or {}).items():
+            # overhead extends NON-ZERO limits only (reference PodLimits
+            # guards with `found && !value.IsZero()`)
+            if total.get(name):
+                v = qty.milli_value() / 1000.0 if name == "cpu" \
+                    else qty.value()
+                total[name] += v
         return total
 
     for pod in store.list_pods():

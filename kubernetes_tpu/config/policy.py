@@ -93,9 +93,16 @@ def policy_to_config(
     plugins = Plugins()
 
     def enable(point: str, name: str, weight: int = 1) -> None:
+        """Idempotent for non-score points; score weights ACCUMULATE when
+        two legacy priorities map to one plugin (legacy_registry.go: e.g.
+        SelectorSpreadPriority + ServiceSpreadingPriority both feed
+        SelectorSpread, and createFromConfig sums their weights)."""
         pset = plugins.get(point)
-        if any(e.name == name for e in pset.enabled):
-            return
+        for e in pset.enabled:
+            if e.name == name:
+                if point == "score":
+                    e.weight += weight
+                return
         pset.enabled.append(PluginEntry(name, weight))
 
     # mandatory wiring createFromConfig always applies (factory.go:253-272)
@@ -129,9 +136,17 @@ def policy_to_config(
     else:
         for entry in priorities:
             name = entry.get("name")
-            weight = int(entry.get("weight") or 1)
-            if weight < 0:
-                raise PolicyError(f"priority {name!r} weight must be >= 0")
+            weight = int(entry["weight"]) if entry.get("weight") is not None \
+                else 1
+            if weight <= 0 or weight >= 2**63 - 1:
+                # reference createFromConfig: "priority ... should have
+                # a positive weight applied to it or it has overflown"
+                # (Weight <= 0 || Weight >= framework.MaxTotalScore) —
+                # do not silently coerce an explicit 0 to 1
+                raise PolicyError(
+                    f"priority {name!r} weight must be positive and "
+                    f"must not overflow"
+                )
             if name not in PRIORITY_MAP:
                 raise PolicyError(f"unknown priority {name!r}")
             plugin, points = PRIORITY_MAP[name]

@@ -108,8 +108,10 @@ class DisruptionController(Controller):
             expected = len(pods)
         if pdb.max_unavailable is not None:
             mu = pdb.max_unavailable
+            # percentages round UP (reference GetScaledValueFromIntOrPercent
+            # with roundUp=true): 30% of 7 allows 3 unavailable, not 2
             unavail = (
-                math.floor(_parse_percent(mu) * expected)
+                math.ceil(_parse_percent(mu) * expected)
                 if _is_percent(mu) else int(mu)
             )
             return expected, max(0, expected - unavail)

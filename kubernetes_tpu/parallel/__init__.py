@@ -1,4 +1,5 @@
 from kubernetes_tpu.parallel.sharded import (
+    ShardedBackend,
     make_mesh,
     solve_scan_sharded,
 )

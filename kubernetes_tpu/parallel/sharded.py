@@ -20,12 +20,19 @@ A separate 2D phase (``batch`` x ``nodes``) computes the batched static
 feasibility counts — the data-parallel analog — before the sequential
 commit; both run under one ``shard_map`` jit so XLA schedules ICI
 collectives, not host transfers.
+
+``ShardedBackend`` packages all of this behind the ``SolverSession``
+backend contract (prepare / solve_lazy / materialize), so the full
+workload path — sidecar drain, pipelined commit, mirror-validity
+accounting — can run on a device mesh. The jitted solve is cached per
+(mesh, params, shape) signature: session rebuilds reuse the compiled
+executable as long as the constraint space doesn't change shape.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional
+from functools import lru_cache, partial
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,202 +58,218 @@ def make_mesh(n_devices: Optional[int] = None, batch_axis: int = 1) -> Mesh:
     return Mesh(devices, axis_names=("batch", "nodes"))
 
 
-def solve_scan_sharded(
-    cluster: EncodedCluster,
-    batch: EncodedBatch,
-    mesh: Mesh,
-    params: SolverParams = SolverParams(),
-):
-    """Sharded solve over `mesh` (axes ("batch","nodes")). Returns
-    (assignments [B] int32 global node indices, feasible_counts [B]).
-    Matches the single-chip solvers exactly (differential tests)."""
-    from jax import shard_map
+class SStatic(NamedTuple):
+    """Solve-invariant arrays in the sharded planes layout."""
 
-    pstatic, pstate = prepare(cluster, batch, device=False)
-    r, sc, t, u, v = pstatic.r, pstatic.sc, pstatic.t, pstatic.u, pstatic.v
-    n = pstatic.nb * LANES
-    shards = mesh.shape["nodes"]
-    if n % shards != 0:
-        raise ValueError(
-            f"padded node count {n} not divisible by mesh nodes axis "
-            f"{shards}"
-        )
-    so, cs = _static_planes(r, sc, t, u)
-    do, cd = _state_planes(r, sc, t)
-    static2 = np.asarray(pstatic.ints).reshape(cs, n)
-    f32s2 = np.asarray(pstatic.f32s).reshape(u, n)
-    planes2 = np.asarray(pstate.planes).reshape(cd, n)
-    totals0 = planes2[do["totals"]][:t].copy()  # encoder pads t >= 1
-    pod_ints, pod_floats = pack_podin(batch)
-    # static per-(profile, constraint) domain existence: hoisted out of
-    # the scan so each step needs no pmax collective for it
-    has_dom = batch.sc_domain[:, :, :v].any(axis=2)     # [U, SC]
+    sc_meta: jnp.ndarray     # [2, SC] int32
+    ints: jnp.ndarray        # [C_s, N] int32 — static planes, node-sharded
+    f32s: jnp.ndarray        # [U, N] float32
+    has_dom: jnp.ndarray     # [U, SC] bool — static domain existence
+    # static dims (part of the compile key)
+    r: int
+    sc: int
+    t: int
+    u: int
+    v: int
+    n: int
 
-    # pod-stream column offsets (pack_podin layout)
+
+class SState(NamedTuple):
+    """Dynamic state carried across batches: node-sharded planes plus the
+    small replicated per-term totals (a node shard can't see the global
+    term columns, so totals ride outside the planes)."""
+
+    planes: jnp.ndarray      # [C_d, N] int32
+    totals: jnp.ndarray      # [T] int32
+
+
+def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
+          carry, pod):
+    """One pod of the sequential commit scan, on this device's node
+    shard. Differentially exact vs the single-chip solvers."""
+    r, sc, t, u, v = dims
+    c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match, \
+        c_match_by, c_own_aff, c_own_anti = cols
+    state, totals = carry
+    row, pref_w = pod
+    n_local = static_l.shape[1]
+    shard_ix = jax.lax.axis_index("nodes")
+    gidx = shard_ix * n_local + jnp.arange(n_local, dtype=jnp.int32)
+
+    node_valid = static_l[so["node_valid"]] > 0
+    alloc = static_l[so["alloc"]:so["alloc"] + r]
+    sc_codes = static_l[so["sc_codes"]:so["sc_codes"] + sc]
+    term_codes = static_l[so["term_codes"]:so["term_codes"] + t]
+    sc_missing = sc_codes >= v
+    t_missing = term_codes >= v
+    max_skew = sc_meta[0]
+    hard = sc_meta[1] > 0
+
+    pod_valid = row[c_valid] > 0
+    profile = row[c_profile]
+    req = row[c_req:c_req + r]
+    pod_sc = row[c_pod_sc:c_pod_sc + sc] > 0
+    sc_match = row[c_sc_match:c_sc_match + sc] > 0
+    match_by = row[c_match_by:c_match_by + t] > 0
+    own_aff = row[c_own_aff:c_own_aff + t] > 0
+    own_anti = row[c_own_anti:c_own_anti + t] > 0
+
+    requested = state[do["requested"]:do["requested"] + r]
+    fit = jnp.all(requested + req[:, None] <= alloc, axis=0)
+    fit &= state[do["pod_count"]] < static_l[so["max_pods"]]
+    static_ok = static_l[so["masks"] + profile] > 0
+
+    counts = state[do["sc_counts"]:do["sc_counts"] + sc]
+    dom = jax.lax.dynamic_slice_in_dim(
+        static_l, so["sc_domain"] + profile * sc, sc, axis=0
+    ) > 0
+    lmin = jnp.min(jnp.where(dom, counts, BIG), axis=1)
+    gmin = jax.lax.pmin(lmin, "nodes")
+    min_c = jnp.where(has_dom_r[profile], gmin, 0)
+    skew = counts + sc_match[:, None].astype(jnp.int32) - min_c[:, None]
+    active_hard = pod_sc & hard
+    spread_violation = jnp.any(
+        active_hard[:, None]
+        & ((skew > max_skew[:, None]) | sc_missing),
+        axis=0,
+    )
+
+    tcounts = state[do["term_counts"]:do["term_counts"] + t]
+    towners = state[do["term_owners"]:do["term_owners"] + t]
+    existing_anti = jnp.any(match_by[:, None] & (towners > 0), axis=0)
+    own_anti_block = jnp.any(own_anti[:, None] & (tcounts > 0), axis=0)
+    aff_here = (tcounts > 0) & ~t_missing
+    aff_sat = jnp.all(~own_aff[:, None] | aff_here, axis=0)
+    no_any = jnp.all(~own_aff | (totals == 0))
+    self_all = jnp.all(~own_aff | match_by)
+    has_aff = jnp.any(own_aff)
+    aff_ok = ~has_aff | aff_sat | (no_any & self_all)
+
+    feasible = (
+        node_valid & static_ok & fit & ~spread_violation
+        & ~existing_anti & ~own_anti_block & aff_ok & pod_valid
+    )
+
+    alloc_cpu = jnp.maximum(alloc[0], 1).astype(jnp.float32)
+    alloc_mem = jnp.maximum(alloc[1], 1).astype(jnp.float32)
+    nz = state[do["nonzero"]:do["nonzero"] + 2]
+    cpu_frac = (nz[0] + row[c_nonzero]).astype(jnp.float32) / alloc_cpu
+    mem_frac = (nz[1] + row[c_nonzero + 1]).astype(
+        jnp.float32
+    ) / alloc_mem
+    over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
+    balanced = jnp.where(
+        over, 0.0, (1.0 - jnp.abs(cpu_frac - mem_frac)) * 100.0
+    )
+    least = (
+        jnp.clip(1.0 - cpu_frac, 0.0, 1.0)
+        + jnp.clip(1.0 - mem_frac, 0.0, 1.0)
+    ) * 50.0
+    active_soft = pod_sc & ~hard
+    soft_counts = jnp.sum(
+        jnp.where(active_soft[:, None], counts, 0), axis=0
+    ).astype(jnp.float32)
+    spread_score = jnp.where(
+        jnp.any(active_soft), 100.0 / (1.0 + soft_counts), 0.0
+    )
+    pref_score = jnp.sum(
+        pref_w[:, None] * tcounts.astype(jnp.float32), axis=0
+    )
+    score = (
+        params.balanced_weight * balanced
+        + params.least_weight * least
+        + params.spread_weight * spread_score
+        + params.affinity_weight * pref_score
+        + params.static_weight * f32_l[profile]
+    )
+    score = jnp.where(feasible, score, NEG_INF)
+
+    # global argmax over the sharded node axis (lowest index on ties)
+    gmx = jax.lax.pmax(jnp.max(score), "nodes")
+    found = gmx > NEG_INF / 2
+    cand = jnp.where(feasible & (score >= gmx), gidx, BIG)
+    chosen = jax.lax.pmin(jnp.min(cand), "nodes")
+    valid = found & pod_valid
+    assignment = jnp.where(found, chosen, -1)
+
+    onehot = (gidx == chosen) & valid
+    inc = onehot.astype(jnp.int32)
+    valid_i = valid.astype(jnp.int32)
+    # winning node's codes, broadcast to every shard
+    sc_code_j = jax.lax.psum(
+        jnp.sum(jnp.where(onehot[None], sc_codes, 0), axis=1), "nodes"
+    )
+    t_code_j = jax.lax.psum(
+        jnp.sum(jnp.where(onehot[None], term_codes, 0), axis=1),
+        "nodes",
+    )
+    sc_inc = (sc_codes == sc_code_j[:, None]).astype(jnp.int32) \
+        * (sc_match.astype(jnp.int32) * valid_i)[:, None]
+    t_same = (term_codes == t_code_j[:, None]).astype(jnp.int32)
+    t_inc = t_same * (match_by.astype(jnp.int32) * valid_i)[:, None]
+    o_inc = t_same * (own_anti.astype(jnp.int32) * valid_i)[:, None]
+
+    new_state = jnp.concatenate([
+        requested + inc[None] * req[:, None],
+        nz + inc[None] * row[c_nonzero:c_nonzero + 2][:, None],
+        (state[do["pod_count"]] + inc)[None],
+        counts + sc_inc,
+        tcounts + t_inc,
+        towners + o_inc,
+        state[do["totals"]][None],
+    ])
+    new_totals = totals + (
+        match_by.astype(jnp.int32) * valid_i * (t_code_j < v)
+    )
+    return (new_state, new_totals), assignment
+
+
+def _batched_static_feasibility(so, r, u, c_req, c_profile, static_l,
+                                pods_ints_l):
+    """2D-parallel precompute: static-mask x fit counts for this
+    device's (batch, nodes) tile — the data-parallel analog phase.
+    Returns per-pod statically-feasible-node counts (psum over the
+    node axis), an unschedulability early-signal."""
+    alloc = static_l[so["alloc"]:so["alloc"] + r]       # [R, n_local]
+    node_ok = static_l[so["node_valid"]] > 0
+    reqs = pods_ints_l[:, c_req:c_req + r]              # [B_local, R]
+    fit = jnp.all(
+        reqs[:, :, None] <= alloc[None, :, :], axis=1
+    )                                                   # [B_local, n_local]
+    profiles = pods_ints_l[:, c_profile]
+    masks = (
+        static_l[so["masks"]:so["masks"] + u] > 0
+    )[profiles]                                         # [B_local, n_local]
+    both = fit & masks & node_ok[None, :]
+    return jax.lax.psum(
+        jnp.sum(both.astype(jnp.int32), axis=1), "nodes"
+    )
+
+
+@lru_cache(maxsize=32)
+def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
+                 u: int, v: int, with_counts: bool = True):
+    """Build (and cache) the jitted shard_map solve for one
+    (mesh, params, shape) signature. Session rebuilds within the same
+    constraint space reuse the compiled executable. ``with_counts=False``
+    drops the batched static-feasibility phase — the session hot path
+    doesn't consume it, so it shouldn't pay the [B x n_local] matrix and
+    its psum every batch."""
+    so, _ = _static_planes(r, sc, t, u)
+    do, _ = _state_planes(r, sc, t)
     c_req, c_nonzero, c_profile, c_valid = 0, r, r + 2, r + 3
     c_pod_sc, c_sc_match = r + 4, r + 4 + sc
     c_match_by = r + 4 + 2 * sc
     c_own_aff = r + 4 + 2 * sc + t
     c_own_anti = r + 4 + 2 * sc + 2 * t
-
-    def _step(sc_meta, static_l, f32_l, has_dom_r, carry, pod):
-        state, totals = carry
-        row, pref_w = pod
-        n_local = static_l.shape[1]
-        shard_ix = jax.lax.axis_index("nodes")
-        gidx = shard_ix * n_local + jnp.arange(n_local, dtype=jnp.int32)
-
-        node_valid = static_l[so["node_valid"]] > 0
-        alloc = static_l[so["alloc"]:so["alloc"] + r]
-        sc_codes = static_l[so["sc_codes"]:so["sc_codes"] + sc]
-        term_codes = static_l[so["term_codes"]:so["term_codes"] + t]
-        sc_missing = sc_codes >= v
-        t_missing = term_codes >= v
-        max_skew = sc_meta[0]
-        hard = sc_meta[1] > 0
-
-        pod_valid = row[c_valid] > 0
-        profile = row[c_profile]
-        req = row[c_req:c_req + r]
-        pod_sc = row[c_pod_sc:c_pod_sc + sc] > 0
-        sc_match = row[c_sc_match:c_sc_match + sc] > 0
-        match_by = row[c_match_by:c_match_by + t] > 0
-        own_aff = row[c_own_aff:c_own_aff + t] > 0
-        own_anti = row[c_own_anti:c_own_anti + t] > 0
-
-        requested = state[do["requested"]:do["requested"] + r]
-        fit = jnp.all(requested + req[:, None] <= alloc, axis=0)
-        fit &= state[do["pod_count"]] < static_l[so["max_pods"]]
-        static_ok = static_l[so["masks"] + profile] > 0
-
-        counts = state[do["sc_counts"]:do["sc_counts"] + sc]
-        dom = jax.lax.dynamic_slice_in_dim(
-            static_l, so["sc_domain"] + profile * sc, sc, axis=0
-        ) > 0
-        lmin = jnp.min(jnp.where(dom, counts, BIG), axis=1)
-        gmin = jax.lax.pmin(lmin, "nodes")
-        min_c = jnp.where(has_dom_r[profile], gmin, 0)
-        skew = counts + sc_match[:, None].astype(jnp.int32) - min_c[:, None]
-        active_hard = pod_sc & hard
-        spread_violation = jnp.any(
-            active_hard[:, None]
-            & ((skew > max_skew[:, None]) | sc_missing),
-            axis=0,
-        )
-
-        tcounts = state[do["term_counts"]:do["term_counts"] + t]
-        towners = state[do["term_owners"]:do["term_owners"] + t]
-        existing_anti = jnp.any(match_by[:, None] & (towners > 0), axis=0)
-        own_anti_block = jnp.any(own_anti[:, None] & (tcounts > 0), axis=0)
-        aff_here = (tcounts > 0) & ~t_missing
-        aff_sat = jnp.all(~own_aff[:, None] | aff_here, axis=0)
-        no_any = jnp.all(~own_aff | (totals == 0))
-        self_all = jnp.all(~own_aff | match_by)
-        has_aff = jnp.any(own_aff)
-        aff_ok = ~has_aff | aff_sat | (no_any & self_all)
-
-        feasible = (
-            node_valid & static_ok & fit & ~spread_violation
-            & ~existing_anti & ~own_anti_block & aff_ok & pod_valid
-        )
-
-        alloc_cpu = jnp.maximum(alloc[0], 1).astype(jnp.float32)
-        alloc_mem = jnp.maximum(alloc[1], 1).astype(jnp.float32)
-        nz = state[do["nonzero"]:do["nonzero"] + 2]
-        cpu_frac = (nz[0] + row[c_nonzero]).astype(jnp.float32) / alloc_cpu
-        mem_frac = (nz[1] + row[c_nonzero + 1]).astype(
-            jnp.float32
-        ) / alloc_mem
-        over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
-        balanced = jnp.where(
-            over, 0.0, (1.0 - jnp.abs(cpu_frac - mem_frac)) * 100.0
-        )
-        least = (
-            jnp.clip(1.0 - cpu_frac, 0.0, 1.0)
-            + jnp.clip(1.0 - mem_frac, 0.0, 1.0)
-        ) * 50.0
-        active_soft = pod_sc & ~hard
-        soft_counts = jnp.sum(
-            jnp.where(active_soft[:, None], counts, 0), axis=0
-        ).astype(jnp.float32)
-        spread_score = jnp.where(
-            jnp.any(active_soft), 100.0 / (1.0 + soft_counts), 0.0
-        )
-        pref_score = jnp.sum(
-            pref_w[:, None] * tcounts.astype(jnp.float32), axis=0
-        )
-        score = (
-            params.balanced_weight * balanced
-            + params.least_weight * least
-            + params.spread_weight * spread_score
-            + params.affinity_weight * pref_score
-            + params.static_weight * f32_l[profile]
-        )
-        score = jnp.where(feasible, score, NEG_INF)
-
-        # global argmax over the sharded node axis (lowest index on ties)
-        gmx = jax.lax.pmax(jnp.max(score), "nodes")
-        found = gmx > NEG_INF / 2
-        cand = jnp.where(feasible & (score >= gmx), gidx, BIG)
-        chosen = jax.lax.pmin(jnp.min(cand), "nodes")
-        valid = found & pod_valid
-        assignment = jnp.where(found, chosen, -1)
-
-        onehot = (gidx == chosen) & valid
-        inc = onehot.astype(jnp.int32)
-        valid_i = valid.astype(jnp.int32)
-        # winning node's codes, broadcast to every shard
-        sc_code_j = jax.lax.psum(
-            jnp.sum(jnp.where(onehot[None], sc_codes, 0), axis=1), "nodes"
-        )
-        t_code_j = jax.lax.psum(
-            jnp.sum(jnp.where(onehot[None], term_codes, 0), axis=1),
-            "nodes",
-        )
-        sc_inc = (sc_codes == sc_code_j[:, None]).astype(jnp.int32) \
-            * (sc_match.astype(jnp.int32) * valid_i)[:, None]
-        t_same = (term_codes == t_code_j[:, None]).astype(jnp.int32)
-        t_inc = t_same * (match_by.astype(jnp.int32) * valid_i)[:, None]
-        o_inc = t_same * (own_anti.astype(jnp.int32) * valid_i)[:, None]
-
-        new_state = jnp.concatenate([
-            requested + inc[None] * req[:, None],
-            nz + inc[None] * row[c_nonzero:c_nonzero + 2][:, None],
-            (state[do["pod_count"]] + inc)[None],
-            counts + sc_inc,
-            tcounts + t_inc,
-            towners + o_inc,
-            state[do["totals"]][None],
-        ])
-        new_totals = totals + (
-            match_by.astype(jnp.int32) * valid_i * (t_code_j < v)
-        )
-        return (new_state, new_totals), assignment
-
-    def _batched_static_feasibility(static_l, pods_ints_l):
-        """2D-parallel precompute: static-mask x fit counts for this
-        device's (batch, nodes) tile — the data-parallel analog phase.
-        Returns per-pod statically-feasible-node counts (psum over the
-        node axis), an unschedulability early-signal."""
-        alloc = static_l[so["alloc"]:so["alloc"] + r]       # [R, n_local]
-        node_ok = static_l[so["node_valid"]] > 0
-        reqs = pods_ints_l[:, c_req:c_req + r]              # [B_local, R]
-        fit = jnp.all(
-            reqs[:, :, None] <= alloc[None, :, :], axis=1
-        )                                                   # [B_local, n_local]
-        profiles = pods_ints_l[:, c_profile]
-        masks = (
-            static_l[so["masks"]:so["masks"] + u] > 0
-        )[profiles]                                         # [B_local, n_local]
-        both = fit & masks & node_ok[None, :]
-        return jax.lax.psum(
-            jnp.sum(both.astype(jnp.int32), axis=1), "nodes"
-        )
+    cols = (c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match,
+            c_match_by, c_own_aff, c_own_anti)
+    dims = (r, sc, t, u, v)
 
     node_sharded = P(None, "nodes")
 
     @partial(
-        shard_map,
+        jax.shard_map,
         mesh=mesh,
         in_specs=(
             P(),                 # sc_meta (replicated)
@@ -256,28 +279,132 @@ def solve_scan_sharded(
             P(),                 # totals (replicated)
             P(),                 # pod ints (scan stream, replicated)
             P(),                 # pod floats
-            P("batch", None),    # pod ints (batch-parallel phase)
+            # batch-parallel phase input: replicated when the phase is
+            # disabled, so the session path carries no batch-axis
+            # divisibility constraint on the pad size
+            P("batch", None) if with_counts else P(),
             P(),                 # has_dom [U, SC] (replicated)
         ),
-        out_specs=(P(), P("batch")),
+        out_specs=(P(), P("batch") if with_counts else P(), node_sharded,
+                   P()),
         check_vma=False,
     )
     def run(sc_meta, static_l, f32_l, planes_l, totals_r, ints_r,
             floats_r, pods_batch_i, has_dom_r):
-        feasible_counts = _batched_static_feasibility(static_l, pods_batch_i)
-        (_, _), assignments = jax.lax.scan(
-            partial(_step, sc_meta, static_l, f32_l, has_dom_r),
+        if with_counts:
+            feasible_counts = _batched_static_feasibility(
+                so, r, u, c_req, c_profile, static_l, pods_batch_i
+            )
+        else:
+            feasible_counts = jnp.zeros(
+                pods_batch_i.shape[0], dtype=jnp.int32
+            )
+        (new_planes, new_totals), assignments = jax.lax.scan(
+            partial(_step, params, dims, so, do, cols, sc_meta, static_l,
+                    f32_l, has_dom_r),
             (planes_l, totals_r),
             (ints_r, floats_r),
         )
-        return assignments, feasible_counts
+        return assignments, feasible_counts, new_planes, new_totals
 
+    return jax.jit(run)
+
+
+def _prepare_sharded(cluster: EncodedCluster, batch: EncodedBatch,
+                     mesh: Mesh):
+    """Pack encoder output into the sharded planes layout."""
+    pstatic, pstate = prepare(cluster, batch, device=False)
+    r, sc, t, u, v = pstatic.r, pstatic.sc, pstatic.t, pstatic.u, pstatic.v
+    n = pstatic.nb * LANES
+    shards = mesh.shape["nodes"]
+    if n % shards != 0:
+        raise ValueError(
+            f"padded node count {n} not divisible by mesh nodes axis "
+            f"{shards}"
+        )
+    _, cs = _static_planes(r, sc, t, u)
+    do, cd = _state_planes(r, sc, t)
+    static2 = np.asarray(pstatic.ints).reshape(cs, n)
+    f32s2 = np.asarray(pstatic.f32s).reshape(u, n)
+    planes2 = np.asarray(pstate.planes).reshape(cd, n)
+    totals0 = planes2[do["totals"]][:t].copy()  # encoder pads t >= 1
+    # static per-(profile, constraint) domain existence: hoisted out of
+    # the scan so each step needs no pmax collective for it
+    has_dom = batch.sc_domain[:, :, :v].any(axis=2)     # [U, SC]
+    sstatic = SStatic(
+        sc_meta=jnp.asarray(pstatic.sc_meta),
+        ints=jnp.asarray(static2),
+        f32s=jnp.asarray(f32s2),
+        has_dom=jnp.asarray(has_dom),
+        r=r, sc=sc, t=t, u=u, v=v, n=n,
+    )
+    sstate = SState(planes=jnp.asarray(planes2), totals=jnp.asarray(totals0))
+    return sstatic, sstate
+
+
+class ShardedBackend:
+    """SolverSession backend running the planes scan over a device mesh
+    (drop-in next to PallasBackend / XlaPlanesBackend / CppBackend): the
+    node axis of every plane is sharded over the mesh's ``nodes`` axis,
+    the batched static-feasibility phase over its ``batch`` axis. State
+    carries across batches exactly like the single-chip backends — the
+    scan's final carry is the next batch's initial state."""
+
+    name = "sharded"
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh or make_mesh()
+
+    def prepare(self, cluster, batch):
+        return _prepare_sharded(cluster, batch, self.mesh)
+
+    def solve_lazy(self, params, sstatic, sstate, pod_ints, pod_floats):
+        run = _build_solve(self.mesh, params, sstatic.r, sstatic.sc,
+                           sstatic.t, sstatic.u, sstatic.v,
+                           with_counts=False)
+        ints = jnp.asarray(pod_ints)
+        floats = jnp.asarray(pod_floats)
+        with self.mesh:
+            assignments, _counts, new_planes, new_totals = run(
+                sstatic.sc_meta, sstatic.ints, sstatic.f32s, sstate.planes,
+                sstate.totals, ints, floats, ints, sstatic.has_dom,
+            )
+        return assignments, SState(planes=new_planes, totals=new_totals)
+
+    @staticmethod
+    def materialize(handle):
+        return np.asarray(handle)
+
+    def solve(self, params, sstatic, sstate, pod_ints, pod_floats):
+        h, state = self.solve_lazy(params, sstatic, sstate, pod_ints,
+                                   pod_floats)
+        return self.materialize(h), state
+
+
+def solve_scan_sharded(
+    cluster: EncodedCluster,
+    batch: EncodedBatch,
+    mesh: Mesh,
+    params: SolverParams = SolverParams(),
+):
+    """Sharded solve over `mesh` (axes ("batch","nodes")). Returns
+    (assignments [B] int32 global node indices, feasible_counts [B]).
+    Matches the single-chip solvers exactly (differential tests)."""
+    sstatic, sstate = _prepare_sharded(cluster, batch, mesh)
+    run = _build_solve(mesh, params, sstatic.r, sstatic.sc, sstatic.t,
+                       sstatic.u, sstatic.v)
+    pod_ints, pod_floats = pack_podin(batch)
+    b_axis = mesh.shape["batch"]
+    if pod_ints.shape[0] % b_axis != 0:
+        raise ValueError(
+            f"padded batch size {pod_ints.shape[0]} not divisible by mesh "
+            f"batch axis {b_axis}"
+        )
+    ints = jnp.asarray(pod_ints)
+    floats = jnp.asarray(pod_floats)
     with mesh:
-        assignments, feasible_counts = jax.jit(run)(
-            jnp.asarray(pstatic.sc_meta), jnp.asarray(static2),
-            jnp.asarray(f32s2), jnp.asarray(planes2),
-            jnp.asarray(totals0), jnp.asarray(pod_ints),
-            jnp.asarray(pod_floats), jnp.asarray(pod_ints),
-            jnp.asarray(has_dom),
+        assignments, feasible_counts, _, _ = run(
+            sstatic.sc_meta, sstatic.ints, sstatic.f32s, sstate.planes,
+            sstate.totals, ints, floats, ints, sstatic.has_dom,
         )
     return np.asarray(assignments), np.asarray(feasible_counts)

@@ -268,6 +268,11 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        # a stopped scheduler must release leadership: keeping the lease
+        # renewing would block standby failover forever
+        elector = getattr(self, "elector", None)
+        if elector is not None:
+            elector.stop()
         self.queue.close()
         self.cache.stop()
         if self._watch_handle is not None:
@@ -365,6 +370,11 @@ class Scheduler:
         PreFilter-computed data."""
         state = CycleState()
         if fwk.has_post_filter_plugins():
+            # the serial path refreshes the snapshot inside Schedule; here
+            # the device solve may have ridden the incremental mirror, so
+            # the snapshot the preemption dry-run (and PreFilter) reads
+            # could predate this epoch's commits — refresh (O(changed))
+            self.algorithm.update_snapshot()
             fwk.run_pre_filter_plugins(state, qpi.pod)
         self._handle_fit_error(fwk, state, qpi, fit_err, cycle)
         self.metrics.schedule_attempts.inc("unschedulable", fwk.profile_name)

@@ -56,6 +56,7 @@ class TPUBatchScheduler:
         max_batch: int = 4096,
         params: SolverParams = SolverParams(),
         validate: bool = False,
+        backend=None,
     ):
         self.sched = scheduler
         self.max_batch = max_batch
@@ -63,9 +64,11 @@ class TPUBatchScheduler:
         # differential-debug mode: re-check every device assignment with
         # the host filter chain before committing
         self.validate = validate
-        # device-resident state mirror, carried across batches
+        # device-resident state mirror, carried across batches.
+        # ``backend`` overrides the platform default (e.g. the
+        # multi-chip ShardedBackend over a device mesh).
         self.session = SolverSession(scheduler, params=params,
-                                     max_batch=max_batch)
+                                     max_batch=max_batch, backend=backend)
         # one solved-but-uncommitted batch (pipelining: the host commits
         # batch k while the device solves batch k+1)
         self._pending: Optional[dict] = None
@@ -480,12 +483,13 @@ def attach_batch_scheduler(
     max_batch: int = 4096,
     params: SolverParams = SolverParams(),
     validate: bool = False,
+    backend=None,
 ) -> Optional[TPUBatchScheduler]:
     """Install the batch path iff the TPUBatchScheduler gate is enabled
     (the --feature-gates=TPUBatchScheduler wiring)."""
     if not sched.feature_gates.enabled("TPUBatchScheduler"):
         return None
     bs = TPUBatchScheduler(sched, max_batch=max_batch, params=params,
-                           validate=validate)
+                           validate=validate, backend=backend)
     sched.batch_scheduler = bs
     return bs

@@ -139,3 +139,29 @@ class TestMetricsResources:
         assert 'pod="p1"' in text and 'resource="cpu"' in text
         assert 'unit="cores"} 0.5' in text
         assert 'resource="memory"' in text
+
+
+class TestPolicyWeightSemantics:
+    def test_same_plugin_weights_accumulate(self):
+        """Two legacy priorities mapping to one plugin sum their weights
+        (createFromConfig accumulates: SelectorSpreadPriority +
+        ServiceSpreadingPriority -> one SelectorSpread entry, weight 5)."""
+        cfg = policy_to_config({
+            "priorities": [
+                {"name": "SelectorSpreadPriority", "weight": 2},
+                {"name": "ServiceSpreadingPriority", "weight": 3},
+            ],
+        })
+        prof = cfg.profiles[0]
+        entries = [e for e in prof.plugins.score.enabled
+                   if e.name == "SelectorSpread"]
+        assert len(entries) == 1
+        assert entries[0].weight == 5
+
+    def test_zero_weight_rejected(self):
+        with pytest.raises(PolicyError):
+            policy_to_config({
+                "priorities": [
+                    {"name": "LeastRequestedPriority", "weight": 0},
+                ],
+            })

@@ -494,3 +494,37 @@ def test_preemption_blocked_by_live_pdb_status():
     finally:
         sched.stop()
         cm.stop()
+
+
+def test_disruption_percentage_rounds_up():
+    """maxUnavailable percentages round UP (reference
+    GetScaledValueFromIntOrPercent roundUp=true): 30% of 7 allows 3
+    unavailable -> desiredHealthy 4, not floor(2.1)=2 -> 5."""
+    from kubernetes_tpu.api.types import PodDisruptionBudget
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["disruption"])
+    cm.start()
+    try:
+        rs = _rs("w7", 7, labels={"app": "w7"})
+        rs.metadata.uid = "rs7-uid"
+        store.add_replica_set(rs)
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "w7"}),
+            max_unavailable="30%",
+        )
+        pdb.metadata.name = "w7-pdb"
+        store.add_pdb(pdb)
+        for i in range(7):
+            store.create_pod(
+                MakePod().name(f"w7-{i}").uid(f"w7u{i}")
+                .label("app", "w7").node(f"n{i}")
+                .owner_reference("ReplicaSet", "w7", "rs7-uid").obj())
+        _wait(lambda: store.get_object(
+            "PodDisruptionBudget", "default", "w7-pdb"
+        ).status.disruptions_allowed == 3, msg="ceil(2.1)=3 allowed")
+        got = store.get_object("PodDisruptionBudget", "default", "w7-pdb")
+        assert got.status.desired_healthy == 4
+        assert got.status.expected_pods == 7
+    finally:
+        cm.stop()

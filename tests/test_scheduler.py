@@ -311,8 +311,9 @@ class TestSchedulerLeaderElection:
         assert b.metrics.schedule_attempts.get(
             "scheduled", "default-scheduler") == 0
 
-        # leader dies: lease expires, B takes over; A must not come back
-        ea.stop()
+        # leader dies: lease expires, B takes over; A must not come back.
+        # Scheduler.stop() alone must stop the elector too — a stopped
+        # scheduler that kept renewing would block failover forever.
         a.stop()
         deadline = _time.time() + 10
         while _time.time() < deadline and not eb.is_leader:
