@@ -49,13 +49,18 @@ SCHEME: Dict[str, type] = {
         "CSINode",
         "PodDisruptionBudget",
         "Event",
+        "Namespace",
+        "ResourceQuota",
+        "ServiceAccount",
+        "CronJob",
     )
 }
 
 
 # schema metadata: which kinds are namespace-scoped (clients need this to
 # build paths; it is API schema, not storage layout)
-CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode"}
+CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
+                  "Namespace"}
 
 
 def is_namespaced(kind: str) -> bool:

@@ -357,6 +357,7 @@ class Volume:
     ephemeral: bool = False
     gce_persistent_disk: Optional[str] = None  # pdName
     aws_elastic_block_store: Optional[str] = None  # volumeID
+    azure_disk: Optional[str] = None  # diskName
     rbd: Optional[dict] = None
     iscsi: Optional[dict] = None
 
@@ -365,6 +366,7 @@ class Volume:
         pvc = d.get("persistentVolumeClaim") or {}
         gce = d.get("gcePersistentDisk") or {}
         aws = d.get("awsElasticBlockStore") or {}
+        azure = d.get("azureDisk") or {}
         return cls(
             name=d.get("name", ""),
             persistent_volume_claim=pvc.get("claimName"),
@@ -372,6 +374,7 @@ class Volume:
             ephemeral=bool(d.get("ephemeral")),
             gce_persistent_disk=gce.get("pdName"),
             aws_elastic_block_store=aws.get("volumeID"),
+            azure_disk=azure.get("diskName"),
             rbd=d.get("rbd"),
             iscsi=d.get("iscsi"),
         )
@@ -533,6 +536,7 @@ class NodeSpec:
     unschedulable: bool = False
     taints: List[Taint] = field(default_factory=list)
     provider_id: str = ""
+    pod_cidr: str = ""  # allocated by the nodeipam controller
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "NodeSpec":
@@ -541,6 +545,7 @@ class NodeSpec:
             unschedulable=bool(d.get("unschedulable")),
             taints=[Taint.from_dict(t) for t in (d.get("taints") or [])],
             provider_id=d.get("providerID", ""),
+            pod_cidr=d.get("podCIDR", ""),
         )
 
 
@@ -664,6 +669,7 @@ class WorkloadStatus:
     observed_generation: int = 0
     succeeded: int = 0  # Job only
     failed: int = 0     # Job only
+    completion_time: Optional[float] = None  # Job only (ttlafterfinished)
 
 
 @dataclass
@@ -756,7 +762,81 @@ class Job:
     completions: int = 1
     parallelism: int = 1
     template: Optional[dict] = None
+    ttl_seconds_after_finished: Optional[int] = None
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace: lifecycle phase drives the namespace
+    controller's content deletion (``pkg/controller/namespace``)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    phase: str = "Active"  # Active | Terminating
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota: spec.hard caps aggregate resource creation
+    in a namespace; status.used is maintained by the resourcequota
+    controller (``pkg/controller/resourcequota``) and consulted by the
+    quota admission plugin."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    used: Dict[str, Quantity] = field(default_factory=dict)  # status
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount (``pkg/controller/serviceaccount`` ensures
+    a "default" account exists per namespace)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class CronJob:
+    """batch/v1beta1 CronJob (``pkg/controller/cronjob``): creates Jobs
+    on a 5-field cron schedule."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    schedule: str = "* * * * *"
+    job_template: Optional[dict] = None  # manifest-shaped pod template
+    suspend: bool = False
+    completions: int = 1
+    parallelism: int = 1
+    ttl_seconds_after_finished: Optional[int] = None
+    last_schedule_time: Optional[float] = None  # status
 
     @property
     def name(self) -> str:

@@ -59,15 +59,32 @@ class AdmissionPlugin:
 class NamespaceLifecycle(AdmissionPlugin):
     name = "NamespaceLifecycle"
 
-    def __init__(self, namespaces: Optional[Dict[str, str]] = None):
-        # namespace -> phase ("Active"/"Terminating"); None = open world
+    def __init__(self, namespaces: Optional[Dict[str, str]] = None,
+                 store=None):
+        # namespace -> phase ("Active"/"Terminating"); or a live store
+        # (Namespace objects consulted per request). With neither, the
+        # world is open. Deviation from upstream: a namespace with no
+        # Namespace OBJECT stays open (the perf harness schedules into
+        # "default" without creating namespace objects); only an
+        # explicitly Terminating namespace rejects creates.
         self.namespaces = namespaces
+        self.store = store
+
+    def _phase(self, namespace: str) -> Optional[str]:
+        if self.namespaces is not None:
+            return self.namespaces.get(namespace)
+        if self.store is not None:
+            ns = self.store.get_namespace(namespace)
+            return ns.phase if ns is not None else "__absent__"
+        return None
 
     def validate(self, req: AdmissionRequest) -> None:
-        if self.namespaces is None or req.operation != CREATE:
+        if req.operation != CREATE or req.kind == "Namespace":
             return
-        phase = self.namespaces.get(req.namespace)
-        if phase is None:
+        if self.namespaces is None and self.store is None:
+            return
+        phase = self._phase(req.namespace)
+        if phase is None and self.namespaces is not None:
             raise AdmissionError(f"namespace {req.namespace!r} not found")
         if phase == "Terminating":
             raise AdmissionError(
@@ -144,6 +161,111 @@ class PodPriorityResolver(AdmissionPlugin):
 
     def validate(self, req: AdmissionRequest) -> None:
         pass
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """Quota gatekeeping (``plugin/pkg/admission/resourcequota``): a pod
+    CREATE that would push any quota dimension in its namespace past
+    ``hard`` is rejected. Usage is charged SYNCHRONOUSLY here, like the
+    upstream plugin's transactional quota evaluator: live usage is
+    recomputed from the store's pods plus the in-flight charges this
+    plugin has admitted but the registry hasn't persisted yet — the
+    controller's async ``status.used`` is reporting, not enforcement
+    (a burst of creates would race a status-based check)."""
+
+    name = "ResourceQuota"
+
+    PENDING_TTL = 30.0  # in-flight charge expiry (failed create path)
+
+    def __init__(self, store=None):
+        import threading
+
+        self.store = store
+        self._lock = threading.Lock()
+        # (ns, name) -> (charge time, cpu_milli, mem) admitted but not
+        # yet visible in the store
+        self._pending: Dict[tuple, tuple] = {}
+
+    def validate(self, req: AdmissionRequest) -> None:
+        if self.store is None or req.kind != "Pod" or \
+                req.operation != CREATE:
+            return
+        quotas = [
+            q for q in self.store.list_resource_quotas()
+            if q.namespace == req.namespace
+        ]
+        if not quotas:
+            return
+        import time as _time
+
+        pod: Pod = req.obj
+        cpu_milli = sum(
+            int(c.resources.requests["cpu"].milli_value())
+            for c in pod.spec.containers if "cpu" in c.resources.requests
+        )
+        mem = sum(
+            int(c.resources.requests["memory"].value())
+            for c in pod.spec.containers
+            if "memory" in c.resources.requests
+        )
+        deltas = {
+            "pods": 1,
+            "requests.cpu": cpu_milli,
+            "cpu": cpu_milli,
+            "requests.memory": mem,
+            "memory": mem,
+        }
+        with self._lock:
+            now = _time.time()
+            live = [
+                p for p in self.store.list_pods()
+                if p.namespace == req.namespace
+                and p.status.phase not in ("Succeeded", "Failed")
+            ]
+            live_keys = {(p.namespace, p.name) for p in live}
+            # settle in-flight charges: visible in the store now, or
+            # expired (the create failed downstream)
+            self._pending = {
+                k: v for k, v in self._pending.items()
+                if k not in live_keys and now - v[0] < self.PENDING_TTL
+            }
+            pend = [v for k, v in self._pending.items()
+                    if k[0] == req.namespace]
+            used_cpu = sum(
+                int(c.resources.requests["cpu"].milli_value())
+                for p in live for c in p.spec.containers
+                if "cpu" in c.resources.requests
+            ) + sum(v[1] for v in pend)
+            used_mem = sum(
+                int(c.resources.requests["memory"].value())
+                for p in live for c in p.spec.containers
+                if "memory" in c.resources.requests
+            ) + sum(v[2] for v in pend)
+            usage = {
+                "pods": len(live) + len(pend),
+                "requests.cpu": used_cpu, "cpu": used_cpu,
+                "requests.memory": used_mem, "memory": used_mem,
+            }
+            for quota in quotas:
+                for key, hard in quota.hard.items():
+                    delta = deltas.get(key)
+                    if delta is None:
+                        continue
+                    hard_v = (
+                        int(hard.milli_value())
+                        if key in ("requests.cpu", "cpu")
+                        else int(hard.value())
+                    )
+                    if usage[key] + delta > hard_v:
+                        raise AdmissionError(
+                            f"exceeded quota {quota.name}: {key} "
+                            f"(used {usage[key]} + requested {delta} > "
+                            f"hard {hard_v})"
+                        )
+            # admitted: charge before releasing the lock
+            self._pending[(req.namespace, pod.name)] = (
+                now, cpu_milli, mem,
+            )
 
 
 @dataclass

@@ -62,6 +62,10 @@ PLURALS: Dict[str, str] = {
     "csinodes": "CSINode",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "events": "Event",
+    "namespaces": "Namespace",
+    "resourcequotas": "ResourceQuota",
+    "serviceaccounts": "ServiceAccount",
+    "cronjobs": "CronJob",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
@@ -544,7 +548,21 @@ class APIServer(ThreadingHTTPServer):
         super().__init__((host, port), _Handler)
         self.store = store if store is not None else ClusterStore()
         self.watch_cache = WatchCache(self.store)
-        self.admission = admission if admission is not None else AdmissionChain.default()
+        if admission is None:
+            admission = AdmissionChain.default()
+            # store-backed plugins: quota gatekeeping charges against
+            # live pods; namespace lifecycle rejects creates into
+            # Terminating namespaces
+            from kubernetes_tpu.apiserver.admission import (
+                NamespaceLifecycle,
+                ResourceQuotaAdmission,
+            )
+
+            for p in admission.plugins:
+                if isinstance(p, NamespaceLifecycle):
+                    p.store = self.store
+            admission.plugins.append(ResourceQuotaAdmission(self.store))
+        self.admission = admission
         self.authorizer = authorizer
         self.tokens = dict(tokens or {})  # bearer token -> username
         self.stopping = threading.Event()

@@ -27,8 +27,10 @@ from kubernetes_tpu.api.types import (
     shallow_copy,
     Deployment,
     Endpoints,
+    CronJob,
     Event as ApiEvent,
     Job,
+    Namespace,
     Node,
     PersistentVolume,
     PersistentVolumeClaim,
@@ -36,7 +38,9 @@ from kubernetes_tpu.api.types import (
     PodDisruptionBudget,
     ReplicaSet,
     ReplicationController,
+    ResourceQuota,
     Service,
+    ServiceAccount,
     StatefulSet,
     StorageClass,
 )
@@ -99,6 +103,10 @@ class ClusterStore:
         self._deployments: Dict[str, Deployment] = {}
         self._daemon_sets: Dict[str, DaemonSet] = {}
         self._jobs: Dict[str, Job] = {}
+        self._namespaces: Dict[str, Namespace] = {}
+        self._quotas: Dict[str, ResourceQuota] = {}
+        self._service_accounts: Dict[str, ServiceAccount] = {}
+        self._cron_jobs: Dict[str, CronJob] = {}
         self._leases: Dict[str, _Lease] = {}
         self._api_events: Dict[str, ApiEvent] = {}
         # Event objects expire (reference: etcd lease TTL on events,
@@ -495,6 +503,59 @@ class ClusterStore:
         with self._lock:
             return list(self._jobs.values())
 
+    # -- namespaces / quotas / service accounts / cron jobs -------------
+    def add_namespace(self, ns: Namespace) -> None:
+        self._upsert(self._namespaces, "Namespace", ns.name, ns)
+
+    def get_namespace(self, name: str) -> Optional[Namespace]:
+        with self._lock:
+            return self._namespaces.get(name)
+
+    def list_namespaces(self) -> List[Namespace]:
+        with self._lock:
+            return list(self._namespaces.values())
+
+    def delete_namespace(self, name: str) -> None:
+        self._delete(self._namespaces, "Namespace", name)
+
+    def add_resource_quota(self, q: ResourceQuota) -> None:
+        self._upsert(self._quotas, "ResourceQuota",
+                     f"{q.namespace}/{q.name}", q)
+
+    def get_resource_quota(self, namespace: str,
+                           name: str) -> Optional[ResourceQuota]:
+        with self._lock:
+            return self._quotas.get(f"{namespace}/{name}")
+
+    def list_resource_quotas(self) -> List[ResourceQuota]:
+        with self._lock:
+            return list(self._quotas.values())
+
+    def add_service_account(self, sa: ServiceAccount) -> None:
+        self._upsert(self._service_accounts, "ServiceAccount",
+                     f"{sa.namespace}/{sa.name}", sa)
+
+    def get_service_account(self, namespace: str,
+                            name: str) -> Optional[ServiceAccount]:
+        with self._lock:
+            return self._service_accounts.get(f"{namespace}/{name}")
+
+    def list_service_accounts(self) -> List[ServiceAccount]:
+        with self._lock:
+            return list(self._service_accounts.values())
+
+    def add_cron_job(self, cj: CronJob) -> None:
+        self._upsert(self._cron_jobs, "CronJob",
+                     f"{cj.namespace}/{cj.name}", cj)
+
+    def get_cron_job(self, namespace: str, name: str) -> Optional[CronJob]:
+        with self._lock:
+            return self._cron_jobs.get(f"{namespace}/{name}")
+
+    def list_cron_jobs(self) -> List[CronJob]:
+        with self._lock:
+            return list(self._cron_jobs.values())
+
     def update_replica_set(self, rs: ReplicaSet) -> None:
         self._upsert(self._rss, "ReplicaSet", f"{rs.namespace}/{rs.name}", rs)
 
@@ -551,6 +612,10 @@ class ClusterStore:
         "CSINode": ("_csi_nodes", False),
         "PodDisruptionBudget": ("_pdbs", True),
         "Event": ("_api_events", True),
+        "Namespace": ("_namespaces", False),
+        "ResourceQuota": ("_quotas", True),
+        "ServiceAccount": ("_service_accounts", True),
+        "CronJob": ("_cron_jobs", True),
     }
 
     # ------------------------------------------------------------------

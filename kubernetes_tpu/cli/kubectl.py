@@ -50,6 +50,12 @@ _KIND_ALIASES = {
     "pdb": "PodDisruptionBudget", "poddisruptionbudget": "PodDisruptionBudget",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "ev": "Event", "event": "Event", "events": "Event",
+    "ns": "Namespace", "namespace": "Namespace", "namespaces": "Namespace",
+    "quota": "ResourceQuota", "resourcequota": "ResourceQuota",
+    "resourcequotas": "ResourceQuota",
+    "sa": "ServiceAccount", "serviceaccount": "ServiceAccount",
+    "serviceaccounts": "ServiceAccount",
+    "cj": "CronJob", "cronjob": "CronJob", "cronjobs": "CronJob",
 }
 
 

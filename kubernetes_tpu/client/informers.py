@@ -186,6 +186,10 @@ _KIND_LISTS = {
     "Deployment": "list_deployments",
     "DaemonSet": "list_daemon_sets",
     "Job": "list_jobs",
+    "Namespace": "list_namespaces",
+    "ResourceQuota": "list_resource_quotas",
+    "ServiceAccount": "list_service_accounts",
+    "CronJob": "list_cron_jobs",
 }
 
 

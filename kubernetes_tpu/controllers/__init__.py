@@ -19,18 +19,26 @@ from kubernetes_tpu.client import (
     SharedInformerFactory,
 )
 from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.job import JobController
+from kubernetes_tpu.controllers.namespace import NamespaceController
+from kubernetes_tpu.controllers.nodeipam import NodeIpamController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import (
     ReplicaSetController,
     ReplicationController,
 )
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.serviceaccount import ServiceAccountController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.ttlafterfinished import (
+    TTLAfterFinishedController,
+)
 from kubernetes_tpu.controllers.volume import PersistentVolumeController
 
 
@@ -43,11 +51,17 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "statefulset": StatefulSetController,
         "daemonset": DaemonSetController,
         "job": JobController,
+        "cronjob": CronJobController,
+        "ttl-after-finished": TTLAfterFinishedController,
         "endpoints": EndpointsController,
         "garbagecollector": GarbageCollector,
         "nodelifecycle": NodeLifecycleController,
+        "nodeipam": NodeIpamController,
         "persistentvolume-binder": PersistentVolumeController,
         "disruption": DisruptionController,
+        "namespace": NamespaceController,
+        "resourcequota": ResourceQuotaController,
+        "serviceaccount": ServiceAccountController,
     }
 
 

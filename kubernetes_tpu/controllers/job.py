@@ -67,6 +67,17 @@ class JobController(Controller):
             succeeded=succeeded,
             failed=failed,
         )
+        # completion anchors the ttl-after-finished countdown; sticky
+        # once set (the reference stamps CompletionTime exactly once) —
+        # even if the finished condition stops holding later (e.g. the
+        # counted terminal pods get deleted), the anchor must survive
+        status.completion_time = job.status.completion_time
+        if status.completion_time is None and (
+            succeeded >= job.completions or failed > 0
+        ):
+            import time as _time
+
+            status.completion_time = _time.time()
         if status != job.status:
             self.store.add_job(with_status(job, status))
 

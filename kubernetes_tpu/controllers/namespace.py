@@ -1,0 +1,58 @@
+"""Namespace lifecycle controller.
+
+Behavioral equivalent of the reference's ``pkg/controller/namespace``
+(namespaced_resources_deleter.go): when a Namespace enters the
+Terminating phase (deletion requested), delete every namespaced object
+it contains, then finalize — remove the Namespace itself. Content
+deletion is idempotent and re-queued until the namespace is empty,
+mirroring ``Delete``'s retry-until-clean loop.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import Namespace
+from kubernetes_tpu.controllers.base import Controller
+
+
+class NamespaceController(Controller):
+    name = "namespace"
+
+    # kinds the deleter sweeps (every namespaced kind the store knows,
+    # discovered dynamically — the reference enumerates via discovery)
+    def register(self) -> None:
+        # cluster-scoped: key by bare name (ObjectMeta defaults the
+        # namespace field, so the generic ns/name enqueue is wrong here)
+        self.factory.informer_for("Namespace").add_event_handler(
+            on_add=lambda ns: self.enqueue_key(ns.name),
+            on_update=lambda old, new: self.enqueue_key(new.name),
+        )
+
+    def sync(self, key: str) -> None:
+        ns = self.store.get_namespace(key)
+        if ns is None:
+            return
+        if ns.phase != "Terminating" and \
+                ns.metadata.deletion_timestamp is None:
+            return
+        # mark Terminating first (kubectl delete ns sets phase before
+        # content deletion; the REST path's NamespaceLifecycle admission
+        # rejects new creates into Terminating namespaces from here on —
+        # direct store writers bypass admission, so the sweep re-queues
+        # until the namespace is actually empty)
+        if ns.phase != "Terminating":
+            updated = Namespace(metadata=ns.metadata, phase="Terminating")
+            self.store.update_object("Namespace", updated)
+        remaining = 0
+        for kind in self.store.known_kinds():
+            if kind == "Namespace" or not self.store.kind_is_namespaced(kind):
+                continue
+            for obj in self.store.list_objects(kind, namespace=key):
+                self.store.delete_object(
+                    kind, obj.metadata.namespace, obj.metadata.name
+                )
+                remaining += 1
+        if remaining:
+            # deletes may cascade more objects (owner refs): re-check
+            self.queue.add_rate_limited(key)
+            return
+        self.store.delete_namespace(key)

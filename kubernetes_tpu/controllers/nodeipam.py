@@ -1,0 +1,78 @@
+"""Node IPAM controller.
+
+Behavioral equivalent of the reference's ``pkg/controller/nodeipam``
+(range allocator): carve per-node pod CIDRs out of the cluster CIDR and
+assign each new node one (``node.spec.podCIDR``); release the block when
+the node is deleted. The default mirrors kubeadm's
+``--pod-network-cidr=10.244.0.0/16`` with /24 node masks.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+
+from kubernetes_tpu.api.types import Node, shallow_copy
+from kubernetes_tpu.controllers.base import Controller
+
+
+class NodeIpamController(Controller):
+    name = "nodeipam"
+
+    cluster_cidr = "10.244.0.0/16"
+    node_mask = 24
+
+    def register(self) -> None:
+        # Node is cluster-scoped: key by bare name
+        self.factory.informer_for("Node").add_event_handler(
+            on_add=lambda n: self.enqueue_key(n.name),
+            on_update=lambda old, new: self.enqueue_key(new.name),
+            on_delete=self._release,
+        )
+        self._alloc_lock = threading.Lock()
+        self._network = ipaddress.ip_network(self.cluster_cidr)
+        self._subnets = self._network.subnets(
+            new_prefix=self.node_mask
+        )
+        self._free: list = []          # released blocks, reused first
+        self._in_use: dict = {}        # cidr -> node name
+        self._adopted = False
+
+    def _claim(self, node_name: str) -> str:
+        with self._alloc_lock:
+            # adopt pre-existing assignments exactly once (restart path)
+            if not self._adopted:
+                self._adopted = True
+                for n in self.store.list_nodes():
+                    if n.spec.pod_cidr and \
+                            n.spec.pod_cidr not in self._in_use:
+                        self._in_use[n.spec.pod_cidr] = n.name
+            if self._free:
+                cidr = self._free.pop()
+            else:
+                for subnet in self._subnets:
+                    cidr = str(subnet)
+                    if cidr not in self._in_use:
+                        break
+                else:
+                    raise RuntimeError(
+                        f"cluster CIDR {self.cluster_cidr} exhausted"
+                    )
+            self._in_use[cidr] = node_name
+            return cidr
+
+    def _release(self, node: Node) -> None:
+        if not node.spec.pod_cidr:
+            return
+        with self._alloc_lock:
+            if self._in_use.pop(node.spec.pod_cidr, None) is not None:
+                self._free.append(node.spec.pod_cidr)
+
+    def sync(self, key: str) -> None:
+        node = self.store.get_node(key)
+        if node is None or node.spec.pod_cidr:
+            return
+        updated = shallow_copy(node)
+        updated.spec = shallow_copy(node.spec)
+        updated.spec.pod_cidr = self._claim(node.name)
+        self.store.update_node(updated)

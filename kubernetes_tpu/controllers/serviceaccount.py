@@ -1,0 +1,37 @@
+"""ServiceAccount controller.
+
+Behavioral equivalent of the reference's ``pkg/controller/serviceaccount``
+(serviceaccounts_controller.go): ensure every active namespace carries
+the "default" ServiceAccount; recreate it when deleted.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import Namespace, ObjectMeta, ServiceAccount
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+
+class ServiceAccountController(Controller):
+    name = "serviceaccount"
+
+    ACCOUNT = "default"
+
+    def register(self) -> None:
+        # keys are bare namespace names (Namespace is cluster-scoped)
+        self.factory.informer_for("Namespace").add_event_handler(
+            on_add=lambda ns: self.enqueue_key(ns.name),
+            on_update=lambda old, new: self.enqueue_key(new.name),
+        )
+        self.factory.informer_for("ServiceAccount").add_event_handler(
+            on_delete=lambda sa: self.enqueue_key(sa.namespace),
+        )
+
+    def sync(self, key: str) -> None:
+        ns = key
+        namespace = self.store.get_namespace(ns)
+        if namespace is None or namespace.phase == "Terminating":
+            return
+        if self.store.get_service_account(ns, self.ACCOUNT) is None:
+            self.store.add_service_account(ServiceAccount(
+                metadata=ObjectMeta(name=self.ACCOUNT, namespace=ns),
+            ))

@@ -1,0 +1,69 @@
+"""TTL-after-finished controller.
+
+Behavioral equivalent of the reference's ``pkg/controller/ttlafterfinished``
+(ttlafterfinished_controller.go): Jobs that declare
+``ttlSecondsAfterFinished`` are deleted once the TTL has elapsed past
+their completion time. Jobs not yet expired re-queue for exactly the
+remaining interval (processJob's requeueAfter), so expiry needs no
+polling loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.types import Job
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+
+def job_finished(job: Job) -> bool:
+    """Complete or Failed condition (the reference checks job
+    conditions; here: all completions succeeded, or any pod failed)."""
+    return (
+        job.status.succeeded >= job.completions or job.status.failed > 0
+    )
+
+
+class TTLAfterFinishedController(Controller):
+    name = "ttl-after-finished"
+
+    def register(self) -> None:
+        self.factory.informer_for("Job").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+        )
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        job = self.store.get_job(ns, name)
+        if job is None or job.ttl_seconds_after_finished is None:
+            return
+        if not job_finished(job):
+            return
+        finished_at = job.status.completion_time
+        if finished_at is None:
+            # completion time unset: stamp it now (the job may predate
+            # the ttl feature) so the TTL has an anchor. Copy-on-write —
+            # store/informer-cached instances must never mutate in place
+            # (watch consumers diff old vs new objects).
+            from kubernetes_tpu.api.types import shallow_copy
+
+            finished_at = time.time()
+            updated = shallow_copy(job)
+            updated.status = shallow_copy(job.status)
+            updated.status.completion_time = finished_at
+            self.store.add_job(updated)
+        expires_at = finished_at + job.ttl_seconds_after_finished
+        now = time.time()
+        if now < expires_at:
+            self.queue.add_after(key, expires_at - now)
+            return
+        # cascade: owned pods die with the job (the reference relies on
+        # foreground GC; the garbage collector loop also covers this)
+        for p in self.store.list_pods():
+            if p.namespace != ns:
+                continue
+            if any(r.get("kind") == "Job" and r.get("name") == name
+                   for r in p.metadata.owner_references):
+                self.store.delete_pod(p.namespace, p.name)
+        self.store.delete_job(ns, name)

@@ -118,7 +118,11 @@ def run_workload(
     tune_for_throughput()
     store = ClusterStore()
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
-    sched = Scheduler.create(store, feature_gates=gates)
+    # gang scheduling is first-class in this harness (BASELINE config #5):
+    # the coscheduling wiring is always on — its queue sort degrades to
+    # exactly PrioritySort when no pod declares a gang
+    sched = Scheduler.create(store, feature_gates=gates,
+                             provider="GangSchedulingProvider")
     bs = attach_batch_scheduler(sched, max_batch=max_batch) if use_batch else None
     sched.start()
 

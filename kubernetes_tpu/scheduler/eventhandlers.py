@@ -11,6 +11,10 @@ from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Node, Pod
 from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, Event
 from kubernetes_tpu.scheduler import events as ev
 
+# gang (coscheduling) group label; a new member activates unschedulable
+# siblings via the queue's gang wakeup
+GANG_GROUP_LABEL = "pod-group.scheduling.k8s.io/name"
+
 
 def assigned(pod: Pod) -> bool:
     return bool(pod.spec.node_name)
@@ -49,6 +53,11 @@ class EventHandlers:
                 bind_run.clear()
             if add_run:
                 sched.queue.add_many(add_run)
+                groups = {
+                    g for p in add_run
+                    if (g := p.metadata.labels.get(GANG_GROUP_LABEL))
+                }
+                sched.queue.gang_members_added(groups)
                 add_run.clear()
 
         for event in events:
@@ -115,6 +124,9 @@ class EventHandlers:
                 sched.queue.assigned_pod_added(pod)
             elif schedulable(pod) and self.responsible_for(pod):
                 sched.queue.add(pod)
+                group = pod.metadata.labels.get(GANG_GROUP_LABEL)
+                if group:
+                    sched.queue.gang_members_added({group})
         elif event.type == MODIFIED:
             if assigned(pod):
                 if old is not None and not assigned(old):
@@ -133,6 +145,13 @@ class EventHandlers:
                 sched.queue.move_all_to_active_or_backoff_queue(
                     ev.ASSIGNED_POD_DELETE
                 )
+                # a deleted bound gang member releases its Permit
+                # arrival slot (a re-created gang must re-gate)
+                if pod.metadata.labels.get(GANG_GROUP_LABEL):
+                    for fwk in sched.profiles.values():
+                        gang = fwk.get_plugin("Coscheduling")
+                        if gang is not None:
+                            gang.note_member_deleted(pod)
             else:
                 sched.queue.delete(pod)
                 # a Permit-parked pod must be rejected so its assumed

@@ -101,4 +101,8 @@ def new_in_tree_registry() -> Registry:
     )
     r.register(default_binder.DefaultBinder.NAME, default_binder.DefaultBinder.factory)
     r.register(coscheduling.Coscheduling.NAME, coscheduling.Coscheduling.factory)
+    r.register(
+        coscheduling.CoschedulingSort.NAME,
+        coscheduling.CoschedulingSort.factory,
+    )
     return r

@@ -1,4 +1,4 @@
-"""Coscheduling (gang scheduling) Permit plugin.
+"""Coscheduling (gang scheduling): Permit plugin + gang-aware QueueSort.
 
 The reference tree has no in-tree equivalent — gang scheduling is the
 Permit-phase pattern of the out-of-tree coscheduling plugin, enabled by the
@@ -9,25 +9,45 @@ gang via labels:
     pod-group.scheduling.k8s.io/name: <group>
     pod-group.scheduling.k8s.io/min-available: "<N>"
 
-A pod whose gang hasn't reached N scheduled-or-waiting members Waits at
-Permit; when the N-th member arrives, every waiting member is allowed.
+Behaviors mirrored from the out-of-tree plugin:
+
+- **Permit wait**: a pod whose gang hasn't reached N scheduled-or-waiting
+  members Waits at Permit; when the N-th member arrives, every waiting
+  member is allowed.
+- **Queue-sort co-ordering** (``CoschedulingSort``): pods sort by
+  priority, then by their GROUP's anchor timestamp (earliest member seen),
+  then by group name — so a gang's members drain consecutively instead of
+  interleaving with other gangs. Interleaving is the starvation mode: two
+  half-admitted gangs each hold resources at Permit that the other needs.
+  Non-gang pods keep exactly the PrioritySort order.
+- **Whole-gang rejection + backoff**: when one member fails downstream
+  (Permit timeout, bind failure, unreserve), every waiting member of the
+  gang is rejected together — partial gangs must not squat on reserved
+  resources — and the gang backs off (PreFilter fails fast) before its
+  next admission attempt.
+
 BASELINE config #5 exercises this together with spread + fit.
 """
 
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, Tuple
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.scheduler.framework.interface import (
     UNSCHEDULABLE,
     WAIT,
     PermitPlugin,
+    PreFilterPlugin,
+    QueueSortPlugin,
     Status,
 )
+from kubernetes_tpu.scheduler.types import QueuedPodInfo
 
 GROUP_NAME_LABEL = "pod-group.scheduling.k8s.io/name"
 MIN_AVAILABLE_LABEL = "pod-group.scheduling.k8s.io/min-available"
 DEFAULT_WAIT_SECONDS = 60.0
+DEFAULT_GANG_BACKOFF_SECONDS = 5.0
 
 
 def pod_group(pod: Pod) -> Tuple[str, int]:
@@ -39,7 +59,51 @@ def pod_group(pod: Pod) -> Tuple[str, int]:
     return name, min_available
 
 
-class Coscheduling(PermitPlugin):
+class CoschedulingSort(QueueSortPlugin):
+    """Gang-aware QueueSort: (priority desc, group anchor timestamp,
+    group name, own timestamp). The anchor is the earliest timestamp seen
+    for the group, so every member sorts where the gang's FIRST member
+    sorts and the gang drains as one contiguous run."""
+
+    NAME = "CoschedulingSort"
+
+    # bounded gang-anchor memory across gang lifetimes: oldest anchors
+    # evict first (an evicted group re-anchors at its next sighting)
+    MAX_ANCHORS = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._anchors: Dict[str, float] = {}
+
+    @staticmethod
+    def factory(args, handle):
+        return CoschedulingSort()
+
+    def _anchor(self, qpi: QueuedPodInfo) -> Tuple[float, str]:
+        group = qpi.pod.metadata.labels.get(GROUP_NAME_LABEL, "")
+        if not group:
+            return qpi.timestamp, ""
+        with self._lock:
+            ts = self._anchors.get(group)
+            if ts is None or qpi.timestamp < ts:
+                ts = qpi.timestamp
+                self._anchors[group] = ts
+                if len(self._anchors) > self.MAX_ANCHORS:
+                    for g, _ in sorted(
+                        self._anchors.items(), key=lambda kv: kv[1]
+                    )[: self.MAX_ANCHORS // 4]:
+                        del self._anchors[g]
+        return ts, group
+
+    def sort_key(self, qpi: QueuedPodInfo) -> tuple:
+        ts, group = self._anchor(qpi)
+        return (-qpi.pod.priority(), ts, group, qpi.timestamp)
+
+    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        return self.sort_key(a) < self.sort_key(b)
+
+
+class Coscheduling(PermitPlugin, PreFilterPlugin):
     NAME = "Coscheduling"
 
     @staticmethod
@@ -48,9 +112,33 @@ class Coscheduling(PermitPlugin):
 
     def __init__(self, handle=None, args=None):
         self.handle = handle
-        self.wait_seconds = float((args or {}).get("permitWaitSeconds", DEFAULT_WAIT_SECONDS))
+        args = args or {}
+        self.wait_seconds = float(
+            args.get("permitWaitSeconds", DEFAULT_WAIT_SECONDS)
+        )
+        self.backoff_seconds = float(
+            args.get("gangBackoffSeconds", DEFAULT_GANG_BACKOFF_SECONDS)
+        )
         self._lock = threading.Lock()
         self._permitted: Dict[str, int] = {}  # group -> pods at/past Permit
+        self._backoff_until: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def pre_filter(self, state, pod: Pod):
+        """Fail fast while the gang backs off after a failed admission
+        attempt — no point running the filter chain (or reserving
+        resources) for a gang that just collapsed at Permit."""
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return None
+        with self._lock:
+            until = self._backoff_until.get(group, 0.0)
+        if time.monotonic() < until:
+            return Status(
+                UNSCHEDULABLE,
+                f"gang {group} backing off after a failed admission",
+            )
+        return None
 
     def permit(self, state, pod: Pod, node_name: str):
         group, min_available = pod_group(pod)
@@ -68,12 +156,58 @@ class Coscheduling(PermitPlugin):
 
             self.handle.iterate_waiting_pods(allow)
             return None, 0.0
+        # activate siblings parked in backoff/unschedulable: the gang
+        # completes only if members OVERLAP at Permit, and staggered
+        # backoffs would stop that overlap from ever happening
+        nominator = getattr(self.handle, "pod_nominator", None)
+        if nominator is not None and hasattr(nominator, "gang_members_added"):
+            nominator.gang_members_added({group})
         return Status(WAIT, f"waiting for gang {group}"), self.wait_seconds
 
-    def unreserve_group(self, pod: Pod) -> None:
-        """Called when a gang member fails downstream: undo its arrival."""
+    def note_member_deleted(self, pod: Pod) -> None:
+        """A scheduled (bound) gang member was deleted: release its
+        arrival slot so a RE-CREATED gang under the same group name
+        starts from zero instead of inheriting the stale count and
+        skipping the Permit wait. Zeroed groups drop their bookkeeping
+        (bounded state across gang lifetimes)."""
         group, _ = pod_group(pod)
-        if group:
-            with self._lock:
-                if self._permitted.get(group, 0) > 0:
-                    self._permitted[group] -= 1
+        if not group:
+            return
+        with self._lock:
+            left = self._permitted.get(group)
+            if left is not None:
+                left -= 1
+                if left <= 0:
+                    self._permitted.pop(group, None)
+                    self._backoff_until.pop(group, None)
+                else:
+                    self._permitted[group] = left
+
+    def unreserve_group(self, pod: Pod) -> None:
+        """Called when a gang member fails downstream (Permit timeout,
+        bind failure, unreserve): undo its arrival, REJECT every member
+        still waiting at Permit (a partial gang must not keep squatting
+        on reserved resources for the full permit timeout), and start
+        the gang's backoff window."""
+        group, _ = pod_group(pod)
+        if not group:
+            return
+        with self._lock:
+            if self._permitted.get(group, 0) > 0:
+                self._permitted[group] -= 1
+            if self.backoff_seconds > 0:
+                self._backoff_until[group] = (
+                    time.monotonic() + self.backoff_seconds
+                )
+        if self.handle is None:
+            return
+
+        def reject(wp):
+            g, _ = pod_group(wp.pod)
+            if g == group:
+                wp.reject(
+                    self.NAME,
+                    f"gang {group} member {pod.name} failed admission",
+                )
+
+        self.handle.iterate_waiting_pods(reject)

@@ -83,7 +83,21 @@ def cluster_autoscaler_plugins(feature_gates=None) -> Plugins:
     return p
 
 
+def gang_scheduling_plugins(feature_gates=None) -> Plugins:
+    """Defaults + the out-of-tree coscheduling wiring (SURVEY.md
+    section 6: gang scheduling is a Permit-phase pattern, registered the
+    way out-of-tree plugins merge into the framework): gang-aware queue
+    sort (identical to PrioritySort for non-gang pods), gang-backoff
+    PreFilter, and the Permit gate. BASELINE config #5's profile."""
+    p = default_plugins(feature_gates)
+    p.queue_sort = PluginSet(enabled=[PluginEntry("CoschedulingSort")])
+    p.pre_filter.enabled.append(PluginEntry("Coscheduling"))
+    p.permit = PluginSet(enabled=[PluginEntry("Coscheduling")])
+    return p
+
+
 PROVIDERS = {
     "DefaultProvider": default_plugins,
     "ClusterAutoscalerProvider": cluster_autoscaler_plugins,
+    "GangSchedulingProvider": gang_scheduling_plugins,
 }

@@ -318,6 +318,36 @@ class SchedulingQueue(PodNominator):
         with self._cond:
             self._move_pods_locked(list(self._unschedulable_q.values()), event)
 
+    def gang_members_added(self, groups) -> None:
+        """A new (or re-queued) member of a coscheduling gang ACTIVATES
+        its siblings (the out-of-tree plugin's PodGroup activation /
+        framework Activate): members parked unschedulable or backing off
+        while the gang was short move straight to the active queue —
+        bypassing backoff, because a gang completes only when its
+        members overlap at Permit, and staggered backoffs prevent the
+        overlap forever. ``groups`` is a set of pod-group names (the
+        ``pod-group.scheduling.k8s.io/name`` label)."""
+        if not groups:
+            return
+        label = "pod-group.scheduling.k8s.io/name"
+
+        def in_groups(qpi: QueuedPodInfo) -> bool:
+            return qpi.pod.metadata.labels.get(label, "") in groups
+
+        with self._cond:
+            moved = False
+            for qpi in [q for q in self._unschedulable_q.values()
+                        if in_groups(q)]:
+                self._unschedulable_q.pop(get_pod_key(qpi.pod), None)
+                self._active_q.add(qpi)
+                moved = True
+            for qpi in [q for q in self._backoff_q.list() if in_groups(q)]:
+                self._backoff_q.delete(qpi)
+                self._active_q.add(qpi)
+                moved = True
+            if moved:
+                self._cond.notify_all()
+
     def assigned_pod_added(self, pod: Pod) -> None:
         with self._cond:
             self._move_pods_locked(

@@ -528,3 +528,234 @@ def test_disruption_percentage_rounds_up():
         assert got.status.expected_pods == 7
     finally:
         cm.stop()
+
+
+# ----------------------------------------------------------------------
+# controller breadth (reference controllermanager.go:387 registers 38):
+# namespace, resourcequota, serviceaccount, ttl-after-finished, cronjob,
+# nodeipam
+def test_namespace_controller_deletes_content_and_finalizes():
+    from kubernetes_tpu.api.types import Namespace, ObjectMeta
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["namespace"])
+    cm.start()
+    try:
+        store.add_namespace(Namespace(metadata=ObjectMeta(name="doomed")))
+        pod = MakePod().name("p1").uid("u1").obj()
+        pod.metadata.namespace = "doomed"
+        store.create_pod(pod)
+        # request deletion: phase -> Terminating
+        ns = store.get_namespace("doomed")
+        ns2 = Namespace(metadata=ns.metadata, phase="Terminating")
+        store.update_object("Namespace", ns2)
+        _wait(lambda: store.get_pod("doomed", "p1") is None,
+              msg="namespace content deleted")
+        _wait(lambda: store.get_namespace("doomed") is None,
+              msg="namespace finalized")
+    finally:
+        cm.stop()
+
+
+def test_resourcequota_controller_and_admission():
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import ObjectMeta, ResourceQuota
+    from kubernetes_tpu.apiserver.admission import (
+        AdmissionError, AdmissionRequest, ResourceQuotaAdmission,
+    )
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["resourcequota"])
+    cm.start()
+    try:
+        store.add_resource_quota(ResourceQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            hard={"pods": parse_quantity("2"),
+                  "requests.cpu": parse_quantity("1")},
+        ))
+        store.create_pod(MakePod().name("q1").uid("qu1")
+                         .req({"cpu": "500m"}).obj())
+        _wait(lambda: (
+            (q := store.get_resource_quota("default", "q").used.get("pods"))
+            is not None and int(q.value()) == 1
+        ), msg="quota usage pods=1")
+        used = store.get_resource_quota("default", "q").used
+        assert int(used["requests.cpu"].milli_value()) == 500
+
+        # admission: a pod pushing cpu past 1 full core is rejected
+        plugin = ResourceQuotaAdmission(store)
+        big = MakePod().name("big").uid("bu").req({"cpu": "600m"}).obj()
+        try:
+            plugin.validate(AdmissionRequest(
+                operation="CREATE", kind="Pod", namespace="default",
+                obj=big,
+            ))
+            raise AssertionError("quota admission should have rejected")
+        except AdmissionError:
+            pass
+        small = MakePod().name("small").uid("su").req({"cpu": "400m"}).obj()
+        plugin.validate(AdmissionRequest(
+            operation="CREATE", kind="Pod", namespace="default", obj=small,
+        ))
+    finally:
+        cm.stop()
+
+
+def test_serviceaccount_controller_ensures_default():
+    from kubernetes_tpu.api.types import Namespace, ObjectMeta
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["serviceaccount"])
+    cm.start()
+    try:
+        store.add_namespace(Namespace(metadata=ObjectMeta(name="team-a")))
+        _wait(lambda: store.get_service_account("team-a", "default")
+              is not None, msg="default SA created")
+        # deleted -> recreated
+        store.delete_object("ServiceAccount", "team-a", "default")
+        _wait(lambda: store.get_service_account("team-a", "default")
+              is not None, msg="default SA recreated")
+    finally:
+        cm.stop()
+
+
+def test_ttl_after_finished_deletes_expired_job():
+    from kubernetes_tpu.api.types import Job, ObjectMeta, WorkloadStatus
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["ttl-after-finished"])
+    cm.start()
+    try:
+        job = Job(
+            metadata=ObjectMeta(name="done", namespace="default"),
+            completions=1,
+            ttl_seconds_after_finished=1,
+            status=WorkloadStatus(succeeded=1,
+                                  completion_time=time.time() - 0.5),
+        )
+        store.add_job(job)
+        _wait(lambda: store.get_job("default", "done") is None,
+              timeout=8.0, msg="expired job deleted")
+        # a job with no ttl survives
+        store.add_job(Job(
+            metadata=ObjectMeta(name="keep", namespace="default"),
+            completions=1,
+            status=WorkloadStatus(succeeded=1,
+                                  completion_time=time.time() - 10),
+        ))
+        time.sleep(0.5)
+        assert store.get_job("default", "keep") is not None
+    finally:
+        cm.stop()
+
+
+def test_cronjob_controller_creates_job_on_schedule():
+    from kubernetes_tpu.api.types import CronJob, ObjectMeta
+    from kubernetes_tpu.controllers.cronjob import (
+        cron_matches, next_fire_after,
+    )
+
+    # cron matcher semantics
+    import calendar
+    t = time.mktime((2026, 7, 30, 12, 30, 0, 3, 0, -1))  # 12:30
+    assert cron_matches("* * * * *", t)
+    assert cron_matches("30 12 * * *", t)
+    assert cron_matches("*/15 * * * *", t)
+    assert not cron_matches("31 12 * * *", t)
+    assert next_fire_after("* * * * *", t) == (int(t) // 60 + 1) * 60
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["cronjob"])
+    ctrl = cm.get("cronjob")
+    # anchor in the past so "* * * * *" is due immediately
+    store.add_cron_job(CronJob(
+        metadata=ObjectMeta(
+            name="tick", namespace="default",
+            creation_timestamp=time.time() - 120,
+        ),
+        schedule="* * * * *",
+        job_template={"metadata": {"labels": {"app": "tick"}},
+                      "spec": {"containers": [{"name": "c"}]}},
+    ))
+    cm.start()
+    try:
+        _wait(lambda: any(
+            j.metadata.name.startswith("tick-")
+            for j in store.list_jobs()
+        ), msg="cron job created a Job")
+        job = next(j for j in store.list_jobs()
+                   if j.metadata.name.startswith("tick-"))
+        assert any(r.get("kind") == "CronJob"
+                   for r in job.metadata.owner_references)
+        cj = store.get_cron_job("default", "tick")
+        assert cj.last_schedule_time is not None
+    finally:
+        cm.stop()
+
+
+def test_nodeipam_allocates_and_recycles_cidrs():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["nodeipam"])
+    cm.start()
+    try:
+        for i in range(3):
+            store.add_node(MakeNode().name(f"ip{i}")
+                           .capacity({"cpu": "4"}).obj())
+        _wait(lambda: all(
+            store.get_node(f"ip{i}").spec.pod_cidr for i in range(3)
+        ), msg="pod CIDRs allocated")
+        cidrs = {store.get_node(f"ip{i}").spec.pod_cidr for i in range(3)}
+        assert len(cidrs) == 3  # unique
+        assert all(c.endswith("/24") and c.startswith("10.244.")
+                   for c in cidrs)
+        # release on delete, reuse for the next node
+        released = store.get_node("ip0").spec.pod_cidr
+        store.delete_node("ip0")
+        time.sleep(0.2)
+        store.add_node(MakeNode().name("ip3").capacity({"cpu": "4"}).obj())
+        _wait(lambda: store.get_node("ip3").spec.pod_cidr,
+              msg="reused CIDR allocated")
+        assert store.get_node("ip3").spec.pod_cidr == released
+    finally:
+        cm.stop()
+
+
+def test_cron_day_of_week_is_sunday_zero():
+    from kubernetes_tpu.controllers.cronjob import cron_matches
+
+    # 2026-08-02 is a Sunday
+    sunday = time.mktime((2026, 8, 2, 9, 0, 0, 0, 0, -1))
+    monday = time.mktime((2026, 8, 3, 9, 0, 0, 0, 0, -1))
+    assert cron_matches("0 9 * * 0", sunday)
+    assert not cron_matches("0 9 * * 0", monday)
+    assert cron_matches("0 9 * * 1", monday)
+
+
+def test_quota_admission_burst_cannot_overshoot():
+    """Synchronous charging: a burst of creates admitted before the
+    controller recomputes status must still respect hard caps."""
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import ObjectMeta, ResourceQuota
+    from kubernetes_tpu.apiserver.admission import (
+        AdmissionError, AdmissionRequest, ResourceQuotaAdmission,
+    )
+
+    store = ClusterStore()
+    store.add_resource_quota(ResourceQuota(
+        metadata=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": parse_quantity("3")},
+    ))
+    plugin = ResourceQuotaAdmission(store)
+    admitted = 0
+    rejected = 0
+    for i in range(10):  # no controller running: status.used stays {}
+        pod = MakePod().name(f"burst{i}").uid(f"bu{i}").obj()
+        try:
+            plugin.validate(AdmissionRequest(
+                operation="CREATE", kind="Pod", namespace="default",
+                obj=pod,
+            ))
+            admitted += 1
+        except AdmissionError:
+            rejected += 1
+    assert admitted == 3 and rejected == 7

@@ -173,7 +173,8 @@ def _run(nodes, pods, mode, store=None, max_batch=512):
         store.add_node(n)
     use_batch = mode != "serial"
     sched = Scheduler.create(
-        store, feature_gates=FeatureGates({"TPUBatchScheduler": use_batch})
+        store, feature_gates=FeatureGates({"TPUBatchScheduler": use_batch}),
+        provider="GangSchedulingProvider",
     )
     bs = None
     if use_batch:

@@ -323,3 +323,133 @@ class TestInterPodAffinity:
         sa, _ = plugin.score(state, pod, "a1")
         sb, _ = plugin.score(state, pod, "b1")
         assert sa > sb
+
+
+class TestNodeVolumeLimits:
+    """nodevolumelimits semantics (reference csi.go / non_csi.go):
+    CSI limits from CSINode allocatable, unbound PVCs resolved through
+    the StorageClass provisioner, in-tree limits from node allocatable
+    attachable-volumes resources."""
+
+    def _store(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        return ClusterStore()
+
+    def _csi_setup(self, store, node_name="n1", limit=2,
+                   driver="csi.fake.driver"):
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import (
+            CSINode, CSINodeDriver, ObjectMeta, PersistentVolume,
+            PersistentVolumeClaim, StorageClass,
+        )
+
+        store.add_csi_node(CSINode(
+            metadata=ObjectMeta(name=node_name),
+            drivers=[CSINodeDriver(name=driver, node_id=node_name,
+                                   allocatable_count=limit)],
+        ))
+        store.add_storage_class(StorageClass(
+            metadata=ObjectMeta(name="sc"), provisioner=driver,
+        ))
+        for i in range(4):
+            store.add_pv(PersistentVolume(
+                metadata=ObjectMeta(name=f"pv-{i}"),
+                capacity={"storage": parse_quantity("1Gi")},
+                storage_class_name="sc", csi_driver=driver,
+            ))
+            store.add_pvc(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"claim-{i}", namespace="default"),
+                storage_class_name="sc", volume_name=f"pv-{i}",
+                phase="Bound",
+            ))
+
+    def test_csi_limit_from_csinode(self):
+        from kubernetes_tpu.scheduler.framework.plugins import (
+            node_volume_limits as nvl,
+        )
+
+        store = self._store()
+        self._csi_setup(store, limit=2)
+        plugin = nvl.CSILimits(FakeHandle(client=store))
+        node = MakeNode().name("n1").capacity({"cpu": "8"}).obj()
+        existing = [
+            MakePod().name(f"e{i}").uid(f"eu{i}").node("n1")
+            .pvc(f"claim-{i}").obj()
+            for i in range(2)
+        ]
+        ni = node_info_for(node, *existing)
+        pod = MakePod().name("p").uid("pu").pvc("claim-2").obj()
+        st = plugin.filter(CycleState(), pod, ni)
+        assert st is not None and not st.is_success()  # 3 > limit 2
+        # a pod reusing an ALREADY-ATTACHED volume fits (same pv)
+        pod2 = MakePod().name("q").uid("qu").pvc("claim-1").obj()
+        assert plugin.filter(CycleState(), pod2, ni) is None
+
+    def test_csi_unbound_pvc_counts_via_storage_class(self):
+        from kubernetes_tpu.api.types import ObjectMeta, PersistentVolumeClaim
+        from kubernetes_tpu.scheduler.framework.plugins import (
+            node_volume_limits as nvl,
+        )
+
+        store = self._store()
+        self._csi_setup(store, limit=2)
+        # two unbound claims: no PV yet, driver resolves via the SC
+        for name in ("pend-0", "pend-1"):
+            store.add_pvc(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                storage_class_name="sc", phase="Pending",
+            ))
+        plugin = nvl.CSILimits(FakeHandle(client=store))
+        node = MakeNode().name("n1").capacity({"cpu": "8"}).obj()
+        existing = [
+            MakePod().name("e0").uid("eu0").node("n1").pvc("claim-0").obj(),
+            MakePod().name("e1").uid("eu1").node("n1").pvc("pend-0").obj(),
+        ]
+        ni = node_info_for(node, *existing)
+        pod = MakePod().name("p").uid("pu").pvc("pend-1").obj()
+        st = plugin.filter(CycleState(), pod, ni)
+        assert st is not None and not st.is_success()  # bound+2 pending > 2
+
+    def test_intree_limit_from_node_allocatable(self):
+        from kubernetes_tpu.api.types import Volume
+        from kubernetes_tpu.scheduler.framework.plugins import (
+            node_volume_limits as nvl,
+        )
+
+        plugin = nvl.EBSLimits(FakeHandle())
+        node = MakeNode().name("n1").capacity({"cpu": "8"}).allocatable({
+            "cpu": "8", "attachable-volumes-aws-ebs": "1",
+        }).obj()
+        existing = MakePod().name("e").uid("eu").node("n1").obj()
+        existing.spec.volumes.append(
+            Volume(name="v0", aws_elastic_block_store="vol-0"))
+        ni = node_info_for(node, existing)
+        pod = MakePod().name("p").uid("pu").obj()
+        pod.spec.volumes.append(
+            Volume(name="v1", aws_elastic_block_store="vol-1"))
+        st = plugin.filter(CycleState(), pod, ni)
+        assert st is not None and not st.is_success()  # 2 > node limit 1
+        # default limit (39) admits the same pod when the node publishes
+        # no attachable-volumes resource
+        node2 = MakeNode().name("n2").capacity({"cpu": "8"}).obj()
+        ni2 = node_info_for(node2, existing)
+        assert plugin.filter(CycleState(), pod, ni2) is None
+
+    def test_azure_disk_counts_azure_volumes(self):
+        from kubernetes_tpu.api.types import Volume
+        from kubernetes_tpu.scheduler.framework.plugins import (
+            node_volume_limits as nvl,
+        )
+
+        plugin = nvl.AzureDiskLimits(FakeHandle())
+        node = MakeNode().name("n1").capacity({"cpu": "8"}).allocatable({
+            "cpu": "8", "attachable-volumes-azure-disk": "1",
+        }).obj()
+        existing = MakePod().name("e").uid("eu").node("n1").obj()
+        existing.spec.volumes.append(Volume(name="v0", azure_disk="d0"))
+        ni = node_info_for(node, existing)
+        pod = MakePod().name("p").uid("pu").obj()
+        pod.spec.volumes.append(Volume(name="v1", azure_disk="d1"))
+        st = plugin.filter(CycleState(), pod, ni)
+        assert st is not None and not st.is_success()

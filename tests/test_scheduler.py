@@ -246,6 +246,116 @@ class TestGangScheduling:
         assert store.get_pod("default", "g2").spec.node_name != ""
         sched.stop()
 
+    def test_queue_sort_coorders_gang_members(self):
+        """CoschedulingSort drains a gang's members contiguously even
+        when their creation interleaves with another gang's (the
+        out-of-tree plugin's queue-sort behavior): interleaving is the
+        gang starvation mode."""
+        from kubernetes_tpu.scheduler.framework.plugins.coscheduling import (
+            CoschedulingSort,
+        )
+        from kubernetes_tpu.scheduler.types import QueuedPodInfo
+
+        sort = CoschedulingSort()
+        qpis = []
+        for i in range(3):  # a0 b0 a1 b1 a2 b2 (interleaved arrival)
+            for g in ("a", "b"):
+                qpi = QueuedPodInfo(self._gang_pod(f"{g}{i}", f"gang-{g}", 3))
+                qpi.timestamp = float(len(qpis))
+                qpis.append(qpi)
+        ordered = sorted(qpis, key=sort.sort_key)
+        names = [q.pod.name for q in ordered]
+        assert names == ["a0", "a1", "a2", "b0", "b1", "b2"], names
+
+    def test_partial_gang_rejected_together_then_backs_off(self):
+        """A partial gang must not squat at Permit: when the first
+        member's permit times out, every waiting member is rejected in
+        the same instant, and the gang backs off (PreFilter fails fast)
+        until the window expires — after which a completed gang binds."""
+        from kubernetes_tpu.config.types import PluginConfig
+
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "4", "memory": "8Gi"}).obj()
+            )
+        profile = KubeSchedulerProfile(
+            plugin_config=[PluginConfig("Coscheduling", {
+                "permitWaitSeconds": 0.3,
+                "gangBackoffSeconds": 0.5,
+            })],
+        )
+        config = KubeSchedulerConfiguration(profiles=[profile])
+        sched = make_scheduler(store, config=config,
+                               provider="GangSchedulingProvider")
+        # only 3 of 4 members exist: the gang can never complete
+        for i in range(3):
+            store.create_pod(self._gang_pod(f"m{i}", "squad", 4))
+        while sched.schedule_one(pop_timeout=0.0):
+            pass
+        t0 = time.monotonic()
+        # permit timeout fires for the first member; the plugin must
+        # reject the OTHER waiting members immediately (not one timeout
+        # each), so all three come back well before 3 x 0.3s
+        sched.wait_for_inflight_bindings(timeout=5.0)
+        assert time.monotonic() - t0 < 0.9
+        assert all(
+            not store.get_pod("default", f"m{i}").spec.node_name
+            for i in range(3)
+        )
+        # while backing off, members fail fast at PreFilter
+        gang = sched.profiles["default-scheduler"].get_plugin("Coscheduling")
+        from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+
+        st = gang.pre_filter(CycleState(), store.get_pod("default", "m0"))
+        assert st is not None and not st.is_success()
+        # after the backoff window, the COMPLETED gang binds
+        time.sleep(0.6)
+        store.create_pod(self._gang_pod("m3", "squad", 4))
+        drain(sched, timeout=15.0)
+        sched.wait_for_inflight_bindings()
+        bound = [store.get_pod("default", f"m{i}").spec.node_name
+                 for i in range(4)]
+        assert all(bound), bound
+        sched.stop()
+
+    def test_gang_sort_prevents_interleaved_gang_deadlock(self):
+        """Capacity for one gang only, two gangs' members interleaved:
+        with gang-aware sorting one gang admits fully and binds; the
+        other stays pending. (With plain FIFO both gangs half-reserve
+        and neither can complete until permit timeouts fire.)"""
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "1", "memory": "8Gi"}).obj()
+            )  # 4 one-cpu slots: exactly one 4-pod gang fits
+        sched = make_scheduler(store, provider="GangSchedulingProvider")
+        for i in range(4):  # interleaved: a0 b0 a1 b1 ...
+            store.create_pod(self._gang_pod(f"a{i}", "gang-a", 4))
+            store.create_pod(self._gang_pod(f"b{i}", "gang-b", 4))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sched.queue.flush_backoff_completed()
+            if sched.schedule_one(pop_timeout=0.0):
+                continue
+            a_bound = sum(
+                1 for i in range(4)
+                if store.get_pod("default", f"a{i}").spec.node_name
+            )
+            if a_bound == 4:
+                break
+            time.sleep(0.02)
+        sched.wait_for_inflight_bindings()
+        a_bound = [store.get_pod("default", f"a{i}").spec.node_name
+                   for i in range(4)]
+        b_bound = [store.get_pod("default", f"b{i}").spec.node_name
+                   for i in range(4)]
+        assert all(a_bound), a_bound      # first gang complete
+        assert not any(b_bound), b_bound  # second gang untouched
+        sched.stop()
+
 
 class TestMultiProfile:
     def test_second_profile(self):
@@ -355,3 +465,49 @@ class TestSchedulerLeaderElection:
             _time.sleep(0.05)
         assert s.lost_lease
         assert s._stop.is_set()  # fatal-style stop
+
+
+class TestGangRecreation:
+    def test_recreated_gang_regates_at_permit(self):
+        """Deleting a bound gang and resubmitting under the same group
+        name must NOT inherit the old arrival count — the new gang's
+        first member has to wait for siblings again."""
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "8Gi"}).obj()
+            )
+        sched = make_scheduler(store, provider="GangSchedulingProvider")
+
+        def gang_pod(name, uid):
+            return (
+                MakePod().name(name).uid(uid)
+                .label("pod-group.scheduling.k8s.io/name", "team")
+                .label("pod-group.scheduling.k8s.io/min-available", "2")
+                .req({"cpu": "1"}).obj()
+            )
+
+        store.create_pod(gang_pod("g1", "u1"))
+        store.create_pod(gang_pod("g2", "u2"))
+        drain(sched)
+        sched.wait_for_inflight_bindings()
+        assert store.get_pod("default", "g1").spec.node_name
+        assert store.get_pod("default", "g2").spec.node_name
+        # delete the whole bound gang
+        store.delete_pod("default", "g1")
+        store.delete_pod("default", "g2")
+        time.sleep(0.1)
+        # resubmit ONE member of a new gang with the same name: it must
+        # wait at Permit (not ride the stale count straight to bind)
+        store.create_pod(gang_pod("h1", "u3"))
+        while sched.schedule_one(pop_timeout=0.0):
+            pass
+        assert store.get_pod("default", "h1").spec.node_name == ""
+        # second member completes the gang
+        store.create_pod(gang_pod("h2", "u4"))
+        drain(sched)
+        sched.wait_for_inflight_bindings()
+        assert store.get_pod("default", "h1").spec.node_name
+        assert store.get_pod("default", "h2").spec.node_name
+        sched.stop()
