@@ -217,10 +217,12 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         }
         with self._lock:
             now = _time.time()
+            # namespace filter runs store-side (one pass under the store
+            # lock) — a cluster-wide copy per quota'd CREATE would make
+            # admission O(all pods) under this plugin-global lock
             live = [
-                p for p in self.store.list_pods()
-                if p.namespace == req.namespace
-                and p.status.phase not in ("Succeeded", "Failed")
+                p for p in self.store.list_pods(namespace=req.namespace)
+                if p.status.phase not in ("Succeeded", "Failed")
             ]
             live_keys = {(p.namespace, p.name) for p in live}
             # settle in-flight charges: visible in the store now, or

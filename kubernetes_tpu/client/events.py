@@ -52,20 +52,28 @@ class EventRecorder:
     # ------------------------------------------------------------------
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         """Record an event against ``obj``. Non-blocking: enqueues for the
-        flush thread (recorderImpl.Event → broadcaster channel)."""
+        flush thread (recorderImpl.Event → broadcaster channel). The
+        object reference is extracted at FLUSH time — API objects are
+        immutable after create (copy-on-write updates), so deferring is
+        safe and keeps the hot path to one deque append."""
+        self._enqueue(obj, event_type, reason, message, ())
+
+    def eventf(self, obj, event_type: str, reason: str,
+               fmt: str, *args) -> None:
+        """Like ``event`` but defers ``fmt % args`` to the flush thread —
+        the scheduler records one Scheduled event per bound pod, and
+        string formatting is pure overhead on the commit hot path."""
+        self._enqueue(obj, event_type, reason, fmt, args)
+
+    def _enqueue(self, obj, event_type, reason, fmt, args) -> None:
         with self._lock:
             if len(self._queue) >= self._cap:
                 self.dropped += 1   # full channel: drop, never block
                 return
             self._queue.append(
-                (object_reference(obj), event_type, reason, message,
-                 time.time())
+                (obj, event_type, reason, fmt, args, time.time())
             )
         self._wake.set()
-
-    def eventf(self, obj, event_type: str, reason: str,
-               fmt: str, *args) -> None:
-        self.event(obj, event_type, reason, fmt % args if args else fmt)
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -105,8 +113,9 @@ class EventRecorder:
         with self._lock:
             items = list(self._queue)
             self._queue.clear()
-        for ref, etype, reason, message, ts in items:
-            self._write(ref, etype, reason, message, ts)
+        for obj, etype, reason, fmt, args, ts in items:
+            message = fmt % args if args else fmt
+            self._write(object_reference(obj), etype, reason, message, ts)
         now = time.time()
         if items and now - self._last_prune > _PRUNE_INTERVAL:
             self._last_prune = now

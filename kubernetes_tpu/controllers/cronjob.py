@@ -117,6 +117,15 @@ class CronJobController(Controller):
         due = next_fire_after(cj.schedule, anchor)
         if due is None or due > now:
             return
+        # only the MOST RECENT unmet fire runs (reference syncOne takes
+        # the latest of getRecentUnmetScheduleTimes and refuses a >100
+        # backlog); catching up one-per-pass would burst a day of missed
+        # "* * * * *" fires into ~1440 Jobs on resume
+        while True:
+            nxt = next_fire_after(cj.schedule, due)
+            if nxt is None or nxt > now:
+                break
+            due = nxt
         job_name = f"{name}-{int(due) // 60}"
         if self.store.get_job(ns, job_name) is None:
             self.store.add_job(Job(

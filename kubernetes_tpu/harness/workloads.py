@@ -252,9 +252,12 @@ def mixed_scheduling_base_pod(nodes, init_pods, measure_pods):
         lambda i: _with(basic_pod(i, labels={"color": f"x{i % 20}"}),
                         _affinity("podAffinity", "color", [f"x{i % 20}"],
                                   "topology.kubernetes.io/zone")),
+        # hostname-keyed anti-affinity: with 20 groups over 10 zones a
+        # zone key would make the 11th member of a group permanently
+        # unschedulable and deadlock the init op's wait-for-scheduled
         lambda i: _with(basic_pod(i, labels={"color": f"y{i % 20}"}),
                         _affinity("podAntiAffinity", "color", [f"y{i % 20}"],
-                                  "topology.kubernetes.io/zone")),
+                                  "kubernetes.io/hostname")),
         lambda i: _with(basic_pod(i, labels={"app": "mix"}),
                         _spread(2, "topology.kubernetes.io/zone",
                                 "DoNotSchedule", {"app": "mix"})),

@@ -10,10 +10,11 @@ from __future__ import annotations
 from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Node, Pod
 from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, Event
 from kubernetes_tpu.scheduler import events as ev
-
 # gang (coscheduling) group label; a new member activates unschedulable
 # siblings via the queue's gang wakeup
-GANG_GROUP_LABEL = "pod-group.scheduling.k8s.io/name"
+from kubernetes_tpu.scheduler.framework.plugins.coscheduling import (
+    GROUP_NAME_LABEL as GANG_GROUP_LABEL,
+)
 
 
 def assigned(pod: Pod) -> bool:

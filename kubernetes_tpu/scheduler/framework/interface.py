@@ -107,23 +107,31 @@ class PostFilterResult:
 @dataclass
 class FitError(Exception):
     """Raised when no node fits (reference core.FitError): carries the
-    per-node filter statuses preemption and diagnostics read."""
+    per-node filter statuses preemption and diagnostics read.
+
+    ``message`` (when set) short-circuits ``__str__`` — the batch
+    mass-decline path shares one statuses map across thousands of pods,
+    and aggregating it per pod is O(nodes) each."""
 
     pod: Pod = None
     num_all_nodes: int = 0
     filtered_nodes_statuses: NodeToStatusMap = field(default_factory=dict)
+    message: str = ""
 
     def __str__(self):
+        if self.message:
+            return self.message
         reasons: Dict[str, int] = {}
         for s in self.filtered_nodes_statuses.values():
             for r in s.reasons:
                 reasons[r] = reasons.get(r, 0) + 1
         parts = [f"{n} {m}" for m, n in sorted(reasons.items(), key=lambda kv: kv[0])]
-        return (
+        self.message = (
             f"0/{self.num_all_nodes} nodes are available: {', '.join(parts)}."
             if parts
             else f"0/{self.num_all_nodes} nodes are available."
         )
+        return self.message
 
 
 class Plugin:

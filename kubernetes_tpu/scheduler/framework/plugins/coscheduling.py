@@ -67,12 +67,17 @@ class CoschedulingSort(QueueSortPlugin):
 
     NAME = "CoschedulingSort"
 
-    # bounded gang-anchor memory across gang lifetimes: oldest anchors
-    # evict first (an evicted group re-anchors at its next sighting)
+    # bounded gang-anchor memory across gang lifetimes: least-recently-
+    # SIGHTED groups evict first — a still-queued gang keeps being
+    # sighted on every sort-key computation, so eviction targets dead
+    # groups and never re-keys entries sitting in the active heap
+    # (re-anchoring an in-heap group would break the heap invariant)
     MAX_ANCHORS = 4096
 
     def __init__(self):
         self._lock = threading.Lock()
+        # group -> anchor timestamp; dict order doubles as the LRU
+        # (move_to_end on every sighting)
         self._anchors: Dict[str, float] = {}
 
     @staticmethod
@@ -86,13 +91,15 @@ class CoschedulingSort(QueueSortPlugin):
         with self._lock:
             ts = self._anchors.get(group)
             if ts is None or qpi.timestamp < ts:
-                ts = qpi.timestamp
-                self._anchors[group] = ts
-                if len(self._anchors) > self.MAX_ANCHORS:
-                    for g, _ in sorted(
-                        self._anchors.items(), key=lambda kv: kv[1]
-                    )[: self.MAX_ANCHORS // 4]:
-                        del self._anchors[g]
+                ts = qpi.timestamp if ts is None else min(ts, qpi.timestamp)
+            # refresh recency (plain dicts preserve insertion order)
+            self._anchors.pop(group, None)
+            self._anchors[group] = ts
+            if len(self._anchors) > self.MAX_ANCHORS:
+                drop = len(self._anchors) - self.MAX_ANCHORS + \
+                    self.MAX_ANCHORS // 4
+                for g in list(self._anchors)[:drop]:
+                    del self._anchors[g]
         return ts, group
 
     def sort_key(self, qpi: QueuedPodInfo) -> tuple:
@@ -122,6 +129,13 @@ class Coscheduling(PermitPlugin, PreFilterPlugin):
         self._lock = threading.Lock()
         self._permitted: Dict[str, int] = {}  # group -> pods at/past Permit
         self._backoff_until: Dict[str, float] = {}
+        # group -> uids of members parked at Permit. Release/reject walk
+        # THIS index via handle.get_waiting_pod (dict lookups) instead of
+        # iterate_waiting_pods — the generic scan is O(all waiting pods)
+        # per release, which is quadratic across a batch full of gangs.
+        # Safe because members are parked sequentially by the commit
+        # loop before the releasing member's permit() runs.
+        self._waiting: Dict[str, set] = {}
 
     # ------------------------------------------------------------------
     def pre_filter(self, state, pod: Pod):
@@ -147,14 +161,17 @@ class Coscheduling(PermitPlugin, PreFilterPlugin):
         with self._lock:
             self._permitted[group] = self._permitted.get(group, 0) + 1
             arrived = self._permitted[group]
-        if arrived >= min_available:
+            if arrived >= min_available:
+                members = self._waiting.pop(group, set())
+            else:
+                self._waiting.setdefault(group, set()).add(pod.uid)
+                members = None
+        if members is not None:
             # release every gang member parked at Permit
-            def allow(wp):
-                g, _ = pod_group(wp.pod)
-                if g == group:
+            for uid in members:
+                wp = self.handle.get_waiting_pod(uid)
+                if wp is not None:
                     wp.allow(self.NAME)
-
-            self.handle.iterate_waiting_pods(allow)
             return None, 0.0
         # activate siblings parked in backoff/unschedulable: the gang
         # completes only if members OVERLAP at Permit, and staggered
@@ -193,21 +210,33 @@ class Coscheduling(PermitPlugin, PreFilterPlugin):
         if not group:
             return
         with self._lock:
-            if self._permitted.get(group, 0) > 0:
-                self._permitted[group] -= 1
+            left = self._permitted.get(group, 0) - 1
+            if left > 0:
+                self._permitted[group] = left
+            else:
+                # zeroed groups drop their counter — failed/deleted-
+                # while-pending gangs must not accumulate state forever
+                self._permitted.pop(group, None)
             if self.backoff_seconds > 0:
                 self._backoff_until[group] = (
                     time.monotonic() + self.backoff_seconds
                 )
+                # opportunistic prune: expired backoff windows are dead
+                # weight (note_member_deleted only covers bound gangs)
+                if len(self._backoff_until) > 1024:
+                    now = time.monotonic()
+                    self._backoff_until = {
+                        g: t for g, t in self._backoff_until.items()
+                        if t > now
+                    }
+            members = self._waiting.pop(group, set())
+            members.discard(pod.uid)
         if self.handle is None:
             return
-
-        def reject(wp):
-            g, _ = pod_group(wp.pod)
-            if g == group:
+        for uid in members:
+            wp = self.handle.get_waiting_pod(uid)
+            if wp is not None:
                 wp.reject(
                     self.NAME,
                     f"gang {group} member {pod.name} failed admission",
                 )
-
-        self.handle.iterate_waiting_pods(reject)

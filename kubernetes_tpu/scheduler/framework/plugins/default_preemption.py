@@ -91,10 +91,27 @@ class DefaultPreemption(PostFilterPlugin):
                     return False
         return True
 
+    # CycleState key for batch-computed candidate hints (the sidecar's
+    # vectorized preemption screen, scheduler/preemption_screen.py)
+    HINTS_KEY = "DefaultPreemption/candidate-hints"
+    # with trusted hints, a handful of validated candidates suffices —
+    # the screen already ranked the whole cluster
+    HINTED_DRY_RUNS = 4
+
     def _find_candidates(
         self, state, pod: Pod, statuses: NodeToStatusMap
     ) -> List[_Candidate]:
         snapshot = self.handle.snapshot()
+        try:
+            hints = state.read(self.HINTS_KEY)
+        except KeyError:
+            hints = None
+        if hints:
+            candidates = self._dry_run_hints(state, pod, statuses,
+                                             snapshot, hints)
+            if candidates:
+                return candidates
+            # stale/empty hints: fall through to the unpruned scan
         # nodes where preemption might help: everything not marked
         # UnschedulableAndUnresolvable (:274 nodesWherePreemptionMightHelp)
         potential = [
@@ -141,6 +158,33 @@ class DefaultPreemption(PostFilterPlugin):
                 # after the offset cannot force a needless PDB break
                 if len(candidates) >= num_candidates and non_violating_found:
                     break
+        return candidates
+
+    def _dry_run_hints(self, state, pod: Pod, statuses: NodeToStatusMap,
+                       snapshot, hints) -> List[_Candidate]:
+        """Dry-run the batch screen's ranked candidates (full filter
+        fidelity — the screen only pruned). Stops once a few validated
+        candidates exist with at least one PDB-non-violating choice."""
+        pdbs = self.handle.client.list_pdbs()
+        candidates: List[_Candidate] = []
+        non_violating_found = False
+        for name in hints:
+            st = statuses.get(name)
+            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue
+            ni = snapshot.get(name)
+            if ni is None or ni.node is None:
+                continue
+            result = self._select_victims_on_node(state, pod, ni, pdbs)
+            if result is None:
+                continue
+            victims, violations = result
+            candidates.append(_Candidate(name, victims, violations))
+            if violations == 0:
+                non_violating_found = True
+            if len(candidates) >= self.HINTED_DRY_RUNS and \
+                    non_violating_found:
+                break
         return candidates
 
     def _select_victims_on_node(

@@ -329,10 +329,13 @@ class SchedulingQueue(PodNominator):
         ``pod-group.scheduling.k8s.io/name`` label)."""
         if not groups:
             return
-        label = "pod-group.scheduling.k8s.io/name"
+        from kubernetes_tpu.scheduler.framework.plugins.coscheduling import (
+            GROUP_NAME_LABEL,
+        )
 
         def in_groups(qpi: QueuedPodInfo) -> bool:
-            return qpi.pod.metadata.labels.get(label, "") in groups
+            return qpi.pod.metadata.labels.get(GROUP_NAME_LABEL, "") \
+                in groups
 
         with self._cond:
             moved = False
@@ -345,6 +348,11 @@ class SchedulingQueue(PodNominator):
                 self._backoff_q.delete(qpi)
                 self._active_q.add(qpi)
                 moved = True
+            # the moveRequestCycle protocol (scheduling_queue.go:317):
+            # a gang member mid-cycle when this wakeup fires must see it,
+            # or its failure parks it unschedulable with no further
+            # activation events until the permit timeout collapses the gang
+            self._move_request_cycle = self.scheduling_cycle
             if moved:
                 self._cond.notify_all()
 

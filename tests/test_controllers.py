@@ -759,3 +759,32 @@ def test_quota_admission_burst_cannot_overshoot():
         except AdmissionError:
             rejected += 1
     assert admitted == 3 and rejected == 7
+
+
+def test_cronjob_resume_runs_only_latest_missed_fire():
+    """A day of missed '* * * * *' fires must NOT burst into a Job per
+    missed minute on resume — only the most recent unmet schedule time
+    runs (reference syncOne + getRecentUnmetScheduleTimes)."""
+    from kubernetes_tpu.api.types import CronJob, ObjectMeta
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["cronjob"])
+    store.add_cron_job(CronJob(
+        metadata=ObjectMeta(
+            name="lag", namespace="default",
+            creation_timestamp=time.time() - 24 * 3600,
+        ),
+        schedule="* * * * *",
+        job_template={"spec": {"containers": [{"name": "c"}]}},
+    ))
+    cm.start()
+    try:
+        _wait(lambda: any(
+            j.metadata.name.startswith("lag-") for j in store.list_jobs()
+        ), msg="latest fire ran")
+        time.sleep(1.5)  # several controller passes
+        jobs = [j for j in store.list_jobs()
+                if j.metadata.name.startswith("lag-")]
+        assert len(jobs) <= 2, [j.metadata.name for j in jobs]
+    finally:
+        cm.stop()

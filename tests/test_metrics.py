@@ -1,0 +1,122 @@
+"""Metrics registry + event recorder hot-path semantics."""
+
+from kubernetes_tpu.metrics.registry import Histogram
+
+
+class TestHistogramBulk:
+    def test_observe_many_matches_observe(self):
+        a = Histogram("h_a", "", ("result",))
+        b = Histogram("h_b", "", ("result",))
+        values = [0.0005, 0.003, 0.05, 0.7, 3.0, 30.0, 100.0]
+        for v in values:
+            a.observe(v, "x")
+        b.observe_many(values, "x")
+        assert a.count("x") == b.count("x") == len(values)
+        assert abs(a.sum("x") - b.sum("x")) < 1e-12
+        for q in (0.5, 0.9, 0.99):
+            assert a.quantile(q, "x") == b.quantile(q, "x")
+
+    def test_observe_many_empty_is_noop(self):
+        h = Histogram("h_c", "")
+        h.observe_many([])
+        assert h.count() == 0
+
+
+class TestLazyEvents:
+    def test_eventf_defers_formatting_to_flush(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.client.events import EventRecorder
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        rec = EventRecorder(store, "test")
+        pod = MakePod().name("p").uid("u").obj()
+        rec.eventf(pod, "Normal", "Scheduled",
+                   "Successfully assigned %s/%s to %s",
+                   pod.namespace, pod.name, "n1")
+        # formatting has not happened yet (queue holds fmt + args)
+        assert not store.list_events()
+        rec.flush_now()
+        evs = store.list_events()
+        assert len(evs) == 1
+        assert evs[0].message == "Successfully assigned default/p to n1"
+        assert evs[0].involved_object.name == "p"
+
+    def test_plain_event_still_correlates(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.client.events import EventRecorder
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        rec = EventRecorder(store, "test")
+        pod = MakePod().name("p").uid("u").obj()
+        for _ in range(3):
+            rec.event(pod, "Warning", "FailedScheduling", "0/5 nodes")
+        rec.flush_now()
+        evs = store.list_events()
+        assert len(evs) == 1
+        assert evs[0].count == 3
+
+
+class TestPreemptionScreen:
+    def test_candidates_ranked_and_screened(self):
+        from kubernetes_tpu.scheduler.preemption_screen import build_screen
+        from kubernetes_tpu.scheduler.snapshot import new_snapshot
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        nodes = [
+            MakeNode().name(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"})
+            .obj()
+            for i in range(4)
+        ]
+        # n0: one 3-cpu victim (prio 1); n1: three 1-cpu victims (prio 1);
+        # n2: high-priority resident only (no victims); n3: empty but
+        # won't need preemption (screen requires victims)
+        pods = [
+            MakePod().name("v0").uid("v0").node("n0").priority(1)
+            .req({"cpu": "3"}).obj(),
+            *[MakePod().name(f"v1{j}").uid(f"v1{j}").node("n1").priority(1)
+              .req({"cpu": "1"}).obj() for j in range(3)],
+            MakePod().name("hi").uid("hi").node("n2").priority(1000)
+            .req({"cpu": "3"}).obj(),
+        ]
+        snap = new_snapshot(pods, nodes)
+        screen = build_screen(snap)
+        preemptor = MakePod().name("p").uid("p").priority(100)
+        pod = preemptor.req({"cpu": "3"}).obj()
+        hints = screen.candidates_for(pod, k=4)
+        # n2's resident outranks the preemptor -> not a candidate;
+        # n3 has no victims -> excluded; n0 (1 victim) ranks before
+        # n1 (needs 2+ of its 3 victims)
+        assert "n2" not in hints and "n3" not in hints
+        assert hints[0] == "n0"
+        assert set(hints) == {"n0", "n1"}
+        # rotation spreads identical preemptors over distinct heads
+        r1 = screen.candidates_for(pod, k=1, rotation=1)
+        assert r1 and r1[0] != hints[0]
+        # a priority-0 preemptor has no one below it
+        zero = MakePod().name("z").uid("z").priority(0).req({"cpu": "1"}).obj()
+        assert screen.candidates_for(zero) == []
+
+    def test_static_mask_prunes(self):
+        import numpy as np
+
+        from kubernetes_tpu.scheduler.preemption_screen import build_screen
+        from kubernetes_tpu.scheduler.snapshot import new_snapshot
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        nodes = [
+            MakeNode().name(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"})
+            .obj()
+            for i in range(2)
+        ]
+        pods = [
+            MakePod().name(f"v{i}").uid(f"v{i}").node(f"n{i}").priority(0)
+            .req({"cpu": "3"}).obj()
+            for i in range(2)
+        ]
+        screen = build_screen(new_snapshot(pods, nodes))
+        pod = MakePod().name("p").uid("p").priority(10).req({"cpu": "3"}).obj()
+        mask = np.array([False, True])
+        hints = screen.candidates_for(pod, static_mask=mask)
+        assert hints == ["n1"]
