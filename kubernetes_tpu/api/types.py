@@ -61,6 +61,7 @@ class ObjectMeta:
     creation_timestamp: float = 0.0
     deletion_timestamp: Optional[float] = None
     owner_references: List[dict] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "ObjectMeta":
@@ -77,6 +78,7 @@ class ObjectMeta:
         m.creation_timestamp = 0.0
         m.deletion_timestamp = None
         m.owner_references = list(g("ownerReferences") or ())
+        m.finalizers = list(g("finalizers") or ())
         return m
 
 

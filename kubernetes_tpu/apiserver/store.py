@@ -240,14 +240,7 @@ class ClusterStore:
             return pod
 
     def delete_pod(self, namespace: str, name: str) -> None:
-        with self._lock:
-            key = f"{namespace}/{name}"
-            old = self._pods.pop(key, None)
-            if old is not None:
-                # a delete creates a new revision (etcd semantics); stamp it
-                # on the final object so watch logs stay monotonic
-                old.metadata.resource_version = self._next_rv()
-                self._dispatch(Event(DELETED, "Pod", old))
+        self._delete(self._pods, "Pod", f"{namespace}/{name}")
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         with self._lock:
@@ -313,11 +306,27 @@ class ClusterStore:
             self._dispatch(Event(MODIFIED if old is not None else ADDED, kind, obj, old))
 
     def _delete(self, table: Dict, kind: str, key: str) -> None:
+        """Finalizer-aware (apimachinery deletion semantics — shared by
+        EVERY delete path, typed or generic): objects carrying
+        finalizers are only marked; see ``delete_object``."""
         with self._lock:
-            old = table.pop(key, None)
-            if old is not None:
-                old.metadata.resource_version = self._next_rv()
-                self._dispatch(Event(DELETED, kind, old))
+            old = table.get(key)
+            if old is None:
+                return
+            if old.metadata.finalizers:
+                if old.metadata.deletion_timestamp is None:
+                    marked = shallow_copy(old)
+                    marked.metadata = shallow_copy(old.metadata)
+                    marked.metadata.deletion_timestamp = time.time()
+                    marked.metadata.resource_version = self._next_rv()
+                    table[key] = marked
+                    self._dispatch(Event(MODIFIED, kind, marked, old))
+                return
+            table.pop(key)
+            # a delete creates a new revision (etcd semantics); stamp it
+            # on the final object so watch logs stay monotonic
+            old.metadata.resource_version = self._next_rv()
+            self._dispatch(Event(DELETED, kind, old))
 
     def add_node(self, node: Node) -> None:
         self._upsert(self._nodes, "Node", node.name, node)
@@ -702,13 +711,61 @@ class ClusterStore:
             return obj
 
     def delete_object(self, kind: str, namespace: str, name: str) -> bool:
+        """Finalizer-aware delete (apimachinery deletion semantics): an
+        object carrying finalizers is only MARKED for deletion
+        (``deletionTimestamp`` set, MODIFIED event) — the controllers
+        owning the finalizers observe, do their cleanup, and call
+        ``remove_finalizer``; the physical delete happens when the last
+        finalizer clears. The typed helpers share these semantics via
+        ``_delete``."""
         with self._lock:
             table, key = self._table_key(kind, namespace, name)
-            old = table.pop(key, None)
-            if old is None:
+            if table.get(key) is None:
                 return False
-            old.metadata.resource_version = self._next_rv()
-            self._dispatch(Event(DELETED, kind, old))
+        self._delete(table, kind, key)
+        return True
+
+    def add_finalizer(self, kind: str, namespace: str, name: str,
+                      finalizer: str) -> bool:
+        """Attach a finalizer (protection controllers do this on ADD)."""
+        with self._lock:
+            table, key = self._table_key(kind, namespace, name)
+            obj = table.get(key)
+            if obj is None or finalizer in obj.metadata.finalizers:
+                return False
+            updated = shallow_copy(obj)
+            updated.metadata = shallow_copy(obj.metadata)
+            updated.metadata.finalizers = (
+                list(obj.metadata.finalizers) + [finalizer]
+            )
+            updated.metadata.resource_version = self._next_rv()
+            table[key] = updated
+            self._dispatch(Event(MODIFIED, kind, updated, obj))
+            return True
+
+    def remove_finalizer(self, kind: str, namespace: str, name: str,
+                         finalizer: str) -> bool:
+        """Clear a finalizer; performs the pending physical delete when
+        it was the last one on a deletion-marked object."""
+        with self._lock:
+            table, key = self._table_key(kind, namespace, name)
+            obj = table.get(key)
+            if obj is None or finalizer not in obj.metadata.finalizers:
+                return False
+            remaining = [f for f in obj.metadata.finalizers
+                         if f != finalizer]
+            if not remaining and obj.metadata.deletion_timestamp is not None:
+                table.pop(key)
+                obj.metadata.finalizers = remaining
+                obj.metadata.resource_version = self._next_rv()
+                self._dispatch(Event(DELETED, kind, obj))
+                return True
+            updated = shallow_copy(obj)
+            updated.metadata = shallow_copy(obj.metadata)
+            updated.metadata.finalizers = remaining
+            updated.metadata.resource_version = self._next_rv()
+            table[key] = updated
+            self._dispatch(Event(MODIFIED, kind, updated, obj))
             return True
 
     def get_object(self, kind: str, namespace: str, name: str):

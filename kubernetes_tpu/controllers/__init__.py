@@ -29,6 +29,8 @@ from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.nodettl import TTLController
+from kubernetes_tpu.controllers.podgc import PodGCController
 from kubernetes_tpu.controllers.replicaset import (
     ReplicaSetController,
     ReplicationController,
@@ -40,6 +42,10 @@ from kubernetes_tpu.controllers.ttlafterfinished import (
     TTLAfterFinishedController,
 )
 from kubernetes_tpu.controllers.volume import PersistentVolumeController
+from kubernetes_tpu.controllers.volumeprotection import (
+    PVCProtectionController,
+    PVProtectionController,
+)
 
 
 def new_controller_initializers() -> Dict[str, Callable]:
@@ -62,6 +68,10 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "namespace": NamespaceController,
         "resourcequota": ResourceQuotaController,
         "serviceaccount": ServiceAccountController,
+        "podgc": PodGCController,
+        "ttl": TTLController,
+        "pvc-protection": PVCProtectionController,
+        "pv-protection": PVProtectionController,
     }
 
 

@@ -1,0 +1,65 @@
+"""TTL controller (node annotations).
+
+Behavioral equivalent of the reference's ``pkg/controller/ttl``
+(ttl_controller.go): annotates every node with
+``node.alpha.kubernetes.io/ttl`` — the secret/configmap cache TTL the
+kubelet should use — scaled by cluster size (bigger clusters get longer
+TTLs to shed apiserver load). The reference's ladder
+(``ttlBoundaries``): 0s up to 100 nodes, 15s up to 500, 30s up to 1000,
+60s up to 2000, 300s beyond.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import Node, shallow_copy
+from kubernetes_tpu.controllers.base import Controller
+
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+
+# (max cluster size, ttl seconds) — ttl_controller.go ttlBoundaries
+_BOUNDARIES = ((100, 0), (500, 15), (1000, 30), (2000, 60))
+_MAX_TTL = 300
+
+
+def ttl_for_cluster_size(n: int) -> int:
+    for bound, ttl in _BOUNDARIES:
+        if n <= bound:
+            return ttl
+    return _MAX_TTL
+
+
+class TTLController(Controller):
+    name = "ttl"
+
+    def register(self) -> None:
+        self._last_ttl = None
+        self.factory.informer_for("Node").add_event_handler(
+            on_add=lambda n: self._maybe_resync(new_node=n.name),
+            on_delete=lambda n: self._maybe_resync(),
+        )
+
+    def _maybe_resync(self, new_node: str = "") -> None:
+        """Re-enqueue the WHOLE cluster only when the size crossed a TTL
+        tier boundary (the reference only resyncs on boundary crossings
+        — enqueueing n nodes on each of n adds is quadratic at
+        bootstrap). Otherwise only the new node needs its annotation."""
+        ttl = ttl_for_cluster_size(len(self.store.list_nodes()))
+        if ttl != self._last_ttl:
+            self._last_ttl = ttl
+            for n in self.store.list_nodes():
+                self.enqueue_key(n.name)
+        elif new_node:
+            self.enqueue_key(new_node)
+
+    def sync(self, key: str) -> None:
+        node = self.store.get_node(key)
+        if node is None:
+            return
+        want = str(ttl_for_cluster_size(len(self.store.list_nodes())))
+        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
+            return
+        updated: Node = shallow_copy(node)
+        updated.metadata = shallow_copy(node.metadata)
+        updated.metadata.annotations = dict(node.metadata.annotations)
+        updated.metadata.annotations[TTL_ANNOTATION] = want
+        self.store.update_node(updated)

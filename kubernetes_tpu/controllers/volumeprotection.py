@@ -1,0 +1,104 @@
+"""PVC/PV protection controllers.
+
+Behavioral equivalents of the reference's
+``pkg/controller/volume/pvcprotection`` and ``.../pvprotection``: every
+PVC (PV) gets the ``kubernetes.io/pvc-protection``
+(``kubernetes.io/pv-protection``) finalizer on arrival, so a delete
+request only MARKS the object while it is in use; the controller
+removes the finalizer — letting the physical delete proceed — once no
+pod references the PVC (no bound PVC references the PV).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+PVC_FINALIZER = "kubernetes.io/pvc-protection"
+PV_FINALIZER = "kubernetes.io/pv-protection"
+
+
+class PVCProtectionController(Controller):
+    name = "pvc-protection"
+
+    def register(self) -> None:
+        self.factory.informer_for("PersistentVolumeClaim").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+        )
+        # pod deletion may release the last user of a deleting PVC
+        self.factory.informer_for("Pod").add_event_handler(
+            on_delete=self._pod_gone,
+            on_update=lambda old, new: self._pod_gone(old),
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+
+    def _pod_gone(self, pod) -> None:
+        for vol in pod.spec.volumes:
+            if vol.persistent_volume_claim:
+                self.enqueue_key(
+                    f"{pod.namespace}/{vol.persistent_volume_claim}"
+                )
+
+    def _in_use(self, namespace: str, claim: str) -> bool:
+        for p in self.pod_lister.by_namespace(namespace):
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            for vol in p.spec.volumes:
+                if vol.persistent_volume_claim == claim:
+                    return True
+        return False
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        pvc = self.store.get_pvc(ns, name)
+        if pvc is None:
+            return
+        if pvc.metadata.deletion_timestamp is None:
+            # live claim: ensure the finalizer is on
+            self.store.add_finalizer(
+                "PersistentVolumeClaim", ns, name, PVC_FINALIZER
+            )
+            return
+        if not self._in_use(ns, name):
+            self.store.remove_finalizer(
+                "PersistentVolumeClaim", ns, name, PVC_FINALIZER
+            )
+
+
+class PVProtectionController(Controller):
+    name = "pv-protection"
+
+    def register(self) -> None:
+        self.factory.informer_for("PersistentVolume").add_event_handler(
+            on_add=lambda pv: self.enqueue_key(pv.name),
+            on_update=lambda old, new: self.enqueue_key(new.name),
+        )
+        self.factory.informer_for("PersistentVolumeClaim").add_event_handler(
+            on_delete=lambda pvc: self._pvc_gone(pvc),
+            on_update=lambda old, new: self._pvc_gone(old),
+        )
+
+    def _pvc_gone(self, pvc) -> None:
+        if pvc.volume_name:
+            self.enqueue_key(pvc.volume_name)
+
+    def _bound(self, name: str) -> bool:
+        for pvc in self.store.list_all_pvcs():
+            if pvc.volume_name == name and \
+                    pvc.metadata.deletion_timestamp is None:
+                return True
+        return False
+
+    def sync(self, key: str) -> None:
+        pv = self.store.get_pv(key)
+        if pv is None:
+            return
+        if pv.metadata.deletion_timestamp is None:
+            self.store.add_finalizer(
+                "PersistentVolume", "", key, PV_FINALIZER
+            )
+            return
+        if not self._bound(key):
+            self.store.remove_finalizer(
+                "PersistentVolume", "", key, PV_FINALIZER
+            )

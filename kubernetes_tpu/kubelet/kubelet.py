@@ -87,6 +87,9 @@ class Kubelet:
         self.volumes = VolumeManager()
         self.probes = ProbeManager()
         self.heartbeat_fn = heartbeat_fn  # optional NodeLifecycle hookup
+        # optional node-pressure eviction (kubelet/eviction.py); attach
+        # an EvictionManager and housekeeping drives synchronize()
+        self.eviction_manager = None
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
         self._terminal: set = set()  # uids already reported Succeeded/Failed
@@ -171,6 +174,11 @@ class Kubelet:
                 except Exception:
                     _logger.exception("sync_pod %s", uid)
             self.probes.tick()
+            if self.eviction_manager is not None:
+                try:
+                    self.eviction_manager.synchronize()
+                except Exception:
+                    _logger.exception("eviction synchronize")
             self.heartbeat()
 
     def heartbeat(self) -> None:

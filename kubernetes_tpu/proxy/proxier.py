@@ -143,3 +143,95 @@ class Proxier:
         self.sync()
         with self._lock:
             return list(self._rules.values())
+
+
+# ----------------------------------------------------------------------
+def render_iptables(rules: List[Rule]) -> str:
+    """Render the rule table as an iptables-restore ruleset — the exact
+    artifact the reference's ``syncProxyRules`` writes through
+    ``utiliptables.RestoreAll`` (``pkg/proxy/iptables/proxier.go:257``
+    onward, writeLine buffers): a KUBE-SERVICES entry chain, one
+    KUBE-SVC-* chain per VIP:port fanning out with
+    ``statistic --mode random --probability 1/k`` matches, and one
+    KUBE-SEP-* DNAT chain per backend. On a real Linux node this text
+    pipes straight into ``iptables-restore --noflush``; in this harness
+    it is the dataplane's canonical serialized form (tested, diffable,
+    and byte-stable for a given rule table).
+    """
+    import hashlib
+
+    def chain_hash(*parts: str) -> str:
+        # KUBE-SVC-XXXXXXXXXXXXXXXX: 16-char base32-ish hash like
+        # servicePortChainName (pkg/proxy/iptables/proxier.go:658)
+        digest = hashlib.sha256("/".join(parts).encode()).hexdigest()
+        return digest[:16].upper()
+
+    nat_lines = ["*nat", ":KUBE-SERVICES - [0:0]"]
+    # no-endpoints REJECTs live in the FILTER table — REJECT is invalid
+    # in nat and would abort the whole iptables-restore COMMIT
+    # (reference: proxier.go writes them into filterRules)
+    filter_lines = ["*filter", ":KUBE-SERVICES - [0:0]"]
+    svc_chains = []
+    sep_chains = []
+    svc_rules = []
+    sep_rules = []
+    reject_rules = []
+    for rule in sorted(rules, key=lambda r: (r.service, r.port)):
+        svc_chain = f"KUBE-SVC-{chain_hash(rule.service, str(rule.port))}"
+        proto = rule.protocol.lower() or "tcp"
+        n = len(rule.backends)
+        if n == 0:
+            reject_rules.append(
+                f'-A KUBE-SERVICES -d {rule.cluster_ip}/32 -p {proto} '
+                f'-m {proto} --dport {rule.port} '
+                f'-m comment --comment "{rule.service} has no endpoints" '
+                f"-j REJECT"
+            )
+            continue
+        svc_chains.append(f":{svc_chain} - [0:0]")
+        svc_rules.append(
+            f'-A KUBE-SERVICES -d {rule.cluster_ip}/32 -p {proto} '
+            f'-m {proto} --dport {rule.port} '
+            f'-m comment --comment "{rule.service} cluster IP" '
+            f"-j {svc_chain}"
+        )
+        sep_names = [
+            f"KUBE-SEP-{chain_hash(rule.service, str(rule.port), backend)}"
+            for backend in rule.backends
+        ]
+        if rule.session_affinity == "ClientIP":
+            # returning sticky clients jump straight to THEIR endpoint
+            # chain (per-SEP recent list, proxier.go writeSessionAffinity)
+            for sep_chain in sep_names:
+                svc_rules.append(
+                    f"-A {svc_chain} -m recent --name {sep_chain} "
+                    f"--rcheck --seconds 10800 --reap -j {sep_chain}"
+                )
+        for i, (backend, sep_chain) in enumerate(
+            zip(rule.backends, sep_names)
+        ):
+            sep_chains.append(f":{sep_chain} - [0:0]")
+            remaining = n - i
+            if remaining > 1:
+                svc_rules.append(
+                    f"-A {svc_chain} -m statistic --mode random "
+                    f"--probability {1.0 / remaining:.5f} -j {sep_chain}"
+                )
+            else:
+                svc_rules.append(f"-A {svc_chain} -j {sep_chain}")
+            if rule.session_affinity == "ClientIP":
+                sep_rules.append(
+                    f"-A {sep_chain} -m recent --name {sep_chain} --set "
+                    f"-p {proto} -m {proto} -j DNAT "
+                    f"--to-destination {backend}"
+                )
+            else:
+                sep_rules.append(
+                    f"-A {sep_chain} -p {proto} -m {proto} -j DNAT "
+                    f"--to-destination {backend}"
+                )
+    nat_lines += svc_chains + sep_chains + svc_rules + sep_rules
+    nat_lines.append("COMMIT")
+    filter_lines += reject_rules
+    filter_lines.append("COMMIT")
+    return "\n".join(nat_lines + filter_lines) + "\n"

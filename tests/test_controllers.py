@@ -788,3 +788,86 @@ def test_cronjob_resume_runs_only_latest_missed_fire():
         assert len(jobs) <= 2, [j.metadata.name for j in jobs]
     finally:
         cm.stop()
+
+
+def test_podgc_collects_orphans_and_excess_terminated():
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["podgc"])
+    ctrl = cm.get("podgc")
+    ctrl.terminated_threshold = 2
+    store.add_node(MakeNode().name("n1").capacity({"cpu": "8"}).obj())
+    store.add_node(MakeNode().name("gone").capacity({"cpu": "8"}).obj())
+    # orphan: bound to a node that will disappear
+    store.create_pod(MakePod().name("orphan").uid("ou").node("gone").obj())
+    # 4 terminated pods, threshold 2 -> oldest 2 collected
+    for i in range(4):
+        p = MakePod().name(f"done{i}").uid(f"du{i}").node("n1").obj()
+        p.status.phase = "Succeeded"
+        p.metadata.creation_timestamp = 100.0 + i
+        store.create_pod(p)
+    cm.start()
+    try:
+        store.delete_node("gone")
+        _wait(lambda: store.get_pod("default", "orphan") is None,
+              msg="orphan collected")
+        _wait(lambda: store.get_pod("default", "done0") is None
+              and store.get_pod("default", "done1") is None,
+              msg="oldest terminated collected")
+        assert store.get_pod("default", "done3") is not None
+    finally:
+        cm.stop()
+
+
+def test_ttl_controller_annotates_by_cluster_size():
+    from kubernetes_tpu.controllers.nodettl import (
+        TTL_ANNOTATION, ttl_for_cluster_size,
+    )
+
+    assert ttl_for_cluster_size(50) == 0
+    assert ttl_for_cluster_size(300) == 15
+    assert ttl_for_cluster_size(5000) == 300
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["ttl"])
+    cm.start()
+    try:
+        for i in range(3):
+            store.add_node(MakeNode().name(f"t{i}").capacity(
+                {"cpu": "4"}).obj())
+        _wait(lambda: all(
+            store.get_node(f"t{i}").metadata.annotations.get(TTL_ANNOTATION)
+            == "0" for i in range(3)
+        ), msg="small-cluster ttl annotation")
+    finally:
+        cm.stop()
+
+
+def test_pvc_protection_blocks_delete_while_in_use():
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import ObjectMeta, PersistentVolumeClaim
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["pvc-protection"])
+    cm.start()
+    try:
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data", namespace="default"),
+            requests={"storage": parse_quantity("1Gi")},
+        ))
+        _wait(lambda: "kubernetes.io/pvc-protection" in
+              store.get_pvc("default", "data").metadata.finalizers,
+              msg="finalizer attached")
+        user = MakePod().name("user").uid("uu").pvc("data").obj()
+        store.create_pod(user)
+        # delete while in use: only MARKED
+        store.delete_object("PersistentVolumeClaim", "default", "data")
+        time.sleep(0.3)
+        pvc = store.get_pvc("default", "data")
+        assert pvc is not None
+        assert pvc.metadata.deletion_timestamp is not None
+        # last user goes away -> finalizer removed -> physical delete
+        store.delete_pod("default", "user")
+        _wait(lambda: store.get_pvc("default", "data") is None,
+              msg="pvc deleted after last user")
+    finally:
+        cm.stop()

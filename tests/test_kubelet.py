@@ -21,7 +21,7 @@ from kubernetes_tpu.kubelet import (
     READINESS,
     TPU_RESOURCE,
 )
-from kubernetes_tpu.testing import MakePod
+from kubernetes_tpu.testing import MakeNode, MakePod
 
 
 def wait_for(cond, timeout=5.0, interval=0.05):
@@ -290,3 +290,100 @@ def test_kubelet_restart_preserves_checkpointed_devices(tmp_path):
         assert dm2.devices_of("u-train")[TPU_RESOURCE] == ["tpu0", "tpu1"]
     finally:
         kubelet2.stop()
+
+
+class TestEvictionManager:
+    def test_pressure_evicts_lowest_priority_and_taints(self):
+        from kubernetes_tpu.kubelet.eviction import (
+            MEMORY_PRESSURE, MEMORY_PRESSURE_TAINT, CgroupStatsStub,
+            EvictionManager,
+        )
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1")
+                       .capacity({"cpu": "8", "memory": "1Gi"}).obj())
+        # 900Mi requested on a 1Gi node with a 200Mi threshold: pressure
+        low = MakePod().name("bulk").uid("bu").node("n1").priority(0) \
+            .req({"memory": "600Mi"}).obj()
+        high = MakePod().name("vip").uid("vu").node("n1").priority(1000) \
+            .req({"memory": "300Mi"}).obj()
+        store.create_pod(low)
+        store.create_pod(high)
+        mgr = EvictionManager(
+            store, "n1", thresholds={"memory.available": "200Mi"},
+            stats=CgroupStatsStub(store, "n1", 1024 * 1024 * 1024),
+        )
+        evicted = mgr.synchronize()
+        assert evicted == "default/bulk"  # lowest priority first
+        assert store.get_pod("default", "bulk") is None
+        assert store.get_pod("default", "vip") is not None
+        node = store.get_node("n1")
+        assert any(c.type == MEMORY_PRESSURE and c.status == "True"
+                   for c in node.status.conditions)
+        assert any(t.key == MEMORY_PRESSURE_TAINT
+                   for t in node.spec.taints)
+        # signal cleared on the next pass: condition flips, taint lifts
+        assert mgr.synchronize() is None
+        node = store.get_node("n1")
+        assert any(c.type == MEMORY_PRESSURE and c.status == "False"
+                   for c in node.status.conditions)
+        assert not any(t.key == MEMORY_PRESSURE_TAINT
+                       for t in node.spec.taints)
+
+    def test_kubelet_housekeeping_drives_eviction(self):
+        import time as _time
+
+        from kubernetes_tpu.kubelet import Kubelet
+        from kubernetes_tpu.kubelet.eviction import (
+            CgroupStatsStub, EvictionManager,
+        )
+
+        store = ClusterStore()
+        kl = Kubelet(store, "kn1", capacity={"cpu": "4", "memory": "512Mi",
+                                             "pods": "10"})
+        kl.start()
+        try:
+            kl.eviction_manager = EvictionManager(
+                store, "kn1", thresholds={"memory.available": "100Mi"},
+                stats=CgroupStatsStub(store, "kn1", 512 * 1024 * 1024),
+            )
+            store.create_pod(MakePod().name("fat").uid("fu").node("kn1")
+                             .req({"memory": "500Mi"}).obj())
+            deadline = _time.time() + 5
+            while _time.time() < deadline and \
+                    store.get_pod("default", "fat") is not None:
+                _time.sleep(0.05)
+            assert store.get_pod("default", "fat") is None
+            assert kl.eviction_manager.evicted == ["default/fat"]
+        finally:
+            kl.stop()
+
+    def test_rank_consults_stats_provider_usage(self):
+        """Pods ABOVE their memory request evict first even when a
+        higher-priority pod uses more absolute memory (rankMemoryPressure
+        usage-over-request tier)."""
+        from kubernetes_tpu.kubelet.eviction import EvictionManager
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1")
+                       .capacity({"cpu": "8", "memory": "1Gi"}).obj())
+        over = MakePod().name("over").uid("o").node("n1").priority(1000) \
+            .req({"memory": "100Mi"}).obj()
+        within = MakePod().name("within").uid("w").node("n1").priority(0) \
+            .req({"memory": "600Mi"}).obj()
+        store.create_pod(over)
+        store.create_pod(within)
+
+        class Stats:
+            def memory_available(self):
+                return 0
+
+            def pod_memory_usage(self, pod):
+                return {"over": 500 * 2**20, "within": 400 * 2**20}[
+                    pod.metadata.name]
+
+        mgr = EvictionManager(store, "n1",
+                              thresholds={"memory.available": "100Mi"},
+                              stats=Stats())
+        ranked = mgr._rank_pods()
+        assert [p.metadata.name for p in ranked] == ["over", "within"]

@@ -163,3 +163,56 @@ def test_rest_assigns_cluster_ip():
         assert client.create(svc4).cluster_ip == "10.96.1.1"
     finally:
         srv.shutdown_server()
+
+
+class TestIptablesRender:
+    def test_ruleset_shape_and_stability(self):
+        from kubernetes_tpu.proxy.proxier import Rule, render_iptables
+
+        rules = [
+            Rule(service="default/web", cluster_ip="10.0.0.10", port=80,
+                 protocol="TCP",
+                 backends=["10.244.0.5:8080", "10.244.1.7:8080",
+                           "10.244.2.9:8080"]),
+            Rule(service="default/db", cluster_ip="10.0.0.11", port=5432,
+                 protocol="TCP", backends=[],
+                 session_affinity="ClientIP"),
+        ]
+        text = render_iptables(rules)
+        assert text.startswith("*nat\n")
+        assert text.rstrip().endswith("COMMIT")
+        # no-endpoints REJECT lives in the filter table, never nat
+        nat_section = text.split("*filter")[0]
+        assert "REJECT" not in nat_section
+        # one KUBE-SVC chain per VIP:port WITH endpoints (the
+        # endpointless service only gets a filter-table REJECT),
+        # one KUBE-SEP per backend
+        assert text.count(":KUBE-SVC-") == 1
+        assert text.count(":KUBE-SEP-") == 3
+        # probability fan-out: 1/3 then 1/2 then unconditional
+        assert "--probability 0.33333" in text
+        assert "--probability 0.50000" in text
+        # DNAT per backend
+        assert text.count("-j DNAT") == 3
+        assert "--to-destination 10.244.0.5:8080" in text
+        # endpointless service REJECTs
+        assert '"default/db has no endpoints" -j REJECT' in text
+        # byte-stable for the same table
+        assert render_iptables(rules) == text
+
+    def test_affinity_uses_recent_match(self):
+        from kubernetes_tpu.proxy.proxier import Rule, render_iptables
+
+        text = render_iptables([
+            Rule(service="default/sticky", cluster_ip="10.0.0.12", port=443,
+                 protocol="TCP", backends=["10.244.0.2:8443"],
+                 session_affinity="ClientIP"),
+        ])
+        assert "-m recent" in text and "--rcheck" in text
+        assert "--set" in text
+        # sticky return traffic jumps to the remembered SEP chain, not
+        # RETURN (which would exit without any DNAT)
+        assert "-j RETURN" not in text
+        import re
+        m = re.search(r"--rcheck --seconds \d+ --reap -j (KUBE-SEP-\w+)", text)
+        assert m, text
