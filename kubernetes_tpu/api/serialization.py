@@ -53,6 +53,8 @@ SCHEME: Dict[str, type] = {
         "ResourceQuota",
         "ServiceAccount",
         "CronJob",
+        "HorizontalPodAutoscaler",
+        "EndpointSlice",
     )
 }
 

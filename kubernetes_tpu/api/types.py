@@ -777,6 +777,51 @@ class Job:
 
 
 @dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v1 HorizontalPodAutoscaler: the horizontalpodautoscaler
+    controller scales ``scale_target_ref`` (Deployment/ReplicaSet/RC)
+    toward ``target_cpu_utilization_percentage``."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    scale_target_ref: Dict[str, str] = field(default_factory=dict)  # kind/name
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization_percentage: int = 80
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class EndpointSlice:
+    """discovery/v1beta1 EndpointSlice: the endpointslice controller
+    mirrors Endpoints into bounded slices (max 100 endpoints each)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    address_type: str = "IPv4"
+    endpoints: List["EndpointAddress"] = field(default_factory=list)
+    ports: List["ServicePort"] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
 class Namespace:
     """core/v1 Namespace: lifecycle phase drives the namespace
     controller's content deletion (``pkg/controller/namespace``)."""

@@ -66,6 +66,8 @@ PLURALS: Dict[str, str] = {
     "resourcequotas": "ResourceQuota",
     "serviceaccounts": "ServiceAccount",
     "cronjobs": "CronJob",
+    "horizontalpodautoscalers": "HorizontalPodAutoscaler",
+    "endpointslices": "EndpointSlice",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 

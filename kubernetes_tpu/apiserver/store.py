@@ -28,7 +28,9 @@ from kubernetes_tpu.api.types import (
     Deployment,
     Endpoints,
     CronJob,
+    EndpointSlice,
     Event as ApiEvent,
+    HorizontalPodAutoscaler,
     Job,
     Namespace,
     Node,
@@ -107,6 +109,8 @@ class ClusterStore:
         self._quotas: Dict[str, ResourceQuota] = {}
         self._service_accounts: Dict[str, ServiceAccount] = {}
         self._cron_jobs: Dict[str, CronJob] = {}
+        self._hpas: Dict[str, HorizontalPodAutoscaler] = {}
+        self._endpoint_slices: Dict[str, EndpointSlice] = {}
         self._leases: Dict[str, _Lease] = {}
         self._api_events: Dict[str, ApiEvent] = {}
         # Event objects expire (reference: etcd lease TTL on events,
@@ -565,6 +569,27 @@ class ClusterStore:
         with self._lock:
             return list(self._cron_jobs.values())
 
+    def add_hpa(self, hpa: HorizontalPodAutoscaler) -> None:
+        self._upsert(self._hpas, "HorizontalPodAutoscaler",
+                     f"{hpa.namespace}/{hpa.name}", hpa)
+
+    def get_hpa(self, namespace: str,
+                name: str) -> Optional[HorizontalPodAutoscaler]:
+        with self._lock:
+            return self._hpas.get(f"{namespace}/{name}")
+
+    def list_hpas(self) -> List[HorizontalPodAutoscaler]:
+        with self._lock:
+            return list(self._hpas.values())
+
+    def add_endpoint_slice(self, es: EndpointSlice) -> None:
+        self._upsert(self._endpoint_slices, "EndpointSlice",
+                     f"{es.namespace}/{es.name}", es)
+
+    def list_endpoint_slices(self) -> List[EndpointSlice]:
+        with self._lock:
+            return list(self._endpoint_slices.values())
+
     def update_replica_set(self, rs: ReplicaSet) -> None:
         self._upsert(self._rss, "ReplicaSet", f"{rs.namespace}/{rs.name}", rs)
 
@@ -625,6 +650,8 @@ class ClusterStore:
         "ResourceQuota": ("_quotas", True),
         "ServiceAccount": ("_service_accounts", True),
         "CronJob": ("_cron_jobs", True),
+        "HorizontalPodAutoscaler": ("_hpas", True),
+        "EndpointSlice": ("_endpoint_slices", True),
     }
 
     # ------------------------------------------------------------------

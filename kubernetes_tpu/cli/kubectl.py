@@ -56,6 +56,10 @@ _KIND_ALIASES = {
     "sa": "ServiceAccount", "serviceaccount": "ServiceAccount",
     "serviceaccounts": "ServiceAccount",
     "cj": "CronJob", "cronjob": "CronJob", "cronjobs": "CronJob",
+    "hpa": "HorizontalPodAutoscaler",
+    "horizontalpodautoscaler": "HorizontalPodAutoscaler",
+    "horizontalpodautoscalers": "HorizontalPodAutoscaler",
+    "endpointslice": "EndpointSlice", "endpointslices": "EndpointSlice",
 }
 
 

@@ -190,6 +190,8 @@ _KIND_LISTS = {
     "ResourceQuota": "list_resource_quotas",
     "ServiceAccount": "list_service_accounts",
     "CronJob": "list_cron_jobs",
+    "HorizontalPodAutoscaler": "list_hpas",
+    "EndpointSlice": "list_endpoint_slices",
 }
 
 

@@ -24,7 +24,11 @@ from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.horizontalpodautoscaler import (
+    HorizontalPodAutoscalerController,
+)
 from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
@@ -60,6 +64,8 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "cronjob": CronJobController,
         "ttl-after-finished": TTLAfterFinishedController,
         "endpoints": EndpointsController,
+        "endpointslice": EndpointSliceController,
+        "horizontalpodautoscaler": HorizontalPodAutoscalerController,
         "garbagecollector": GarbageCollector,
         "nodelifecycle": NodeLifecycleController,
         "nodeipam": NodeIpamController,

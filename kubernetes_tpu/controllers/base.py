@@ -22,11 +22,15 @@ _logger = logging.getLogger(__name__)
 
 class Controller:
     """Base: subclasses set ``name``, wire handlers in ``register`` and
-    implement ``sync(key)``."""
+    implement ``sync(key)``. Controllers needing a periodic resync
+    backstop set ``RESYNC_SECONDS`` and override ``resync()`` — the base
+    runs the tick thread (started in ``run``, joined in ``stop``) so the
+    boilerplate exists exactly once."""
 
     name = "controller"
     workers = 1
     max_requeues = 10
+    RESYNC_SECONDS: Optional[float] = None
 
     def __init__(self, store: ClusterStore, factory: SharedInformerFactory):
         self.store = store
@@ -34,6 +38,8 @@ class Controller:
         self.queue = RateLimitingQueue()
         self._threads: List[threading.Thread] = []
         self._stopped = False
+        self._tick_stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
         self.register()
 
     # -- subclass surface ----------------------------------------------
@@ -42,6 +48,16 @@ class Controller:
 
     def sync(self, key: str) -> None:
         raise NotImplementedError
+
+    def resync(self) -> None:
+        """Periodic enqueue hook, driven every ``RESYNC_SECONDS``."""
+
+    def _tick_loop(self) -> None:
+        while not self._tick_stop.wait(self.RESYNC_SECONDS):
+            try:
+                self.resync()
+            except Exception:  # noqa: BLE001 — ticks must not die
+                _logger.exception("%s: resync failed", self.name)
 
     # ------------------------------------------------------------------
     def enqueue(self, obj) -> None:
@@ -58,6 +74,12 @@ class Controller:
                                  name=f"{self.name}-{i}")
             t.start()
             self._threads.append(t)
+        if self.RESYNC_SECONDS is not None:
+            self._tick_thread = threading.Thread(
+                target=self._tick_loop, daemon=True,
+                name=f"{self.name}-tick",
+            )
+            self._tick_thread.start()
 
     def _worker(self) -> None:
         while not self._stopped:
@@ -84,7 +106,10 @@ class Controller:
 
     def stop(self) -> None:
         self._stopped = True
+        self._tick_stop.set()
         self.queue.shutdown()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=2.0)
         for t in self._threads:
             t.join(timeout=2.0)
 

@@ -12,7 +12,6 @@ tick interval is injectable for the same reason.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
 
@@ -77,31 +76,17 @@ class CronJobController(Controller):
     name = "cronjob"
 
     # injectable for tests (the reference uses a 10s resync)
-    TICK_SECONDS = 1.0
+    RESYNC_SECONDS = 1.0
 
     def register(self) -> None:
         self.factory.informer_for("CronJob").add_event_handler(
             on_add=self.enqueue,
             on_update=lambda old, new: self.enqueue(new),
         )
-        self._tick_stop = threading.Event()
-        self._tick_thread: Optional[threading.Thread] = None
 
-    def run(self) -> None:
-        super().run()
-        self._tick_thread = threading.Thread(
-            target=self._tick_loop, daemon=True, name="cronjob-tick"
-        )
-        self._tick_thread.start()
-
-    def stop(self) -> None:
-        self._tick_stop.set()
-        super().stop()
-
-    def _tick_loop(self) -> None:
-        while not self._tick_stop.wait(self.TICK_SECONDS):
-            for cj in self.store.list_cron_jobs():
-                self.enqueue(cj)
+    def resync(self) -> None:
+        for cj in self.store.list_cron_jobs():
+            self.enqueue(cj)
 
     def now(self) -> float:
         return time.time()

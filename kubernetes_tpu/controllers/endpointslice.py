@@ -1,0 +1,120 @@
+"""EndpointSlice controller.
+
+Behavioral equivalent of the reference's
+``pkg/controller/endpointslice`` (reconciler.go): mirror each Service's
+ready backend addresses into EndpointSlice objects bounded at
+``max_endpoints_per_slice`` (reference default 100), named
+``<service>-<index>`` and labeled ``kubernetes.io/service-name`` so
+consumers (kube-proxy's EndpointSliceCache) can select them. Slices are
+rewritten in place and excess slices deleted when a service shrinks.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import (
+    EndpointAddress,
+    EndpointSlice,
+    ObjectMeta,
+    Pod,
+    Service,
+)
+from kubernetes_tpu.controllers.base import Controller, split_key
+
+SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+
+
+class EndpointSliceController(Controller):
+    name = "endpointslice"
+
+    max_endpoints_per_slice = 100
+
+    def register(self) -> None:
+        self.factory.informer_for("Service").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: (self._pod_changed(old),
+                                        self._pod_changed(new)),
+            on_delete=self._pod_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+        self.svc_lister = self.factory.lister_for("Service")
+
+    def _pod_changed(self, pod: Pod) -> None:
+        for svc in self.svc_lister.by_namespace(pod.namespace):
+            if self._selects(svc, pod):
+                self.enqueue(svc)
+
+    @staticmethod
+    def _selects(svc: Service, pod: Pod) -> bool:
+        if not svc.selector:
+            return False
+        return all(
+            pod.metadata.labels.get(k) == v for k, v in svc.selector.items()
+        )
+
+    def _existing_slices(self, namespace: str, service: str):
+        return [
+            es for es in self.store.list_endpoint_slices()
+            if es.namespace == namespace
+            and es.metadata.labels.get(SERVICE_NAME_LABEL) == service
+        ]
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        svc = self.store.get_object("Service", ns, name)
+        existing = self._existing_slices(ns, name)
+        if svc is None:
+            for es in existing:
+                self.store.delete_object("EndpointSlice", ns, es.name)
+            return
+        addresses = [
+            EndpointAddress(
+                # same placeholder scheme as the endpoints controller
+                # when no IP was allocated yet
+                ip=p.status.pod_ip or p.full_name(),
+                node_name=p.spec.node_name,
+                target_pod=f"{p.namespace}/{p.metadata.name}",
+            )
+            for p in sorted(
+                (p for p in self.pod_lister.by_namespace(ns)
+                 if self._selects(svc, p) and p.spec.node_name
+                 and p.metadata.deletion_timestamp is None),
+                key=lambda p: p.metadata.name,
+            )
+        ]
+        chunks = [
+            addresses[i:i + self.max_endpoints_per_slice]
+            for i in range(0, len(addresses), self.max_endpoints_per_slice)
+        ] or [[]]
+        wanted = {}
+        for idx, chunk in enumerate(chunks):
+            slice_name = f"{name}-{idx}"
+            wanted[slice_name] = EndpointSlice(
+                metadata=ObjectMeta(
+                    name=slice_name, namespace=ns,
+                    labels={SERVICE_NAME_LABEL: name},
+                ),
+                endpoints=chunk,
+                ports=list(svc.ports),
+            )
+        def fingerprint(es: EndpointSlice):
+            # FULL address + port identity: an IP assigned after
+            # scheduling (or a changed port) must rewrite the slice,
+            # not just membership changes
+            return (
+                [(a.ip, a.node_name, a.target_pod) for a in es.endpoints],
+                [(p.name, p.port, p.target_port) for p in es.ports],
+            )
+
+        current = {es.name: es for es in existing}
+        for slice_name, es in wanted.items():
+            old = current.get(slice_name)
+            if old is None or fingerprint(old) != fingerprint(es):
+                self.store.add_endpoint_slice(es)
+        for slice_name in current:
+            if slice_name not in wanted:
+                self.store.delete_object("EndpointSlice", ns, slice_name)

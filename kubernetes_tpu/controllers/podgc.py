@@ -14,9 +14,6 @@ Behavioral equivalent of the reference's ``pkg/controller/podgc``
 
 from __future__ import annotations
 
-import threading
-from typing import Optional
-
 from kubernetes_tpu.api.types import FAILED, SUCCEEDED
 from kubernetes_tpu.controllers.base import Controller
 
@@ -32,8 +29,8 @@ class PodGCController(Controller):
 
     def register(self) -> None:
         # event-driven enqueues (node deletes orphan pods immediately;
-        # terminal-phase pods feed the threshold sweep) plus a periodic
-        # resync as the backstop
+        # terminal-phase pods feed the threshold sweep) plus the base
+        # class's periodic resync as the backstop
         self.factory.informer_for("Node").add_event_handler(
             on_delete=lambda n: self.enqueue_key(_SYNC_KEY),
         )
@@ -47,23 +44,9 @@ class PodGCController(Controller):
             on_add=pod_changed,
             on_update=lambda old, new: pod_changed(new),
         )
-        self._tick_stop = threading.Event()
-        self._tick_thread: Optional[threading.Thread] = None
 
-    def run(self) -> None:
-        super().run()
-        self._tick_thread = threading.Thread(
-            target=self._tick_loop, daemon=True, name="podgc-tick"
-        )
-        self._tick_thread.start()
-
-    def stop(self) -> None:
-        self._tick_stop.set()
-        super().stop()
-
-    def _tick_loop(self) -> None:
-        while not self._tick_stop.wait(self.RESYNC_SECONDS):
-            self.enqueue_key(_SYNC_KEY)
+    def resync(self) -> None:
+        self.enqueue_key(_SYNC_KEY)
 
     def sync(self, key: str) -> None:
         pods = self.store.list_pods()

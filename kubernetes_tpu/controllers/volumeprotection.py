@@ -41,7 +41,12 @@ class PVCProtectionController(Controller):
 
     def _in_use(self, namespace: str, claim: str) -> bool:
         for p in self.pod_lister.by_namespace(namespace):
-            if p.metadata.deletion_timestamp is not None:
+            # a deletion-MARKED pod may still be running through its
+            # finalizers/grace period and still mounts the claim
+            # (upstream podIsShutDown: only actually-terminated pods
+            # release protection); any pod that still EXISTS and is not
+            # terminal counts as a user
+            if p.status.phase in ("Succeeded", "Failed"):
                 continue
             for vol in p.spec.volumes:
                 if vol.persistent_volume_claim == claim:

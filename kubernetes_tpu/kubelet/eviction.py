@@ -110,6 +110,10 @@ class EvictionManager:
             p for p in self.store.list_pods()
             if p.spec.node_name == self.node_name
             and p.status.phase not in ("Succeeded", "Failed")
+            # already deletion-marked (e.g. waiting on a finalizer):
+            # re-"evicting" it every pass would livelock while the
+            # second-ranked pod never gets evicted
+            and p.metadata.deletion_timestamp is None
         ]
         usage_fn = getattr(self.stats, "pod_memory_usage", None)
 

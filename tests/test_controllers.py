@@ -871,3 +871,107 @@ def test_pvc_protection_blocks_delete_while_in_use():
               msg="pvc deleted after last user")
     finally:
         cm.stop()
+
+
+def test_hpa_scales_deployment_toward_target():
+    """podautoscaler semantics: desired = ceil(current * avg/target),
+    10% tolerance band, [min,max] clamp, status published."""
+    from kubernetes_tpu.api.types import Deployment, HorizontalPodAutoscaler
+    from kubernetes_tpu.controllers.horizontalpodautoscaler import (
+        USAGE_ANNOTATION,
+    )
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["deployment", "replicaset",
+                                               "horizontalpodautoscaler"])
+    cm.start()
+    try:
+        d = Deployment(
+            selector=LabelSelector(match_labels={"app": "web"}),
+            replicas=2,
+            template=_template(cpu="1000m"),
+        )
+        d.metadata.name = "web"
+        store.add_deployment(d)
+        _wait(lambda: len(store.list_pods()) == 2, msg="2 pods via RS")
+        hpa = HorizontalPodAutoscaler(
+            scale_target_ref={"kind": "Deployment", "name": "web"},
+            min_replicas=1, max_replicas=8,
+            target_cpu_utilization_percentage=50,
+        )
+        hpa.metadata.name = "web-hpa"
+        store.add_hpa(hpa)
+        # every pod reports 1000m usage against 1000m request: 100%
+        # utilization vs the 50% target -> scale 2 -> 4
+        def annotate_all():
+            for p in store.list_pods():
+                if USAGE_ANNOTATION not in p.metadata.annotations:
+                    p2 = store.get_pod(p.namespace, p.name)
+                    from kubernetes_tpu.api.types import shallow_copy
+                    up = shallow_copy(p2)
+                    up.metadata = shallow_copy(p2.metadata)
+                    up.metadata.annotations = dict(p2.metadata.annotations)
+                    up.metadata.annotations[USAGE_ANNOTATION] = "1000"
+                    store.update_pod(up)
+        annotate_all()
+        _wait(lambda: store.get_deployment("default", "web").replicas == 4,
+              msg="scaled 2 -> 4")
+        got = store.get_hpa("default", "web-hpa")
+        assert got.current_cpu_utilization_percentage == 100
+        assert got.last_scale_time is not None
+        # usage drops to 100m (10% vs 50% target) -> scale toward 1 (min)
+        _wait(lambda: len([p for p in store.list_pods()]) == 4,
+              msg="4 pods after scale-up")
+        for p in store.list_pods():
+            from kubernetes_tpu.api.types import shallow_copy
+            up = shallow_copy(p)
+            up.metadata = shallow_copy(p.metadata)
+            up.metadata.annotations = dict(p.metadata.annotations)
+            up.metadata.annotations[USAGE_ANNOTATION] = "100"
+            store.update_pod(up)
+        _wait(lambda: store.get_deployment("default", "web").replicas == 1,
+              msg="scaled down to min")
+    finally:
+        cm.stop()
+
+
+def test_endpointslice_mirrors_service_backends_in_slices():
+    from kubernetes_tpu.api.types import ObjectMeta, Service, ServicePort
+    from kubernetes_tpu.controllers.endpointslice import SERVICE_NAME_LABEL
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["endpointslice"])
+    ctrl = cm.get("endpointslice")
+    ctrl.max_endpoints_per_slice = 2  # force slicing
+    cm.start()
+    try:
+        store.add_service(Service(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            selector={"app": "web"},
+            ports=[ServicePort(name="http", port=80, target_port=8080)],
+            cluster_ip="10.0.0.60",
+        ))
+        for i in range(5):
+            store.create_pod(
+                MakePod().name(f"be{i}").uid(f"beu{i}")
+                .label("app", "web").node("n1").obj())
+        _wait(lambda: sum(
+            len(es.endpoints) for es in store.list_endpoint_slices()
+            if es.metadata.labels.get(SERVICE_NAME_LABEL) == "web"
+        ) == 5, msg="5 endpoints mirrored")
+        slices = [es for es in store.list_endpoint_slices()
+                  if es.metadata.labels.get(SERVICE_NAME_LABEL) == "web"]
+        assert len(slices) == 3  # 2+2+1
+        assert all(len(es.endpoints) <= 2 for es in slices)
+        # shrink: slices rewritten and excess deleted
+        for i in range(4):
+            store.delete_pod("default", f"be{i}")
+        _wait(lambda: sum(
+            len(es.endpoints) for es in store.list_endpoint_slices()
+            if es.metadata.labels.get(SERVICE_NAME_LABEL) == "web"
+        ) == 1, msg="slices shrank")
+        slices = [es for es in store.list_endpoint_slices()
+                  if es.metadata.labels.get(SERVICE_NAME_LABEL) == "web"]
+        assert len(slices) == 1
+    finally:
+        cm.stop()
