@@ -517,6 +517,9 @@ class NodeStatus:
     allocatable: Dict[str, Quantity] = field(default_factory=dict)
     images: List[ContainerImage] = field(default_factory=list)
     conditions: List[PodCondition] = field(default_factory=list)
+    # maintained by the attachdetach controller / kubelet volume manager
+    volumes_attached: List[str] = field(default_factory=list)  # PV names
+    volumes_in_use: List[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "NodeStatus":

@@ -18,6 +18,7 @@ from kubernetes_tpu.client import (
     LeaderElector,
     SharedInformerFactory,
 )
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
@@ -70,6 +71,7 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "nodelifecycle": NodeLifecycleController,
         "nodeipam": NodeIpamController,
         "persistentvolume-binder": PersistentVolumeController,
+        "attachdetach": AttachDetachController,
         "disruption": DisruptionController,
         "namespace": NamespaceController,
         "resourcequota": ResourceQuotaController,

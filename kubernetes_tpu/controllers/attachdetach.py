@@ -1,0 +1,96 @@
+"""Attach/detach controller.
+
+Behavioral equivalent of the reference's
+``pkg/controller/volume/attachdetach`` (attach_detach_controller.go +
+reconciler): maintain each node's ``status.volumesAttached`` — the PVs
+that must be attached because a pod scheduled to the node mounts their
+claim — and detach (remove) volumes whose last consumer left the node.
+The desired-state-of-world is recomputed from pods+PVCs per sync (the
+reference builds the same DSW from the informer caches; its actuation
+talks to cloud APIs, ours ends at the API-visible attach state, which is
+what the scheduler's volume plugins and operators consume).
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+from kubernetes_tpu.api.types import Pod, shallow_copy
+from kubernetes_tpu.controllers.base import Controller
+
+
+class AttachDetachController(Controller):
+    name = "attachdetach"
+
+    # reconciler backstop (the reference reconciler loops every 100ms
+    # against its cloud actuator; a slow periodic resync suffices for
+    # API-visible state)
+    RESYNC_SECONDS = 30.0
+
+    def register(self) -> None:
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self._pod_changed,
+            on_update=lambda old, new: (self._pod_changed(old),
+                                        self._pod_changed(new)),
+            on_delete=self._pod_changed,
+        )
+        # all three PVC transitions matter: a claim may arrive already
+        # Bound (ADDED), re-bind (MODIFIED), or vanish (DELETED)
+        self.factory.informer_for("PersistentVolumeClaim").add_event_handler(
+            on_add=self._pvc_changed,
+            on_update=lambda old, new: self._pvc_changed(new),
+            on_delete=self._pvc_changed,
+        )
+        self.pod_lister = self.factory.lister_for("Pod")
+
+    def resync(self) -> None:
+        for n in self.store.list_nodes():
+            self.enqueue_key(n.name)
+
+    def _pod_changed(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.enqueue_key(pod.spec.node_name)
+
+    def _pvc_changed(self, pvc) -> None:
+        # (re)bound claim: every node running one of its consumers
+        # needs its attach state refreshed
+        for p in self.pod_lister.by_namespace(pvc.namespace):
+            if not p.spec.node_name:
+                continue
+            if any(v.persistent_volume_claim == pvc.name
+                   for v in p.spec.volumes):
+                self.enqueue_key(p.spec.node_name)
+
+    def _desired_attached(self, node_name: str) -> Set[str]:
+        """PV names any non-terminal pod on the node mounts via a bound
+        claim (the desired state of world)."""
+        wanted: Set[str] = set()
+        for p in self.store.list_pods():
+            if p.spec.node_name != node_name:
+                continue
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue
+            for vol in p.spec.volumes:
+                if not vol.persistent_volume_claim:
+                    continue
+                pvc = self.store.get_pvc(p.namespace,
+                                         vol.persistent_volume_claim)
+                if pvc is not None and pvc.volume_name:
+                    wanted.add(pvc.volume_name)
+        return wanted
+
+    def sync(self, key: str) -> None:
+        node = self.store.get_node(key)
+        if node is None:
+            return
+        wanted = sorted(self._desired_attached(key))
+        if node.status.volumes_attached == wanted:
+            return
+        updated = shallow_copy(node)
+        updated.metadata = shallow_copy(node.metadata)
+        updated.status = shallow_copy(node.status)
+        updated.status.volumes_attached = wanted
+        # volumes_in_use is the KUBELET's mount report (the safety
+        # interlock against premature detach) — not this controller's
+        # to write
+        self.store.update_node(updated)

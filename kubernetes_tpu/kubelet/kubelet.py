@@ -90,6 +90,9 @@ class Kubelet:
         # optional node-pressure eviction (kubelet/eviction.py); attach
         # an EvictionManager and housekeeping drives synchronize()
         self.eviction_manager = None
+        # optional image GC (kubelet/imagegc.py); housekeeping drives
+        # maybe_garbage_collect()
+        self.image_gc_manager = None
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
         self._terminal: set = set()  # uids already reported Succeeded/Failed
@@ -179,6 +182,11 @@ class Kubelet:
                     self.eviction_manager.synchronize()
                 except Exception:
                     _logger.exception("eviction synchronize")
+            if self.image_gc_manager is not None:
+                try:
+                    self.image_gc_manager.maybe_garbage_collect()
+                except Exception:
+                    _logger.exception("image gc")
             self.heartbeat()
 
     def heartbeat(self) -> None:

@@ -975,3 +975,33 @@ def test_endpointslice_mirrors_service_backends_in_slices():
         assert len(slices) == 1
     finally:
         cm.stop()
+
+
+def test_attachdetach_maintains_node_attach_state():
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import (
+        ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+    )
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["attachdetach"])
+    cm.start()
+    try:
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "8"}).obj())
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name="pv-a"),
+            capacity={"storage": parse_quantity("1Gi")},
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data", namespace="default"),
+            volume_name="pv-a", phase="Bound",
+        ))
+        store.create_pod(MakePod().name("u").uid("uu").node("n1")
+                         .pvc("data").obj())
+        _wait(lambda: store.get_node("n1").status.volumes_attached
+              == ["pv-a"], msg="volume attached")
+        store.delete_pod("default", "u")
+        _wait(lambda: store.get_node("n1").status.volumes_attached == [],
+              msg="volume detached after last consumer")
+    finally:
+        cm.stop()

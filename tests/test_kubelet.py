@@ -387,3 +387,69 @@ class TestEvictionManager:
                               stats=Stats())
         ranked = mgr._rank_pods()
         assert [p.metadata.name for p in ranked] == ["over", "within"]
+
+
+class TestImageGC:
+    def test_lru_images_freed_to_low_watermark(self):
+        from kubernetes_tpu.api.types import ContainerImage, shallow_copy
+        from kubernetes_tpu.kubelet.imagegc import ImageGCManager
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "8"}).obj())
+        node = store.get_node("n1")
+        up = shallow_copy(node)
+        up.status = shallow_copy(node.status)
+        # 4 x 30 bytes on a 100-byte disk: 120% > high 85%
+        up.status.images = [
+            ContainerImage([f"img{i}"], 30) for i in range(4)
+        ]
+        store.update_node(up)
+        # img3 is in use by a pod; img0 oldest, img2 most recently used
+        p = MakePod().name("p").uid("pu").node("n1") \
+            .container(image="img3").obj()
+        store.create_pod(p)
+        mgr = ImageGCManager(store, "n1", capacity_bytes=100,
+                             high_threshold_percent=85,
+                             low_threshold_percent=60)
+        mgr.note_image_used("img0")
+        mgr.note_image_used("img1")
+        mgr.note_image_used("img2")
+        freed = mgr.garbage_collect()
+        # target 60 bytes: free img0 then img1 (LRU), keep img2 + in-use
+        assert freed == ["img0", "img1"], freed
+        remaining = {i.names[0]
+                     for i in store.get_node("n1").status.images}
+        assert remaining == {"img2", "img3"}
+        # below the high watermark now: second pass is a no-op
+        assert mgr.garbage_collect() == []
+
+    def test_kubelet_housekeeping_drives_image_gc(self):
+        import time as _time
+
+        from kubernetes_tpu.api.types import ContainerImage, shallow_copy
+        from kubernetes_tpu.kubelet import Kubelet
+        from kubernetes_tpu.kubelet.imagegc import ImageGCManager
+
+        store = ClusterStore()
+        kl = Kubelet(store, "gc1", capacity={"cpu": "4", "memory": "1Gi",
+                                             "pods": "10"})
+        kl.start()
+        try:
+            node = store.get_node("gc1")
+            up = shallow_copy(node)
+            up.status = shallow_copy(node.status)
+            up.status.images = [ContainerImage([f"i{j}"], 50)
+                                for j in range(4)]
+            store.update_node(up)
+            mgr = ImageGCManager(store, "gc1", capacity_bytes=100,
+                                 low_threshold_percent=50)
+            mgr.GC_INTERVAL_SECONDS = 0.0
+            kl.image_gc_manager = mgr
+            deadline = _time.time() + 5
+            while _time.time() < deadline and not mgr.freed:
+                _time.sleep(0.05)
+            assert mgr.freed, "housekeeping never ran image GC"
+            assert sum(i.size_bytes
+                       for i in store.get_node("gc1").status.images) <= 50
+        finally:
+            kl.stop()
