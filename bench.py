@@ -65,35 +65,49 @@ def log(msg: str) -> None:
 
 
 def run_one(key: str, name: str, nodes: int, init_pods: int,
-            measure_pods: int, serial_rate: float) -> dict:
-    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
-                        measure_pods=measure_pods)
-    t0 = time.time()
-    # 4096 measured within noise of 8192 on throughput (solve/commit
-    # overlap hides the extra cycles) while halving the per-cycle p99
-    # contribution — and the p99 budget is part of the headline metric
-    batch = run_workload(f"{name}/batch", ops, use_batch=True,
-                         max_batch=min(measure_pods, 4096),
-                         wait_timeout=1200, progress=log)
-    # --all runs many workloads in one process; the GC tuning used for
-    # throughput defers collection, so reclaim the previous session's
-    # device-resident arrays before the next workload compiles
-    import gc
+            measure_pods: int, serial_rate: float,
+            repeat: int = 1) -> dict:
+    """One workload row. ``repeat > 1`` runs the measured phase that
+    many times and reports the MEDIAN — the shared TPU tunnel's
+    contention swings single runs by ±30%, which is noise about the
+    binary, not signal (all samples are carried in the JSON line)."""
+    samples = []
+    for r in range(repeat):
+        ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                            measure_pods=measure_pods)
+        t0 = time.time()
+        # 4096 measured within noise of 8192 on throughput (solve/commit
+        # overlap hides the extra cycles) while halving the per-cycle p99
+        # contribution — and the p99 budget is part of the headline metric
+        batch = run_workload(f"{name}/batch", ops, use_batch=True,
+                             max_batch=min(measure_pods, 4096),
+                             wait_timeout=1200, progress=log)
+        # --all runs many workloads in one process; the GC tuning used
+        # for throughput defers collection, so reclaim the previous
+        # session's device-resident arrays before the next compile
+        import gc
 
-    gc.collect()
-    log(f"[{key}] batch: {batch.pods_per_second:.1f} pods/s "
-        f"(wall {time.time() - t0:.1f}s, p99 latency "
-        f"{batch.metrics.get('Perc99', 0):.0f}ms)")
-    return {
+        gc.collect()
+        log(f"[{key}] batch run {r + 1}/{repeat}: "
+            f"{batch.pods_per_second:.1f} pods/s "
+            f"(wall {time.time() - t0:.1f}s, p99 latency "
+            f"{batch.metrics.get('Perc99', 0):.0f}ms)")
+        samples.append(batch)
+    samples.sort(key=lambda b: b.pods_per_second)
+    median = samples[len(samples) // 2]
+    row = {
         "metric": f"pods_scheduled_per_sec[{name} {nodes}nodes/"
                   f"{measure_pods}pods, TPU batch path]",
-        "value": round(batch.pods_per_second, 1),
+        "value": round(median.pods_per_second, 1),
         "unit": "pods/s",
-        "p99_latency_ms": round(batch.metrics.get("Perc99", 0)),
+        "p99_latency_ms": round(median.metrics.get("Perc99", 0)),
         "vs_baseline": round(
-            batch.pods_per_second / serial_rate, 2
+            median.pods_per_second / serial_rate, 2
         ) if serial_rate > 0 else 0.0,
     }
+    if repeat > 1:
+        row["runs"] = [round(b.pods_per_second, 1) for b in samples]
+    return row
 
 
 def measure_serial(name: str, nodes: int, measure_pods: int,
@@ -169,8 +183,12 @@ def main() -> None:
         serial_rate = measure_serial(name, nodes, measure_pods,
                                      args.serial_pods)
 
+    # the standalone headline is the driver's recorded artifact: take
+    # the median of 3 so one contended tunnel window can't misreport it
+    repeat = 3 if args.config == "headline" and not args.quick else 1
     print(json.dumps(run_one(args.config, name, nodes, init_pods,
-                             measure_pods, serial_rate)), flush=True)
+                             measure_pods, serial_rate, repeat=repeat)),
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -752,6 +752,37 @@ class ClusterStore:
         self._delete(table, kind, key)
         return True
 
+    def mutate_object(self, kind: str, namespace: str, name: str,
+                     mutate, retries: int = 8):
+        """Read-modify-write with optimistic concurrency (the reference's
+        ``GuaranteedUpdate`` retry loop): ``mutate(fresh_copy)`` edits a
+        shallow-copied object (metadata/status pre-copied) and the write
+        CASes on the resourceVersion read. Concurrent writers — e.g. the
+        attachdetach controller and a kubelet's image GC both updating
+        one Node's status — retry instead of clobbering each other's
+        fields. ``mutate`` may return False to abort (no write). Returns
+        the stored object, or None when absent/aborted."""
+        for _ in range(retries):
+            current = self.get_object(kind, namespace, name)
+            if current is None:
+                return None
+            updated = shallow_copy(current)
+            updated.metadata = shallow_copy(current.metadata)
+            if hasattr(current, "status"):
+                updated.status = shallow_copy(current.status)
+            if mutate(updated) is False:
+                return None
+            try:
+                return self.update_object(
+                    kind, updated,
+                    expect_rv=current.metadata.resource_version,
+                )
+            except ConflictError:
+                continue
+        raise ConflictError(
+            f"{kind} {namespace}/{name}: mutate_object retries exhausted"
+        )
+
     def add_finalizer(self, kind: str, namespace: str, name: str,
                       finalizer: str) -> bool:
         """Attach a finalizer (protection controllers do this on ADD)."""

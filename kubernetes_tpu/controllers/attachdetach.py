@@ -5,34 +5,46 @@ Behavioral equivalent of the reference's
 reconciler): maintain each node's ``status.volumesAttached`` — the PVs
 that must be attached because a pod scheduled to the node mounts their
 claim — and detach (remove) volumes whose last consumer left the node.
-The desired-state-of-world is recomputed from pods+PVCs per sync (the
-reference builds the same DSW from the informer caches; its actuation
-talks to cloud APIs, ours ends at the API-visible attach state, which is
-what the scheduler's volume plugins and operators consume).
+
+Like the reference, the controller keeps an incremental desired-state-
+of-world (``pkg/controller/volume/attachdetach/cache``): pod and PVC
+events update per-node maps in O(event) instead of rescanning the whole
+pod table per sync, and a slow periodic resync rebuilds the DSW from
+scratch as the backstop. Node writes go through the store's CAS mutate
+loop so concurrent node-status writers (kubelet image GC, eviction)
+never clobber this controller's fields, and a volume the kubelet still
+reports in ``status.volumesInUse`` is NOT detached (the reference's
+safe-detach interlock; its 6-minute force-detach timeout is out of
+scope for this harness).
 """
 
 from __future__ import annotations
 
-from typing import Set
+import threading
+from typing import Dict, Set
 
-from kubernetes_tpu.api.types import Pod, shallow_copy
+from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.controllers.base import Controller
 
 
 class AttachDetachController(Controller):
     name = "attachdetach"
 
-    # reconciler backstop (the reference reconciler loops every 100ms
-    # against its cloud actuator; a slow periodic resync suffices for
+    # DSW rebuild backstop (the reference reconciler loops every 100ms
+    # against its cloud actuator; a slow resync suffices for
     # API-visible state)
     RESYNC_SECONDS = 30.0
 
     def register(self) -> None:
+        self._dsw_lock = threading.Lock()
+        # node -> pod key -> referenced claim keys ("ns/claim")
+        self._dsw: Dict[str, Dict[str, Set[str]]] = {}
+        # claim key -> node names with consumers (PVC-event fanout)
+        self._claim_nodes: Dict[str, Set[str]] = {}
         self.factory.informer_for("Pod").add_event_handler(
-            on_add=self._pod_changed,
-            on_update=lambda old, new: (self._pod_changed(old),
-                                        self._pod_changed(new)),
-            on_delete=self._pod_changed,
+            on_add=self._pod_upsert,
+            on_update=lambda old, new: self._pod_update(old, new),
+            on_delete=self._pod_delete,
         )
         # all three PVC transitions matter: a claim may arrive already
         # Bound (ADDED), re-bind (MODIFIED), or vanish (DELETED)
@@ -41,56 +53,117 @@ class AttachDetachController(Controller):
             on_update=lambda old, new: self._pvc_changed(new),
             on_delete=self._pvc_changed,
         )
-        self.pod_lister = self.factory.lister_for("Pod")
+        # a kubelet unmount report (volumesInUse shrinks) may unblock a
+        # pending detach — don't wait for the resync backstop
+        self.factory.informer_for("Node").add_event_handler(
+            on_update=lambda old, new: (
+                self.enqueue_key(new.name)
+                if old is not None
+                and old.status.volumes_in_use != new.status.volumes_in_use
+                else None
+            ),
+        )
 
-    def resync(self) -> None:
-        for n in self.store.list_nodes():
-            self.enqueue_key(n.name)
+    # -- incremental DSW maintenance -----------------------------------
+    @staticmethod
+    def _claims_of(pod: Pod) -> Set[str]:
+        return {
+            f"{pod.namespace}/{v.persistent_volume_claim}"
+            for v in pod.spec.volumes if v.persistent_volume_claim
+        }
 
-    def _pod_changed(self, pod: Pod) -> None:
-        if pod.spec.node_name:
-            self.enqueue_key(pod.spec.node_name)
+    def _pod_upsert(self, pod: Pod) -> None:
+        if not pod.spec.node_name:
+            return
+        claims = self._claims_of(pod)
+        node = pod.spec.node_name
+        key = pod.full_name()
+        with self._dsw_lock:
+            if claims and pod.status.phase not in ("Succeeded", "Failed"):
+                self._dsw.setdefault(node, {})[key] = claims
+                for c in claims:
+                    self._claim_nodes.setdefault(c, set()).add(node)
+            else:
+                self._dsw.get(node, {}).pop(key, None)
+        self.enqueue_key(node)
+
+    def _pod_update(self, old: Pod, new: Pod) -> None:
+        if old is not None and old.spec.node_name and \
+                old.spec.node_name != new.spec.node_name:
+            self._pod_delete(old)
+        self._pod_upsert(new)
+
+    def _pod_delete(self, pod: Pod) -> None:
+        if not pod.spec.node_name:
+            return
+        with self._dsw_lock:
+            self._dsw.get(pod.spec.node_name, {}).pop(pod.full_name(), None)
+        self.enqueue_key(pod.spec.node_name)
 
     def _pvc_changed(self, pvc) -> None:
-        # (re)bound claim: every node running one of its consumers
-        # needs its attach state refreshed
-        for p in self.pod_lister.by_namespace(pvc.namespace):
-            if not p.spec.node_name:
-                continue
-            if any(v.persistent_volume_claim == pvc.name
-                   for v in p.spec.volumes):
-                self.enqueue_key(p.spec.node_name)
+        # (re)bound or deleted claim: refresh every node with a consumer
+        key = f"{pvc.namespace}/{pvc.name}"
+        with self._dsw_lock:
+            nodes = list(self._claim_nodes.get(key, ()))
+        for node in nodes:
+            self.enqueue_key(node)
 
-    def _desired_attached(self, node_name: str) -> Set[str]:
-        """PV names any non-terminal pod on the node mounts via a bound
-        claim (the desired state of world)."""
-        wanted: Set[str] = set()
+    def resync(self) -> None:
+        """Rebuild the DSW from scratch (one O(pods) pass) and enqueue
+        every node whose attach state could have drifted."""
+        dsw: Dict[str, Dict[str, Set[str]]] = {}
+        claim_nodes: Dict[str, Set[str]] = {}
         for p in self.store.list_pods():
-            if p.spec.node_name != node_name:
+            if not p.spec.node_name or \
+                    p.status.phase in ("Succeeded", "Failed"):
                 continue
-            if p.status.phase in ("Succeeded", "Failed"):
+            claims = self._claims_of(p)
+            if not claims:
                 continue
-            for vol in p.spec.volumes:
-                if not vol.persistent_volume_claim:
-                    continue
-                pvc = self.store.get_pvc(p.namespace,
-                                         vol.persistent_volume_claim)
-                if pvc is not None and pvc.volume_name:
-                    wanted.add(pvc.volume_name)
+            dsw.setdefault(p.spec.node_name, {})[p.full_name()] = claims
+            for c in claims:
+                claim_nodes.setdefault(c, set()).add(p.spec.node_name)
+        with self._dsw_lock:
+            stale = set(self._dsw) | set(dsw)
+            self._dsw = dsw
+            self._claim_nodes = claim_nodes
+        for node in stale:
+            self.enqueue_key(node)
+
+    # -- reconcile ------------------------------------------------------
+    def _desired_attached(self, node_name: str) -> Set[str]:
+        """PV names backing the node's consumed, BOUND claims."""
+        with self._dsw_lock:
+            claims = {
+                c for per_pod in self._dsw.get(node_name, {}).values()
+                for c in per_pod
+            }
+        wanted: Set[str] = set()
+        for claim in claims:
+            ns, _, name = claim.partition("/")
+            pvc = self.store.get_pvc(ns, name)
+            if pvc is not None and pvc.volume_name:
+                wanted.add(pvc.volume_name)
         return wanted
 
     def sync(self, key: str) -> None:
         node = self.store.get_node(key)
         if node is None:
+            with self._dsw_lock:
+                self._dsw.pop(key, None)
             return
-        wanted = sorted(self._desired_attached(key))
-        if node.status.volumes_attached == wanted:
-            return
-        updated = shallow_copy(node)
-        updated.metadata = shallow_copy(node.metadata)
-        updated.status = shallow_copy(node.status)
-        updated.status.volumes_attached = wanted
-        # volumes_in_use is the KUBELET's mount report (the safety
-        # interlock against premature detach) — not this controller's
-        # to write
-        self.store.update_node(updated)
+        wanted = self._desired_attached(key)
+
+        def mutate(n) -> bool:
+            attached = set(n.status.volumes_attached)
+            # the kubelet's mount report is the safe-detach interlock:
+            # a volume still in use stays attached even with no desired
+            # consumer left
+            in_use = set(n.status.volumes_in_use)
+            new = sorted(wanted | (attached & in_use))
+            if new == n.status.volumes_attached:
+                return False
+            n.status.volumes_attached = new
+            return True
+
+        self.store.mutate_object("Node", "", key, mutate)

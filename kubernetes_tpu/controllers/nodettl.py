@@ -11,7 +11,7 @@ TTLs to shed apiserver load). The reference's ladder
 
 from __future__ import annotations
 
-from kubernetes_tpu.api.types import Node, shallow_copy
+from kubernetes_tpu.api.types import Node
 from kubernetes_tpu.controllers.base import Controller
 
 TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
@@ -52,14 +52,13 @@ class TTLController(Controller):
             self.enqueue_key(new_node)
 
     def sync(self, key: str) -> None:
-        node = self.store.get_node(key)
-        if node is None:
-            return
         want = str(ttl_for_cluster_size(len(self.store.list_nodes())))
-        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
-            return
-        updated: Node = shallow_copy(node)
-        updated.metadata = shallow_copy(node.metadata)
-        updated.metadata.annotations = dict(node.metadata.annotations)
-        updated.metadata.annotations[TTL_ANNOTATION] = want
-        self.store.update_node(updated)
+
+        def mutate(n: Node) -> bool:
+            if n.metadata.annotations.get(TTL_ANNOTATION) == want:
+                return False
+            n.metadata.annotations = dict(n.metadata.annotations)
+            n.metadata.annotations[TTL_ANNOTATION] = want
+            return True
+
+        self.store.mutate_object("Node", "", key, mutate)

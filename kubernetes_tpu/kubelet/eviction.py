@@ -128,30 +128,31 @@ class EvictionManager:
 
     # ------------------------------------------------------------------
     def _set_pressure(self, under: bool) -> None:
-        node = self.store.get_node(self.node_name)
-        if node is None:
-            return
-        have = any(
-            c.type == MEMORY_PRESSURE and c.status == "True"
-            for c in node.status.conditions
-        )
-        if have == under:
-            return
-        updated = shallow_copy(node)
-        updated.status = shallow_copy(node.status)
-        updated.status.conditions = [
-            c for c in node.status.conditions if c.type != MEMORY_PRESSURE
-        ] + [PodCondition(
-            MEMORY_PRESSURE,
-            "True" if under else "False",
-            "KubeletHasInsufficientMemory" if under
-            else "KubeletHasSufficientMemory",
-        )]
-        updated.spec = shallow_copy(node.spec)
-        taints = [t for t in node.spec.taints
-                  if t.key != MEMORY_PRESSURE_TAINT]
-        if under:
-            taints.append(Taint(key=MEMORY_PRESSURE_TAINT,
-                                effect="NoSchedule"))
-        updated.spec.taints = taints
-        self.store.update_node(updated)
+        def mutate(n) -> bool:
+            have = any(
+                c.type == MEMORY_PRESSURE and c.status == "True"
+                for c in n.status.conditions
+            )
+            if have == under:
+                return False
+            n.status.conditions = [
+                c for c in n.status.conditions
+                if c.type != MEMORY_PRESSURE
+            ] + [PodCondition(
+                MEMORY_PRESSURE,
+                "True" if under else "False",
+                "KubeletHasInsufficientMemory" if under
+                else "KubeletHasSufficientMemory",
+            )]
+            n.spec = shallow_copy(n.spec)
+            taints = [t for t in n.spec.taints
+                      if t.key != MEMORY_PRESSURE_TAINT]
+            if under:
+                taints.append(Taint(key=MEMORY_PRESSURE_TAINT,
+                                    effect="NoSchedule"))
+            n.spec.taints = taints
+            return True
+
+        # CAS mutate: other node-status writers (attachdetach, image
+        # GC) must not be clobbered by a stale read
+        self.store.mutate_object("Node", "", self.node_name, mutate)

@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Dict, List
 
-from kubernetes_tpu.api.types import ContainerImage, shallow_copy
+from kubernetes_tpu.api.types import ContainerImage
 
 
 class ImageGCManager:
@@ -107,10 +107,19 @@ class ImageGCManager:
             freed.extend(img.names[:1])
         if not freed:
             return []
-        updated = shallow_copy(node)
-        updated.metadata = shallow_copy(node.metadata)
-        updated.status = shallow_copy(node.status)
-        updated.status.images = keep
-        self.store.update_node(updated)
+        freed_names = {n for i in images if i not in keep for n in i.names}
+
+        def mutate(n) -> bool:
+            # CAS merge against the LIVE image list: another node-status
+            # writer (attachdetach, eviction) may have landed since the
+            # read above, and blind last-write-wins would resurrect
+            # their fields or our freed images
+            n.status.images = [
+                i for i in n.status.images
+                if not any(name in freed_names for name in i.names)
+            ]
+            return True
+
+        self.store.mutate_object("Node", "", self.node_name, mutate)
         self.freed = (self.freed + freed)[-self.FREED_LOG_CAP:]
         return freed

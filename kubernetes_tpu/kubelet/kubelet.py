@@ -95,6 +95,7 @@ class Kubelet:
         self.image_gc_manager = None
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
+        self._pvs_of: Dict[str, list] = {}  # uid -> PV names reported in-use
         self._terminal: set = set()  # uids already reported Succeeded/Failed
         self._key_of: Dict[str, tuple] = {}  # uid -> (namespace, name)
         self._work = threading.Event()
@@ -240,6 +241,7 @@ class Kubelet:
             _logger.warning("pod %s admission failed: %s", pod.full_name(), e)
             return
         self.volumes.mount_pod_volumes(pod)
+        self._report_volumes_in_use(pod.uid, pod)
         sid = self.runtime.run_pod_sandbox(pod.uid, pod.name, pod.namespace)
         self._sandbox_of[pod.uid] = sid
         cids = {}
@@ -247,6 +249,9 @@ class Kubelet:
             cid = self.runtime.create_container(sid, c.name, c.image)
             self.runtime.start_container(cid)
             cids[c.name] = cid
+            # image sighting feeds the GC manager's LRU order
+            if self.image_gc_manager is not None and c.image:
+                self.image_gc_manager.note_image_used(c.image)
         self._containers_of[pod.uid] = cids
         ip = getattr(self.runtime, "sandbox_ip", lambda s: "")(sid)
         self.store.set_pod_phase(pod.namespace, pod.name, RUNNING, pod_ip=ip,
@@ -296,8 +301,47 @@ class Kubelet:
         _release is idempotent and must run even without a sandbox —
         admission-failed pods can still hold device/volume state."""
         self._release(uid)
+        self._report_volumes_in_use(uid, None)
         self._terminal.discard(uid)
         self._key_of.pop(uid, None)
+
+    def _pod_pv_names(self, pod: Pod) -> list:
+        out = []
+        for v in pod.spec.volumes:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self.store.get_pvc(pod.namespace, v.persistent_volume_claim)
+            if pvc is not None and pvc.volume_name:
+                out.append(pvc.volume_name)
+        return out
+
+    def _report_volumes_in_use(self, uid: str, pod: Optional[Pod]) -> None:
+        """Publish node.status.volumesInUse (reference volume manager's
+        mount report, ``kubelet_node_status.go`` setVolumesInUseStatus):
+        the attachdetach controller's safe-detach interlock. The report
+        per pod is remembered at mount time — at teardown the pod may
+        already be gone from the store. CAS mutate so concurrent
+        node-status writers don't clobber each other."""
+        if pod is not None:
+            pvs = self._pod_pv_names(pod)
+            if not pvs:
+                return
+            self._pvs_of[uid] = pvs
+        else:
+            if self._pvs_of.pop(uid, None) is None:
+                return
+        in_use = sorted({pv for pvs in self._pvs_of.values() for pv in pvs})
+
+        def mutate(n) -> bool:
+            if n.status.volumes_in_use == in_use:
+                return False
+            n.status.volumes_in_use = in_use
+            return True
+
+        try:
+            self.store.mutate_object("Node", "", self.node_name, mutate)
+        except Exception:
+            _logger.exception("volumesInUse report failed")
 
     def _release(self, uid: str) -> None:
         sid = self._sandbox_of.pop(uid, None)

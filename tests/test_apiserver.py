@@ -249,3 +249,45 @@ def test_rest_feeds_informers_over_http(server):
     assert synced.wait(5)
     assert adds == ["seed", "late"]
     handle.stop()
+
+
+class TestMutateObject:
+    def test_cas_retries_on_concurrent_writer(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.testing import MakeNode
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "4"}).obj())
+        calls = {"n": 0}
+
+        def mutate(n):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # interleave a concurrent write between read and CAS:
+                # the first attempt must conflict and retry
+                other = store.get_node("n1")
+                from kubernetes_tpu.api.types import shallow_copy
+                up = shallow_copy(other)
+                up.metadata = shallow_copy(other.metadata)
+                up.metadata.annotations = dict(other.metadata.annotations)
+                up.metadata.annotations["other"] = "写"
+                store.update_node(up)
+            n.status.volumes_attached = ["pv-1"]
+            return True
+
+        store.mutate_object("Node", "", "n1", mutate)
+        node = store.get_node("n1")
+        assert calls["n"] == 2  # first attempt conflicted
+        assert node.status.volumes_attached == ["pv-1"]
+        assert node.metadata.annotations.get("other") == "写"  # preserved
+
+    def test_mutate_abort_writes_nothing(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.testing import MakeNode
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "4"}).obj())
+        rv = store.get_node("n1").metadata.resource_version
+        assert store.mutate_object("Node", "", "n1",
+                                   lambda n: False) is None
+        assert store.get_node("n1").metadata.resource_version == rv

@@ -1005,3 +1005,49 @@ def test_attachdetach_maintains_node_attach_state():
               msg="volume detached after last consumer")
     finally:
         cm.stop()
+
+
+def test_attachdetach_honors_kubelet_in_use_report():
+    """The safe-detach interlock: a volume the kubelet still reports in
+    volumesInUse stays attached even after its last desired consumer."""
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import (
+        ObjectMeta, PersistentVolume, PersistentVolumeClaim, shallow_copy,
+    )
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["attachdetach"])
+    cm.start()
+    try:
+        store.add_node(MakeNode().name("n1").capacity({"cpu": "8"}).obj())
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name="pv-b"),
+            capacity={"storage": parse_quantity("1Gi")},
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data", namespace="default"),
+            volume_name="pv-b", phase="Bound",
+        ))
+        store.create_pod(MakePod().name("u").uid("uu").node("n1")
+                         .pvc("data").obj())
+        _wait(lambda: store.get_node("n1").status.volumes_attached
+              == ["pv-b"], msg="attached")
+        # kubelet reports the volume mounted
+        store.mutate_object(
+            "Node", "", "n1",
+            lambda n: n.status.__setattr__("volumes_in_use", ["pv-b"])
+            or True,
+        )
+        store.delete_pod("default", "u")
+        time.sleep(0.5)
+        assert store.get_node("n1").status.volumes_attached == ["pv-b"], \
+            "detached while kubelet still reported the mount"
+        # kubelet unmounts: detach proceeds
+        store.mutate_object(
+            "Node", "", "n1",
+            lambda n: n.status.__setattr__("volumes_in_use", []) or True,
+        )
+        _wait(lambda: store.get_node("n1").status.volumes_attached == [],
+              msg="detached after unmount report")
+    finally:
+        cm.stop()
