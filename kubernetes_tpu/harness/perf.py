@@ -111,8 +111,15 @@ def run_workload(
     max_batch: int = 4096,
     wait_timeout: float = 600.0,
     progress: Optional[Callable[[str], None]] = None,
+    backend_factory: Optional[Callable[[], object]] = None,
+    result_hook: Optional[Callable[[object, object], None]] = None,
 ) -> BenchmarkResult:
-    """Execute one workload (scheduler_perf_test.go:309 runWorkload)."""
+    """Execute one workload (scheduler_perf_test.go:309 runWorkload).
+
+    ``backend_factory`` overrides the solver backend (e.g. the
+    mesh-sharded planes backend for the multi-chip scaling bench);
+    ``result_hook(sched, bs)`` runs after the workload completes, before
+    teardown — the scaling bench reads solver-segment histograms there."""
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
     tune_for_throughput()
@@ -123,7 +130,10 @@ def run_workload(
     # exactly PrioritySort when no pod declares a gang
     sched = Scheduler.create(store, feature_gates=gates,
                              provider="GangSchedulingProvider")
-    bs = attach_batch_scheduler(sched, max_batch=max_batch) if use_batch else None
+    bs = attach_batch_scheduler(
+        sched, max_batch=max_batch,
+        backend=backend_factory() if backend_factory else None,
+    ) if use_batch else None
     sched.start()
 
     def pump_until_quiescent(deadline: float, wait_names=None) -> None:
@@ -199,9 +209,13 @@ def run_workload(
                         Pod.from_dict(template(offset + i))
                         for i in range(min(200, op["count"]))
                     ]
-                    # host-only pods (PVCs, host ports) never take the
-                    # batch path — don't compile device shapes for them
-                    samples = [p for p in samples if not is_host_only(p)]
+                    # host-only pods (unbound PVCs, host ports) never
+                    # take the batch path — don't compile device shapes
+                    # for them (bound-PVC pods DO batch, so the client
+                    # must inform the check or their shape stays cold)
+                    samples = [
+                        p for p in samples if not is_host_only(p, store)
+                    ]
                     warm = bs.warmup(sample_pods=samples) if samples else 0.0
                     if progress and warm > 0.05:
                         progress(f"{name}: solver warmup {warm:.1f}s")
@@ -242,6 +256,8 @@ def run_workload(
             bs.flush()
         sched.wait_for_inflight_bindings(timeout=30.0)
         duration = time.monotonic() - measure_start if measure_start else 0.0
+        if result_hook is not None:
+            result_hook(sched, bs)
     finally:
         if collector:
             collector.stop()

@@ -280,7 +280,8 @@ class SolverSession:
         t0 = time.monotonic()
         self.sched.algorithm.update_snapshot()
         self._encoder = BatchEncoder(
-            self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes
+            self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes,
+            client=getattr(self.sched, "client", None),
         )
         cluster, batch = self._encoder.encode(
             pods, pad_pods=pad or self.max_batch

@@ -155,6 +155,16 @@ class SchedulerCache:
         with self._lock:
             return self._mutation_seq
 
+    def note_external_mutation(self) -> None:
+        """Record a state change the cache itself doesn't track (PV /
+        PVC / StorageClass / CSINode / Service object churn). The batch
+        sidecar's device mirror encodes volume feasibility and attach
+        budgets from those objects, so their mutations must invalidate
+        the mirror exactly like pod/node mutations do — the bump makes
+        ``SolverSession.mirror_current``'s arithmetic fail."""
+        with self._lock:
+            self._mutation_seq += 1
+
     # ------------------------------------------------------------------
     # pods
     def assume_pod(self, pod: Pod) -> None:

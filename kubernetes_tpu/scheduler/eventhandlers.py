@@ -100,13 +100,26 @@ class EventHandlers:
                 DELETED: ev.SERVICE_DELETE,
             })
         elif kind == "PersistentVolume":
+            self._storage_mutated()
             self._move(event, {ADDED: ev.PV_ADD, MODIFIED: ev.PV_UPDATE})
         elif kind == "PersistentVolumeClaim":
+            self._storage_mutated()
             self._move(event, {ADDED: ev.PVC_ADD, MODIFIED: ev.PVC_UPDATE})
         elif kind == "StorageClass":
+            self._storage_mutated()
             self._move(event, {ADDED: ev.STORAGE_CLASS_ADD})
         elif kind == "CSINode":
+            self._storage_mutated()
             self._move(event, {ADDED: ev.CSI_NODE_ADD, MODIFIED: ev.CSI_NODE_UPDATE})
+
+    def _storage_mutated(self) -> None:
+        """Storage objects (PV/PVC/StorageClass/CSINode) feed the batch
+        sidecar's device mirror (volume masks, attach columns); ANY
+        mutation — including DELETED, which has no queue-move event
+        (deletion never helps a pending pod) — must invalidate the
+        mirror like a cache mutation would. Services are excluded: the
+        encoder reads no Service state."""
+        self.sched.cache.note_external_mutation()
 
     def _move(self, event: Event, mapping) -> None:
         name = mapping.get(event.type)

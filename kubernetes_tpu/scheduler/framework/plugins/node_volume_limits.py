@@ -36,6 +36,41 @@ DEFAULT_GCE_PD_LIMIT = 16
 DEFAULT_AZURE_LIMIT = 16
 
 
+def pod_csi_volumes(client, pod: Pod) -> Set[Tuple[str, str]]:
+    """(driver, volume-key) pairs the pod would attach. Bound PVCs
+    resolve via the PV (csi.go getCSIDriverInfo); unbound PVCs via
+    the StorageClass provisioner (getCSIDriverInfoFromSC) — keyed by
+    the claim itself, since no PV exists yet. Shared single source of
+    truth between this filter and the batch encoder's attach-limit
+    resource columns (``ops/encode.py``) — the two must count the same
+    volumes or the device path diverges from the host filter."""
+    out = set()
+    for vol in pod.spec.volumes:
+        if not vol.persistent_volume_claim:
+            continue
+        pvc = client.get_pvc(pod.namespace, vol.persistent_volume_claim)
+        if pvc is None:
+            continue
+        if pvc.volume_name:
+            pv = client.get_pv(pvc.volume_name)
+            if pv is None:
+                continue
+            driver = getattr(pv, "csi_driver", None)
+            if driver:
+                out.add((driver, pv.name))
+            continue
+        # unbound claim: the provisioner that WILL serve it defines
+        # which driver's attach budget it consumes
+        sc_name = pvc.storage_class_name
+        if not sc_name:
+            continue
+        sc = client.get_storage_class(sc_name)
+        if sc is None or not sc.provisioner:
+            continue
+        out.add((sc.provisioner, f"{pod.namespace}/{pvc.name}"))
+    return out
+
+
 class CSILimits(FilterPlugin):
     NAME = "NodeVolumeLimits"
 
@@ -71,35 +106,7 @@ class CSILimits(FilterPlugin):
         return None
 
     def _pod_csi_volumes(self, client, pod: Pod) -> Set[Tuple[str, str]]:
-        """(driver, volume-key) pairs the pod would attach. Bound PVCs
-        resolve via the PV (csi.go getCSIDriverInfo); unbound PVCs via
-        the StorageClass provisioner (getCSIDriverInfoFromSC) — keyed by
-        the claim itself, since no PV exists yet."""
-        out = set()
-        for vol in pod.spec.volumes:
-            if not vol.persistent_volume_claim:
-                continue
-            pvc = client.get_pvc(pod.namespace, vol.persistent_volume_claim)
-            if pvc is None:
-                continue
-            if pvc.volume_name:
-                pv = client.get_pv(pvc.volume_name)
-                if pv is None:
-                    continue
-                driver = getattr(pv, "csi_driver", None)
-                if driver:
-                    out.add((driver, pv.name))
-                continue
-            # unbound claim: the provisioner that WILL serve it defines
-            # which driver's attach budget it consumes
-            sc_name = pvc.storage_class_name
-            if not sc_name:
-                continue
-            sc = client.get_storage_class(sc_name)
-            if sc is None or not sc.provisioner:
-                continue
-            out.add((sc.provisioner, f"{pod.namespace}/{pvc.name}"))
-        return out
+        return pod_csi_volumes(client, pod)
 
 
 class _InTreeLimits(FilterPlugin):

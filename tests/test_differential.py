@@ -58,24 +58,86 @@ def _random_cluster(rng, n_nodes, taints=True):
     return nodes
 
 
-def _pvc_setup(store: ClusterStore, claim: str):
-    """A 1:1 immediate-binding PV/PVC pair (the volumebinding plugin's
-    Reserve/PreBind path keeps these pods on the serial fallback)."""
+CSI_DRIVER = "csi.diff.driver"
+CSI_LIMIT = 16
+
+
+def _csi_nodes(store: ClusterStore, nodes):
+    """CSINode attach limits on every node so bound CSI-PV pods exercise
+    the encoder's attach-limit resource columns on the batch path."""
+    from kubernetes_tpu.api.types import CSINode, CSINodeDriver
+
+    for n in nodes:
+        store.add_csi_node(CSINode(
+            metadata=ObjectMeta(name=n.name),
+            drivers=[CSINodeDriver(
+                name=CSI_DRIVER, node_id=n.name,
+                allocatable_count=CSI_LIMIT,
+            )],
+        ))
+
+
+def _pvc_setup(store: ClusterStore, claim: str, variant: int = 0):
+    """A 1:1 PV/PVC pair in four variants (round-3 coverage — bound
+    claims are batch-expressible, VERDICT r2 #1):
+
+    0. bound, CSI driver (attach-limit columns), unconstrained PV
+    1. bound, PV zone-labelled z0 (VolumeZone mask)
+    2. bound, PV node-affinity to z1 (VolumeBinding bound-claim mask)
+    3. unbound immediate — UnschedulableAndUnresolvable on both paths
+       (the serial-fallback contract's original coverage)
+    """
+    from kubernetes_tpu.api.types import (
+        NodeSelector, NodeSelectorRequirement, NodeSelectorTerm,
+    )
+
     if store.get_storage_class("diff-sc") is None:
         store.add_storage_class(StorageClass(
             metadata=ObjectMeta(name="diff-sc"),
             provisioner="kubernetes.io/fake",
             volume_binding_mode="Immediate",
         ))
+    if variant == 3:
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name=f"pv-{claim}"),
+            capacity={"storage": parse_quantity("1Gi")},
+            storage_class_name="diff-sc",
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=claim, namespace="default"),
+            storage_class_name="diff-sc",
+            requests={"storage": parse_quantity("1Gi")},
+        ))
+        return
+    labels = {}
+    node_affinity = None
+    driver = ""
+    if variant == 0:
+        driver = CSI_DRIVER
+    elif variant == 1:
+        labels = {ZONE_KEY: "z0"}
+    elif variant == 2:
+        node_affinity = NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(
+                    key=ZONE_KEY, operator="In", values=["z1"]),
+            ])
+        ])
     store.add_pv(PersistentVolume(
-        metadata=ObjectMeta(name=f"pv-{claim}"),
+        metadata=ObjectMeta(name=f"pv-{claim}", labels=labels),
         capacity={"storage": parse_quantity("1Gi")},
         storage_class_name="diff-sc",
+        claim_ref=f"default/{claim}",
+        phase="Bound",
+        node_affinity=node_affinity,
+        csi_driver=driver,
     ))
     store.add_pvc(PersistentVolumeClaim(
         metadata=ObjectMeta(name=claim, namespace="default"),
         storage_class_name="diff-sc",
         requests={"storage": parse_quantity("1Gi")},
+        volume_name=f"pv-{claim}",
+        phase="Bound",
     ))
 
 
@@ -140,7 +202,7 @@ def _random_pods(rng, count, store=None, gangs=False, pvcs=False,
             w.toleration(TAINT_KEY, TAINT_VAL, "NoSchedule")
         elif kind == 8 and pvcs and store is not None:
             claim = f"claim-{i}"
-            _pvc_setup(store, claim)
+            _pvc_setup(store, claim, variant=i % 4)
             w.pvc(claim)
         # remaining kinds: plain fit pods
         pods.append(w.obj())
@@ -297,6 +359,48 @@ def _assert_valid(bound, store):
         assert n_bound in (0, len(members)), (
             f"gang {g}: {n_bound}/{len(members)} bound (not all-or-nothing)"
         )
+    # volume feasibility: bound-PV zone labels and node affinity must
+    # admit the chosen node; CSI attach counts within CSINode limits
+    from kubernetes_tpu.scheduler.framework.plugins.helpers import (
+        node_matches_node_selector,
+    )
+
+    attach = {}
+    for name, node_name in bound.items():
+        pod = pods[name]
+        node = nodes[node_name]
+        for v in pod.spec.volumes:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = store.get_pvc(pod.namespace, v.persistent_volume_claim)
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = store.get_pv(pvc.volume_name)
+            if pv is None:
+                continue
+            zone = pv.metadata.labels.get(ZONE_KEY)
+            if zone is not None:
+                assert node.metadata.labels.get(ZONE_KEY) in \
+                    set(zone.split("__")), (
+                        f"{name}: PV zone {zone} violated on {node_name}"
+                    )
+            assert node_matches_node_selector(node, pv.node_affinity), (
+                f"{name}: PV node affinity violated on {node_name}"
+            )
+            if pv.csi_driver:
+                attach.setdefault(
+                    (node_name, pv.csi_driver), set()
+                ).add(pv.name)
+    for (node_name, drv), vols in attach.items():
+        cn = store.get_csi_node(node_name)
+        if cn is None:
+            continue
+        for d in cn.drivers:
+            if d.name == drv and d.allocatable_count is not None:
+                assert len(vols) <= d.allocatable_count, (
+                    f"{node_name}: {len(vols)} {drv} attachments > "
+                    f"{d.allocatable_count}"
+                )
 
 
 # ----------------------------------------------------------------------
@@ -310,6 +414,7 @@ class TestSerialBatchEquivalence:
         rng = random.Random(seed)
         nodes = _random_cluster(rng, 200)
         store_s = ClusterStore()
+        _csi_nodes(store_s, nodes)
         pods = _random_pods(rng, 2000, store=store_s, gangs=True,
                             pvcs=True, priorities=True)
         serial_bound, serial_store = _run(nodes, pods, "serial",
@@ -317,6 +422,7 @@ class TestSerialBatchEquivalence:
         rng = random.Random(seed)
         nodes = _random_cluster(rng, 200)
         store_b = ClusterStore()
+        _csi_nodes(store_b, nodes)
         pods = _random_pods(rng, 2000, store=store_b, gangs=True,
                             pvcs=True, priorities=True)
         batch_bound, batch_store = _run(nodes, pods, "batch",
