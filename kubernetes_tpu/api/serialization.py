@@ -55,6 +55,10 @@ SCHEME: Dict[str, type] = {
         "CronJob",
         "HorizontalPodAutoscaler",
         "EndpointSlice",
+        "Role",
+        "ClusterRole",
+        "RoleBinding",
+        "ClusterRoleBinding",
     )
 }
 
@@ -62,7 +66,7 @@ SCHEME: Dict[str, type] = {
 # schema metadata: which kinds are namespace-scoped (clients need this to
 # build paths; it is API schema, not storage layout)
 CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
-                  "Namespace"}
+                  "Namespace", "ClusterRole", "ClusterRoleBinding"}
 
 
 def is_namespaced(kind: str) -> bool:

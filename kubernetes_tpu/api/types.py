@@ -999,3 +999,96 @@ class Event:
     first_timestamp: float = 0.0
     last_timestamp: float = 0.0
     source_component: str = ""
+
+
+# ---------------------------------------------------------------------------
+# RBAC API group (reference staging/src/k8s.io/api/rbac/v1/types.go;
+# served by pkg/registry/rbac/, evaluated by
+# plugin/pkg/auth/authorizer/rbac/rbac.go)
+
+
+@dataclass
+class PolicyRule:
+    """One grant: the cross-product of verbs x resources (with optional
+    per-object resourceNames). "*" wildcards both axes (reference
+    rbac/v1 PolicyRule + VerbMatches/ResourceMatches,
+    plugin/pkg/auth/authorizer/rbac/rbac.go RuleAllows)."""
+
+    verbs: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    non_resource_urls: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RBACSubject:
+    """Who a binding grants to (rbac/v1 Subject)."""
+
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""  # ServiceAccount subjects only
+
+
+@dataclass
+class RoleRef:
+    kind: str = "ClusterRole"  # ClusterRole | Role
+    name: str = ""
+
+
+@dataclass
+class Role:
+    """Namespaced rule set (rbac/v1 Role)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ClusterRole:
+    """Cluster-scoped rule set (rbac/v1 ClusterRole)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class RoleBinding:
+    """Grants a Role (or a ClusterRole, scoped down to this binding's
+    namespace) to subjects (rbac/v1 RoleBinding)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ClusterRoleBinding:
+    """Grants a ClusterRole cluster-wide (rbac/v1 ClusterRoleBinding)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[RBACSubject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

@@ -68,6 +68,10 @@ PLURALS: Dict[str, str] = {
     "cronjobs": "CronJob",
     "horizontalpodautoscalers": "HorizontalPodAutoscaler",
     "endpointslices": "EndpointSlice",
+    "roles": "Role",
+    "clusterroles": "ClusterRole",
+    "rolebindings": "RoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
@@ -306,6 +310,39 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         kind, ns, name, sub, q = self._route()
         if kind is None:
+            path = urlparse(self.path).path.rstrip("/")
+            if path.endswith("/selfsubjectaccessreviews"):
+                # virtual kind (reference authorization.k8s.io/v1
+                # SelfSubjectAccessReview): any authenticated user may
+                # ask "can I?" — the answer comes from the authorizer
+                # seam, so it works for allow_all and RBAC alike
+                try:
+                    body = self._read_body()
+                except json.JSONDecodeError as e:
+                    self._send_error(400, "BadRequest", f"invalid JSON: {e}")
+                    return
+                user = self._user()
+                attrs = (body.get("spec") or {}).get(
+                    "resourceAttributes") or {}
+                authz = self.server.authorizer
+                if hasattr(authz, "authorize"):
+                    allowed = authz.authorize(
+                        user, attrs.get("verb", ""),
+                        attrs.get("resource", ""),
+                        attrs.get("namespace", ""), attrs.get("name", ""),
+                    )
+                else:
+                    allowed = authz(
+                        user, attrs.get("verb", ""),
+                        attrs.get("resource", ""),
+                        attrs.get("namespace", ""),
+                    )
+                self._send_json(201, {
+                    "kind": "SelfSubjectAccessReview",
+                    "apiVersion": "v1",
+                    "status": {"allowed": bool(allowed)},
+                })
+                return
             self._send_error(404, "NotFound", f"no route for {self.path}")
             return
         try:
@@ -725,8 +762,12 @@ class RestClient:
         return from_wire(payload, kind)
 
     def delete(self, kind: str, name: str, namespace: Optional[str] = "default") -> bool:
+        """True = deleted, False = not found; authorization failures
+        raise (a 403 must never read as a routine miss)."""
         ns = namespace if is_namespaced(kind) else None
         code, payload = self._request("DELETE", self._path(kind, ns, name))
+        if code == 403:
+            self._raise_for(code, payload)
         return code == 200
 
     def bind(self, namespace: str, name: str, uid: str, node_name: str) -> None:
@@ -745,6 +786,23 @@ class RestClient:
             {"status": {"phase": phase, "podIP": pod_ip, "hostIP": host_ip}},
         )
         self._raise_for(code, payload)
+
+    def can_i(self, verb: str, resource: str, namespace: str = "",
+              name: str = "") -> bool:
+        """SelfSubjectAccessReview: ask the server whether the caller's
+        token may perform verb on resource (authorization.k8s.io)."""
+        code, payload = self._request(
+            "POST", "/api/v1/selfsubjectaccessreviews",
+            {
+                "kind": "SelfSubjectAccessReview",
+                "spec": {"resourceAttributes": {
+                    "verb": verb, "resource": resource,
+                    "namespace": namespace, "name": name,
+                }},
+            },
+        )
+        self._raise_for(code, payload)
+        return bool((payload.get("status") or {}).get("allowed"))
 
     def healthz(self) -> bool:
         import urllib.request

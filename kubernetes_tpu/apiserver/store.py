@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import (
     CSINode,
+    ClusterRole,
+    ClusterRoleBinding,
     DaemonSet,
     shallow_copy,
     Deployment,
@@ -41,6 +43,8 @@ from kubernetes_tpu.api.types import (
     ReplicaSet,
     ReplicationController,
     ResourceQuota,
+    Role,
+    RoleBinding,
     Service,
     ServiceAccount,
     StatefulSet,
@@ -101,6 +105,10 @@ class ClusterStore:
         self._storage_classes: Dict[str, StorageClass] = {}
         self._csi_nodes: Dict[str, CSINode] = {}
         self._pdbs: Dict[str, PodDisruptionBudget] = {}
+        self._roles: Dict[str, Role] = {}
+        self._cluster_roles: Dict[str, ClusterRole] = {}
+        self._role_bindings: Dict[str, RoleBinding] = {}
+        self._cluster_role_bindings: Dict[str, ClusterRoleBinding] = {}
         self._endpoints: Dict[str, Endpoints] = {}
         self._deployments: Dict[str, Deployment] = {}
         self._daemon_sets: Dict[str, DaemonSet] = {}
@@ -617,6 +625,56 @@ class ClusterStore:
             self._dispatch(Event(MODIFIED, "Pod", new_pod, pod))
             return True
 
+    # RBAC objects (reference pkg/registry/rbac/)
+    def add_role(self, r: Role) -> None:
+        self._upsert(self._roles, "Role", f"{r.namespace}/{r.name}", r)
+
+    def get_role(self, namespace: str, name: str) -> Optional[Role]:
+        with self._lock:
+            return self._roles.get(f"{namespace}/{name}")
+
+    def list_roles(self, namespace: Optional[str] = None) -> List[Role]:
+        with self._lock:
+            return [
+                r for r in self._roles.values()
+                if namespace is None or r.namespace == namespace
+            ]
+
+    def add_cluster_role(self, r: ClusterRole) -> None:
+        self._upsert(self._cluster_roles, "ClusterRole", r.name, r)
+
+    def get_cluster_role(self, name: str) -> Optional[ClusterRole]:
+        with self._lock:
+            return self._cluster_roles.get(name)
+
+    def list_cluster_roles(self) -> List[ClusterRole]:
+        with self._lock:
+            return list(self._cluster_roles.values())
+
+    def add_role_binding(self, rb: RoleBinding) -> None:
+        self._upsert(
+            self._role_bindings, "RoleBinding",
+            f"{rb.namespace}/{rb.name}", rb,
+        )
+
+    def list_role_bindings(
+        self, namespace: Optional[str] = None
+    ) -> List[RoleBinding]:
+        with self._lock:
+            return [
+                rb for rb in self._role_bindings.values()
+                if namespace is None or rb.namespace == namespace
+            ]
+
+    def add_cluster_role_binding(self, crb: ClusterRoleBinding) -> None:
+        self._upsert(
+            self._cluster_role_bindings, "ClusterRoleBinding", crb.name, crb
+        )
+
+    def list_cluster_role_bindings(self) -> List[ClusterRoleBinding]:
+        with self._lock:
+            return list(self._cluster_role_bindings.values())
+
     def add_pdb(self, pdb: PodDisruptionBudget) -> None:
         self._upsert(self._pdbs, "PodDisruptionBudget",
                      f"{pdb.namespace}/{pdb.name}", pdb)
@@ -652,6 +710,10 @@ class ClusterStore:
         "CronJob": ("_cron_jobs", True),
         "HorizontalPodAutoscaler": ("_hpas", True),
         "EndpointSlice": ("_endpoint_slices", True),
+        "Role": ("_roles", True),
+        "ClusterRole": ("_cluster_roles", False),
+        "RoleBinding": ("_role_bindings", True),
+        "ClusterRoleBinding": ("_cluster_role_bindings", False),
     }
 
     # ------------------------------------------------------------------

@@ -35,13 +35,36 @@ class Cluster:
         self.scheduler: Optional[Scheduler] = None
         self.nodes = None  # HollowCluster
         self.bootstrap_token: str = ""
+        self.component_tokens: Dict[str, str] = {}
         self._up = False
 
     # -- phases (kubeadm init) -----------------------------------------
     def phase_control_plane(self, leader_elect: bool = False,
-                            controllers: Optional[List[str]] = None) -> None:
+                            controllers: Optional[List[str]] = None,
+                            rbac: bool = True) -> None:
         self.store = ClusterStore()
-        self.apiserver = APIServer(store=self.store).start()
+        authorizer = None
+        if rbac:
+            # default-on RBAC (reference kubeadm enables the RBAC
+            # authorization mode by default): bootstrap roles/bindings
+            # for the control-plane components + per-component tokens
+            from kubernetes_tpu.apiserver.rbac import (
+                provision_bootstrap_policy,
+            )
+
+            authorizer = provision_bootstrap_policy(self.store)
+        self.apiserver = APIServer(
+            store=self.store,
+            **({"authorizer": authorizer} if authorizer else {}),
+        ).start()
+        if rbac:
+            for component in ("kube-scheduler", "kube-controller-manager"):
+                token = secrets.token_hex(12)
+                self.apiserver.tokens[token] = f"system:{component}"
+                self.component_tokens[component] = token
+            admin_token = secrets.token_hex(12)
+            self.apiserver.tokens[admin_token] = "admin"
+            self.component_tokens["admin"] = admin_token
         self.controller_manager = ControllerManager(
             self.store, controllers=controllers, leader_elect=leader_elect
         )
@@ -93,7 +116,12 @@ class Cluster:
         cluster._up = True
         return cluster
 
-    def client(self, token: str = "") -> RestClient:
+    def client(self, token: Optional[str] = None) -> RestClient:
+        """Porcelain client. Default = the admin credential (kubeadm's
+        admin.conf is cluster-admin); pass token="" explicitly for an
+        anonymous client or a component token for that identity."""
+        if token is None:
+            token = self.component_tokens.get("admin", "")
         return RestClient(self.apiserver.url, token=token)
 
     @property

@@ -330,6 +330,15 @@ class Kubectl:
         print(f"{kind.lower()}/{name} labeled", file=self.out)
         return 0
 
+    def auth_can_i(self, verb: str, resource: str, namespace: str,
+                   name: str = "") -> int:
+        """kubectl auth can-i VERB RESOURCE — a SelfSubjectAccessReview
+        round-trip (reference kubectl/pkg/cmd/auth/cani.go); exit code 0
+        for yes, 1 for no (upstream contract)."""
+        allowed = self.client.can_i(verb, resource, namespace, name)
+        print("yes" if allowed else "no", file=self.out)
+        return 0 if allowed else 1
+
     def top_nodes(self) -> int:
         """Requested/allocatable per node (the /metrics/resources view)."""
         nodes, _ = self.client.list("Node")
@@ -406,6 +415,13 @@ def build_parser() -> argparse.ArgumentParser:
     lb.add_argument("spec")
     lb.add_argument("-n", "--namespace", default="default")
 
+    au = sub.add_parser("auth")
+    au.add_argument("subverb", choices=["can-i"])
+    au.add_argument("can_verb")
+    au.add_argument("resource")
+    au.add_argument("obj_name", nargs="?", default="")
+    au.add_argument("-n", "--namespace", default="")
+
     tp = sub.add_parser("top")
     tp.add_argument("what", choices=["nodes"])
 
@@ -471,6 +487,9 @@ def _dispatch(k: "Kubectl", args) -> int:
         return k.taint(args.name, args.spec)
     if args.verb == "label":
         return k.label(args.kind, args.name, args.spec, args.namespace)
+    if args.verb == "auth":
+        return k.auth_can_i(args.can_verb, args.resource, args.namespace,
+                            args.obj_name)
     if args.verb == "top":
         return k.top_nodes()
     if args.verb == "api-resources":

@@ -228,7 +228,9 @@ def test_rest_authz_denies(server):
     server.authorizer = lambda user, verb, kind, ns: verb != "delete"
     client = RestClient(server.url)
     client.create(MakeNode().name("n1").obj())
-    assert not client.delete("Node", "n1")
+    # a 403 raises (it must never read as a routine not-found miss)
+    with pytest.raises(PermissionError):
+        client.delete("Node", "n1")
     assert client.get("Node", "n1") is not None
 
 

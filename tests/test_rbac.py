@@ -1,0 +1,215 @@
+"""RBAC authorization (VERDICT r2 #5; reference
+``plugin/pkg/auth/authorizer/rbac/rbac.go:159`` + ``pkg/registry/rbac/``
++ bootstrappolicy): Role/ClusterRole/(Cluster)RoleBinding objects, the
+store-backed authorizer behind the API server's Authorizer seam,
+bootstrap-provisioned component grants, and ``kubectl auth can-i``."""
+
+import io
+
+from kubernetes_tpu.api.types import (
+    ClusterRole,
+    ClusterRoleBinding,
+    ObjectMeta,
+    PolicyRule,
+    RBACSubject,
+    Role,
+    RoleBinding,
+    RoleRef,
+)
+from kubernetes_tpu.apiserver.rbac import (
+    RBACAuthorizer,
+    provision_bootstrap_policy,
+    rule_allows,
+)
+from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.bootstrap import Cluster
+from kubernetes_tpu.cli.kubectl import run_command
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+class TestRuleMatching:
+    def test_wildcards(self):
+        assert rule_allows(PolicyRule(verbs=["*"], resources=["*"]),
+                           "delete", "nodes")
+        assert rule_allows(PolicyRule(verbs=["get"], resources=["pods"]),
+                           "get", "pods")
+        assert not rule_allows(PolicyRule(verbs=["get"], resources=["pods"]),
+                               "delete", "pods")
+        assert not rule_allows(PolicyRule(verbs=["get"], resources=["pods"]),
+                               "get", "nodes")
+
+    def test_resource_names_scope(self):
+        rule = PolicyRule(verbs=["get"], resources=["configmaps"],
+                          resource_names=["the-one"])
+        assert rule_allows(rule, "get", "configmaps", "the-one")
+        assert not rule_allows(rule, "get", "configmaps", "other")
+        # list carries no name: named rules never grant it
+        assert not rule_allows(rule, "get", "configmaps", "")
+
+
+class TestAuthorizer:
+    def _store_with_policy(self):
+        store = ClusterStore()
+        store.add_cluster_role(ClusterRole(
+            metadata=ObjectMeta(name="pod-reader"),
+            rules=[PolicyRule(verbs=["get", "list", "watch"],
+                              resources=["pods"])],
+        ))
+        store.add_cluster_role_binding(ClusterRoleBinding(
+            metadata=ObjectMeta(name="alice-reads"),
+            subjects=[RBACSubject(kind="User", name="alice")],
+            role_ref=RoleRef(kind="ClusterRole", name="pod-reader"),
+        ))
+        store.add_role(Role(
+            metadata=ObjectMeta(name="deployer", namespace="dev"),
+            rules=[PolicyRule(verbs=["*"], resources=["deployments"])],
+        ))
+        store.add_role_binding(RoleBinding(
+            metadata=ObjectMeta(name="bob-deploys", namespace="dev"),
+            subjects=[RBACSubject(kind="User", name="bob")],
+            role_ref=RoleRef(kind="Role", name="deployer"),
+        ))
+        return store
+
+    def test_cluster_role_binding_grants_cluster_wide(self):
+        authz = RBACAuthorizer(self._store_with_policy())
+        assert authz.authorize("alice", "get", "pods", "any-ns")
+        assert authz.authorize("alice", "list", "pods")
+        assert not authz.authorize("alice", "delete", "pods", "any-ns")
+        assert not authz.authorize("mallory", "get", "pods", "any-ns")
+
+    def test_role_binding_is_namespace_scoped(self):
+        authz = RBACAuthorizer(self._store_with_policy())
+        assert authz.authorize("bob", "create", "deployments", "dev")
+        assert not authz.authorize("bob", "create", "deployments", "prod")
+        assert not authz.authorize("bob", "create", "deployments", "")
+
+    def test_rolebinding_to_clusterrole_scopes_down(self):
+        store = self._store_with_policy()
+        store.add_role_binding(RoleBinding(
+            metadata=ObjectMeta(name="carol-reads-dev", namespace="dev"),
+            subjects=[RBACSubject(kind="User", name="carol")],
+            role_ref=RoleRef(kind="ClusterRole", name="pod-reader"),
+        ))
+        authz = RBACAuthorizer(store)
+        assert authz.authorize("carol", "get", "pods", "dev")
+        assert not authz.authorize("carol", "get", "pods", "prod")
+
+    def test_group_subjects_and_masters(self):
+        store = ClusterStore()
+        store.add_cluster_role(ClusterRole(
+            metadata=ObjectMeta(name="reader"),
+            rules=[PolicyRule(verbs=["get"], resources=["pods"])],
+        ))
+        store.add_cluster_role_binding(ClusterRoleBinding(
+            metadata=ObjectMeta(name="authenticated-read"),
+            subjects=[RBACSubject(kind="Group",
+                                  name="system:authenticated")],
+            role_ref=RoleRef(kind="ClusterRole", name="reader"),
+        ))
+        authz = RBACAuthorizer(store)
+        assert authz.authorize("anyone", "get", "pods", "ns")
+        assert not authz.authorize("system:anonymous", "get", "pods", "ns")
+        authz.add_user_to_group("root", "system:masters")
+        assert authz.authorize("root", "delete", "nodes")
+
+    def test_kind_names_normalize_to_plurals(self):
+        # the REST handler passes kinds ("Pod", "Binding"); rules use
+        # plurals ("pods", "bindings")
+        store = self._store_with_policy()
+        authz = RBACAuthorizer(store)
+        assert authz.authorize("alice", "get", "Pod", "ns")
+        assert not authz.authorize("alice", "get", "Node", "ns")
+
+
+class TestBootstrapPolicyIntegration:
+    """VERDICT done-condition: the scheduler token can bind pods but
+    cannot delete nodes — through the real HTTP stack."""
+
+    def _serve(self):
+        store = ClusterStore()
+        authz = provision_bootstrap_policy(store)
+        server = APIServer(
+            store=store,
+            authorizer=authz,
+            tokens={"sched-token": "system:kube-scheduler",
+                    "admin-token": "admin"},
+        ).start()
+        return store, server
+
+    def test_scheduler_can_bind_but_not_delete_nodes(self):
+        store, server = self._serve()
+        try:
+            store.add_node(MakeNode().name("n1")
+                           .capacity({"cpu": "8", "memory": "16Gi"}).obj())
+            store.create_pod(MakePod().name("p1").uid("u1")
+                             .req({"cpu": "1"}).obj())
+            sched = RestClient(server.url, token="sched-token")
+            # bind succeeds
+            sched.bind("default", "p1", "u1", "n1")
+            assert store.get_pod("default", "p1").spec.node_name == "n1"
+            # delete nodes: forbidden (403 -> PermissionError)
+            try:
+                sched.delete("Node", "n1", namespace=None)
+                raise AssertionError("scheduler deleted a node")
+            except PermissionError:
+                pass
+            # pods it may read and delete (preemption)
+            assert sched.get("Pod", "p1") is not None
+        finally:
+            server.shutdown_server()
+
+    def test_anonymous_is_denied_admin_is_not(self):
+        store, server = self._serve()
+        try:
+            store.add_node(MakeNode().name("n1").obj())
+            anon = RestClient(server.url)
+            try:
+                anon.list("Pod")
+                raise AssertionError("anonymous listed pods")
+            except PermissionError:
+                pass
+            admin = RestClient(server.url, token="admin-token")
+            admin.list("Pod")  # no raise: system:masters short-circuit
+        finally:
+            server.shutdown_server()
+
+    def test_rbac_objects_have_rest_routes(self):
+        store, server = self._serve()
+        try:
+            admin = RestClient(server.url, token="admin-token")
+            roles, _ = admin.list("ClusterRole")
+            assert any(r.metadata.name == "system:kube-scheduler"
+                       for r in roles)
+            admin.create(Role(
+                metadata=ObjectMeta(name="r1", namespace="default"),
+                rules=[PolicyRule(verbs=["get"], resources=["pods"])],
+            ))
+            got = admin.get("Role", "r1")
+            assert got.rules[0].verbs == ["get"]
+        finally:
+            server.shutdown_server()
+
+
+class TestKubectlCanI:
+    def test_can_i_through_cluster(self):
+        cluster = Cluster.up(nodes=1)
+        try:
+            sched_client = cluster.client(
+                cluster.component_tokens["kube-scheduler"])
+            out = io.StringIO()
+            rc = run_command(["auth", "can-i", "create", "bindings"],
+                             client=sched_client, out=out)
+            assert rc == 0 and out.getvalue().strip() == "yes"
+            out = io.StringIO()
+            rc = run_command(["auth", "can-i", "delete", "nodes"],
+                             client=sched_client, out=out)
+            assert rc == 1 and out.getvalue().strip() == "no"
+            # the default porcelain client is cluster-admin
+            out = io.StringIO()
+            rc = run_command(["auth", "can-i", "delete", "nodes"],
+                             client=cluster.client(), out=out)
+            assert rc == 0 and out.getvalue().strip() == "yes"
+        finally:
+            cluster.down()
