@@ -458,6 +458,10 @@ class PodStatus:
     pod_ip: str = ""
     host_ip: str = ""
     start_time: float = 0.0
+    # terminal-phase record (core/v1 PodStatus.Reason/Message; e.g.
+    # reason=Evicted from the kubelet's eviction manager)
+    reason: str = ""
+    message: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
@@ -468,6 +472,8 @@ class PodStatus:
         st.pod_ip = ""
         st.host_ip = ""
         st.start_time = 0.0
+        st.reason = d.get("reason", "") if d else ""
+        st.message = d.get("message", "") if d else ""
         return st
 
 

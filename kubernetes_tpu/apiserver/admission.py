@@ -224,12 +224,14 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                 p for p in self.store.list_pods(namespace=req.namespace)
                 if p.status.phase not in ("Succeeded", "Failed")
             ]
-            live_keys = {(p.namespace, p.name) for p in live}
-            # settle in-flight charges: visible in the store now, or
-            # expired (the create failed downstream)
+            # settle in-flight charges: visible in the store now
+            # (checked against the entry's OWN namespace — an entry
+            # from another namespace must not linger to TTL), or
+            # expired (the create failed without a rollback call)
             self._pending = {
                 k: v for k, v in self._pending.items()
-                if k not in live_keys and now - v[0] < self.PENDING_TTL
+                if now - v[0] < self.PENDING_TTL
+                and self.store.get_pod(k[0], k[1]) is None
             }
             pend = [v for k, v in self._pending.items()
                     if k[0] == req.namespace]
@@ -269,6 +271,16 @@ class ResourceQuotaAdmission(AdmissionPlugin):
                 now, cpu_milli, mem,
             )
 
+    def rollback(self, req: AdmissionRequest) -> None:
+        """Drop the in-flight charge immediately when the create fails
+        downstream (later plugin rejection, store conflict) — without
+        this the phantom charge blocks namespace headroom for up to
+        PENDING_TTL seconds, spuriously rejecting creates that fit."""
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        with self._lock:
+            self._pending.pop((req.namespace, req.obj.metadata.name), None)
+
 
 @dataclass
 class AdmissionChain:
@@ -289,8 +301,34 @@ class AdmissionChain:
         )
 
     def run(self, req: AdmissionRequest) -> Any:
-        for p in self.plugins:
-            p.admit(req)
-        for p in self.plugins:
-            p.validate(req)
+        ran: List[AdmissionPlugin] = []
+        try:
+            for p in self.plugins:
+                p.admit(req)
+                ran.append(p)
+            for p in self.plugins:
+                p.validate(req)
+                if p not in ran:
+                    ran.append(p)
+        except Exception:
+            # a later plugin rejected after earlier ones took side
+            # effects (e.g. the quota plugin's in-flight charge):
+            # unwind them NOW instead of letting a 30s TTL hold the
+            # headroom hostage (upstream's quota evaluator is
+            # transactional for the same reason)
+            self.rollback(req, ran)
+            raise
         return req.obj
+
+    def rollback(self, req: AdmissionRequest,
+                 plugins: Optional[List[AdmissionPlugin]] = None) -> None:
+        """Undo admission side effects after a downstream failure (a
+        later plugin's rejection, a store conflict, an allocator
+        error). Safe to call for requests with no side effects."""
+        for p in (plugins if plugins is not None else self.plugins):
+            hook = getattr(p, "rollback", None)
+            if hook is not None:
+                try:
+                    hook(req)
+                except Exception:  # noqa: BLE001 — unwind must not mask
+                    pass

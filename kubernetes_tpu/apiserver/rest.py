@@ -377,12 +377,14 @@ class _Handler(BaseHTTPRequestHandler):
             # fault — 400, never the store-conflict 409
             self._send_error(400, "BadRequest", str(e))
             return
+        adm_req = None
         try:
             if ns is not None and store.kind_is_namespaced(kind):
                 obj.metadata.namespace = ns
-            obj = self.server.admission.run(
-                AdmissionRequest(CREATE, kind, obj.metadata.namespace, obj, user=user)
+            adm_req = AdmissionRequest(
+                CREATE, kind, obj.metadata.namespace, obj, user=user
             )
+            obj = self.server.admission.run(adm_req)
             allocated_ip = None
             if kind == "Service":
                 # the registry assigns the VIP (reference
@@ -415,8 +417,13 @@ class _Handler(BaseHTTPRequestHandler):
                 raise
             self._send_json(201, to_wire(created))
         except AdmissionError as e:
+            # admission.run already unwound its own plugins' charges
             self._send_error(422, "Invalid", str(e))
         except ValueError as e:
+            # create failed AFTER admission admitted (store conflict):
+            # release the quota plugin's in-flight charge immediately
+            if adm_req is not None:
+                self.server.admission.rollback(adm_req)
             self._send_error(409, "AlreadyExists", str(e))
 
     def do_PUT(self) -> None:

@@ -20,44 +20,72 @@ from kubernetes_tpu.controllers.base import Controller, owner_ref, split_key
 
 
 def cron_field_matches(field: str, value: int) -> bool:
-    """One 5-field cron term: ``*``, ``*/n``, ``a``, ``a,b,c``, ``a-b``."""
+    """One 5-field cron term: ``*``, ``*/n``, ``a``, ``a,b,c``, ``a-b``,
+    ``a-b/n`` (stepped range, standard cron)."""
     for part in field.split(","):
         if part == "*":
             return True
-        if part.startswith("*/"):
+        step = 1
+        if "/" in part:
+            part, _, step_s = part.partition("/")
             try:
-                step = int(part[2:])
+                step = int(step_s)
             except ValueError:
                 continue
-            if step > 0 and value % step == 0:
+            if step <= 0:
+                continue
+        if part == "*":
+            if value % step == 0:
                 return True
         elif "-" in part:
             try:
                 lo, hi = (int(x) for x in part.split("-", 1))
             except ValueError:
                 continue
-            if lo <= value <= hi:
+            if lo <= value <= hi and (value - lo) % step == 0:
                 return True
         else:
             try:
-                if int(part) == value:
-                    return True
+                lo = int(part)
             except ValueError:
                 continue
+            # "a/n" behaves as "a-max/n" in standard cron; without a
+            # range a bare value with a step only matches the value
+            # itself when step is 1 (robfig/cron, the reference's
+            # library, rejects bare-value steps — match conservatively)
+            if lo == value:
+                return True
     return False
 
 
 def cron_matches(schedule: str, t: float) -> bool:
     """Does the 5-field ``schedule`` fire at time ``t`` (minute
-    resolution)?"""
+    resolution)? Standard cron (and the reference's robfig/cron): when
+    BOTH day-of-month and day-of-week are restricted (neither is
+    ``*``), they are ORed — '0 0 13 * 5' fires on the 13th OR any
+    Friday, not only Friday-the-13th."""
     fields = schedule.split()
     if len(fields) != 5:
         return False
     tm = time.localtime(t)
     # cron DOW is Sunday=0; Python tm_wday is Monday=0
-    values = (tm.tm_min, tm.tm_hour, tm.tm_mday, tm.tm_mon,
-              (tm.tm_wday + 1) % 7)
-    return all(cron_field_matches(f, v) for f, v in zip(fields, values))
+    dow = (tm.tm_wday + 1) % 7
+    if not cron_field_matches(fields[0], tm.tm_min):
+        return False
+    if not cron_field_matches(fields[1], tm.tm_hour):
+        return False
+    if not cron_field_matches(fields[3], tm.tm_mon):
+        return False
+    dom_field, dow_field = fields[2], fields[4]
+    # vixie-cron rule: a field counts as restricted iff it does not
+    # start with '*' ("*/2" is still unrestricted for the OR rule)
+    dom_restricted = not dom_field.startswith("*")
+    dow_restricted = not dow_field.startswith("*")
+    dom_ok = cron_field_matches(dom_field, tm.tm_mday)
+    dow_ok = cron_field_matches(dow_field, dow)
+    if dom_restricted and dow_restricted:
+        return dom_ok or dow_ok
+    return dom_ok and dow_ok
 
 
 def next_fire_after(schedule: str, after: float,

@@ -71,6 +71,16 @@ class EndpointSliceController(Controller):
             for es in existing:
                 self.store.delete_object("EndpointSlice", ns, es.name)
             return
+        if not svc.selector:
+            # selectorless Services manage their endpoints manually; the
+            # reference controller skips them entirely
+            # (endpointslice_controller.go syncService: nil-selector
+            # return) — materializing an empty '<svc>-0' slice would
+            # fight the manual owner. Drop any slices this controller
+            # previously created for it.
+            for es in existing:
+                self.store.delete_object("EndpointSlice", ns, es.name)
+            return
         addresses = [
             EndpointAddress(
                 # same placeholder scheme as the endpoints controller

@@ -86,14 +86,25 @@ class EvictionManager:
         victims = self._rank_pods()
         for pod in victims:
             key = f"{pod.namespace}/{pod.name}"
+            message = (
+                "The node was low on resource: memory. "
+                f"Threshold quantity: {threshold}, available: {available}"
+            )
             if self.recorder is not None:
-                self.recorder.eventf(
-                    pod, "Warning", "Evicted",
-                    "The node was low on resource: memory. "
-                    "Threshold quantity: %d, available: %d",
-                    threshold, available,
-                )
-            self.store.delete_pod(pod.namespace, pod.name)
+                self.recorder.eventf(pod, "Warning", "Evicted", "%s",
+                                     message)
+
+            # the reference's evictPod marks the pod Failed with
+            # reason=Evicted rather than deleting it — the object stays
+            # observable for workload controllers/operators; podgc or
+            # the owner cleans it up later (eviction_manager.go
+            # evictPod -> killPod, status_manager terminal phase)
+            def mark(p):
+                p.status.phase = "Failed"
+                p.status.reason = "Evicted"
+                p.status.message = message
+
+            self.store.mutate_object("Pod", pod.namespace, pod.name, mark)
             with self._lock:
                 self.evicted.append(key)
             return key  # one victim per pass, then re-observe

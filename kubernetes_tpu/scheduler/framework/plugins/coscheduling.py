@@ -90,8 +90,14 @@ class CoschedulingSort(QueueSortPlugin):
             return qpi.timestamp, ""
         with self._lock:
             ts = self._anchors.get(group)
-            if ts is None or qpi.timestamp < ts:
-                ts = qpi.timestamp if ts is None else min(ts, qpi.timestamp)
+            if ts is None:
+                # FROZEN at first sighting: a member sighted later with
+                # an earlier timestamp (e.g. a requeued pod keeping its
+                # original stamp) must NOT re-key the group while
+                # siblings sit in the active heap — lowering the anchor
+                # of in-heap entries breaks the heap invariant and pops
+                # come out mis-ordered until the entries churn
+                ts = qpi.timestamp
             # refresh recency (plain dicts preserve insertion order)
             self._anchors.pop(group, None)
             self._anchors[group] = ts

@@ -594,9 +594,25 @@ def test_resourcequota_controller_and_admission():
         except AdmissionError:
             pass
         small = MakePod().name("small").uid("su").req({"cpu": "400m"}).obj()
-        plugin.validate(AdmissionRequest(
+        req_small = AdmissionRequest(
             operation="CREATE", kind="Pod", namespace="default", obj=small,
-        ))
+        )
+        plugin.validate(req_small)
+        # "small" admitted -> in-flight charge holds 400m; another 400m
+        # pod would exceed 1 CPU with the phantom charge...
+        small2 = MakePod().name("small2").uid("s2").req({"cpu": "400m"}).obj()
+        req_small2 = AdmissionRequest(
+            operation="CREATE", kind="Pod", namespace="default", obj=small2,
+        )
+        try:
+            plugin.validate(req_small2)
+            raise AssertionError("in-flight charge should block small2")
+        except AdmissionError:
+            pass
+        # ...but a downstream create failure rolls the charge back
+        # IMMEDIATELY (no 30s TTL wait), freeing the headroom
+        plugin.rollback(req_small)
+        plugin.validate(req_small2)
     finally:
         cm.stop()
 
@@ -656,13 +672,23 @@ def test_cronjob_controller_creates_job_on_schedule():
     )
 
     # cron matcher semantics
-    import calendar
-    t = time.mktime((2026, 7, 30, 12, 30, 0, 3, 0, -1))  # 12:30
+    t = time.mktime((2026, 7, 30, 12, 30, 0, 3, 0, -1))  # Thu July 30 12:30
     assert cron_matches("* * * * *", t)
     assert cron_matches("30 12 * * *", t)
     assert cron_matches("*/15 * * * *", t)
     assert not cron_matches("31 12 * * *", t)
     assert next_fire_after("* * * * *", t) == (int(t) // 60 + 1) * 60
+    # stepped ranges (a-b/n — standard cron)
+    assert cron_matches("20-40/10 * * * *", t)      # 20,30,40
+    assert not cron_matches("20-40/15 * * * *", t)  # 20,35
+    # DOM/DOW OR rule (vixie cron): when BOTH are restricted, either
+    # matches — 2026-07-30 is a Thursday (DOW 4), not the 13th
+    assert cron_matches("30 12 13 * 4", t)      # not 13th, but Thursday
+    assert cron_matches("30 12 30 * 5", t)      # 30th, though not Friday
+    assert not cron_matches("30 12 13 * 5", t)  # neither 13th nor Friday
+    # only one restricted: AND as before
+    assert not cron_matches("30 12 13 * *", t)
+    assert cron_matches("30 12 * * 4", t)
 
     store = ClusterStore()
     cm = ControllerManager(store, controllers=["cronjob"])

@@ -315,8 +315,13 @@ class TestEvictionManager:
         )
         evicted = mgr.synchronize()
         assert evicted == "default/bulk"  # lowest priority first
-        assert store.get_pod("default", "bulk") is None
-        assert store.get_pod("default", "vip") is not None
+        # the reference marks the victim Failed/Evicted (terminal-phase
+        # record stays observable); it does NOT delete the object
+        victim = store.get_pod("default", "bulk")
+        assert victim is not None
+        assert victim.status.phase == "Failed"
+        assert victim.status.reason == "Evicted"
+        assert store.get_pod("default", "vip").status.phase != "Failed"
         node = store.get_node("n1")
         assert any(c.type == MEMORY_PRESSURE and c.status == "True"
                    for c in node.status.conditions)
@@ -351,9 +356,11 @@ class TestEvictionManager:
                              .req({"memory": "500Mi"}).obj())
             deadline = _time.time() + 5
             while _time.time() < deadline and \
-                    store.get_pod("default", "fat") is not None:
+                    store.get_pod("default", "fat").status.phase != "Failed":
                 _time.sleep(0.05)
-            assert store.get_pod("default", "fat") is None
+            victim = store.get_pod("default", "fat")
+            assert victim.status.phase == "Failed"
+            assert victim.status.reason == "Evicted"
             assert kl.eviction_manager.evicted == ["default/fat"]
         finally:
             kl.stop()
