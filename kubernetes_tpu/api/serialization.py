@@ -59,6 +59,7 @@ SCHEME: Dict[str, type] = {
         "ClusterRole",
         "RoleBinding",
         "ClusterRoleBinding",
+        "CustomResourceDefinition",
     )
 }
 
@@ -66,7 +67,8 @@ SCHEME: Dict[str, type] = {
 # schema metadata: which kinds are namespace-scoped (clients need this to
 # build paths; it is API schema, not storage layout)
 CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
-                  "Namespace", "ClusterRole", "ClusterRoleBinding"}
+                  "Namespace", "ClusterRole", "ClusterRoleBinding",
+                  "CustomResourceDefinition"}
 
 
 def is_namespaced(kind: str) -> bool:
@@ -121,9 +123,17 @@ def _encode(value: Any) -> Any:
 
 
 def to_wire(obj: Any) -> Dict[str, Any]:
-    """Encode a typed object for the wire, with kind discriminator."""
+    """Encode a typed object for the wire, with kind discriminator.
+    CustomObject instances (runtime-registered kinds) carry their OWN
+    kind string — the dynamic-client unstructured path."""
+    from kubernetes_tpu.api.types import CustomObject
+
     d = _encode(obj)
-    d["kind"] = kind_of(obj)
+    if isinstance(obj, CustomObject):
+        d.pop("kind", None)
+        d["kind"] = obj.kind
+    else:
+        d["kind"] = kind_of(obj)
     d["apiVersion"] = "v1"
     return d
 
@@ -180,12 +190,24 @@ def _decode(hint: Any, value: Any) -> Any:
 
 def from_wire(d: Dict[str, Any], kind: Optional[str] = None) -> Any:
     """Decode a wire dict into its typed object (kind from the payload's
-    discriminator unless given explicitly)."""
+    discriminator unless given explicitly). Kinds outside the typed
+    scheme decode to CustomObject — the REST layer only routes plurals
+    it knows (typed or CRD-registered), so an unknown kind here IS a
+    runtime-registered one (apiextensions custom resource)."""
     k = kind or d.get("kind")
-    cls = SCHEME.get(k or "")
-    if cls is None:
-        raise TypeError(f"cannot decode unknown kind {k!r}")
+    if not k:
+        raise TypeError("cannot decode object with no kind")
+    cls = SCHEME.get(k)
     body = {key: v for key, v in d.items() if key not in ("kind", "apiVersion")}
+    if cls is None:
+        from kubernetes_tpu.api.types import CustomObject, ObjectMeta
+
+        return CustomObject(
+            kind=k,
+            metadata=_decode(ObjectMeta, body.get("metadata") or {}),
+            spec=body.get("spec") or {},
+            status=body.get("status") or {},
+        )
     return _decode(cls, body)
 
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional
 
 from kubernetes_tpu.api.labels import LabelSelector, Requirement
 from kubernetes_tpu.api.resource import Quantity, parse_quantity
@@ -1098,3 +1098,55 @@ class ClusterRoleBinding:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# CustomResourceDefinition analog (reference
+# staging/src/k8s.io/apiextensions-apiserver/pkg/apis/apiextensions/
+# types.go): runtime-registered custom kinds — creating a CRD object
+# registers a new plural route + storage table + watch support with NO
+# edit to this module's typed kinds.
+
+
+@dataclass
+class CRDNames:
+    """apiextensions CustomResourceDefinitionNames (plural + kind are
+    the two the routing/storage layers need)."""
+
+    plural: str = ""
+    kind: str = ""
+
+
+@dataclass
+class CustomResourceDefinition:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    group: str = ""
+    names: CRDNames = field(default_factory=CRDNames)
+    scope: str = "Namespaced"  # Namespaced | Cluster
+    # opaque openAPIV3Schema-style validation payload (stored, not
+    # enforced — the reference's structural-schema validation is a
+    # non-goal for the scheduling framework)
+    schema: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class CustomObject:
+    """An instance of a runtime-registered kind (the dynamic client's
+    unstructured object): typed metadata + opaque spec/status payloads."""
+
+    kind: str = ""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, Any] = field(default_factory=dict)
+    status: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

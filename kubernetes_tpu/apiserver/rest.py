@@ -72,6 +72,7 @@ PLURALS: Dict[str, str] = {
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
     "clusterrolebindings": "ClusterRoleBinding",
+    "customresourcedefinitions": "CustomResourceDefinition",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
@@ -232,6 +233,10 @@ class _Handler(BaseHTTPRequestHandler):
         if not rest:
             return None, ns, None, None, q
         kind = PLURALS.get(rest[0])
+        if kind is None:
+            # CRD-registered plurals resolve through the store's live
+            # registry (apiextensions: a new CRD IS a new route)
+            kind = self.server.store.custom_plural_to_kind(rest[0])
         name = rest[1] if len(rest) >= 2 else None
         sub = rest[2] if len(rest) >= 3 else None
         return kind, ns, name, sub, q
@@ -713,7 +718,9 @@ class RestClient:
 
     def _path(self, kind: str, namespace: Optional[str], name: Optional[str] = None,
               sub: Optional[str] = None) -> str:
-        plural = KIND_TO_PLURAL[kind]
+        # custom (CRD-registered) kinds pluralize naively — the same
+        # default the server-side registration applies
+        plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
         p = f"/api/v1/namespaces/{namespace}/{plural}" if namespace else f"/api/v1/{plural}"
         if name:
             p += f"/{name}"
@@ -735,8 +742,14 @@ class RestClient:
         raise RuntimeError(f"HTTP {code}: {msg}")
 
     # -- typed verbs ---------------------------------------------------
+    @staticmethod
+    def _kind_name(obj) -> str:
+        # CustomObject instances carry their runtime-registered kind
+        return getattr(obj, "kind", None) if type(obj).__name__ == \
+            "CustomObject" else type(obj).__name__
+
     def create(self, obj) -> Any:
-        kind = type(obj).__name__
+        kind = self._kind_name(obj)
         ns = obj.metadata.namespace if is_namespaced(kind) else None
         code, payload = self._request(
             "POST", self._path(kind, ns), to_wire(obj)
@@ -760,7 +773,7 @@ class RestClient:
         return [from_wire(item, kind) for item in payload.get("items", [])], rv
 
     def update(self, obj) -> Any:
-        kind = type(obj).__name__
+        kind = self._kind_name(obj)
         ns = obj.metadata.namespace if is_namespaced(kind) else None
         code, payload = self._request(
             "PUT", self._path(kind, ns, obj.metadata.name), to_wire(obj)

@@ -109,6 +109,11 @@ class ClusterStore:
         self._cluster_roles: Dict[str, ClusterRole] = {}
         self._role_bindings: Dict[str, RoleBinding] = {}
         self._cluster_role_bindings: Dict[str, ClusterRoleBinding] = {}
+        # CRD analog (apiextensions-apiserver): the CRD objects plus
+        # per-instance storage for runtime-registered kinds
+        self._crds: Dict[str, Any] = {}
+        self._custom_kinds: Dict[str, Tuple[Dict[str, Any], bool]] = {}
+        self._custom_plurals: Dict[str, str] = {}
         self._endpoints: Dict[str, Endpoints] = {}
         self._deployments: Dict[str, Deployment] = {}
         self._daemon_sets: Dict[str, DaemonSet] = {}
@@ -339,6 +344,10 @@ class ClusterStore:
             # on the final object so watch logs stay monotonic
             old.metadata.resource_version = self._next_rv()
             self._dispatch(Event(DELETED, kind, old))
+            if kind == "CustomResourceDefinition":
+                # definition gone -> kind unregistered, instances
+                # cascade-deleted (apiextensions finalizer semantics)
+                self._unregister_crd_locked(old)
 
     def add_node(self, node: Node) -> None:
         self._upsert(self._nodes, "Node", node.name, node)
@@ -714,6 +723,7 @@ class ClusterStore:
         "ClusterRole": ("_cluster_roles", False),
         "RoleBinding": ("_role_bindings", True),
         "ClusterRoleBinding": ("_cluster_role_bindings", False),
+        "CustomResourceDefinition": ("_crds", False),
     }
 
     # ------------------------------------------------------------------
@@ -749,16 +759,70 @@ class ClusterStore:
                 removed += 1
         return removed
 
+    def _kind_entry(self, kind: str) -> Tuple[Dict[str, Any], bool]:
+        """(table, namespaced) for typed OR runtime-registered kinds."""
+        entry = self._KIND_TABLES.get(kind)
+        if entry is not None:
+            return getattr(self, entry[0]), entry[1]
+        got = self._custom_kinds.get(kind)
+        if got is None:
+            raise KeyError(f"unknown kind {kind!r}")
+        return got
+
     def _table_key(self, kind: str, namespace: str, name: str):
-        attr, namespaced = self._KIND_TABLES[kind]
+        table, namespaced = self._kind_entry(kind)
         key = f"{namespace}/{name}" if namespaced else name
-        return getattr(self, attr), key
+        return table, key
 
     def kind_is_namespaced(self, kind: str) -> bool:
-        return self._KIND_TABLES[kind][1]
+        return self._kind_entry(kind)[1]
 
     def known_kinds(self) -> List[str]:
-        return list(self._KIND_TABLES)
+        return list(self._KIND_TABLES) + list(self._custom_kinds)
+
+    # -- CRD analog (runtime kind registration) ------------------------
+    def custom_kind_names(self) -> List[str]:
+        with self._lock:
+            return list(self._custom_kinds)
+
+    def custom_plural_to_kind(self, plural: str) -> Optional[str]:
+        with self._lock:
+            return self._custom_plurals.get(plural)
+
+    def _register_crd_locked(self, crd) -> None:
+        kind = crd.names.kind
+        plural = crd.names.plural or (kind.lower() + "s")
+        if not kind:
+            raise ValueError("CRD names.kind is required")
+        if kind in self._KIND_TABLES:
+            raise ValueError(f"kind {kind!r} shadows a built-in kind")
+        namespaced = crd.scope != "Cluster"
+        existing = self._custom_kinds.get(kind)
+        table = existing[0] if existing is not None else {}
+        self._custom_kinds[kind] = (table, namespaced)
+        # a re-registration (CRD update) may have renamed the plural
+        self._custom_plurals = {
+            p: k for p, k in self._custom_plurals.items() if k != kind
+        }
+        self._custom_plurals[plural] = kind
+
+    def _unregister_crd_locked(self, crd) -> None:
+        kind = crd.names.kind
+        got = self._custom_kinds.pop(kind, None)
+        self._custom_plurals = {
+            p: k for p, k in self._custom_plurals.items() if k != kind
+        }
+        if got is None:
+            return
+        # cascade: instances die with their definition (the reference
+        # apiextensions finalizer deletes all CRs before the CRD goes)
+        table, _ = got
+        for obj in list(table.values()):
+            obj.metadata.resource_version = self._next_rv()
+        doomed = list(table.values())
+        table.clear()
+        for obj in doomed:
+            self._dispatch(Event(DELETED, kind, obj))
 
     def current_rv(self) -> int:
         with self._lock:
@@ -771,6 +835,11 @@ class ClusterStore:
             )
             if key in table:
                 raise ValueError(f"{kind} {key!r} already exists")
+            if kind == "CustomResourceDefinition":
+                # validates AND registers the new kind's table + plural
+                # route (apiextensions: creating the CRD IS the
+                # registration; rejected CRDs never get stored)
+                self._register_crd_locked(obj)
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = time.time()
             obj.metadata.resource_version = self._next_rv()
@@ -794,6 +863,10 @@ class ClusterStore:
                     f"{kind} {key!r}: resourceVersion conflict "
                     f"(have {old.metadata.resource_version}, want {expect_rv})"
                 )
+            if kind == "CustomResourceDefinition":
+                # re-register: scope/plural changes take effect (the
+                # instance table is carried over)
+                self._register_crd_locked(obj)
             obj.metadata.resource_version = self._next_rv()
             table[key] = obj
             self._dispatch(Event(MODIFIED, kind, obj, old))
@@ -903,8 +976,8 @@ class ClusterStore:
         List+Watch bootstrap contract (a watch from this RV misses
         nothing that isn't already in the list)."""
         with self._lock:
-            attr, namespaced = self._KIND_TABLES[kind]
-            objs = list(getattr(self, attr).values())
+            table, namespaced = self._kind_entry(kind)
+            objs = list(table.values())
             if namespace is not None and namespaced:
                 objs = [o for o in objs if o.metadata.namespace == namespace]
             return objs, self._rv

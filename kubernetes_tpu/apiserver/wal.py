@@ -94,9 +94,12 @@ class WalHandle:
         objects = []
         with self.store._lock:   # reentrant: callers already hold it
             rv = self.store._rv
+            # known_kinds lists CustomResourceDefinition (a built-in)
+            # before the custom kinds it defines, so restore re-registers
+            # each kind before replaying its instances
             for kind in self.store.known_kinds():
-                attr, _ = self.store._KIND_TABLES[kind]
-                for obj in getattr(self.store, attr).values():
+                table, _ = self.store._kind_entry(kind)
+                for obj in table.values():
                     objects.append([kind, to_wire(obj)])
         tmp = os.path.join(self.dir, SNAP_TMP)
         with open(tmp, "w", encoding="utf-8") as f:
@@ -141,6 +144,8 @@ def restore_store(directory: str,
         with store._lock:
             for kind, wire in snap.get("objects", ()):
                 obj = from_wire(wire, kind)
+                if kind == "CustomResourceDefinition":
+                    store._register_crd_locked(obj)
                 table, key = store._table_key(
                     kind, obj.metadata.namespace, obj.metadata.name
                 )
@@ -159,12 +164,20 @@ def restore_store(directory: str,
                 max_rv = max(max_rv, int(line.get("rv") or 0))
                 kind = line["k"]
                 if line["t"] == "DEL":
-                    table, key = store._table_key(
-                        kind, line.get("ns", ""), line["n"]
-                    )
-                    table.pop(key, None)
+                    try:
+                        table, key = store._table_key(
+                            kind, line.get("ns", ""), line["n"]
+                        )
+                    except KeyError:
+                        continue  # delete of an already-unregistered kind
+                    old = table.pop(key, None)
+                    if kind == "CustomResourceDefinition" and \
+                            old is not None:
+                        store._unregister_crd_locked(old)
                 else:
                     obj = from_wire(line["o"], kind)
+                    if kind == "CustomResourceDefinition":
+                        store._register_crd_locked(obj)
                     table, key = store._table_key(
                         kind, obj.metadata.namespace, obj.metadata.name
                     )

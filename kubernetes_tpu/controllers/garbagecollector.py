@@ -52,16 +52,25 @@ class GarbageCollector(Controller):
             self.enqueue_key("sweep")
 
     def sync(self, key: str) -> None:
+        # runtime-registered (CRD) kinds join the graph on both sides:
+        # their instances can own and be owned (the reference GC is
+        # fully generic over discovered resources,
+        # garbagecollector.go Sync/resyncMonitors)
+        custom_kinds = list(getattr(self.store, "custom_kind_names",
+                                    list)())
         live_uids = set()
         for list_name in _OWNER_KINDS.values():
             for obj in getattr(self.store, list_name)():
+                live_uids.add(obj.metadata.uid)
+        for kind in custom_kinds:
+            for obj in self.store.list_objects(kind):
                 live_uids.add(obj.metadata.uid)
         # dependents: pods owned by a controller that no longer exists.
         # Only kinds we track count as "absent"; an owner of an untracked
         # kind can't be proven dead, so its dependents are left alone
         # (upstream GC deletes only when the referenced object is
         # actually verified absent).
-        tracked = set(_OWNER_KINDS)
+        tracked = set(_OWNER_KINDS) | set(custom_kinds)
         for pod in self.pod_lister.list():
             for ref in pod.metadata.owner_references:
                 if (
@@ -81,3 +90,17 @@ class GarbageCollector(Controller):
                 ):
                     self.store.delete_replica_set(rs.namespace, rs.name)
                     break
+        # custom instances owned by a vanished owner (typed or custom)
+        for kind in custom_kinds:
+            for obj in self.store.list_objects(kind):
+                for ref in obj.metadata.owner_references:
+                    if (
+                        ref.get("controller")
+                        and ref.get("kind") in tracked
+                        and ref.get("uid") not in live_uids
+                    ):
+                        self.store.delete_object(
+                            kind, obj.metadata.namespace,
+                            obj.metadata.name,
+                        )
+                        break

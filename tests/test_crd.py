@@ -1,0 +1,216 @@
+"""CRD analog (VERDICT r2 #6; reference
+``staging/src/k8s.io/apiextensions-apiserver/``): creating a
+CustomResourceDefinition registers a new kind at runtime — plural REST
+route, storage table, watch support — with no edit to ``api/types.py``.
+Instances participate in owner-reference GC; the WAL re-registers kinds
+on restore."""
+
+import threading
+import time
+
+from kubernetes_tpu.api.types import (
+    CRDNames,
+    CustomObject,
+    CustomResourceDefinition,
+    ObjectMeta,
+)
+from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+from kubernetes_tpu.apiserver.store import ClusterStore
+
+
+def _crd(kind="Widget", plural="widgets", scope="Namespaced"):
+    return CustomResourceDefinition(
+        metadata=ObjectMeta(name=f"{plural}.example.com"),
+        group="example.com",
+        names=CRDNames(plural=plural, kind=kind),
+        scope=scope,
+    )
+
+
+def _widget(name, spec=None, ns="default"):
+    return CustomObject(
+        kind="Widget",
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=spec or {"size": 3},
+    )
+
+
+class TestStoreRegistration:
+    def test_create_crd_registers_kind(self):
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd())
+        assert "Widget" in store.known_kinds()
+        assert store.custom_plural_to_kind("widgets") == "Widget"
+        assert store.kind_is_namespaced("Widget")
+        store.create_object("Widget", _widget("w1"))
+        assert store.get_object("Widget", "default", "w1").spec == {"size": 3}
+        assert [o.name for o in store.list_objects("Widget")] == ["w1"]
+
+    def test_cluster_scoped_crd(self):
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd(
+            kind="Fleet", plural="fleets", scope="Cluster"))
+        assert not store.kind_is_namespaced("Fleet")
+
+    def test_builtin_kind_cannot_be_shadowed(self):
+        store = ClusterStore()
+        try:
+            store.create_object("CustomResourceDefinition",
+                                _crd(kind="Pod", plural="pods2"))
+            raise AssertionError("shadowing Pod should be rejected")
+        except ValueError:
+            pass
+        assert store.get_object("CustomResourceDefinition", "",
+                                "pods2.example.com") is None
+
+    def test_crd_delete_cascades_instances_and_unregisters(self):
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd())
+        store.create_object("Widget", _widget("w1"))
+        deleted = []
+        store.watch(lambda ev: deleted.append(
+            (ev.type, ev.kind, ev.obj.metadata.name))
+            if ev.type == "DELETED" else None)
+        store.delete_object("CustomResourceDefinition", "",
+                            "widgets.example.com")
+        assert "Widget" not in store.known_kinds()
+        assert store.custom_plural_to_kind("widgets") is None
+        assert ("DELETED", "Widget", "w1") in deleted
+
+    def test_watch_delivers_custom_events(self):
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd())
+        got = []
+        store.watch(lambda ev: got.append((ev.type, ev.kind))
+                        if ev.kind == "Widget" else None)
+        store.create_object("Widget", _widget("w1"))
+        w = store.get_object("Widget", "default", "w1")
+        store.update_object("Widget", w)
+        store.delete_object("Widget", "default", "w1")
+        assert got == [("ADDED", "Widget"), ("MODIFIED", "Widget"),
+                       ("DELETED", "Widget")]
+
+
+class TestRestRoutes:
+    def test_crud_and_watch_over_http(self):
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            client.create(_crd())
+            # new plural route is live immediately
+            created = client.create(_widget("w1", spec={"size": 7}))
+            assert created.kind == "Widget"
+            assert created.spec == {"size": 7}
+            got = client.get("Widget", "w1")
+            assert got.spec == {"size": 7}
+            got.spec = {"size": 9}
+            client.update(got)
+            items, rv = client.list("Widget", namespace="default")
+            assert len(items) == 1 and items[0].spec == {"size": 9}
+            # watch: a follow-up create streams an ADDED frame
+            events = []
+            done = threading.Event()
+
+            def on_event(ev_type, obj):
+                events.append((ev_type, obj.metadata.name))
+                done.set()
+
+            handle = client.watch("Widget", rv, on_event,
+                                  namespace="default")
+            client.create(_widget("w2"))
+            assert done.wait(5)
+            handle.stop()
+            assert ("ADDED", "w2") in events
+            assert client.delete("Widget", "w1")
+            assert client.get("Widget", "w1") is None
+            # unknown plural: 404, not a crash
+            code, _ = client._request("GET", "/api/v1/gadgets")
+            assert code == 404
+        finally:
+            server.shutdown_server()
+
+
+class TestGarbageCollection:
+    def test_custom_instances_swept_when_owner_vanishes(self):
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.api.types import ReplicaSet
+
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd())
+        rs = ReplicaSet(metadata=ObjectMeta(name="own", namespace="default",
+                                            uid="rs-uid"))
+        store.add_replica_set(rs)
+        w = _widget("dep")
+        w.metadata.owner_references = [{
+            "kind": "ReplicaSet", "name": "own", "uid": "rs-uid",
+            "controller": True,
+        }]
+        store.create_object("Widget", w)
+        cm = ControllerManager(store, controllers=["garbagecollector"])
+        gc = cm.get("garbagecollector")
+        gc.sweep_interval = 0.2
+        cm.start()
+        try:
+            # owner alive: the instance stays
+            time.sleep(0.6)
+            assert store.get_object("Widget", "default", "dep") is not None
+            store.delete_replica_set("default", "own")
+            deadline = time.time() + 10
+            while time.time() < deadline and store.get_object(
+                    "Widget", "default", "dep") is not None:
+                time.sleep(0.1)
+            assert store.get_object("Widget", "default", "dep") is None
+        finally:
+            cm.stop()
+
+    def test_custom_owner_of_pod(self):
+        """A custom kind can OWN typed objects: pods owned by a deleted
+        Widget get swept (the reference GC is generic over discovered
+        resources)."""
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        store.create_object("CustomResourceDefinition", _crd())
+        w = _widget("boss")
+        store.create_object("Widget", w)
+        pod = MakePod().name("p1").uid("pu1").obj()
+        pod.metadata.owner_references = [{
+            "kind": "Widget", "name": "boss", "uid": w.metadata.uid,
+            "controller": True,
+        }]
+        store.create_pod(pod)
+        cm = ControllerManager(store, controllers=["garbagecollector"])
+        cm.get("garbagecollector").sweep_interval = 0.2
+        cm.start()
+        try:
+            time.sleep(0.6)
+            assert store.get_pod("default", "p1") is not None
+            store.delete_object("Widget", "default", "boss")
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    store.get_pod("default", "p1") is not None:
+                time.sleep(0.1)
+            assert store.get_pod("default", "p1") is None
+        finally:
+            cm.stop()
+
+
+class TestWalRoundtrip:
+    def test_custom_kinds_survive_restore(self, tmp_path):
+        from kubernetes_tpu.apiserver.wal import attach_wal, restore_store
+
+        store = ClusterStore()
+        handle = attach_wal(store, str(tmp_path))
+        store.create_object("CustomResourceDefinition", _crd())
+        store.create_object("Widget", _widget("w1", spec={"size": 42}))
+        handle.close()
+
+        restored = restore_store(str(tmp_path))
+        assert "Widget" in restored.known_kinds()
+        assert restored.custom_plural_to_kind("widgets") == "Widget"
+        got = restored.get_object("Widget", "default", "w1")
+        assert got is not None and got.spec == {"size": 42}
+        # the restored registry accepts new instances immediately
+        restored.create_object("Widget", _widget("w2"))
