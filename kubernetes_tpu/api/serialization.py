@@ -60,6 +60,8 @@ SCHEME: Dict[str, type] = {
         "RoleBinding",
         "ClusterRoleBinding",
         "CustomResourceDefinition",
+        "MutatingWebhookConfiguration",
+        "ValidatingWebhookConfiguration",
     )
 }
 
@@ -68,7 +70,9 @@ SCHEME: Dict[str, type] = {
 # build paths; it is API schema, not storage layout)
 CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
                   "Namespace", "ClusterRole", "ClusterRoleBinding",
-                  "CustomResourceDefinition"}
+                  "CustomResourceDefinition",
+                  "MutatingWebhookConfiguration",
+                  "ValidatingWebhookConfiguration"}
 
 
 def is_namespaced(kind: str) -> bool:

@@ -1150,3 +1150,51 @@ class CustomObject:
     @property
     def namespace(self) -> str:
         return self.metadata.namespace
+
+
+# ---------------------------------------------------------------------------
+# Admission webhook registration (reference
+# staging/src/k8s.io/api/admissionregistration/v1/types.go; dispatched by
+# staging/.../admission/plugin/webhook/{mutating,validating}/dispatcher.go)
+
+
+@dataclass
+class WebhookRule:
+    """Which (operations x resources) a webhook intercepts
+    (admissionregistration RuleWithOperations; "*" wildcards)."""
+
+    operations: List[str] = field(default_factory=lambda: ["*"])
+    resources: List[str] = field(default_factory=lambda: ["*"])
+
+
+@dataclass
+class Webhook:
+    """One registered hook: where to POST the AdmissionReview and how to
+    treat call failures (failurePolicy Fail|Ignore, reference
+    v1.FailurePolicyType)."""
+
+    name: str = ""
+    url: str = ""
+    rules: List[WebhookRule] = field(default_factory=list)
+    failure_policy: str = "Fail"  # Fail | Ignore
+    timeout_seconds: int = 10
+
+
+@dataclass
+class MutatingWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

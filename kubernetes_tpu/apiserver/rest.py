@@ -73,6 +73,8 @@ PLURALS: Dict[str, str] = {
     "rolebindings": "RoleBinding",
     "clusterrolebindings": "ClusterRoleBinding",
     "customresourcedefinitions": "CustomResourceDefinition",
+    "mutatingwebhookconfigurations": "MutatingWebhookConfiguration",
+    "validatingwebhookconfigurations": "ValidatingWebhookConfiguration",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
@@ -613,6 +615,13 @@ class APIServer(ThreadingHTTPServer):
                 if isinstance(p, NamespaceLifecycle):
                     p.store = self.store
             admission.plugins.append(ResourceQuotaAdmission(self.store))
+            # out-of-process extension point, last in the chain:
+            # mutating webhooks run after the in-process mutators,
+            # validating webhooks after every in-process validator
+            # (reference mutating-then-validating dispatcher ordering)
+            from kubernetes_tpu.apiserver.webhook import WebhookAdmission
+
+            admission.plugins.append(WebhookAdmission(self.store))
         self.admission = admission
         self.authorizer = authorizer
         self.tokens = dict(tokens or {})  # bearer token -> username

@@ -109,6 +109,9 @@ class ClusterStore:
         self._cluster_roles: Dict[str, ClusterRole] = {}
         self._role_bindings: Dict[str, RoleBinding] = {}
         self._cluster_role_bindings: Dict[str, ClusterRoleBinding] = {}
+        # admission webhook registrations (admissionregistration.k8s.io)
+        self._mutating_webhooks: Dict[str, Any] = {}
+        self._validating_webhooks: Dict[str, Any] = {}
         # CRD analog (apiextensions-apiserver): the CRD objects plus
         # per-instance storage for runtime-registered kinds
         self._crds: Dict[str, Any] = {}
@@ -724,6 +727,8 @@ class ClusterStore:
         "RoleBinding": ("_role_bindings", True),
         "ClusterRoleBinding": ("_cluster_role_bindings", False),
         "CustomResourceDefinition": ("_crds", False),
+        "MutatingWebhookConfiguration": ("_mutating_webhooks", False),
+        "ValidatingWebhookConfiguration": ("_validating_webhooks", False),
     }
 
     # ------------------------------------------------------------------
