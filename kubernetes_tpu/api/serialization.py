@@ -62,6 +62,9 @@ SCHEME: Dict[str, type] = {
         "CustomResourceDefinition",
         "MutatingWebhookConfiguration",
         "ValidatingWebhookConfiguration",
+        "Secret",
+        "ConfigMap",
+        "CertificateSigningRequest",
     )
 }
 
@@ -72,7 +75,8 @@ CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
                   "Namespace", "ClusterRole", "ClusterRoleBinding",
                   "CustomResourceDefinition",
                   "MutatingWebhookConfiguration",
-                  "ValidatingWebhookConfiguration"}
+                  "ValidatingWebhookConfiguration",
+                  "CertificateSigningRequest"}
 
 
 def is_namespaced(kind: str) -> bool:

@@ -1059,10 +1059,17 @@ class Role:
 
 @dataclass
 class ClusterRole:
-    """Cluster-scoped rule set (rbac/v1 ClusterRole)."""
+    """Cluster-scoped rule set (rbac/v1 ClusterRole). A role carrying
+    ``aggregation_label_selectors`` is managed by the
+    clusterrole-aggregation controller: its rules are the union of all
+    ClusterRoles matching any selector (rbac/v1 AggregationRule)."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     rules: List[PolicyRule] = field(default_factory=list)
+    # each entry is a matchLabels dict (the common AggregationRule shape)
+    aggregation_label_selectors: List[Dict[str, str]] = field(
+        default_factory=list
+    )
 
     @property
     def name(self) -> str:
@@ -1198,3 +1205,81 @@ class ValidatingWebhookConfiguration:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# Secrets / ConfigMaps / CertificateSigningRequests (core/v1 Secret +
+# ConfigMap; certificates.k8s.io/v1 CSR) — the object surface the
+# certificate and bootstrap-token controller families reconcile.
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    # values kept as plain strings (the reference carries base64 bytes
+    # on the wire; the framework's store is in-process)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class CSRCondition:
+    type: str = ""       # Approved | Denied | Failed
+    reason: str = ""
+    message: str = ""
+    timestamp: float = 0.0
+
+
+@dataclass
+class CertificateSigningRequest:
+    """certificates.k8s.io/v1 CSR: spec.request (PEM CSR) + signerName;
+    approval is a status condition; the signer fills
+    status.certificate."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    request: str = ""       # the CSR payload (PEM in the reference)
+    signer_name: str = ""
+    username: str = ""
+    usages: List[str] = field(default_factory=list)
+    conditions: List[CSRCondition] = field(default_factory=list)
+    certificate: str = ""   # issued cert (status.certificate)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def condition(self, type_: str) -> Optional[CSRCondition]:
+        for c in self.conditions:
+            if c.type == type_:
+                return c
+        return None
+
+    @property
+    def approved(self) -> bool:
+        return self.condition("Approved") is not None
+
+    @property
+    def denied(self) -> bool:
+        return self.condition("Denied") is not None

@@ -75,6 +75,9 @@ PLURALS: Dict[str, str] = {
     "customresourcedefinitions": "CustomResourceDefinition",
     "mutatingwebhookconfigurations": "MutatingWebhookConfiguration",
     "validatingwebhookconfigurations": "ValidatingWebhookConfiguration",
+    "secrets": "Secret",
+    "configmaps": "ConfigMap",
+    "certificatesigningrequests": "CertificateSigningRequest",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 

@@ -112,6 +112,9 @@ class ClusterStore:
         # admission webhook registrations (admissionregistration.k8s.io)
         self._mutating_webhooks: Dict[str, Any] = {}
         self._validating_webhooks: Dict[str, Any] = {}
+        self._secrets: Dict[str, Any] = {}
+        self._config_maps: Dict[str, Any] = {}
+        self._csrs: Dict[str, Any] = {}
         # CRD analog (apiextensions-apiserver): the CRD objects plus
         # per-instance storage for runtime-registered kinds
         self._crds: Dict[str, Any] = {}
@@ -729,6 +732,9 @@ class ClusterStore:
         "CustomResourceDefinition": ("_crds", False),
         "MutatingWebhookConfiguration": ("_mutating_webhooks", False),
         "ValidatingWebhookConfiguration": ("_validating_webhooks", False),
+        "Secret": ("_secrets", True),
+        "ConfigMap": ("_config_maps", True),
+        "CertificateSigningRequest": ("_csrs", False),
     }
 
     # ------------------------------------------------------------------

@@ -219,7 +219,17 @@ class SharedInformerFactory:
         with self._cond:
             inf = self._informers.get(kind)
             if inf is None:
-                inf = SharedInformer(kind, getattr(self._store, _KIND_LISTS[kind]))
+                list_name = _KIND_LISTS.get(kind)
+                if list_name is not None:
+                    list_fn = getattr(self._store, list_name)
+                else:
+                    # kinds without a typed list accessor (Secret,
+                    # ConfigMap, CSR, RBAC kinds, CRD-registered kinds)
+                    # ride the generic registry surface
+                    list_fn = (
+                        lambda kind=kind: self._store.list_objects(kind)
+                    )
+                inf = SharedInformer(kind, list_fn)
                 self._informers[kind] = inf
                 if self._thread is not None:
                     # registered after start(): sync on the dispatch thread

@@ -20,6 +20,25 @@ from kubernetes_tpu.client import (
 )
 from kubernetes_tpu.controllers.attachdetach import AttachDetachController
 from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.bootstraptoken import (
+    BootstrapSignerController,
+    TokenCleanerController,
+)
+from kubernetes_tpu.controllers.certificates import (
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+)
+from kubernetes_tpu.controllers.clusterroleaggregation import (
+    ClusterRoleAggregationController,
+)
+from kubernetes_tpu.controllers.ephemeralvolume import (
+    EphemeralVolumeController,
+)
+from kubernetes_tpu.controllers.endpointslicemirroring import (
+    EndpointSliceMirroringController,
+)
+from kubernetes_tpu.controllers.volumeexpand import VolumeExpandController
 from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
@@ -80,6 +99,15 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "ttl": TTLController,
         "pvc-protection": PVCProtectionController,
         "pv-protection": PVProtectionController,
+        "csrapproving": CSRApprovingController,
+        "csrsigning": CSRSigningController,
+        "csrcleaner": CSRCleanerController,
+        "bootstrapsigner": BootstrapSignerController,
+        "tokencleaner": TokenCleanerController,
+        "endpointslicemirroring": EndpointSliceMirroringController,
+        "volumeexpand": VolumeExpandController,
+        "ephemeral-volume": EphemeralVolumeController,
+        "clusterrole-aggregation": ClusterRoleAggregationController,
     }
 
 

@@ -1,0 +1,64 @@
+"""ClusterRole aggregation controller (reference
+``pkg/controller/clusterroleaggregation/clusterroleaggregation_
+controller.go``): a ClusterRole with an aggregation rule gets its
+``rules`` REPLACED by the union of all ClusterRoles matching any of its
+label selectors — RBAC extensibility without editing the aggregate role
+(how e.g. ``admin``/``edit``/``view`` absorb CRD roles upstream).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import ClusterRole, PolicyRule
+from kubernetes_tpu.controllers.base import Controller
+
+
+def _rule_key(r: PolicyRule) -> tuple:
+    return (
+        tuple(sorted(r.verbs)), tuple(sorted(r.resources)),
+        tuple(sorted(r.resource_names)),
+        tuple(sorted(r.non_resource_urls)),
+    )
+
+
+class ClusterRoleAggregationController(Controller):
+    name = "clusterrole-aggregation"
+
+    def register(self) -> None:
+        self.factory.informer_for("ClusterRole").add_event_handler(
+            on_add=lambda r: self._enqueue_aggregates(),
+            on_update=lambda o, n: self._enqueue_aggregates(),
+            on_delete=lambda r: self._enqueue_aggregates(),
+        )
+
+    def _enqueue_aggregates(self) -> None:
+        for role in self.store.list_cluster_roles():
+            if role.aggregation_label_selectors:
+                self.enqueue_key(role.name)
+
+    def sync(self, key: str) -> None:
+        role = self.store.get_cluster_role(key)
+        if role is None or not role.aggregation_label_selectors:
+            return
+        union: dict = {}
+        for candidate in sorted(self.store.list_cluster_roles(),
+                                key=lambda r: r.name):
+            if candidate.name == key:
+                continue
+            labels = candidate.metadata.labels
+            if not any(
+                all(labels.get(k) == v for k, v in sel.items())
+                for sel in role.aggregation_label_selectors
+            ):
+                continue
+            for rule in candidate.rules:
+                union.setdefault(_rule_key(rule), rule)
+        want = list(union.values())
+        if [_rule_key(r) for r in role.rules] == \
+                [_rule_key(r) for r in want]:
+            return
+
+        def mutate(r: ClusterRole) -> bool:
+            r.rules = want
+            return True
+
+        self.store.mutate_object("ClusterRole", "", key, mutate)

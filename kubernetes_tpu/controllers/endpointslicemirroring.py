@@ -1,0 +1,98 @@
+"""EndpointSliceMirroring controller (reference
+``pkg/controller/endpointslicemirroring``): selectorless Services have
+their Endpoints managed manually; this loop mirrors those custom
+Endpoints objects into EndpointSlices (labelled as mirrored and
+owner-bound to the Endpoints object) so slice consumers see a uniform
+API. Services WITH selectors are the endpointslice controller's job and
+are skipped here (endpointslicemirroring_controller.go shouldMirror).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import (
+    EndpointAddress,
+    EndpointSlice,
+    ObjectMeta,
+)
+from kubernetes_tpu.controllers.base import Controller, owner_ref, split_key
+
+SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+MANAGED_BY_LABEL = "endpointslice.kubernetes.io/managed-by"
+MIRRORING_CONTROLLER = "endpointslicemirroring-controller.k8s.io"
+
+
+class EndpointSliceMirroringController(Controller):
+    name = "endpointslicemirroring"
+    max_endpoints_per_slice = 100
+
+    def register(self) -> None:
+        self.factory.informer_for("Endpoints").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+            on_delete=self.enqueue,
+        )
+        self.factory.informer_for("Service").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+        )
+
+    def _mirrored_slices(self, namespace: str, service: str):
+        return [
+            es for es in self.store.list_endpoint_slices()
+            if es.namespace == namespace
+            and es.metadata.labels.get(SERVICE_NAME_LABEL) == service
+            and es.metadata.labels.get(MANAGED_BY_LABEL)
+            == MIRRORING_CONTROLLER
+        ]
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        ep = self.store.get_object("Endpoints", ns, name)
+        svc = self.store.get_object("Service", ns, name)
+        existing = self._mirrored_slices(ns, name)
+        # mirror only when the Endpoints' Service exists AND is
+        # selectorless (shouldMirror)
+        if ep is None or svc is None or svc.selector:
+            for es in existing:
+                self.store.delete_object("EndpointSlice", ns, es.name)
+            return
+        addresses = [
+            EndpointAddress(ip=a.ip, node_name=a.node_name,
+                            target_pod=a.target_pod)
+            for a in sorted(ep.addresses, key=lambda a: a.ip)
+        ]
+        chunks = [
+            addresses[i:i + self.max_endpoints_per_slice]
+            for i in range(0, len(addresses),
+                           self.max_endpoints_per_slice)
+        ] or [[]]
+        wanted = {}
+        for idx, chunk in enumerate(chunks):
+            slice_name = f"{name}-mirror-{idx}"
+            wanted[slice_name] = EndpointSlice(
+                metadata=ObjectMeta(
+                    name=slice_name, namespace=ns,
+                    labels={
+                        SERVICE_NAME_LABEL: name,
+                        MANAGED_BY_LABEL: MIRRORING_CONTROLLER,
+                    },
+                    owner_references=[owner_ref("Endpoints", ep)],
+                ),
+                endpoints=chunk,
+                ports=list(svc.ports),
+            )
+
+        def fingerprint(es: EndpointSlice):
+            return (
+                [(a.ip, a.node_name, a.target_pod) for a in es.endpoints],
+                [(p.name, p.port, p.target_port) for p in es.ports],
+            )
+
+        current = {es.name: es for es in existing}
+        for slice_name, es in wanted.items():
+            old = current.get(slice_name)
+            if old is None or fingerprint(old) != fingerprint(es):
+                self.store.add_endpoint_slice(es)
+        for slice_name in current:
+            if slice_name not in wanted:
+                self.store.delete_object("EndpointSlice", ns, slice_name)

@@ -1,0 +1,45 @@
+"""Ephemeral-volume controller (reference ``pkg/controller/volume/
+ephemeral/controller.go``): a pod volume with ``ephemeral`` set implies
+a PVC named ``<pod>-<volume>`` owned by the pod; this loop creates the
+claim when absent (the owner reference makes the GC reclaim it with the
+pod — controller.go handleVolume/podWork).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import ObjectMeta, PersistentVolumeClaim
+from kubernetes_tpu.controllers.base import Controller, owner_ref, split_key
+
+
+class EphemeralVolumeController(Controller):
+    name = "ephemeral-volume"
+
+    def register(self) -> None:
+        self.factory.informer_for("Pod").add_event_handler(
+            on_add=self.enqueue,
+            on_update=lambda old, new: self.enqueue(new),
+        )
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        pod = self.store.get_pod(ns, name)
+        if pod is None or pod.metadata.deletion_timestamp is not None:
+            return
+        for vol in pod.spec.volumes:
+            if not vol.ephemeral:
+                continue
+            claim_name = f"{name}-{vol.name}"
+            if self.store.get_pvc(ns, claim_name) is not None:
+                # controller.go: an existing claim NOT owned by this pod
+                # is a conflict the controller reports and leaves alone;
+                # either way there is nothing to create
+                continue
+            self.store.add_pvc(PersistentVolumeClaim(
+                metadata=ObjectMeta(
+                    name=claim_name, namespace=ns,
+                    owner_references=[owner_ref("Pod", pod)],
+                ),
+                requests={"storage": parse_quantity("1Gi")},
+                phase="Pending",
+            ))
