@@ -265,6 +265,28 @@ class ClusterStore:
     def delete_pod(self, namespace: str, name: str) -> None:
         self._delete(self._pods, "Pod", f"{namespace}/{name}")
 
+    def delete_pods(self, keys: List[Tuple[str, str]]) -> None:
+        """Bulk delete ((namespace, name) pairs): one lock acquisition
+        AND one batched watch delivery — the mass-preemption path evicts
+        thousands of victims per batch, and per-event delivery would
+        cost a queue move-all per victim (same rationale as
+        ``create_pods``/``bind_pods``). Finalizer-carrying pods keep the
+        single-delete marking semantics."""
+        events: List[Event] = []
+        with self._lock:
+            for namespace, name in keys:
+                key = f"{namespace}/{name}"
+                old = self._pods.get(key)
+                if old is None:
+                    continue
+                if old.metadata.finalizers:
+                    self._delete(self._pods, "Pod", key)
+                    continue
+                self._pods.pop(key)
+                old.metadata.resource_version = self._next_rv()
+                events.append(Event(DELETED, "Pod", old))
+            self._dispatch_many(events)
+
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
         with self._lock:
             return self._pods.get(f"{namespace}/{name}")

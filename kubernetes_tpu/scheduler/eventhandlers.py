@@ -43,8 +43,9 @@ class EventHandlers:
         matter at throughput scale are bind transitions (commit) and
         unassigned adds (admission)."""
         sched = self.sched
-        bind_run = []   # Pods newly assigned (MODIFIED, old unassigned)
-        add_run = []    # unassigned schedulable ADDED pods
+        bind_run = []    # Pods newly assigned (MODIFIED, old unassigned)
+        add_run = []     # unassigned schedulable ADDED pods
+        delete_run = []  # assigned DELETED pods (mass preemption)
 
         def flush():
             if bind_run:
@@ -60,6 +61,29 @@ class EventHandlers:
                 }
                 sched.queue.gang_members_added(groups)
                 add_run.clear()
+            if delete_run:
+                for p in delete_run:
+                    sched.cache.remove_pod(p)
+                    if p.metadata.labels.get(GANG_GROUP_LABEL):
+                        for fwk in sched.profiles.values():
+                            gang = fwk.get_plugin("Coscheduling")
+                            if gang is not None:
+                                gang.note_member_deleted(p)
+                # ONE wake-up for the whole run: a per-victim move-all
+                # is what made bulk preemption O(victims x pending)
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    ev.ASSIGNED_POD_DELETE
+                )
+                delete_run.clear()
+
+        def run_for(target):
+            if target is not bind_run and bind_run:
+                flush()
+            elif target is not add_run and add_run:
+                flush()
+            elif target is not delete_run and delete_run:
+                flush()
+            return target
 
         for event in events:
             if event.kind == "Pod":
@@ -70,9 +94,7 @@ class EventHandlers:
                     and event.old_obj is not None
                     and not assigned(event.old_obj)
                 ):
-                    if add_run:
-                        flush()
-                    bind_run.append(pod)
+                    run_for(bind_run).append(pod)
                     continue
                 if (
                     event.type == ADDED
@@ -80,9 +102,10 @@ class EventHandlers:
                     and schedulable(pod)
                     and self.responsible_for(pod)
                 ):
-                    if bind_run:
-                        flush()
-                    add_run.append(pod)
+                    run_for(add_run).append(pod)
+                    continue
+                if event.type == DELETED and assigned(pod):
+                    run_for(delete_run).append(pod)
                     continue
             flush()
             self.handle(event)

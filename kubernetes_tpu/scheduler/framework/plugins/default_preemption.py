@@ -76,20 +76,7 @@ class DefaultPreemption(PostFilterPlugin):
     # ------------------------------------------------------------------
     def _eligible_to_preempt_others(self, pod: Pod) -> bool:
         """default_preemption.go:246 PodEligibleToPreemptOthers."""
-        if pod.spec.preemption_policy == "Never":
-            return False
-        nominated = pod.status.nominated_node_name
-        if nominated:
-            ni = self.handle.snapshot().get(nominated)
-            if ni is not None:
-                # a previous preemption is still playing out: wait for it
-                if any(
-                    pi.pod.metadata.deletion_timestamp is not None
-                    and pi.pod.priority() < pod.priority()
-                    for pi in ni.pods
-                ):
-                    return False
-        return True
+        return pod_eligible_to_preempt_others(pod, self.handle.snapshot())
 
     # CycleState key for batch-computed candidate hints (the sidecar's
     # vectorized preemption screen, scheduler/preemption_screen.py)
@@ -283,18 +270,44 @@ class DefaultPreemption(PostFilterPlugin):
         return None
 
 
+def pdb_covers(pod: Pod, pdb) -> bool:
+    """Does this PDB select this pod? The single matching predicate
+    shared by the dry-run's violation split and the batch planner's
+    conservative victim exclusion."""
+    return pdb.namespace == pod.namespace and \
+        pdb.selector.matches(pod.metadata.labels)
+
+
+def pod_eligible_to_preempt_others(pod: Pod, snapshot=None) -> bool:
+    """default_preemption.go:246 PodEligibleToPreemptOthers — shared by
+    the serial PostFilter and the batch victim planner (the two must
+    gate identically or the batch path evicts for pods the reference
+    would refuse, e.g. preemptionPolicy Never)."""
+    if pod.spec.preemption_policy == "Never":
+        return False
+    nominated = pod.status.nominated_node_name
+    if nominated and snapshot is not None:
+        ni = snapshot.get(nominated)
+        if ni is not None:
+            # a previous preemption is still playing out: wait for it
+            if any(
+                pi.pod.metadata.deletion_timestamp is not None
+                and pi.pod.priority() < pod.priority()
+                for pi in ni.pods
+            ):
+                return False
+    return True
+
+
 def _split_pods_by_pdb_violation(pods: List[Pod], pdbs) -> Tuple[List[Pod], List[Pod]]:
     """Pods whose eviction would violate a PodDisruptionBudget (reference
     filterPodsWithPDBViolation)."""
     violating, non_violating = [], []
     for pod in pods:
-        violates = False
-        for pdb in pdbs:
-            if pdb.namespace != pod.namespace:
-                continue
-            if pdb.selector.matches(pod.metadata.labels) and pdb.disruptions_allowed <= 0:
-                violates = True
-                break
+        violates = any(
+            pdb_covers(pod, pdb) and pdb.disruptions_allowed <= 0
+            for pdb in pdbs
+        )
         if violates:
             violating.append(pod)
         else:

@@ -405,17 +405,23 @@ class TPUBatchScheduler:
             # (per-pod dry-run over hundreds of candidates is what
             # collapses mass-preemption throughput)
             screen = None
+            planner = None
             screen_masks: dict = {}
             if fwk.has_post_filter_plugins() and any(
                 q.pod.priority() > 0 for _, q, _ in declined
             ):
                 from kubernetes_tpu.scheduler.preemption_screen import (
                     build_screen,
+                    build_victim_planner,
                 )
 
                 sched.algorithm.update_snapshot()
                 try:
                     screen = build_screen(sched.algorithm.snapshot)
+                    planner = build_victim_planner(
+                        sched.algorithm.snapshot,
+                        pdbs=sched.client.list_pdbs(),
+                    )
                 except Exception:  # noqa: BLE001 — hints are advisory
                     _logger.exception("preemption screen build failed")
 
@@ -442,6 +448,23 @@ class TPUBatchScheduler:
                 )
                 screen_masks[ui] = aligned
                 return aligned
+            # batch preemption planning (VERDICT r2 #3): group the
+            # declined preemptors by shape — mass declines are runs of
+            # identical (priority, requests, static profile) pods — and
+            # let the planner propose ONE (node, minimal victim set)
+            # per pod from its per-(node, priority) sorted prefix sums
+            # with live capacity accounting. Planned pods skip the
+            # per-pod PostFilter dry-run entirely; the real filter
+            # chain still validates every plan post-eviction.
+            from kubernetes_tpu.scheduler.framework.plugins.default_preemption import (  # noqa: E501
+                pod_eligible_to_preempt_others,
+            )
+            from kubernetes_tpu.scheduler.types import (
+                compute_pod_resource_request,
+            )
+
+            groups: dict = {}   # shape key -> [(bi, qpi, cycle)]
+            rest: List[tuple] = []
             for bi, qpi, cycle in declined:
                 # an inexpressible pod's -1 is NOT a device verdict (the
                 # tensor model simply can't express it) — it keeps the
@@ -450,6 +473,33 @@ class TPUBatchScheduler:
                         and inexpressible[bi]:
                     serial.append(qpi)
                     continue
+                if planner is not None and qpi.pod.priority() > 0 and \
+                        pod_eligible_to_preempt_others(
+                            qpi.pod, sched.algorithm.snapshot):
+                    req = compute_pod_resource_request(qpi.pod)
+                    profiles = pending["profiles"]
+                    ui = int(profiles[bi]) if profiles is not None and \
+                        bi < len(profiles) else -1
+                    key = (qpi.pod.priority(), req.milli_cpu,
+                           req.memory, ui)
+                    groups.setdefault(key, []).append((bi, qpi, cycle))
+                else:
+                    rest.append((bi, qpi, cycle))
+            plans: List[tuple] = []  # (qpi, cycle, node_name, victims)
+            for key, members in groups.items():
+                got = []
+                try:
+                    got = planner.plan_group(
+                        members[0][1].pod, len(members),
+                        static_mask=screen_mask(members[0][0]),
+                    )
+                except Exception:  # noqa: BLE001 — advisory
+                    _logger.exception("victim planning failed")
+                for (bi, qpi, cycle), (node_name, victims) in zip(
+                        members, got):
+                    plans.append((qpi, cycle, node_name, victims))
+                rest.extend(members[len(got):])
+            for bi, qpi, cycle in rest:
                 hints = None
                 if screen is not None and qpi.pod.priority() > 0:
                     # rotate by position in the declined set: uniform
@@ -463,9 +513,113 @@ class TPUBatchScheduler:
                                            statuses_by_profile,
                                            candidate_hints=hints):
                     serial.append(qpi)
+            if plans:
+                committed += self._execute_preemption_plans(
+                    fwk, plans, pending["start"], serial
+                )
         now = time.monotonic()
         sched.metrics.batch_solve_duration.observe(now - t0, "commit")
         self._tune_chunk(pending.get("pad", self.max_batch), now - start)
+        return committed
+
+    def _execute_preemption_plans(self, fwk, plans, start,
+                                  serial: List[QueuedPodInfo]) -> int:
+        """Execute a batch of (preemptor, node, victims) plans: evict
+        all victims in bulk, refresh the snapshot once, then validate
+        each preemptor on its planned node with the REAL filter chain
+        (against clones carrying the batch's earlier placements — the
+        assume semantics without touching the cache) and commit the
+        validated set in bulk. A failed validation routes that pod to
+        the serial path; its victims are already gone, which the serial
+        PostFilter treats as ordinary freed capacity.
+
+        Semantics vs the reference: victims get the same Preempted
+        events and waiting-pod rejection (``default_preemption.go:698``
+        PrepareCandidate); the preemptor binds in THIS cycle instead of
+        being requeued with ``nominatedNodeName`` — outcome-equivalent
+        (PreferNominatedNode would pick the same node next cycle,
+        ``generic_scheduler.go:250``) minus one full solve round trip,
+        which is what makes mass preemption fast."""
+        sched = self.sched
+        recorder = getattr(fwk, "event_recorder", None)
+        doomed: List[tuple] = []
+        for qpi, _cycle, node_name, victims in plans:
+            for victim in victims:
+                if fwk.reject_waiting_pod(victim.uid):
+                    continue
+                doomed.append((victim.namespace, victim.name))
+                if recorder is not None:
+                    recorder.event(
+                        victim, "Normal", "Preempted",
+                        f"Preempted by {qpi.pod.namespace}/"
+                        f"{qpi.pod.metadata.name} on node {node_name}",
+                    )
+        if doomed:
+            sched.client.delete_pods(doomed)
+        sched.algorithm.update_snapshot()
+        snapshot = sched.algorithm.snapshot
+        clones: dict = {}
+        commits: List[tuple] = []
+        from kubernetes_tpu.scheduler.framework import interface as fw_iface
+
+        for qpi, cycle, node_name, _victims in plans:
+            ni = clones.get(node_name)
+            if ni is None:
+                base = snapshot.get(node_name)
+                if base is None or base.node is None:
+                    serial.append(qpi)
+                    continue
+                ni = base.clone()
+                clones[node_name] = ni
+            state = CycleState()
+            status = fwk.run_pre_filter_plugins(state, qpi.pod)
+            ok = fw_iface.Status.is_ok(status)
+            if ok:
+                ok = fw_iface.Status.is_ok(
+                    fwk.run_filter_plugins_with_nominated_pods(
+                        state, qpi.pod, ni
+                    )
+                )
+            if not ok:
+                # resource model said yes, full filters said no
+                # (topology/affinity effect): exact fallback
+                serial.append(qpi)
+                continue
+            ni.add_pod(qpi.pod)
+            result = ScheduleResult(
+                suggested_host=node_name,
+                evaluated_nodes=len(snapshot.list()),
+                feasible_nodes=1,
+            )
+            commits.append((qpi, result, cycle, start))
+        committed = 0
+        if commits:
+            committed, failed = sched.commit_assignments_bulk(fwk, commits)
+            if failed:
+                self.session.invalidate()
+        # stale-nomination cleanup (default_preemption.go:277-282 via
+        # _prepare_candidate): lower-priority pods nominated on a node a
+        # batch preemptor just took must lose the nomination, or their
+        # phantom reservation keeps filtering other pods off the node
+        nominator = getattr(fwk, "pod_nominator", None)
+        if nominator is not None:
+            max_prio_by_node: dict = {}
+            for qpi, _cycle, node_name, _victims in plans:
+                prio = qpi.pod.priority()
+                cur = max_prio_by_node.get(node_name)
+                if cur is None or prio > cur:
+                    max_prio_by_node[node_name] = prio
+            for node_name, prio in max_prio_by_node.items():
+                for pi in list(
+                    nominator.nominated_pods_for_node(node_name)
+                ):
+                    if pi.pod.priority() < prio:
+                        nominator.delete_nominated_pod_if_exists(pi.pod)
+                        sched.client.clear_nominated_node_name(
+                            pi.pod.namespace, pi.pod.name
+                        )
+        # victim deletions mutated the cache outside the commit
+        # accounting: the mirror rebuilds next batch regardless
         return committed
 
     # shared (read-only) status instances for synthesized fit errors

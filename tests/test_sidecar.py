@@ -376,6 +376,87 @@ class TestBatchVolumes:
         )
 
 
+class TestBatchPreemption:
+    """The mass-decline victim planner (VERDICT r2 #3): semantics it
+    must share with the serial PostFilter path."""
+
+    @staticmethod
+    def _full_cluster(store, nodes=3):
+        for i in range(nodes):
+            store.add_node(MakeNode().name(f"n{i}")
+                           .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        fillers = [
+            MakePod().name(f"low{i}").uid(f"lu{i}").priority(0)
+            .req({"cpu": "3"}).obj()
+            for i in range(nodes)
+        ]
+        return fillers
+
+    def test_preemption_policy_never_is_respected(self):
+        """A mass-decline batch of preemptionPolicy=Never pods must not
+        evict anyone (PodEligibleToPreemptOthers,
+        default_preemption.go:246) — shared gate with the serial path."""
+        store = ClusterStore()
+        fillers = self._full_cluster(store, nodes=3)
+        sched, bs = make_batch_scheduler(store)
+        # force the mass-decline branch for small batches
+        bs.DECLINED_SERIAL_LIMIT = 0
+        store.create_pods(fillers)
+        drain_batches(sched, bs)
+        assert all(p.spec.node_name for p in store.list_pods())
+        never = []
+        for i in range(40):
+            p = MakePod().name(f"hi{i}").uid(f"hu{i}").priority(100) \
+                .req({"cpu": "3"}).obj()
+            p.spec.preemption_policy = "Never"
+            never.append(p)
+        store.create_pods(never)
+        drain_batches(sched, bs)
+        # no filler was evicted; no Never pod bound
+        assert sum(1 for p in store.list_pods()
+                   if p.metadata.name.startswith("low")) == 3
+        assert not any(
+            p.spec.node_name for p in store.list_pods()
+            if p.metadata.name.startswith("hi")
+        )
+        sched.stop()
+
+    def test_planner_never_proposes_pdb_covered_victims(self):
+        """One planned batch must not burn a PodDisruptionBudget: any
+        PDB-COVERED pod is excluded from planning outright (the exact
+        dry-run path owns violation counting)."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.types import ObjectMeta, PodDisruptionBudget
+        from kubernetes_tpu.scheduler.preemption_screen import (
+            build_victim_planner,
+        )
+        from kubernetes_tpu.scheduler.snapshot import Snapshot
+        from kubernetes_tpu.scheduler.types import NodeInfo
+
+        node = MakeNode().name("n0").capacity(
+            {"cpu": "4", "memory": "8Gi"}).obj()
+        ni = NodeInfo()
+        ni.set_node(node)
+        protected = MakePod().name("guard").uid("gu").priority(0) \
+            .label("app", "guarded").req({"cpu": "3"}).obj()
+        ni.add_pod(protected)
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            label_selector=LabelSelector(match_labels={"app": "guarded"}),
+        )
+        # budget LEFT — coverage alone excludes
+        pdb.status.disruptions_allowed = 5
+
+        class Snap:
+            def list(self):
+                return [ni]
+
+        planner = build_victim_planner(Snap(), pdbs=[pdb])
+        preemptor = MakePod().name("hi").uid("hu").priority(100) \
+            .req({"cpu": "3"}).obj()
+        assert planner.plan_group(preemptor, 1) == []
+
+
 class TestWarmup:
     def test_warmup_without_samples_compiles(self, caplog):
         """warmup() with no sample pods must encode+solve cleanly (not
