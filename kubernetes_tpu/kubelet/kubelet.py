@@ -87,6 +87,23 @@ class Kubelet:
         self.volumes = VolumeManager()
         self.probes = ProbeManager()
         self.heartbeat_fn = heartbeat_fn  # optional NodeLifecycle hookup
+        # container manager: QoS tiers + pod cgroups + node-allocatable
+        # admission (reference cm/container_manager_linux.go:210)
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.kubelet.cm import ContainerManager
+
+        self.container_manager = ContainerManager(
+            capacity_cpu_milli=int(parse_quantity(
+                self.capacity.get("cpu", "0")).milli_value()),
+            capacity_memory=int(parse_quantity(
+                self.capacity.get("memory", "0")).value()),
+        )
+        # PLEG: runtime relist → lifecycle events → dirty pods
+        # (reference pleg/generic.go:110; driven from the sync loop like
+        # syncLoopIteration's plegCh branch)
+        from kubernetes_tpu.kubelet.pleg import PLEG
+
+        self.pleg = PLEG(self.runtime, self._on_pleg_event)
         # optional node-pressure eviction (kubelet/eviction.py); attach
         # an EvictionManager and housekeeping drives synchronize()
         self.eviction_manager = None
@@ -165,6 +182,11 @@ class Kubelet:
             self._dirty.add(uid)
         self._work.set()
 
+    def _on_pleg_event(self, event) -> None:
+        """PLEG sink: a container state delta re-syncs its pod (the
+        reference's syncLoopIteration plegCh → HandlePodSyncs)."""
+        self._mark_dirty(event.pod_uid)
+
     def _sync_loop(self) -> None:
         while not self._stop.is_set():
             self._work.wait(timeout=self.sync_interval)
@@ -177,6 +199,12 @@ class Kubelet:
                     self.sync_pod(uid)
                 except Exception:
                     _logger.exception("sync_pod %s", uid)
+            try:
+                # runtime-truth pass: container crashes/exits surface
+                # here even when no API event fired
+                self.pleg.relist()
+            except Exception:
+                _logger.exception("pleg relist")
             self.probes.tick()
             if self.eviction_manager is not None:
                 try:
@@ -223,7 +251,16 @@ class Kubelet:
         self._reconcile_containers(pod)
 
     def _admit_and_start(self, pod: Pod) -> None:
-        # device admission first: unsatisfiable extended resources fail the
+        # node-allocatable admission (cm enforcement): a pod the
+        # scheduler raced past this node's allocatable fails here with
+        # an OutOf* reason, like the reference kubelet's admit handlers
+        reason = self.container_manager.admit(pod)
+        if reason is not None:
+            self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
+            self._terminal.add(pod.uid)
+            _logger.warning("pod %s rejected: %s", pod.full_name(), reason)
+            return
+        # device admission next: unsatisfiable extended resources fail the
         # pod rather than half-starting it. A checkpointed assignment from
         # a previous kubelet incarnation satisfies admission as-is — that
         # is the whole point of the device checkpoint.
@@ -242,6 +279,9 @@ class Kubelet:
             return
         self.volumes.mount_pod_volumes(pod)
         self._report_volumes_in_use(pod.uid, pod)
+        # pod cgroup under its QoS tier (podContainerManager
+        # EnsureExists before the sandbox starts)
+        self.container_manager.create_pod_cgroup(pod)
         sid = self.runtime.run_pod_sandbox(pod.uid, pod.name, pod.namespace)
         self._sandbox_of[pod.uid] = sid
         cids = {}
@@ -352,6 +392,7 @@ class Kubelet:
         self.devices.free(uid)
         self.volumes.unmount_pod_volumes(uid)
         self.probes.remove_pod(uid)
+        self.container_manager.delete_pod_cgroup(uid)
 
     def _set_ready_condition(self, pod: Pod, ready: bool) -> None:
         self.store.patch_pod_condition(

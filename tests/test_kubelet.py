@@ -460,3 +460,160 @@ class TestImageGC:
                        for i in store.get_node("gc1").status.images) <= 50
         finally:
             kl.stop()
+
+
+class TestContainerManager:
+    """QoS classes + cgroup tree (VERDICT r2 #10; reference
+    cm/container_manager_linux.go:210, qos.go GetPodQOS)."""
+
+    def test_qos_classification(self):
+        from kubernetes_tpu.kubelet.cm import (
+            BEST_EFFORT, BURSTABLE, GUARANTEED, pod_qos,
+        )
+
+        best_effort = MakePod().name("be").obj()
+        assert pod_qos(best_effort) == BEST_EFFORT
+        burstable = MakePod().name("bu").req({"cpu": "100m"}).obj()
+        assert pod_qos(burstable) == BURSTABLE
+        guaranteed = MakePod().name("g").req(
+            {"cpu": "500m", "memory": "1Gi"}).obj()
+        c = guaranteed.spec.containers[0]
+        c.resources.limits = dict(c.resources.requests)
+        assert pod_qos(guaranteed) == GUARANTEED
+        # limits != requests -> burstable
+        mixed = MakePod().name("m").req(
+            {"cpu": "500m", "memory": "1Gi"}).obj()
+        from kubernetes_tpu.api.resource import parse_quantity
+        mixed.spec.containers[0].resources.limits = {
+            "cpu": parse_quantity("1"), "memory": parse_quantity("1Gi"),
+        }
+        assert pod_qos(mixed) == BURSTABLE
+
+    def test_cgroup_tree_and_qos_tiers(self):
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.kubelet.cm import ContainerManager
+
+        cm = ContainerManager(capacity_cpu_milli=8000,
+                              capacity_memory=16 * 2**30)
+        bu = MakePod().name("bu").uid("bu1").req({"cpu": "500m"}).obj()
+        cm.create_pod_cgroup(bu)
+        g = MakePod().name("g").uid("g1").req(
+            {"cpu": "1", "memory": "1Gi"}).obj()
+        gc0 = g.spec.containers[0]
+        gc0.resources.limits = dict(gc0.resources.requests)
+        cm.create_pod_cgroup(g)
+        tree = cm.tree()
+        # guaranteed pod parents directly under /kubepods
+        assert "/kubepods/podg1" in tree
+        assert "/kubepods/burstable/podbu1" in tree
+        # cm/helpers_linux.go MilliCPUToShares / MilliCPUToQuota
+        assert tree["/kubepods/podg1"].cpu_shares == 1024
+        assert tree["/kubepods/podg1"].cpu_quota == 100_000
+        assert tree["/kubepods/podg1"].memory_limit == 2**30
+        # burstable tier shares track the sum of its pods' requests
+        assert tree["/kubepods/burstable"].cpu_shares == 512
+        cm.delete_pod_cgroup("bu1")
+        assert cm.tree()["/kubepods/burstable"].cpu_shares == 2
+        assert "/kubepods/burstable/podbu1" not in cm.tree()
+
+    def test_node_allocatable_admission(self):
+        from kubernetes_tpu.kubelet.cm import ContainerManager
+
+        cm = ContainerManager(capacity_cpu_milli=1000,
+                              capacity_memory=2**30)
+        ok = MakePod().name("a").uid("a1").req({"cpu": "800m"}).obj()
+        assert cm.admit(ok) is None
+        cm.create_pod_cgroup(ok)
+        over = MakePod().name("b").uid("b1").req({"cpu": "500m"}).obj()
+        reason = cm.admit(over)
+        assert reason is not None and "OutOfcpu" in reason
+
+    def test_kubelet_rejects_over_allocatable_pod(self):
+        import time as _time
+
+        store = ClusterStore()
+        kl = Kubelet(store, "cmn1", capacity={"cpu": "1", "memory": "1Gi",
+                                              "pods": "10"})
+        kl.start()
+        try:
+            store.create_pod(MakePod().name("fits").uid("f1").node("cmn1")
+                             .req({"cpu": "800m"}).obj())
+            deadline = _time.time() + 5
+            while _time.time() < deadline and \
+                    store.get_pod("default", "fits").status.phase != "Running":
+                _time.sleep(0.05)
+            assert store.get_pod("default", "fits").status.phase == "Running"
+            assert kl.container_manager.qos_of("f1") == "Burstable"
+            store.create_pod(MakePod().name("over").uid("o1").node("cmn1")
+                             .req({"cpu": "500m"}).obj())
+            deadline = _time.time() + 5
+            while _time.time() < deadline and \
+                    store.get_pod("default", "over").status.phase != "Failed":
+                _time.sleep(0.05)
+            assert store.get_pod("default", "over").status.phase == "Failed"
+        finally:
+            kl.stop()
+
+
+class TestPLEG:
+    def test_relist_generates_lifecycle_events(self):
+        from kubernetes_tpu.kubelet.cri import FakeRuntime
+        from kubernetes_tpu.kubelet.pleg import (
+            CONTAINER_DIED, CONTAINER_REMOVED, CONTAINER_STARTED, PLEG,
+        )
+
+        rt = FakeRuntime()
+        got = []
+        pleg = PLEG(rt, lambda ev: got.append((ev.type, ev.pod_uid)))
+        sid = rt.run_pod_sandbox("u1", "p1", "default")
+        cid = rt.create_container(sid, "c", "img")
+        pleg.relist()          # CREATED state: no events yet
+        assert got == []
+        rt.start_container(cid)
+        events = pleg.relist()
+        assert [(e.type, e.pod_uid) for e in events] == \
+            [(CONTAINER_STARTED, "u1")]
+        rt.stop_container(cid)
+        events = pleg.relist()
+        assert events[0].type == CONTAINER_DIED
+        rt.remove_container(cid)
+        events = pleg.relist()
+        assert events[0].type == CONTAINER_REMOVED
+        assert got and pleg.events_emitted == 3
+        assert pleg.healthy()
+
+    def test_pleg_drives_crash_restart(self):
+        """A container exiting in the RUNTIME (no API event) must be
+        observed by the PLEG relist and re-synced: restartPolicy Always
+        restarts it (the reference's crash-loop path runs through
+        plegCh, not the apiserver watch)."""
+        import time as _time
+
+        from kubernetes_tpu.kubelet.cri import FakeRuntime
+
+        store = ClusterStore()
+        rt = FakeRuntime()
+        kl = Kubelet(store, "plegn1", runtime=rt,
+                     capacity={"cpu": "4", "memory": "8Gi", "pods": "10"})
+        kl.start()
+        try:
+            store.create_pod(MakePod().name("p").uid("pu").node("plegn1")
+                             .req({"cpu": "100m"}).obj())
+            deadline = _time.time() + 5
+            while _time.time() < deadline and not kl._containers_of.get("pu"):
+                _time.sleep(0.05)
+            cid = list(kl._containers_of["pu"].values())[0]
+            # kill the container BEHIND the kubelet's back
+            rt.stop_container(cid)
+            deadline = _time.time() + 5
+            restarted = False
+            while _time.time() < deadline:
+                st = rt.container_status(cid)
+                if st is not None and st.state == "RUNNING" and \
+                        st.restarts >= 1:
+                    restarted = True
+                    break
+                _time.sleep(0.05)
+            assert restarted, "PLEG did not drive the crash restart"
+        finally:
+            kl.stop()
