@@ -59,7 +59,11 @@ EXTRA_MATRIX = {
     # measured pods schedule around them
     "unschedulable": ("Unschedulable", 5000, 1000, 10000),
     "mixed": ("MixedSchedulingBasePod", 5000, 1000, 30000),
+    # the PV families ride the batch path since round 3 (bound-claim
+    # masks + attach columns); all three recorded to show the breadth
     "csipvs": ("SchedulingCSIPVs", 1000, 0, 5000),
+    "intreepvs": ("SchedulingInTreePVs", 1000, 0, 5000),
+    "migratedpvs": ("SchedulingMigratedInTreePVs", 1000, 0, 5000),
 }
 
 

@@ -60,13 +60,33 @@ _KIND_ALIASES = {
     "horizontalpodautoscaler": "HorizontalPodAutoscaler",
     "horizontalpodautoscalers": "HorizontalPodAutoscaler",
     "endpointslice": "EndpointSlice", "endpointslices": "EndpointSlice",
+    "secret": "Secret", "secrets": "Secret",
+    "cm": "ConfigMap", "configmap": "ConfigMap", "configmaps": "ConfigMap",
+    "csr": "CertificateSigningRequest",
+    "certificatesigningrequest": "CertificateSigningRequest",
+    "certificatesigningrequests": "CertificateSigningRequest",
+    "role": "Role", "roles": "Role",
+    "clusterrole": "ClusterRole", "clusterroles": "ClusterRole",
+    "rolebinding": "RoleBinding", "rolebindings": "RoleBinding",
+    "clusterrolebinding": "ClusterRoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
+    "crd": "CustomResourceDefinition",
+    "crds": "CustomResourceDefinition",
+    "customresourcedefinition": "CustomResourceDefinition",
+    "customresourcedefinitions": "CustomResourceDefinition",
+    "mutatingwebhookconfiguration": "MutatingWebhookConfiguration",
+    "mutatingwebhookconfigurations": "MutatingWebhookConfiguration",
+    "validatingwebhookconfiguration": "ValidatingWebhookConfiguration",
+    "validatingwebhookconfigurations": "ValidatingWebhookConfiguration",
 }
 
 
 def _resolve_kind(token: str) -> str:
     kind = _KIND_ALIASES.get(token.lower())
     if kind is None:
-        raise SystemExit(f"error: the server doesn't have a resource type {token!r}")
+        # CRD-registered kinds: pass through plural/kind tokens as-is —
+        # the server resolves live registrations ("Widget"/"widgets")
+        return token if token[:1].isupper() else token.rstrip("s").title()
     return kind
 
 
