@@ -1,0 +1,188 @@
+"""Versioned API machinery: the runtime.Scheme analog (reference
+``staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go`` + the
+generated per-version conversion/defaulting in ``pkg/apis/<group>``).
+
+The reference's model is hub-and-spoke: every group has an INTERNAL
+(hub) type; each served VERSION registers defaulting (applied on
+decode, before conversion) and a pair of conversion functions
+(versioned wire ↔ internal). This module carries the same model over
+the wire-dict representation: the internal hub is the typed dataclass
+scheme (``api/serialization.py``), spokes are wire-shape transforms.
+
+Registered spokes (the demonstration group, mirroring upstream's most
+visibly version-split API):
+
+- ``autoscaling/v1`` HorizontalPodAutoscaler — flat
+  ``targetCpuUtilizationPercentage`` (the internal hub shape),
+- ``autoscaling/v2`` HorizontalPodAutoscaler — the ``metrics`` list
+  with Resource/Utilization targets, converted losslessly to/from the
+  hub for the cpu-utilization metric the controller consumes.
+
+New versions register at runtime (``SCHEME_V.register_version``) — the
+same extension point the reference's scheme builders use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from kubernetes_tpu.api.serialization import from_wire, to_wire
+
+INTERNAL_VERSION = "v1"  # the hub (legacy core routes serve it directly)
+
+Defaulter = Callable[[Dict[str, Any]], None]
+Converter = Callable[[Dict[str, Any]], Dict[str, Any]]
+
+
+class VersionedScheme:
+    """Registry of (apiVersion, kind) spokes around the internal hub."""
+
+    def __init__(self):
+        # (api_version, kind) -> (defaulter, to_internal, from_internal)
+        self._spokes: Dict[
+            Tuple[str, str],
+            Tuple[Optional[Defaulter], Converter, Converter],
+        ] = {}
+
+    def register_version(
+        self,
+        api_version: str,
+        kind: str,
+        to_internal: Converter,
+        from_internal: Converter,
+        defaulter: Optional[Defaulter] = None,
+    ) -> None:
+        self._spokes[(api_version, kind)] = (
+            defaulter, to_internal, from_internal,
+        )
+
+    def kinds_for(self, api_version: str):
+        return [k for (v, k) in self._spokes if v == api_version]
+
+    def recognizes(self, api_version: str, kind: str) -> bool:
+        return api_version == INTERNAL_VERSION or \
+            (api_version, kind) in self._spokes
+
+    # -- decode/encode --------------------------------------------------
+    def decode(self, body: Dict[str, Any], kind: str,
+               api_version: str) -> Any:
+        """Versioned wire dict → internal typed object: defaulting
+        (versioned), then conversion to the hub, then the typed decode
+        (reference codec DecodeToVersion → default → convert)."""
+        if api_version != INTERNAL_VERSION:
+            spoke = self._spokes.get((api_version, kind))
+            if spoke is None:
+                raise TypeError(
+                    f"no kind {kind!r} registered in {api_version!r}"
+                )
+            defaulter, to_internal, _ = spoke
+            body = dict(body)
+            if defaulter is not None:
+                defaulter(body)
+            body = to_internal(body)
+        return from_wire(body, kind)
+
+    def encode(self, obj: Any, api_version: str) -> Dict[str, Any]:
+        """Internal typed object → versioned wire dict."""
+        d = to_wire(obj)
+        if api_version == INTERNAL_VERSION:
+            return d
+        kind = d.get("kind", "")
+        spoke = self._spokes.get((api_version, kind))
+        if spoke is None:
+            raise TypeError(
+                f"no kind {kind!r} registered in {api_version!r}"
+            )
+        _, _, from_internal = spoke
+        out = from_internal(d)
+        out["apiVersion"] = api_version
+        out["kind"] = kind
+        return out
+
+
+# ---------------------------------------------------------------------------
+# autoscaling/v2 spoke for HorizontalPodAutoscaler
+
+
+def _hpa_v2_defaults(d: Dict[str, Any]) -> None:
+    """v2 defaulting (reference pkg/apis/autoscaling/v2/defaults.go):
+    minReplicas defaults to 1; an absent metrics list defaults to 80%
+    cpu utilization."""
+    spec = d.setdefault("spec", {}) if "spec" in d else d
+    if spec.get("minReplicas") is None:
+        spec["minReplicas"] = 1
+    if not spec.get("metrics"):
+        spec["metrics"] = [{
+            "type": "Resource",
+            "resource": {
+                "name": "cpu",
+                "target": {"type": "Utilization",
+                           "averageUtilization": 80},
+            },
+        }]
+
+
+def _hpa_v2_to_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    """v2 → hub (reference pkg/apis/autoscaling/v2/conversion.go):
+    the cpu Resource/Utilization metric folds back into the flat
+    targetCpuUtilizationPercentage field."""
+    out = {k: v for k, v in d.items()
+           if k not in ("metrics", "spec", "apiVersion")}
+    src = d.get("spec", d)
+    for key in ("scaleTargetRef", "minReplicas", "maxReplicas"):
+        if key in src:
+            out[key] = src[key]
+    for m in src.get("metrics") or []:
+        res = m.get("resource") or {}
+        target = res.get("target") or {}
+        if (
+            m.get("type") == "Resource" and res.get("name") == "cpu"
+            and target.get("type") == "Utilization"
+        ):
+            out["targetCpuUtilizationPercentage"] = \
+                target.get("averageUtilization", 80)
+            break
+    return out
+
+
+def _hpa_v2_from_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: v for k, v in d.items() if k not in (
+        "scaleTargetRef", "minReplicas", "maxReplicas",
+        "targetCpuUtilizationPercentage", "apiVersion", "kind",
+    )}
+    out["spec"] = {
+        "scaleTargetRef": d.get("scaleTargetRef") or {},
+        "minReplicas": d.get("minReplicas", 1),
+        "maxReplicas": d.get("maxReplicas", 1),
+        "metrics": [{
+            "type": "Resource",
+            "resource": {
+                "name": "cpu",
+                "target": {
+                    "type": "Utilization",
+                    "averageUtilization": d.get(
+                        "targetCpuUtilizationPercentage", 80),
+                },
+            },
+        }],
+    }
+    return out
+
+
+def _hpa_v1_identity(d: Dict[str, Any]) -> Dict[str, Any]:
+    # autoscaling/v1 IS the hub shape; conversion is a relabel
+    return {k: v for k, v in d.items() if k != "apiVersion"}
+
+
+SCHEME_V = VersionedScheme()
+SCHEME_V.register_version(
+    "autoscaling/v1", "HorizontalPodAutoscaler",
+    to_internal=_hpa_v1_identity,
+    from_internal=lambda d: dict(d),
+)
+SCHEME_V.register_version(
+    "autoscaling/v2", "HorizontalPodAutoscaler",
+    to_internal=_hpa_v2_to_internal,
+    from_internal=_hpa_v2_from_internal,
+    defaulter=_hpa_v2_defaults,
+)

@@ -207,6 +207,18 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    # -- versioned codec (scheme hub-and-spoke) ------------------------
+    def _decode(self, body: Dict, kind: str) -> Any:
+        from kubernetes_tpu.api.scheme import SCHEME_V
+
+        return SCHEME_V.decode(body, kind,
+                               getattr(self, "_api_version", "v1"))
+
+    def _encode(self, obj: Any) -> Dict:
+        from kubernetes_tpu.api.scheme import SCHEME_V
+
+        return SCHEME_V.encode(obj, getattr(self, "_api_version", "v1"))
+
     # -- authn/authz ---------------------------------------------------
     def _user(self) -> str:
         auth = self.headers.get("Authorization") or ""
@@ -223,11 +235,36 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routing -------------------------------------------------------
     def _route(self) -> Tuple[Optional[str], Optional[str], Optional[str], Optional[str], Dict]:
-        """→ (kind, namespace, name, subresource, query)"""
+        """→ (kind, namespace, name, subresource, query). Also resolves
+        the request's apiVersion into ``self._api_version``: the legacy
+        core path ``/api/v1`` serves the internal hub shape; group
+        routes ``/apis/<group>/<version>`` serve versioned spokes
+        through the scheme's conversion/defaulting (reference
+        InstallLegacyAPI vs InstallAPIs, ``pkg/controlplane/
+        instance.go:547,580``)."""
+        from kubernetes_tpu.api.scheme import SCHEME_V
+
         u = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(u.query).items()}
         parts = [p for p in u.path.split("/") if p]
-        # /api/v1/... only
+        self._api_version = "v1"
+        if len(parts) >= 3 and parts[0] == "apis":
+            api_version = f"{parts[1]}/{parts[2]}"
+            rest = parts[3:]
+            ns: Optional[str] = None
+            if rest and rest[0] == "namespaces" and len(rest) >= 2:
+                ns = rest[1]
+                rest = rest[2:]
+            if not rest:
+                return None, ns, None, None, q
+            kind = PLURALS.get(rest[0])
+            if kind is None or not SCHEME_V.recognizes(api_version, kind):
+                return None, None, None, None, q
+            self._api_version = api_version
+            name = rest[1] if len(rest) >= 2 else None
+            sub = rest[2] if len(rest) >= 3 else None
+            return kind, ns, name, sub, q
+        # legacy core: /api/v1/...
         if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
             return None, None, None, None, q
         rest = parts[2:]
@@ -303,7 +340,7 @@ class _Handler(BaseHTTPRequestHandler):
             if obj is None:
                 self._send_error(404, "NotFound", f"{kind} {name!r} not found")
                 return
-            self._send_json(200, to_wire(obj))
+            self._send_json(200, self._encode(obj))
             return
         # list + RV atomically: a watch from this RV misses nothing
         objs, rv = store.list_objects_with_rv(kind, ns)
@@ -313,7 +350,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "kind": f"{kind}List",
                 "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(rv)},
-                "items": [to_wire(o) for o in objs],
+                "items": [self._encode(o) for o in objs],
             },
         )
 
@@ -381,7 +418,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(403, "Forbidden", str(e))
             return
         try:
-            obj = from_wire(body, kind)
+            obj = self._decode(body, kind)
         except (ValueError, TypeError) as e:
             # decode failure (bad quantity, wrong shape) is the client's
             # fault — 400, never the store-conflict 409
@@ -425,7 +462,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if allocated_ip is not None:
                     self.server.ip_allocator.release(allocated_ip)
                 raise
-            self._send_json(201, to_wire(created))
+            self._send_json(201, self._encode(created))
         except AdmissionError as e:
             # admission.run already unwound its own plugins' charges
             self._send_error(422, "Invalid", str(e))
@@ -472,7 +509,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(403, "Forbidden", str(e))
             return
         try:
-            obj = from_wire(body, kind)
+            obj = self._decode(body, kind)
         except (ValueError, TypeError) as e:
             self._send_error(400, "BadRequest", str(e))
             return
@@ -506,7 +543,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
             expect = body.get("metadata", {}).get("resourceVersion") or None
             updated = store.update_object(kind, obj, expect_rv=expect)
-            self._send_json(200, to_wire(updated))
+            self._send_json(200, self._encode(updated))
         except AdmissionError as e:
             self._send_error(422, "Invalid", str(e))
         except ConflictError as e:
