@@ -1,2 +1,3 @@
+from kubernetes_tpu.proxy.dataplane import VirtualDataplane
 from kubernetes_tpu.proxy.ipallocator import IPAllocator, IPAllocatorFull
 from kubernetes_tpu.proxy.proxier import Proxier, Rule
