@@ -552,6 +552,124 @@ class TestPreemptionEquivalence:
             _assert_valid(bound, store)
 
 
+class TestPreemptionPlannerEquivalence:
+    """Round-3 victim planner under HETEROGENEOUS contention: mixed
+    victim sizes and priorities, PDB-covered pods the planner must
+    never evict, and preemptors needing MULTI-victim sets — on both
+    paths, with invariants on who died."""
+
+    @pytest.mark.parametrize("seed", [17, 43])
+    def test_mixed_priority_preemption(self, seed):
+        from kubernetes_tpu.api.types import (
+            ObjectMeta, PodDisruptionBudget,
+        )
+        from kubernetes_tpu.api.labels import LabelSelector
+
+        for mode in ("serial", "batch"):
+            rng = random.Random(seed)
+            nodes = _random_cluster(rng, 30, taints=False)
+            store = ClusterStore()
+            for n in nodes:
+                store.add_node(n)
+            # mixed fillers: priorities 0/10/50, sizes 1-2 cpu; a
+            # PDB-protected subset that must survive
+            fillers = []
+            for i, n in enumerate(nodes):
+                cap = int(n.status.allocatable["cpu"].milli_value()) // 1000
+                used = 0
+                j = 0
+                while used + 1 <= cap:
+                    size = rng.choice([1, 1, 2])
+                    if used + size > cap:
+                        size = 1
+                    prio = rng.choice([0, 0, 10, 50])
+                    protected = rng.random() < 0.1
+                    w = (MakePod().name(f"f{i:02d}-{j}")
+                         .uid(f"fu{i}-{j}")
+                         .label("app", "protected" if protected else "low")
+                         .priority(prio)
+                         .req({"cpu": str(size), "memory": "64Mi"}))
+                    fillers.append(w.obj())
+                    used += size
+                    j += 1
+            pdb = PodDisruptionBudget(
+                metadata=ObjectMeta(name="guard", namespace="default"),
+                label_selector=LabelSelector(
+                    match_labels={"app": "protected"}),
+            )
+            pdb.status.disruptions_allowed = 0
+            store.add_pdb(pdb)
+            use_batch = mode == "batch"
+            sched = Scheduler.create(store, feature_gates=FeatureGates(
+                {"TPUBatchScheduler": use_batch}))
+            bs = attach_batch_scheduler(sched, max_batch=128) \
+                if use_batch else None
+            sched.start()
+            try:
+                store.create_pods(fillers)
+                _pump(sched, bs)
+                protected_before = {
+                    p.metadata.name for p in store.list_pods()
+                    if p.metadata.labels.get("app") == "protected"
+                }
+                # 40 high-priority preemptors needing 2 cpu each
+                # (multi-victim sets where fillers are 1-cpu)
+                high = [
+                    MakePod().name(f"high{i:02d}").uid(f"hi{i}")
+                    .label("app", "high").priority(1000)
+                    .req({"cpu": "2", "memory": "64Mi"}).obj()
+                    for i in range(40)
+                ]
+                store.create_pods(high)
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    sched.queue.flush_backoff_completed()
+                    if bs is not None:
+                        if bs.run_batch(pop_timeout=0.0) or bs.flush():
+                            continue
+                    else:
+                        sched.schedule_one(pop_timeout=0.0)
+                    n_high = sum(
+                        1 for p in store.list_pods()
+                        if p.metadata.labels.get("app") == "high"
+                        and p.spec.node_name
+                    )
+                    if n_high == 40:
+                        break
+                    time.sleep(0.005)
+                sched.wait_for_inflight_bindings()
+                n_high = sum(
+                    1 for p in store.list_pods()
+                    if p.metadata.labels.get("app") == "high"
+                    and p.spec.node_name
+                )
+                assert n_high == 40, (
+                    f"seed {seed} {mode}: {n_high}/40 preemptors bound"
+                )
+                # PDB-protected pods all survived on both paths
+                protected_after = {
+                    p.metadata.name for p in store.list_pods()
+                    if p.metadata.labels.get("app") == "protected"
+                }
+                assert protected_after == protected_before, (
+                    f"seed {seed} {mode}: PDB-protected pods evicted: "
+                    f"{sorted(protected_before - protected_after)}"
+                )
+                # only priority < 1000 pods may have vanished
+                assert all(
+                    p.metadata.labels.get("app") != "high" or
+                    p.spec.node_name
+                    for p in store.list_pods()
+                )
+                bound = {
+                    p.metadata.name: p.spec.node_name
+                    for p in store.list_pods() if p.spec.node_name
+                }
+                _assert_valid(bound, store)
+            finally:
+                sched.stop()
+
+
 class TestUnschedulableEquivalence:
     """Deterministically-impossible pods must be declined by BOTH paths
     (and by the device's mass-decline fast path), never bound."""
