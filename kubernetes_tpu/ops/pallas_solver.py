@@ -151,6 +151,38 @@ def prepare(cluster: EncodedCluster, batch: EncodedBatch,
          batch.sc_hard.astype(np.int32)]
     )                                                         # [2, SC]
 
+    put = jax.device_put if device else (lambda a: a)
+    pstatic = PStatic(
+        ints=put(_to_planes(ints, nb)),
+        f32s=put(_to_planes(batch.static_scores.astype(np.float32), nb)),
+        sc_meta=put(sc_meta),
+        r=r, sc=scn, t=tn, u=u, v=v, nb=nb,
+    )
+    pstate = prepare_state(cluster, batch, device=device)
+    return pstatic, pstate
+
+
+def prepare_state(cluster: EncodedCluster, batch: EncodedBatch,
+                  device: bool = True) -> PState:
+    """The DYNAMIC half of ``prepare`` alone: per-node requested /
+    nonzero / pod-count planes plus the topology/affinity count planes.
+    Used by the session's state-only rebuild — after self-inflicted
+    cache mutations whose static planes are bit-identical (e.g. mass
+    preemption: victims change only the dynamic state), re-uploading
+    just these planes skips the static upload and its host packing."""
+    n = cluster.allocatable.shape[0]
+    nb = n // LANES
+    r = cluster.allocatable.shape[1]
+    scn = batch.sc_counts.shape[0]
+    tn = batch.term_counts.shape[0]
+    v = batch.num_values
+    sc_codes = np.minimum(
+        cluster.topo_codes[:, batch.sc_key_idx].T, v
+    ).astype(np.int32)
+    term_codes = np.minimum(
+        cluster.topo_codes[:, batch.term_key_idx].T, v
+    ).astype(np.int32)
+
     # dynamic state: counts translated to the per-node representation
     do, cd = _state_planes(r, scn, tn)
     planes = np.zeros((cd, n), dtype=np.int32)
@@ -176,14 +208,7 @@ def prepare(cluster: EncodedCluster, batch: EncodedBatch,
     planes[do["totals"]] = totals
 
     put = jax.device_put if device else (lambda a: a)
-    pstatic = PStatic(
-        ints=put(_to_planes(ints, nb)),
-        f32s=put(_to_planes(batch.static_scores.astype(np.float32), nb)),
-        sc_meta=put(sc_meta),
-        r=r, sc=scn, t=tn, u=u, v=v, nb=nb,
-    )
-    pstate = PState(planes=put(_to_planes(planes, nb)))
-    return pstatic, pstate
+    return PState(planes=put(_to_planes(planes, nb)))
 
 
 # ----------------------------------------------------------------------
@@ -872,6 +897,9 @@ class XlaPlanesBackend:
     def prepare(self, cluster, batch):
         return prepare(cluster, batch)
 
+    def prepare_state_only(self, cluster, batch):
+        return prepare_state(cluster, batch)
+
     def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
         """Dispatch the solve; the returned assignments handle is a
         device array the caller materializes later (jax dispatch is
@@ -919,6 +947,9 @@ class PallasBackend:
 
     def prepare(self, cluster, batch):
         return prepare(cluster, batch)
+
+    def prepare_state_only(self, cluster, batch):
+        return prepare_state(cluster, batch)
 
     def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
         """Async-dispatched solve; materialize the handle later."""

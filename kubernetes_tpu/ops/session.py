@@ -152,6 +152,7 @@ class SolverSession:
         self._cluster: Optional[EncodedCluster] = None
         self._static = None   # device-resident solve-invariant arrays
         self._state = None    # device-resident dynamic state (carried)
+        self._static_fp = None  # fingerprint of the resident static
         # host-side static predicate masks + the last batch's per-pod
         # profile indices: lets the sidecar synthesize per-node filter
         # statuses for device-declined pods without a serial re-run
@@ -167,6 +168,7 @@ class SolverSession:
         # telemetry: how often the incremental path was taken
         self.incremental_hits = 0
         self.rebuilds = 0
+        self.state_only_rebuilds = 0
         # optional device profiling (SURVEY.md section 5: JAX profiler /
         # xplane dumps per solve batch): KTPU_PROFILE_DIR starts a trace
         # at the first non-warming solve and stops it after
@@ -272,6 +274,32 @@ class SolverSession:
         # the caller just committed any in-flight batch anyway)
         return self._rebuild_and_solve(pods, seq_before, pad)
 
+    # inputs whose equality makes the packed STATIC planes bit-identical
+    _STATIC_FP_CLUSTER = ("allocatable", "max_pods", "topo_codes")
+    _STATIC_FP_BATCH = (
+        "static_masks", "static_scores", "sc_key_idx", "sc_max_skew",
+        "sc_hard", "sc_domain", "term_key_idx",
+    )
+
+    def _static_fingerprint(self, cluster, batch):
+        return (
+            [np.asarray(getattr(cluster, k))
+             for k in self._STATIC_FP_CLUSTER]
+            + [np.asarray(getattr(batch, k))
+               for k in self._STATIC_FP_BATCH],
+            (cluster.resource_names, batch.num_values,
+             cluster.num_real_nodes),
+        )
+
+    @staticmethod
+    def _fingerprints_equal(a, b) -> bool:
+        if a is None or b is None or a[1] != b[1]:
+            return False
+        return all(
+            x.shape == y.shape and np.array_equal(x, y)
+            for x, y in zip(a[0], b[0])
+        )
+
     def _rebuild_and_solve(self, pods: List, seq_before: int,
                            pad: Optional[int] = None):
         if not self._warming:
@@ -292,15 +320,44 @@ class SolverSession:
         self.last_inexpressible = batch.inexpressible
         ints, floats = pack_podin(batch)
         self._observe("encode", time.monotonic() - t0)
-        from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
-        # a demoted backend earns retries of the preferred one: transient
-        # device errors (tunnel flakes) must not pin the session to a
-        # slower backend for its whole lifetime
+        # a demoted backend earns retries of the preferred one FIRST —
+        # the state-only fast path below must not starve the cooldown
+        # (transient device errors would pin the slower backend forever)
         if self.backend is not self._preferred:
             self._demote_cooldown -= 1
             if self._demote_cooldown <= 0:
                 self.backend = self._preferred
+
+        # state-only rebuild: when the mutation that invalidated the
+        # mirror touched only DYNAMIC state (mass preemption's victim
+        # deletions, serial binds), the packed static planes are
+        # bit-identical to the resident ones — re-upload just the state
+        # planes and keep the device-resident static (halves the
+        # per-round host→device traffic on the rebuild-heavy paths)
+        fp = self._static_fingerprint(cluster, batch)
+        if (
+            self._static is not None
+            and self._active is self.backend
+            and hasattr(self._active, "prepare_state_only")
+            and self._fingerprints_equal(fp, self._static_fp)
+        ):
+            try:
+                t0 = time.monotonic()
+                state = self._active.prepare_state_only(cluster, batch)
+                out, self._state = self._active.solve(
+                    self.params, self._static, state, ints, floats
+                )
+                self.last_materializer = None
+                self._observe("device", time.monotonic() - t0)
+                self._last_seq = seq_before
+                if not self._warming:
+                    self.state_only_rebuilds += 1
+                return out, cluster, seq_before
+            except Exception:  # noqa: BLE001 — fall back to full rebuild
+                _logger.exception("state-only rebuild failed; full path")
+        self._static_fp = fp
+        from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
         # solve chain (clean-fallback contract, like an IsIgnorable
         # extender): preferred backend when the space fits it, then the
