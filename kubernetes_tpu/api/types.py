@@ -892,6 +892,11 @@ class CronJob:
     completions: int = 1
     parallelism: int = 1
     ttl_seconds_after_finished: Optional[int] = None
+    # a missed fire older than this is skipped entirely (reference
+    # spec.startingDeadlineSeconds, cronjob/utils.go
+    # getRecentUnmetScheduleTimes earliestTime clamp)
+    starting_deadline_seconds: Optional[float] = None
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
     last_schedule_time: Optional[float] = None  # status
 
     @property

@@ -119,6 +119,26 @@ class CronJobController(Controller):
     def now(self) -> float:
         return time.time()
 
+    def _active_jobs(self, ns: str, cj: CronJob):
+        """Unfinished Jobs owned by this CronJob (syncOne's activeList)."""
+        out = []
+        for job in self.store.list_jobs():
+            if job.namespace != ns:
+                continue
+            if not any(
+                r.get("kind") == "CronJob"
+                and r.get("uid") == cj.metadata.uid
+                for r in job.metadata.owner_references
+            ):
+                continue
+            finished = (
+                job.status.succeeded >= job.completions
+                or job.status.failed > 0
+            )
+            if not finished:
+                out.append(job)
+        return out
+
     def sync(self, key: str) -> None:
         ns, name = split_key(key)
         cj = self.store.get_cron_job(ns, name)
@@ -139,6 +159,26 @@ class CronJobController(Controller):
             if nxt is None or nxt > now:
                 break
             due = nxt
+        # startingDeadlineSeconds (cronjob/utils.go earliestTime clamp +
+        # syncOne "Missed starting window"): a fire older than the
+        # deadline is skipped — last_schedule_time still advances so the
+        # stale fire never retries
+        if cj.starting_deadline_seconds is not None and \
+                now - due > cj.starting_deadline_seconds:
+            updated = shallow_copy(cj)
+            updated.last_schedule_time = due
+            self.store.add_cron_job(updated)
+            return
+        # concurrencyPolicy (syncOne): Forbid skips the fire while a
+        # previous Job still runs (WITHOUT advancing last_schedule_time,
+        # so the fire retries until it runs or falls past the deadline);
+        # Replace deletes the running Jobs first
+        if cj.concurrency_policy in ("Forbid", "Replace"):
+            active = self._active_jobs(ns, cj)
+            if active and cj.concurrency_policy == "Forbid":
+                return
+            for job in active:
+                self.store.delete_object("Job", ns, job.name)
         job_name = f"{name}-{int(due) // 60}"
         if self.store.get_job(ns, job_name) is None:
             self.store.add_job(Job(

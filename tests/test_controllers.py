@@ -1317,3 +1317,77 @@ def test_clusterrole_aggregation_unions_rules():
         )
     finally:
         cm.stop()
+
+
+def test_cronjob_starting_deadline_skips_stale_fires():
+    from kubernetes_tpu.api.types import CronJob, ObjectMeta
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["cronjob"])
+    try:
+        # hourly schedule; "now" pinned 10 min past the hour, deadline
+        # 60s: the missed top-of-hour fire is skipped, pointer advances
+        now = (int(time.time()) // 3600) * 3600 + 600
+        store.add_cron_job(CronJob(
+            metadata=ObjectMeta(
+                name="stale", namespace="default",
+                creation_timestamp=now - 2 * 3600,
+            ),
+            schedule="0 * * * *",
+            starting_deadline_seconds=60.0,
+            job_template={"spec": {"containers": [{"name": "c"}]}},
+        ))
+        ctrl = cm.get("cronjob")
+        ctrl.now = lambda: now
+        # drive sync directly (no threads): the stale fire must be
+        # skipped without creating a Job
+        ctrl.sync("default/stale")
+        assert store.list_jobs() == []
+        cj = store.get_cron_job("default", "stale")
+        assert cj.last_schedule_time == now - 600  # pointer advanced
+    finally:
+        cm.stop()
+
+
+def test_cronjob_concurrency_forbid_and_replace():
+    from kubernetes_tpu.api.types import CronJob, ObjectMeta
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["cronjob"])
+    try:
+        ctrl = cm.get("cronjob")
+        store.add_cron_job(CronJob(
+            metadata=ObjectMeta(
+                name="fb", namespace="default",
+                creation_timestamp=time.time() - 120,
+            ),
+            schedule="* * * * *",
+            concurrency_policy="Forbid",
+            job_template={"spec": {"containers": [{"name": "c"}]}},
+        ))
+        ctrl.sync("default/fb")
+        jobs = [j for j in store.list_jobs()
+                if j.metadata.name.startswith("fb-")]
+        assert len(jobs) == 1
+        first = jobs[0].metadata.name
+        # next fire due while the first Job is still active: Forbid
+        # skips WITHOUT advancing the pointer
+        cj = store.get_cron_job("default", "fb")
+        before = cj.last_schedule_time
+        ctrl.now = lambda: before + 61  # one minute later
+        ctrl.sync("default/fb")
+        jobs = [j for j in store.list_jobs()
+                if j.metadata.name.startswith("fb-")]
+        assert [j.metadata.name for j in jobs] == [first]
+        assert store.get_cron_job("default", "fb").last_schedule_time \
+            == before
+        # Replace: the active Job dies, the new fire runs
+        cj = store.get_cron_job("default", "fb")
+        cj.concurrency_policy = "Replace"
+        store.add_cron_job(cj)
+        ctrl.sync("default/fb")
+        jobs = [j for j in store.list_jobs()
+                if j.metadata.name.startswith("fb-")]
+        assert len(jobs) == 1 and jobs[0].metadata.name != first
+    finally:
+        cm.stop()
