@@ -50,6 +50,12 @@ class HorizontalPodAutoscalerController(Controller):
 
     RESYNC_SECONDS = 1.0  # reference --horizontal-pod-autoscaler-sync-period
     #                       is 15s; scaled for the harness
+    # reference --horizontal-pod-autoscaler-downscale-stabilization (5m
+    # upstream; scaled for the harness, injectable in tests): a
+    # downscale only applies the HIGHEST recommendation of the window,
+    # so a brief utilization dip can't flap replicas away
+    # (horizontal.go stabilizeRecommendation)
+    DOWNSCALE_STABILIZATION_SECONDS = 5.0
 
     metrics_provider = AnnotationMetricsProvider()
 
@@ -148,6 +154,7 @@ class HorizontalPodAutoscalerController(Controller):
             # still-hot average would compound the scale every tick
             desired = math.ceil((len(ratios) + missing) * scale_ratio)
         desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        desired = self._stabilize(f"{ns}/{name}", current, desired)
         if desired != current:
             updated = shallow_copy(target)
             updated.metadata = shallow_copy(target.metadata)
@@ -155,6 +162,25 @@ class HorizontalPodAutoscalerController(Controller):
             self.store.update_object(kind, updated)
         self._publish(hpa, current, desired, int(round(utilization)),
                       scaled=desired != current)
+
+    def _stabilize(self, key: str, current: int, desired: int) -> int:
+        """horizontal.go stabilizeRecommendation: record every
+        recommendation; a DOWNSCALE is clamped to the maximum
+        recommendation still inside the stabilization window (upscales
+        apply immediately)."""
+        now = time.time()
+        window = self.DOWNSCALE_STABILIZATION_SECONDS
+        if not hasattr(self, "_recommendations"):
+            self._recommendations = {}
+        hist = self._recommendations.setdefault(key, [])
+        hist.append((now, desired))
+        del hist[: max(0, len(hist) - 64)]  # bounded memory
+        if desired >= current:
+            return desired
+        floor = max(
+            (d for t, d in hist if now - t <= window), default=desired
+        )
+        return min(current, max(desired, floor))
 
     def _publish(self, hpa, current: int, desired: int,
                  utilization: Optional[int], scaled: bool = False) -> None:

@@ -1391,3 +1391,55 @@ def test_cronjob_concurrency_forbid_and_replace():
         assert len(jobs) == 1 and jobs[0].metadata.name != first
     finally:
         cm.stop()
+
+
+def test_hpa_downscale_stabilization_window():
+    """A brief utilization dip must not flap replicas down: downscales
+    clamp to the window's highest recommendation
+    (horizontal.go stabilizeRecommendation)."""
+    from kubernetes_tpu.api.types import HorizontalPodAutoscaler, ObjectMeta
+    from kubernetes_tpu.controllers.horizontalpodautoscaler import (
+        USAGE_ANNOTATION,
+    )
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["horizontalpodautoscaler"])
+    ctrl = cm.get("horizontalpodautoscaler")
+    ctrl.DOWNSCALE_STABILIZATION_SECONDS = 3600.0  # effectively forever
+    cm.start()
+    try:
+        rs = _rs("web", 4)
+        store.add_replica_set(rs)
+        for i in range(4):
+            p = MakePod().name(f"w{i}").uid(f"wu{i}") \
+                .label("app", "web").req({"cpu": "1"}).obj()
+            p.metadata.annotations[USAGE_ANNOTATION] = "900"  # hot: 90%
+            p.metadata.owner_references = [{
+                "kind": "ReplicaSet", "name": "web",
+                "uid": rs.metadata.uid, "controller": True,
+            }]
+            store.create_pod(p)
+        store.add_hpa(HorizontalPodAutoscaler(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            scale_target_ref={"kind": "ReplicaSet", "name": "web"},
+            min_replicas=1, max_replicas=8,
+            target_cpu_utilization_percentage=50,
+        ))
+        # hot fleet: scaled UP immediately (stabilization is downscale-only)
+        _wait(lambda: store.get_replica_set("default", "web").replicas == 8,
+              msg="scale up to 8")
+        # fleet goes idle: the downscale recommendation is clamped by
+        # the window's max recommendation (8) -> stays at 8
+        for i in range(4):
+            p = store.get_pod("default", f"w{i}")
+            p.metadata.annotations[USAGE_ANNOTATION] = "10"
+            store.update_pod(p)
+        time.sleep(2.5)  # several resync ticks
+        assert store.get_replica_set("default", "web").replicas == 8
+        # with no stabilization, the same dip scales down at once
+        ctrl.DOWNSCALE_STABILIZATION_SECONDS = 0.0
+        ctrl._recommendations.clear()
+        _wait(lambda: store.get_replica_set("default", "web").replicas < 8,
+              msg="scale down applies without the window")
+    finally:
+        cm.stop()
