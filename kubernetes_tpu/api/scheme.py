@@ -76,8 +76,12 @@ class VersionedScheme:
                     f"no kind {kind!r} registered in {api_version!r}"
                 )
             defaulter, to_internal, _ = spoke
-            body = dict(body)
             if defaulter is not None:
+                import copy
+
+                # defaulters mutate nested dicts (spec): never leak the
+                # injected fields into the CALLER's request body
+                body = copy.deepcopy(body)
                 defaulter(body)
             body = to_internal(body)
         return from_wire(body, kind)

@@ -348,7 +348,7 @@ class _Handler(BaseHTTPRequestHandler):
             200,
             {
                 "kind": f"{kind}List",
-                "apiVersion": "v1",
+                "apiVersion": getattr(self, "_api_version", "v1"),
                 "metadata": {"resourceVersion": str(rv)},
                 "items": [self._encode(o) for o in objs],
             },
@@ -572,6 +572,11 @@ class _Handler(BaseHTTPRequestHandler):
     # -- watch streaming ----------------------------------------------
     def _serve_watch(self, kind: str, ns: Optional[str], rv: int) -> None:
         frames: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=10_000)
+        # capture the REQUEST's api version: the sink runs on store
+        # threads, and group-route watches must stream the same wire
+        # shape their GETs serve (versioned-codec contract)
+        api_version = getattr(self, "_api_version", "v1")
+        from kubernetes_tpu.api.scheme import SCHEME_V
 
         def sink(event_rv: int, event: Event) -> None:
             if event.kind != kind:
@@ -579,7 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
             if ns is not None and getattr(event.obj.metadata, "namespace", None) != ns:
                 return
             frame = json.dumps(
-                {"type": event.type, "object": to_wire(event.obj)}
+                {"type": event.type,
+                 "object": SCHEME_V.encode(event.obj, api_version)}
             ).encode() + b"\n"
             try:
                 frames.put_nowait(frame)

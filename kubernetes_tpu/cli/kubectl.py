@@ -84,9 +84,16 @@ _KIND_ALIASES = {
 def _resolve_kind(token: str) -> str:
     kind = _KIND_ALIASES.get(token.lower())
     if kind is None:
-        # CRD-registered kinds: pass through plural/kind tokens as-is —
-        # the server resolves live registrations ("Widget"/"widgets")
-        return token if token[:1].isupper() else token.rstrip("s").title()
+        if token[:1].isupper():
+            # CRD-registered kinds pass through VERBATIM ("Widget",
+            # "MyWidget") — the server resolves live registrations;
+            # guessing a kind from a lowercase token would mangle
+            # CamelCase kinds and turn typos into fabricated routes
+            return token
+        raise SystemExit(
+            f"error: the server doesn't have a resource type {token!r} "
+            "(for a custom kind, use its exact Kind name, e.g. 'Widget')"
+        )
     return kind
 
 

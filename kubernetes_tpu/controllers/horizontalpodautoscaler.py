@@ -65,10 +65,19 @@ class HorizontalPodAutoscalerController(Controller):
             on_update=lambda old, new: self.enqueue(new),
         )
         self.pod_lister = self.factory.lister_for("Pod")
+        # per-HPA recommendation history for the stabilization window
+        self._recommendations: dict = {}
 
     def resync(self) -> None:
+        live = set()
         for hpa in self.store.list_hpas():
+            live.add(f"{hpa.namespace}/{hpa.name}")
             self.enqueue(hpa)
+        # drop history of deleted HPAs (the controller runs forever;
+        # HPA churn must not accumulate dead keys)
+        for key in list(self._recommendations):
+            if key not in live:
+                del self._recommendations[key]
 
     # ------------------------------------------------------------------
     SCALABLE_KINDS = ("Deployment", "ReplicaSet", "ReplicationController")
@@ -170,8 +179,6 @@ class HorizontalPodAutoscalerController(Controller):
         apply immediately)."""
         now = time.time()
         window = self.DOWNSCALE_STABILIZATION_SECONDS
-        if not hasattr(self, "_recommendations"):
-            self._recommendations = {}
         hist = self._recommendations.setdefault(key, [])
         hist.append((now, desired))
         del hist[: max(0, len(hist) - 64)]  # bounded memory

@@ -62,10 +62,15 @@ class PLEG:
                 if old == state:
                     continue
                 uid, cid = key
-                if state == "RUNNING" and old != "RUNNING":
+                if state == "RUNNING":
                     events.append(PodLifecycleEvent(
                         uid, CONTAINER_STARTED, cid))
-                elif state in ("EXITED", "UNKNOWN") and old == "RUNNING":
+                elif state in ("EXITED", "UNKNOWN"):
+                    # ANY transition into exited generates ContainerDied
+                    # (generic.go generateEvents) — including a container
+                    # that started AND crashed between two relists
+                    # (old CREATED or first sighting), or the pod never
+                    # re-syncs and a crash-loop sits EXITED forever
                     events.append(PodLifecycleEvent(
                         uid, CONTAINER_DIED, cid))
             for key in self._last:

@@ -581,6 +581,17 @@ class TestPLEG:
         assert events[0].type == CONTAINER_REMOVED
         assert got and pleg.events_emitted == 3
         assert pleg.healthy()
+        # a container that starts AND crashes BETWEEN relists (first
+        # sighting already EXITED) must still produce ContainerDied —
+        # generic.go generateEvents emits it for any transition into
+        # exited, which is the whole crash-loop coverage point
+        sid2 = rt.run_pod_sandbox("u2", "p2", "default")
+        cid2 = rt.create_container(sid2, "c", "img")
+        rt.start_container(cid2)
+        rt.stop_container(cid2)
+        events = pleg.relist()
+        assert [(e.type, e.pod_uid) for e in events] == \
+            [(CONTAINER_DIED, "u2")]
 
     def test_pleg_drives_crash_restart(self):
         """A container exiting in the RUNTIME (no API event) must be
