@@ -169,3 +169,52 @@ class TestJsonPatch:
         assert out["metadata"]["labels"] == {"a": "9", "b": "2"}
         assert out["spec"]["containers"] == [{"name": "c2"}]
         assert out["metadata"]["annotations"] == {"x/y": "z"}
+
+
+class TestWebhooksComposeWithCRDs:
+    def test_custom_resource_admission(self, hook_server, api):
+        """The reference's two extension mechanisms compose: a webhook
+        intercepts CREATEs of a CRD-registered kind (rules match the
+        custom plural) and mutates/validates its instances."""
+        from kubernetes_tpu.api.types import (
+            CRDNames, CustomObject, CustomResourceDefinition,
+        )
+
+        store, server, client = api
+        client.create(CustomResourceDefinition(
+            metadata=ObjectMeta(name="widgets.example.com"),
+            group="example.com",
+            names=CRDNames(plural="widgets", kind="Widget"),
+        ))
+        client.create(MutatingWebhookConfiguration(
+            metadata=ObjectMeta(name="label-widgets"),
+            webhooks=[Webhook(
+                name="label.example.com", url=hook_server + "/label",
+                rules=[WebhookRule(operations=["CREATE"],
+                                   resources=["widgets"])],
+            )],
+        ))
+        client.create(ValidatingWebhookConfiguration(
+            metadata=ObjectMeta(name="deny-bad-widgets"),
+            webhooks=[Webhook(
+                name="deny.example.com", url=hook_server + "/deny-bad",
+                rules=[WebhookRule(operations=["CREATE"],
+                                   resources=["widgets"])],
+            )],
+        ))
+        created = client.create(CustomObject(
+            kind="Widget",
+            metadata=ObjectMeta(name="w1", namespace="default"),
+            spec={"size": 1},
+        ))
+        # mutating webhook patched the custom instance
+        assert created.metadata.labels.get("injected") == "yes"
+        # validating webhook rejects bad instances
+        bad = CustomObject(
+            kind="Widget",
+            metadata=ObjectMeta(name="w2", namespace="default",
+                                labels={"bad": "true"}),
+        )
+        with pytest.raises(PermissionError):
+            client.create(bad)
+        assert store.get_object("Widget", "default", "w2") is None
