@@ -100,10 +100,15 @@ def _measure(name: str, nodes: int, pods: int, devices: int) -> dict:
 
     ops = make_workload(name, nodes=nodes, init_pods=0, measure_pods=pods)
     t0 = time.time()
+    # adaptive_chunk=False: every mesh size must solve the IDENTICAL
+    # batch partition (the latency tuner would shrink slow
+    # configurations' chunks and inflate their batch counts — round-3's
+    # 13-vs-29 artifact measured the tuner, not the sharding)
     r = run_workload(
         f"{name}/sharded-{devices}dev", ops, use_batch=True,
         max_batch=4096, wait_timeout=3600, progress=log,
         backend_factory=backend_factory, result_hook=hook,
+        adaptive_chunk=False,
     )
     dev_total, dev_batches = seg.get("device", (0.0, 0))
     return {
@@ -117,7 +122,101 @@ def _measure(name: str, nodes: int, pods: int, devices: int) -> dict:
     }
 
 
-def main(quick: bool = False) -> None:
+def _breakdown(n_nodes: int, batch_pods: int, device_counts) -> list:
+    """Per-batch compute-vs-collective split on one representative
+    solve batch. The ablated build (``collectives=False``) replaces
+    every cross-shard op with a local stand-in of identical arithmetic
+    shape, so full-minus-ablated wall time isolates pure collective
+    cost — the quantity shared-silicon virtual devices inflate (every
+    shard's collective work serializes onto the same cores) and real
+    ICI does not."""
+    import jax
+
+    from kubernetes_tpu.ops import BatchEncoder
+    from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
+    from kubernetes_tpu.ops.solver import SolverParams, pack_podin
+    from kubernetes_tpu.parallel.sharded import (
+        _build_solve,
+        _prepare_sharded,
+        make_mesh,
+    )
+    from kubernetes_tpu.scheduler.snapshot import new_snapshot
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    nodes = [
+        MakeNode().name(f"n{i}")
+        .capacity({"cpu": "32", "memory": "64Gi", "pods": "110"}).obj()
+        for i in range(n_nodes)
+    ]
+    pods = [
+        MakePod().name(f"p{i}").uid(f"u{i}")
+        .req({"cpu": "100m", "memory": "200Mi"}).obj()
+        for i in range(batch_pods)
+    ]
+    snap = new_snapshot([], nodes)
+    cluster, batch = BatchEncoder(snap, pad_nodes=128).encode(
+        pods, pad_pods=batch_pods
+    )
+    params = SolverParams()
+    ints, floats = pack_podin(batch)
+
+    def timed(fn, reps: int = 3) -> float:
+        fn()  # warm (compile)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    rows = []
+    # single-device reference: the same planes scan the sharded build
+    # distributes
+    be = XlaPlanesBackend()
+    static1, state1 = be.prepare(cluster, batch)
+    base_s = timed(
+        lambda: be.solve(params, static1, state1, ints, floats)[0]
+    )
+    rows.append({
+        "metric": f"sharded_breakdown[{n_nodes}nodes/{batch_pods}pod-batch]",
+        "devices": 1, "batch_solve_s": round(base_s, 3),
+        "compute_s": round(base_s, 3), "collective_s": 0.0,
+        "collective_frac": 0.0,
+    })
+    # 1-shard control: the SAME shard_map build on a 1-device mesh —
+    # collectives are no-ops, so (control - planes-scan baseline)
+    # isolates the shard_map machinery's constant overhead from
+    # anything that scales with shard count
+    for d in [1] + list(device_counts):
+        mesh = make_mesh(d, batch_axis=1)
+        sstatic, sstate = _prepare_sharded(cluster, batch, mesh)
+        args = (sstatic.sc_meta, sstatic.ints, sstatic.f32s,
+                sstate.planes, sstate.totals, ints, floats, ints,
+                sstatic.has_dom)
+        times = {}
+        for collectives in (True, False):
+            run = _build_solve(
+                mesh, params, sstatic.r, sstatic.sc, sstatic.t,
+                sstatic.u, sstatic.v, with_counts=False,
+                any_hard=sstatic.any_hard, collectives=collectives,
+            )
+            with mesh:
+                times[collectives] = timed(lambda: run(*args)[0])
+        coll = max(times[True] - times[False], 0.0)
+        rows.append({
+            "metric":
+                f"sharded_breakdown[{n_nodes}nodes/{batch_pods}pod-batch]"
+                + ("(1-shard shard_map control)" if d == 1 else ""),
+            "devices": d,
+            "batch_solve_s": round(times[True], 3),
+            "compute_s": round(times[False], 3),
+            "collective_s": round(coll, 3),
+            "collective_frac": round(coll / max(times[True], 1e-9), 3),
+        })
+    return rows
+
+
+def main(quick: bool = False, breakdown_only: bool = False) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -130,7 +229,7 @@ def main(quick: bool = False) -> None:
     nodes, pods = (512, 4096) if quick else (5000, 30000)
     rows = []
     for devices in (1, 2, 4, 8):
-        if devices > n_dev:
+        if devices > n_dev or breakdown_only:
             continue
         log(f"--- {devices} device(s) ---")
         rows.append(_measure(name, nodes, pods, devices))
@@ -141,9 +240,16 @@ def main(quick: bool = False) -> None:
                 base["device_solve_s"] / r["device_solve_s"], 2
             )
         print(json.dumps(r), flush=True)
+    log("--- per-batch compute/collective breakdown ---")
+    bd_nodes, bd_pods = (512, 1024) if quick else (5000, 4096)
+    for row in _breakdown(bd_nodes, bd_pods,
+                          [d for d in (2, 4, 8) if d <= n_dev]):
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    main(quick=ap.parse_args().quick)
+    ap.add_argument("--breakdown-only", action="store_true")
+    a = ap.parse_args()
+    main(quick=a.quick, breakdown_only=a.breakdown_only)

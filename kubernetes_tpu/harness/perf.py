@@ -113,6 +113,7 @@ def run_workload(
     progress: Optional[Callable[[str], None]] = None,
     backend_factory: Optional[Callable[[], object]] = None,
     result_hook: Optional[Callable[[object, object], None]] = None,
+    adaptive_chunk: bool = True,
 ) -> BenchmarkResult:
     """Execute one workload (scheduler_perf_test.go:309 runWorkload).
 
@@ -133,6 +134,7 @@ def run_workload(
     bs = attach_batch_scheduler(
         sched, max_batch=max_batch,
         backend=backend_factory() if backend_factory else None,
+        adaptive_chunk=adaptive_chunk,
     ) if use_batch else None
     sched.start()
 

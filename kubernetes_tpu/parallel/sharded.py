@@ -8,13 +8,19 @@ representation as the single-chip backends (``ops.pallas_solver``):
 
 - per device: feasibility + scores for the local node shard (dense
   vector ops, no gathers);
-- global argmax via ``pmax`` on scores then ``pmin`` on candidate
-  global indices (lowest index wins ties, matching ``jnp.argmax``);
-- per-constraint domain minima via local min + ``pmin``;
-- the winning node's topology codes broadcast via ``psum`` of the
-  one-hot-masked code planes, so every shard applies its local slice of
-  the domain-count update and the small replicated state (per-term
-  totals) never diverges.
+- ONE fused ``all_gather`` per pod carries each shard's local best
+  (score, lowest candidate global index) together with that candidate's
+  topology codes — every shard then resolves the global argmax, the
+  lowest-index tie-break (matching ``jnp.argmax``), and the winner-code
+  broadcast locally from the gathered [shards, 2+SC+T] row block. This
+  replaces the naive pmax(score) + pmin(index) + 2x psum(codes) chain:
+  collectives are latency-bound on ICI (the payload is tiny), so the
+  sequential-dependency DEPTH per scan step, not bytes, is what the
+  mesh pays for;
+- per-constraint domain minima via local min + ``pmin`` — emitted only
+  when the batch actually carries a hard topology-spread constraint
+  (a static property of the encoded batch, so it is a compile-time
+  branch): the common no-hard-spread batch runs ONE collective per pod.
 
 A separate 2D phase (``batch`` x ``nodes``) computes the batched static
 feasibility counts — the data-parallel analog — before the sequential
@@ -72,6 +78,10 @@ class SStatic(NamedTuple):
     u: int
     v: int
     n: int
+    # True iff any encoded spread constraint is hard (DoNotSchedule):
+    # compile-time branch — soft-only batches skip the per-pod domain-min
+    # pmin collective entirely
+    any_hard: bool = True
 
 
 class SState(NamedTuple):
@@ -86,8 +96,15 @@ class SState(NamedTuple):
 def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
           carry, pod):
     """One pod of the sequential commit scan, on this device's node
-    shard. Differentially exact vs the single-chip solvers."""
-    r, sc, t, u, v = dims
+    shard. Differentially exact vs the single-chip solvers.
+
+    ``dims`` carries three static solve-shape flags beyond the sizes:
+    ``shards`` (mesh width), ``any_hard`` (whether the domain-min pmin
+    exists at all), and ``collectives`` (False = the timing-ablation
+    build: every cross-shard op replaced by a local stand-in of the same
+    arithmetic shape, so full-minus-ablated wall time isolates pure
+    collective cost — results are garbage, never use for scheduling)."""
+    r, sc, t, u, v, shards, any_hard, collectives = dims
     c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match, \
         c_match_by, c_own_aff, c_own_anti = cols
     state, totals = carry
@@ -120,19 +137,25 @@ def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
     static_ok = static_l[so["masks"] + profile] > 0
 
     counts = state[do["sc_counts"]:do["sc_counts"] + sc]
-    dom = jax.lax.dynamic_slice_in_dim(
-        static_l, so["sc_domain"] + profile * sc, sc, axis=0
-    ) > 0
-    lmin = jnp.min(jnp.where(dom, counts, BIG), axis=1)
-    gmin = jax.lax.pmin(lmin, "nodes")
-    min_c = jnp.where(has_dom_r[profile], gmin, 0)
-    skew = counts + sc_match[:, None].astype(jnp.int32) - min_c[:, None]
-    active_hard = pod_sc & hard
-    spread_violation = jnp.any(
-        active_hard[:, None]
-        & ((skew > max_skew[:, None]) | sc_missing),
-        axis=0,
-    )
+    if any_hard:
+        # hard-spread feasibility needs the GLOBAL per-domain count
+        # minimum; soft-only batches never read it, so the pmin exists
+        # only in builds whose batch has a DoNotSchedule constraint
+        dom = jax.lax.dynamic_slice_in_dim(
+            static_l, so["sc_domain"] + profile * sc, sc, axis=0
+        ) > 0
+        lmin = jnp.min(jnp.where(dom, counts, BIG), axis=1)
+        gmin = jax.lax.pmin(lmin, "nodes") if collectives else lmin
+        min_c = jnp.where(has_dom_r[profile], gmin, 0)
+        skew = counts + sc_match[:, None].astype(jnp.int32) - min_c[:, None]
+        active_hard = pod_sc & hard
+        spread_violation = jnp.any(
+            active_hard[:, None]
+            & ((skew > max_skew[:, None]) | sc_missing),
+            axis=0,
+        )
+    else:
+        spread_violation = jnp.zeros(static_l.shape[1], dtype=bool)
 
     tcounts = state[do["term_counts"]:do["term_counts"] + t]
     towners = state[do["term_owners"]:do["term_owners"] + t]
@@ -184,25 +207,45 @@ def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
     )
     score = jnp.where(feasible, score, NEG_INF)
 
-    # global argmax over the sharded node axis (lowest index on ties)
-    gmx = jax.lax.pmax(jnp.max(score), "nodes")
+    # fused winner selection: each shard's local best score, its lowest
+    # candidate global index at that score, and THAT candidate's
+    # topology codes ride one all_gather; the global argmax, the
+    # lowest-index tie-break, and the winner-code broadcast then resolve
+    # locally on every shard from the [shards, 2+SC+T] block. One
+    # latency-bound collective where the naive chain pays four.
+    lmax = jnp.max(score)
+    lcand = jnp.min(jnp.where(feasible & (score >= lmax), gidx, BIG))
+    lone = gidx == lcand
+    l_sc = jnp.sum(jnp.where(lone[None], sc_codes, 0), axis=1)
+    l_t = jnp.sum(jnp.where(lone[None], term_codes, 0), axis=1)
+    # f32 payload is exact: node indices < 2^24, topology codes <= V
+    payload = jnp.concatenate([
+        jnp.stack([lmax, lcand.astype(jnp.float32)]),
+        l_sc.astype(jnp.float32),
+        l_t.astype(jnp.float32),
+    ])
+    if collectives:
+        gathered = jax.lax.all_gather(payload, "nodes")  # [S, 2+SC+T]
+    else:
+        gathered = jnp.tile(payload[None], (shards, 1))
+    scores_g = gathered[:, 0]
+    gmx = jnp.max(scores_g)
     found = gmx > NEG_INF / 2
-    cand = jnp.where(feasible & (score >= gmx), gidx, BIG)
-    chosen = jax.lax.pmin(jnp.min(cand), "nodes")
+    # shards' gidx ranges are disjoint and ordered, so the min over
+    # tying shards' candidates IS the global lowest-index winner
+    cand_sel = jnp.where(scores_g >= gmx, gathered[:, 1],
+                         jnp.float32(BIG))
+    wshard = jnp.argmin(cand_sel)
+    chosen = cand_sel[wshard].astype(jnp.int32)
     valid = found & pod_valid
     assignment = jnp.where(found, chosen, -1)
 
     onehot = (gidx == chosen) & valid
     inc = onehot.astype(jnp.int32)
     valid_i = valid.astype(jnp.int32)
-    # winning node's codes, broadcast to every shard
-    sc_code_j = jax.lax.psum(
-        jnp.sum(jnp.where(onehot[None], sc_codes, 0), axis=1), "nodes"
-    )
-    t_code_j = jax.lax.psum(
-        jnp.sum(jnp.where(onehot[None], term_codes, 0), axis=1),
-        "nodes",
-    )
+    wrow = gathered[wshard]
+    sc_code_j = wrow[2:2 + sc].astype(jnp.int32)
+    t_code_j = wrow[2 + sc:2 + sc + t].astype(jnp.int32)
     sc_inc = (sc_codes == sc_code_j[:, None]).astype(jnp.int32) \
         * (sc_match.astype(jnp.int32) * valid_i)[:, None]
     t_same = (term_codes == t_code_j[:, None]).astype(jnp.int32)
@@ -248,13 +291,17 @@ def _batched_static_feasibility(so, r, u, c_req, c_profile, static_l,
 
 @lru_cache(maxsize=32)
 def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
-                 u: int, v: int, with_counts: bool = True):
+                 u: int, v: int, with_counts: bool = True,
+                 any_hard: bool = True, collectives: bool = True):
     """Build (and cache) the jitted shard_map solve for one
     (mesh, params, shape) signature. Session rebuilds within the same
     constraint space reuse the compiled executable. ``with_counts=False``
     drops the batched static-feasibility phase — the session hot path
     doesn't consume it, so it shouldn't pay the [B x n_local] matrix and
-    its psum every batch."""
+    its psum every batch. ``any_hard=False`` (no DoNotSchedule spread
+    constraint in the batch) compiles out the per-pod domain-min pmin.
+    ``collectives=False`` builds the timing-ablation variant (local
+    stand-ins for every cross-shard op; results are garbage)."""
     so, _ = _static_planes(r, sc, t, u)
     do, _ = _state_planes(r, sc, t)
     c_req, c_nonzero, c_profile, c_valid = 0, r, r + 2, r + 3
@@ -264,7 +311,7 @@ def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
     c_own_anti = r + 4 + 2 * sc + 2 * t
     cols = (c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match,
             c_match_by, c_own_aff, c_own_anti)
-    dims = (r, sc, t, u, v)
+    dims = (r, sc, t, u, v, mesh.shape["nodes"], any_hard, collectives)
 
     node_sharded = P(None, "nodes")
 
@@ -337,6 +384,7 @@ def _prepare_sharded(cluster: EncodedCluster, batch: EncodedBatch,
         f32s=jnp.asarray(f32s2),
         has_dom=jnp.asarray(has_dom),
         r=r, sc=sc, t=t, u=u, v=v, n=n,
+        any_hard=bool(np.asarray(batch.sc_hard).any()),
     )
     sstate = SState(planes=jnp.asarray(planes2), totals=jnp.asarray(totals0))
     return sstatic, sstate
@@ -361,7 +409,7 @@ class ShardedBackend:
     def solve_lazy(self, params, sstatic, sstate, pod_ints, pod_floats):
         run = _build_solve(self.mesh, params, sstatic.r, sstatic.sc,
                            sstatic.t, sstatic.u, sstatic.v,
-                           with_counts=False)
+                           with_counts=False, any_hard=sstatic.any_hard)
         ints = jnp.asarray(pod_ints)
         floats = jnp.asarray(pod_floats)
         with self.mesh:
@@ -392,7 +440,7 @@ def solve_scan_sharded(
     Matches the single-chip solvers exactly (differential tests)."""
     sstatic, sstate = _prepare_sharded(cluster, batch, mesh)
     run = _build_solve(mesh, params, sstatic.r, sstatic.sc, sstatic.t,
-                       sstatic.u, sstatic.v)
+                       sstatic.u, sstatic.v, any_hard=sstatic.any_hard)
     pod_ints, pod_floats = pack_podin(batch)
     b_axis = mesh.shape["batch"]
     if pod_ints.shape[0] % b_axis != 0:

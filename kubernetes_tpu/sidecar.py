@@ -60,9 +60,16 @@ class TPUBatchScheduler:
         params: SolverParams = SolverParams(),
         validate: bool = False,
         backend=None,
+        adaptive_chunk: bool = True,
     ):
         self.sched = scheduler
         self.max_batch = max_batch
+        # False pins the drain/pad size at max_batch (no latency-budget
+        # tuning): the multi-chip scaling bench needs every mesh size to
+        # solve the IDENTICAL batch partition, or slower configurations
+        # shrink their chunks and the comparison measures the tuner, not
+        # the sharding
+        self.adaptive_chunk = adaptive_chunk
         self.params = params
         # differential-debug mode: re-check every device assignment with
         # the host filter chain before committing
@@ -104,6 +111,8 @@ class TPUBatchScheduler:
         its own compiled executable, and a single outlier cycle (e.g.
         one absorbing a compile) must not trigger a cascade of unwarmed
         shapes mid-run."""
+        if not self.adaptive_chunk:
+            return
         if padded_pods <= 0 or cycle_seconds <= 0:
             return
         per_pod = cycle_seconds / padded_pods
@@ -711,12 +720,14 @@ def attach_batch_scheduler(
     params: SolverParams = SolverParams(),
     validate: bool = False,
     backend=None,
+    adaptive_chunk: bool = True,
 ) -> Optional[TPUBatchScheduler]:
     """Install the batch path iff the TPUBatchScheduler gate is enabled
     (the --feature-gates=TPUBatchScheduler wiring)."""
     if not sched.feature_gates.enabled("TPUBatchScheduler"):
         return None
     bs = TPUBatchScheduler(sched, max_batch=max_batch, params=params,
-                           validate=validate, backend=backend)
+                           validate=validate, backend=backend,
+                           adaptive_chunk=adaptive_chunk)
     sched.batch_scheduler = bs
     return bs
