@@ -12,5 +12,6 @@ from kubernetes_tpu.kubelet.devicemanager import (
     DevicePlugin,
     TPU_RESOURCE,
 )
-from kubernetes_tpu.kubelet.kubelet import Kubelet, VolumeManager
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
 from kubernetes_tpu.kubelet.probes import LIVENESS, READINESS, ProbeManager, ProbeSpec

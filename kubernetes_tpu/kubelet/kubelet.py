@@ -44,27 +44,6 @@ from kubernetes_tpu.testing.wrappers import MakeNode
 _logger = logging.getLogger(__name__)
 
 
-class VolumeManager:
-    """Mount bookkeeping (reference volumemanager reconciler): tracks which
-    pod volumes are 'mounted'; unmount happens on pod teardown."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._mounted: Dict[str, List[str]] = {}  # pod_uid -> volume names
-
-    def mount_pod_volumes(self, pod: Pod) -> None:
-        with self._lock:
-            self._mounted[pod.uid] = [v.name for v in pod.spec.volumes]
-
-    def unmount_pod_volumes(self, pod_uid: str) -> None:
-        with self._lock:
-            self._mounted.pop(pod_uid, None)
-
-    def mounted(self, pod_uid: str) -> List[str]:
-        with self._lock:
-            return list(self._mounted.get(pod_uid, ()))
-
-
 class Kubelet:
     sync_interval = 0.2  # housekeeping tick (reference 1s; scaled down)
 
@@ -84,7 +63,12 @@ class Kubelet:
         self.labels = dict(labels or {})
         self.runtime = runtime if runtime is not None else FakeRuntime()
         self.devices = device_manager or DeviceManager()
-        self.volumes = VolumeManager()
+        # volume manager: desired/actual-state-of-world reconciler
+        # (reference volumemanager/volume_manager.go:247); container
+        # start gates on its WaitForAttachAndMount analog
+        from kubernetes_tpu.kubelet.volumemanager import VolumeManager
+
+        self.volumes = VolumeManager(store, node_name)
         self.probes = ProbeManager()
         self.heartbeat_fn = heartbeat_fn  # optional NodeLifecycle hookup
         # container manager: QoS tiers + pod cgroups + node-allocatable
@@ -112,7 +96,6 @@ class Kubelet:
         self.image_gc_manager = None
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
-        self._pvs_of: Dict[str, list] = {}  # uid -> PV names reported in-use
         self._terminal: set = set()  # uids already reported Succeeded/Failed
         self._key_of: Dict[str, tuple] = {}  # uid -> (namespace, name)
         self._work = threading.Event()
@@ -205,6 +188,14 @@ class Kubelet:
                 self.pleg.relist()
             except Exception:
                 _logger.exception("pleg relist")
+            try:
+                # volume reconciler pass (reference reconciler.go:77
+                # runs every 100ms): an attach landing re-syncs the
+                # pods it unblocks so their containers start
+                for uid in self.volumes.reconcile():
+                    self._mark_dirty(uid)
+            except Exception:
+                _logger.exception("volume reconcile")
             self.probes.tick()
             if self.eviction_manager is not None:
                 try:
@@ -277,8 +268,21 @@ class Kubelet:
             self._terminal.add(pod.uid)
             _logger.warning("pod %s admission failed: %s", pod.full_name(), e)
             return
-        self.volumes.mount_pod_volumes(pod)
-        self._report_volumes_in_use(pod.uid, pod)
+        # volume gate (reference WaitForAttachAndMount,
+        # volume_manager.go:387): containers must not start before every
+        # volume is mounted — claim-backed ones wait for the attachdetach
+        # controller's volumesAttached handshake. The reconciler re-syncs
+        # this pod when its volumes land; until then it stays Pending.
+        self.volumes.add_pod(pod)
+        # reconcile returns ONE-SHOT newly-ready notifications; any pod
+        # they name (not just this one) must be re-synced or it strands
+        # Pending — this call may consume the notification the sync
+        # loop's own reconcile would otherwise have delivered
+        for uid in self.volumes.reconcile():
+            if uid != pod.uid:
+                self._mark_dirty(uid)
+        if not self.volumes.volumes_ready(pod):
+            return
         # pod cgroup under its QoS tier (podContainerManager
         # EnsureExists before the sandbox starts)
         self.container_manager.create_pod_cgroup(pod)
@@ -341,47 +345,8 @@ class Kubelet:
         _release is idempotent and must run even without a sandbox —
         admission-failed pods can still hold device/volume state."""
         self._release(uid)
-        self._report_volumes_in_use(uid, None)
         self._terminal.discard(uid)
         self._key_of.pop(uid, None)
-
-    def _pod_pv_names(self, pod: Pod) -> list:
-        out = []
-        for v in pod.spec.volumes:
-            if not v.persistent_volume_claim:
-                continue
-            pvc = self.store.get_pvc(pod.namespace, v.persistent_volume_claim)
-            if pvc is not None and pvc.volume_name:
-                out.append(pvc.volume_name)
-        return out
-
-    def _report_volumes_in_use(self, uid: str, pod: Optional[Pod]) -> None:
-        """Publish node.status.volumesInUse (reference volume manager's
-        mount report, ``kubelet_node_status.go`` setVolumesInUseStatus):
-        the attachdetach controller's safe-detach interlock. The report
-        per pod is remembered at mount time — at teardown the pod may
-        already be gone from the store. CAS mutate so concurrent
-        node-status writers don't clobber each other."""
-        if pod is not None:
-            pvs = self._pod_pv_names(pod)
-            if not pvs:
-                return
-            self._pvs_of[uid] = pvs
-        else:
-            if self._pvs_of.pop(uid, None) is None:
-                return
-        in_use = sorted({pv for pvs in self._pvs_of.values() for pv in pvs})
-
-        def mutate(n) -> bool:
-            if n.status.volumes_in_use == in_use:
-                return False
-            n.status.volumes_in_use = in_use
-            return True
-
-        try:
-            self.store.mutate_object("Node", "", self.node_name, mutate)
-        except Exception:
-            _logger.exception("volumesInUse report failed")
 
     def _release(self, uid: str) -> None:
         sid = self._sandbox_of.pop(uid, None)
@@ -390,7 +355,14 @@ class Kubelet:
             self.runtime.remove_pod_sandbox(sid)
         self._containers_of.pop(uid, None)
         self.devices.free(uid)
-        self.volumes.unmount_pod_volumes(uid)
+        # teardown ordering: the sandbox is stopped ABOVE, then the pod
+        # leaves the volume manager's desired state; the reconcile
+        # unmounts and shrinks volumesInUse, which is what finally lets
+        # the attachdetach controller detach (never detach under a
+        # running container)
+        self.volumes.remove_pod(uid)
+        for ready_uid in self.volumes.reconcile():
+            self._mark_dirty(ready_uid)
         self.probes.remove_pod(uid)
         self.container_manager.delete_pod_cgroup(uid)
 

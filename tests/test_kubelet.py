@@ -628,3 +628,121 @@ class TestPLEG:
             assert restarted, "PLEG did not drive the crash restart"
         finally:
             kl.stop()
+
+
+# ---------------------------------------------------------------------------
+# Volume manager: desired/actual-state-of-world reconciler
+# (reference pkg/kubelet/volumemanager/volume_manager.go:247,
+#  reconciler/reconciler.go:77)
+
+
+def _bound_pvc_pod(store, name, claim, pv_name, node="n1"):
+    from kubernetes_tpu.api.resource import parse_quantity
+    from kubernetes_tpu.api.types import (
+        ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+    )
+
+    store.add_pv(PersistentVolume(
+        metadata=ObjectMeta(name=pv_name),
+        capacity={"storage": parse_quantity("1Gi")},
+    ))
+    store.add_pvc(PersistentVolumeClaim(
+        metadata=ObjectMeta(name=claim, namespace="default"),
+        volume_name=pv_name, phase="Bound",
+    ))
+    pod = MakePod().name(name).uid(f"u-{name}").pvc(claim).obj()
+    store.create_pod(pod)
+    store.bind("default", name, pod.uid, node)
+    return pod
+
+
+def test_volume_gate_blocks_containers_until_attached(cluster):
+    """A pod with a claim-backed volume must NOT start containers until
+    the attachdetach controller reports the PV attached
+    (WaitForAttachAndMount, volume_manager.go:387)."""
+    store, kubelet = cluster
+    pod = _bound_pvc_pod(store, "db", "data", "pv-1")
+    # reconciler publishes volumesInUse from desired state BEFORE mount
+    assert wait_for(
+        lambda: store.get_node("n1").status.volumes_in_use == ["pv-1"]
+    )
+    time.sleep(0.6)  # several sync ticks
+    assert store.get_pod("default", "db").status.phase != RUNNING
+    assert not kubelet.running_pods(), "sandbox started before attach"
+    assert kubelet.volumes.mounted(pod.uid) == []
+    # the controller attaches -> mount -> containers start
+    store.mutate_object(
+        "Node", "", "n1",
+        lambda n: n.status.__setattr__("volumes_attached", ["pv-1"]) or True,
+    )
+    assert wait_for(
+        lambda: store.get_pod("default", "db").status.phase == RUNNING
+    )
+    assert kubelet.volumes.mounted(pod.uid) == ["vol0"]
+
+
+def test_volume_teardown_ordering(cluster):
+    """Unmount happens after the sandbox stops, and only the resulting
+    volumesInUse shrink releases the controller's detach interlock."""
+    store, kubelet = cluster
+    pod = _bound_pvc_pod(store, "db2", "data2", "pv-2")
+    store.mutate_object(
+        "Node", "", "n1",
+        lambda n: n.status.__setattr__("volumes_attached", ["pv-2"]) or True,
+    )
+    assert wait_for(
+        lambda: store.get_pod("default", "db2").status.phase == RUNNING
+    )
+    assert store.get_node("n1").status.volumes_in_use == ["pv-2"]
+    store.delete_pod("default", "db2")
+    assert wait_for(lambda: not kubelet.running_pods())
+    assert wait_for(
+        lambda: store.get_node("n1").status.volumes_in_use == []
+    )
+    assert kubelet.volumes.mounted(pod.uid) == []
+    # the sandbox is long gone by the time the in-use report shrank
+    assert kubelet.runtime.list_pod_sandboxes() == []
+
+
+def test_volume_attach_mount_detach_end_to_end():
+    """Full handshake with the real attachdetach controller: attach ->
+    mount -> run -> delete -> unmount -> detach."""
+    from kubernetes_tpu.controllers import ControllerManager
+
+    store = ClusterStore()
+    cm = ControllerManager(store, controllers=["attachdetach"])
+    cm.start()
+    kubelet = Kubelet(store, "n1", capacity={"cpu": "8", "memory": "16Gi"})
+    kubelet.start()
+    try:
+        _bound_pvc_pod(store, "web", "data3", "pv-3")
+        # controller sees the scheduled pod and attaches; kubelet mounts
+        assert wait_for(
+            lambda: store.get_pod("default", "web").status.phase == RUNNING,
+            timeout=10.0,
+        )
+        assert store.get_node("n1").status.volumes_attached == ["pv-3"]
+        assert store.get_node("n1").status.volumes_in_use == ["pv-3"]
+        store.delete_pod("default", "web")
+        assert wait_for(
+            lambda: store.get_node("n1").status.volumes_attached == [],
+            timeout=10.0,
+        ), "controller never detached after unmount"
+    finally:
+        kubelet.stop()
+        cm.stop()
+
+
+def test_local_volumes_mount_without_attach(cluster):
+    """emptyDir-style volumes are node-local: no attach handshake."""
+    from kubernetes_tpu.api.types import Volume
+
+    store, kubelet = cluster
+    pod = MakePod().name("scratch").uid("u-scratch").obj()
+    pod.spec.volumes.append(Volume(name="tmp", ephemeral=True))
+    store.create_pod(pod)
+    store.bind("default", "scratch", pod.uid, "n1")
+    assert wait_for(
+        lambda: store.get_pod("default", "scratch").status.phase == RUNNING
+    )
+    assert kubelet.volumes.mounted(pod.uid) == ["tmp"]
