@@ -401,6 +401,9 @@ class PodSpec:
     volumes: List[Volume] = field(default_factory=list)
     host_network: bool = False
     restart_policy: str = "Always"  # Always | OnFailure | Never
+    # identity the pod runs as; the ServiceAccount admission plugin
+    # injects "default" when unset (core/v1 spec.serviceAccountName)
+    service_account_name: str = ""
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "PodSpec":
@@ -439,6 +442,7 @@ class PodSpec:
         s.volumes = [Volume.from_dict(v) for v in vols] if vols else []
         s.host_network = bool(g("hostNetwork"))
         s.restart_policy = g("restartPolicy") or "Always"
+        s.service_account_name = g("serviceAccountName", "")
         return s
 
 

@@ -44,6 +44,10 @@ class AdmissionRequest:
     obj: Any
     old_obj: Any = None
     user: str = "system:anonymous"
+    # "status" for pods/status writes etc. — webhook rule matching
+    # treats "<plural>/<subresource>" as its own vocabulary entry (a
+    # rule naming "pods" must NOT intercept kubelet status writes)
+    subresource: str = ""
 
 
 class AdmissionPlugin:
@@ -282,6 +286,94 @@ class ResourceQuotaAdmission(AdmissionPlugin):
             self._pending.pop((req.namespace, req.obj.metadata.name), None)
 
 
+class ServiceAccountAdmission(AdmissionPlugin):
+    """ServiceAccount admission (reference ``plugin/pkg/admission/
+    serviceaccount/admission.go:100 Admit``): pods that name no service
+    account get the namespace's ``default`` account injected; a pod
+    naming a NONEXISTENT account is rejected. Deviation from upstream
+    (documented like NamespaceLifecycle's): the injected ``default`` is
+    allowed to be absent — the serviceaccount controller provisions it
+    asynchronously per namespace, and the perf harness schedules into
+    namespaces that have no objects at all; only an EXPLICITLY named
+    missing account rejects."""
+
+    name = "ServiceAccount"
+
+    DEFAULT = "default"
+
+    def __init__(self, store=None):
+        self.store = store
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        if not pod.spec.service_account_name:
+            pod.spec.service_account_name = self.DEFAULT
+
+    def validate(self, req: AdmissionRequest) -> None:
+        if self.store is None or req.kind != "Pod" or \
+                req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        sa = pod.spec.service_account_name
+        if sa and sa != self.DEFAULT and \
+                self.store.get_service_account(req.namespace, sa) is None:
+            raise AdmissionError(
+                f"service account {req.namespace}/{sa} not found"
+            )
+
+
+MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"
+
+
+class NodeRestriction(AdmissionPlugin):
+    """Node identity confinement (reference ``plugin/pkg/admission/
+    noderestriction/admission.go:79 Admit``): a kubelet authenticating
+    as ``system:node:<name>`` may only touch its OWN Node object and
+    pods BOUND to it — node A's credentials patching node B (or B's
+    pods) is exactly the lateral movement this plugin exists to stop.
+    Creates of regular pods by node identities are rejected; mirror
+    pods (``kubernetes.io/config.mirror`` annotation) are allowed only
+    on the node itself."""
+
+    name = "NodeRestriction"
+
+    PREFIX = "system:node:"
+
+    def validate(self, req: AdmissionRequest) -> None:
+        user = req.user or ""
+        if not user.startswith(self.PREFIX):
+            return
+        node_name = user[len(self.PREFIX):]
+        if req.kind == "Node":
+            target = (req.obj or req.old_obj).metadata.name
+            if target != node_name:
+                raise AdmissionError(
+                    f"node {node_name!r} is not allowed to modify node "
+                    f"{target!r}"
+                )
+        elif req.kind == "Pod":
+            if req.operation == CREATE:
+                pod: Pod = req.obj
+                if MIRROR_POD_ANNOTATION not in pod.metadata.annotations:
+                    raise AdmissionError(
+                        f"node {node_name!r} may only create mirror pods"
+                    )
+                if pod.spec.node_name != node_name:
+                    raise AdmissionError(
+                        f"node {node_name!r} may only create mirror pods "
+                        f"bound to itself"
+                    )
+                return
+            bound = (req.old_obj or req.obj).spec.node_name
+            if bound != node_name:
+                raise AdmissionError(
+                    f"node {node_name!r} is not allowed to modify pods "
+                    f"bound to node {bound!r}"
+                )
+
+
 @dataclass
 class AdmissionChain:
     """Ordered plugin chain: all mutating passes, then all validating
@@ -319,6 +411,13 @@ class AdmissionChain:
             self.rollback(req, ran)
             raise
         return req.obj
+
+    def validate_only(self, req: AdmissionRequest) -> None:
+        """Run just the validating passes — the DELETE path's admission
+        (the reference dispatches DELETE through validating admission;
+        there is no object body to mutate)."""
+        for p in self.plugins:
+            p.validate(req)
 
     def rollback(self, req: AdmissionRequest,
                  plugins: Optional[List[AdmissionPlugin]] = None) -> None:

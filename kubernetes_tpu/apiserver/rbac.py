@@ -74,6 +74,18 @@ class RBACAuthorizer:
         groups = set(self._groups.get(user, ()))
         if user and user != ANONYMOUS:
             groups.add(AUTHENTICATED)
+        # identity-derived groups, as the reference authenticators
+        # attach them: node users join system:nodes (pkg/auth x509/
+        # bootstrap authenticators), service accounts join
+        # system:serviceaccounts and their namespace group
+        # (pkg/serviceaccount/util.go MakeGroupNames)
+        if user.startswith("system:node:"):
+            groups.add("system:nodes")
+        elif user.startswith("system:serviceaccount:"):
+            parts = user.split(":")
+            if len(parts) == 4:
+                groups.add("system:serviceaccounts")
+                groups.add(f"system:serviceaccounts:{parts[2]}")
         return groups
 
     # -- evaluation ----------------------------------------------------
@@ -102,7 +114,7 @@ class RBACAuthorizer:
         """``resource`` accepts either the lowercase plural ("pods") or
         a kind name ("Pod" — the REST handler passes kinds); both are
         normalized to the plural the rules use."""
-        resource = _normalize_resource(resource)
+        resource = _normalize_resource(resource, self.store)
         groups = self.groups_for(user)
         if MASTERS in groups:
             return True
@@ -126,15 +138,24 @@ class RBACAuthorizer:
         return self.authorize(user, verb, kind, namespace)
 
 
-def _normalize_resource(resource: str) -> str:
+def _normalize_resource(resource: str, store=None) -> str:
     from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL
 
     got = KIND_TO_PLURAL.get(resource)
     if got is not None:
         return got
     if resource[:1].isupper():
-        # unregistered kind name (e.g. the virtual "Binding"): naive
-        # pluralization matches the rule vocabulary ("bindings")
+        # CRD-registered kinds use their DECLARED plural (mandatory on
+        # the CRD names object) — a naive lower()+"s" would route a
+        # kind like "Policy" to "policys", silently matching no rule
+        # and turning a typo'd vocabulary into an authz bypass/lockout
+        if store is not None:
+            plural = store.custom_kind_to_plural(resource)
+            if plural is not None:
+                return plural
+        # remaining uppercase names are the virtual built-ins with no
+        # storage table (exactly "Binding" today), whose regular
+        # pluralization is the rule vocabulary ("bindings")
         return resource.lower() + "s"
     return resource
 

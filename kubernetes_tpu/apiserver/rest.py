@@ -224,7 +224,16 @@ class _Handler(BaseHTTPRequestHandler):
         auth = self.headers.get("Authorization") or ""
         if auth.startswith("Bearer "):
             token = auth[len("Bearer "):].strip()
-            return self.server.tokens.get(token, f"token:{token[:8]}")
+            user = self.server.tokens.get(token)
+            if user is not None:
+                return user
+            # service-account tokens (minted by the tokens controller)
+            # authenticate as system:serviceaccount:<ns>:<name> —
+            # reference pkg/serviceaccount token authenticator
+            user = self.server.resolve_sa_token(token)
+            if user is not None:
+                return user
+            return f"token:{token[:8]}"
         return "system:anonymous"
 
     def _check_authz(self, verb: str, kind: str, namespace: str) -> str:
@@ -487,11 +496,38 @@ class _Handler(BaseHTTPRequestHandler):
         # status subresource — phase/podIP only (kubelet status-manager path)
         if kind == "Pod" and sub == "status":
             try:
-                self._check_authz("update", "Pod", ns or "")
+                # the subresource is its own authz vocabulary entry
+                # (the node role grants "pods/status", not "pods")
+                user = self._check_authz("update", "pods/status", ns or "")
             except Forbidden as e:
                 self._send_error(403, "Forbidden", str(e))
                 return
+            # status writes dispatch through validating admission too
+            # (NodeRestriction: a kubelet may only write status of pods
+            # bound to it). Validators must judge the PROPOSED object —
+            # req.obj carries the incoming status applied to a copy of
+            # the live pod, old_obj the untouched stored one.
+            live = store.get_pod(ns or "default", name)
             status = body.get("status") or {}
+            if live is not None:
+                from kubernetes_tpu.api.types import shallow_copy
+
+                proposed = shallow_copy(live)
+                proposed.status = shallow_copy(live.status)
+                if status.get("phase"):
+                    proposed.status.phase = status["phase"]
+                if status.get("podIP"):
+                    proposed.status.pod_ip = status["podIP"]
+                if status.get("hostIP"):
+                    proposed.status.host_ip = status["hostIP"]
+                try:
+                    self.server.admission.validate_only(AdmissionRequest(
+                        UPDATE, "Pod", ns or "default", proposed,
+                        old_obj=live, user=user, subresource="status",
+                    ))
+                except AdmissionError as e:
+                    self._send_error(422, "Invalid", str(e))
+                    return
             if store.set_pod_phase(
                 ns or "default",
                 name,
@@ -562,6 +598,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(403, "Forbidden", str(e))
             return
         old = self.server.store.get_object(kind, ns or "default", name)
+        if old is not None:
+            # DELETE dispatches through validating admission (the
+            # reference's delete path runs validating plugins/webhooks;
+            # there is no body to mutate) — NodeRestriction confines a
+            # node identity to deleting its own pods here
+            try:
+                self.server.admission.validate_only(AdmissionRequest(
+                    DELETE, kind, ns or "default", old, old_obj=old,
+                    user=self._user(),
+                ))
+            except AdmissionError as e:
+                self._send_error(422, "Invalid", str(e))
+                return
         if self.server.store.delete_object(kind, ns or "default", name):
             if kind == "Service" and old is not None and old.cluster_ip:
                 self.server.ip_allocator.release(old.cluster_ip)
@@ -660,6 +709,13 @@ class APIServer(ThreadingHTTPServer):
             for p in admission.plugins:
                 if isinstance(p, NamespaceLifecycle):
                     p.store = self.store
+            from kubernetes_tpu.apiserver.admission import (
+                NodeRestriction,
+                ServiceAccountAdmission,
+            )
+
+            admission.plugins.append(ServiceAccountAdmission(self.store))
+            admission.plugins.append(NodeRestriction())
             admission.plugins.append(ResourceQuotaAdmission(self.store))
             # out-of-process extension point, last in the chain:
             # mutating webhooks run after the in-process mutators,
@@ -671,6 +727,21 @@ class APIServer(ThreadingHTTPServer):
         self.admission = admission
         self.authorizer = authorizer
         self.tokens = dict(tokens or {})  # bearer token -> username
+        # service-account token index (token -> identity triple), built
+        # lazily and invalidated by Secret events. The generation
+        # counter closes the rebuild/invalidate race: a rebuild that
+        # listed secrets BEFORE a revocation event must not install its
+        # snapshot AFTER the event cleared the cache (a revoked token
+        # would keep authenticating until an unrelated Secret write).
+        self._sa_tokens: Optional[Dict[str, tuple]] = None
+        self._sa_gen = 0
+
+        def _maybe_invalidate(event) -> None:
+            if event.kind == "Secret":
+                self._sa_gen += 1
+                self._sa_tokens = None
+
+        self._sa_watch = self.store.watch(_maybe_invalidate)
         self.stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._metrics_text_fn = metrics_text_fn
@@ -682,6 +753,61 @@ class APIServer(ThreadingHTTPServer):
         for svc in self.store.list_all_services():
             if svc.cluster_ip:
                 self.ip_allocator.reserve(svc.cluster_ip)
+
+    def _sa_token_index(self) -> Dict[str, tuple]:
+        """token -> (namespace, sa name, recorded uid), rebuilt lazily
+        and invalidated by Secret watch events — authn must not pay an
+        O(all secrets) scan per request."""
+        idx = self._sa_tokens
+        if idx is None:
+            from kubernetes_tpu.controllers.serviceaccounttoken import (
+                SA_NAME_ANNOTATION,
+                SA_TOKEN_TYPE,
+                SA_UID_ANNOTATION,
+            )
+
+            gen = self._sa_gen
+            idx = {}
+            for secret in self.store.list_objects("Secret"):
+                if secret.type != SA_TOKEN_TYPE:
+                    continue
+                tok = secret.data.get("token")
+                if tok:
+                    ann = secret.metadata.annotations
+                    idx[tok] = (
+                        secret.namespace,
+                        ann.get(SA_NAME_ANNOTATION, ""),
+                        ann.get(SA_UID_ANNOTATION),
+                    )
+            if gen == self._sa_gen:
+                self._sa_tokens = idx
+            # else: a Secret event landed mid-list — serve this
+            # request from the snapshot (the request raced the event)
+            # but don't cache it
+        return idx
+
+    def resolve_sa_token(self, token: str) -> Optional[str]:
+        """Map a bearer token to its service-account identity, or None.
+        The trust chain: the tokens controller minted the Secret, the
+        Secret names its account, and the account must still exist with
+        the recorded uid (a recreated same-name account must not be
+        impersonable with the old credential — the controller also
+        deletes such secrets asynchronously, but authn must not depend
+        on that race)."""
+        if not token:
+            return None
+        entry = self._sa_token_index().get(token)
+        if entry is None:
+            return None
+        ns, name, uid = entry
+        sa = self.store.get_service_account(ns, name)
+        if sa is None or sa.metadata.uid != uid:
+            return None
+        from kubernetes_tpu.controllers.serviceaccounttoken import (
+            sa_username,
+        )
+
+        return sa_username(ns, name)
 
     def metrics_text(self) -> str:
         if self._metrics_text_fn is not None:
@@ -712,6 +838,8 @@ class APIServer(ThreadingHTTPServer):
         self.stopping.set()
         self.shutdown()
         self.watch_cache.stop()
+        if self._sa_watch is not None:
+            self._sa_watch.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -750,6 +878,7 @@ class RestClient:
     tools/watch)."""
 
     def __init__(self, base_url: str, token: str = ""):
+        self._crd_plurals: Dict[str, str] = {}
         self.base_url = base_url.rstrip("/")
         self.token = token
 
@@ -771,11 +900,31 @@ class RestClient:
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read() or b"{}")
 
+    def _discover_plural(self, kind: str) -> Optional[str]:
+        """Resolve a CRD-registered kind's declared plural from the
+        server (the reference client's discovery/RESTMapper role):
+        naive pluralization would mis-route -y/-s/-x kinds ("Policy" →
+        /policys → 404). Cached, including misses (a None entry) so an
+        unregistered kind costs ONE discovery round-trip, not one per
+        request; the miss cache clears when this client creates a CRD
+        (the only registration path it can observe)."""
+        if kind in self._crd_plurals:
+            return self._crd_plurals[kind]
+        code, payload = self._request(
+            "GET", "/api/v1/customresourcedefinitions")
+        if code == 200:
+            for item in payload.get("items", []):
+                names = item.get("names") or {}
+                if names.get("kind") and names.get("plural"):
+                    self._crd_plurals[names["kind"]] = names["plural"]
+            self._crd_plurals.setdefault(kind, None)
+        return self._crd_plurals.get(kind)
+
     def _path(self, kind: str, namespace: Optional[str], name: Optional[str] = None,
               sub: Optional[str] = None) -> str:
-        # custom (CRD-registered) kinds pluralize naively — the same
-        # default the server-side registration applies
-        plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
+        plural = KIND_TO_PLURAL.get(kind)
+        if plural is None:
+            plural = self._discover_plural(kind) or kind.lower() + "s"
         p = f"/api/v1/namespaces/{namespace}/{plural}" if namespace else f"/api/v1/{plural}"
         if name:
             p += f"/{name}"
@@ -805,6 +954,11 @@ class RestClient:
 
     def create(self, obj) -> Any:
         kind = self._kind_name(obj)
+        if kind == "CustomResourceDefinition":
+            # a fresh registration obsoletes cached discovery misses
+            self._crd_plurals = {
+                k: v for k, v in self._crd_plurals.items() if v
+            }
         ns = obj.metadata.namespace if is_namespaced(kind) else None
         code, payload = self._request(
             "POST", self._path(kind, ns), to_wire(obj)
@@ -837,11 +991,12 @@ class RestClient:
         return from_wire(payload, kind)
 
     def delete(self, kind: str, name: str, namespace: Optional[str] = "default") -> bool:
-        """True = deleted, False = not found; authorization failures
-        raise (a 403 must never read as a routine miss)."""
+        """True = deleted, False = not found; authorization and
+        admission failures raise (a 403/422 must never read as a
+        routine miss)."""
         ns = namespace if is_namespaced(kind) else None
         code, payload = self._request("DELETE", self._path(kind, ns, name))
-        if code == 403:
+        if code in (403, 422):
             self._raise_for(code, payload)
         return code == 200
 

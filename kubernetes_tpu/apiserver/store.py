@@ -822,11 +822,27 @@ class ClusterStore:
         with self._lock:
             return self._custom_plurals.get(plural)
 
+    def custom_kind_to_plural(self, kind: str) -> Optional[str]:
+        """Reverse plural lookup for a runtime-registered kind — the
+        authoritative vocabulary for authz rules and webhook rule
+        matching (naive ``lower()+"s"`` mis-pluralizes -y/-s/-x kinds,
+        which for authz is a policy-bypass-shaped bug)."""
+        with self._lock:
+            for plural, k in self._custom_plurals.items():
+                if k == kind:
+                    return plural
+        return None
+
     def _register_crd_locked(self, crd) -> None:
         kind = crd.names.kind
-        plural = crd.names.plural or (kind.lower() + "s")
+        plural = crd.names.plural
         if not kind:
             raise ValueError("CRD names.kind is required")
+        if not plural:
+            # the reference makes spec.names.plural mandatory
+            # (apiextensions validation); guessing it here would put a
+            # wrong word in the authz/webhook rule vocabulary
+            raise ValueError("CRD names.plural is required")
         if kind in self._KIND_TABLES:
             raise ValueError(f"kind {kind!r} shadows a built-in kind")
         namespaced = crd.scope != "Cluster"

@@ -121,7 +121,17 @@ class WebhookAdmission(AdmissionPlugin):
                   mutating: bool) -> None:
         from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL
 
-        resource = KIND_TO_PLURAL.get(req.kind, req.kind.lower() + "s")
+        resource = KIND_TO_PLURAL.get(req.kind)
+        if resource is None:
+            # CRD kinds match webhook rules by their DECLARED plural
+            # (mandatory on the CRD); naive pluralization would let a
+            # "Policy" CRD slip past a "policies" rule
+            resource = self.store.custom_kind_to_plural(req.kind) \
+                or req.kind.lower() + "s"
+        if req.subresource:
+            # upstream rule matching: status writes match only rules
+            # naming "pods/status", never bare "pods"
+            resource = f"{resource}/{req.subresource}"
         for cfg in configs:
             for hook in cfg.webhooks:
                 if not _hook_matches(hook, req.operation, resource):

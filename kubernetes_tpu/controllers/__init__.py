@@ -60,7 +60,11 @@ from kubernetes_tpu.controllers.replicaset import (
     ReplicationController,
 )
 from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.rootcacertpublisher import (
+    RootCACertPublisher,
+)
 from kubernetes_tpu.controllers.serviceaccount import ServiceAccountController
+from kubernetes_tpu.controllers.serviceaccounttoken import TokensController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.controllers.ttlafterfinished import (
     TTLAfterFinishedController,
@@ -95,6 +99,8 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "namespace": NamespaceController,
         "resourcequota": ResourceQuotaController,
         "serviceaccount": ServiceAccountController,
+        "serviceaccount-token": TokensController,
+        "root-ca-cert-publisher": RootCACertPublisher,
         "podgc": PodGCController,
         "ttl": TTLController,
         "pvc-protection": PVCProtectionController,

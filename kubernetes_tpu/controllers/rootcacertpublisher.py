@@ -1,0 +1,77 @@
+"""Root CA certificate publisher.
+
+Behavioral equivalent of the reference's
+``pkg/controller/certificates/rootcacertpublisher/publisher.go:56
+NewPublisher``: every active namespace carries a ``kube-root-ca.crt``
+ConfigMap holding the cluster CA bundle (the trust anchor pods use to
+verify the apiserver), recreated when deleted and overwritten when its
+data drifts from the configured root.
+
+The published bundle comes from the same stand-in CA the certificates
+signing controller uses (``controllers/certificates.py`` ``CA_KEY``),
+so a workload that verifies a kubelet serving cert against this bundle
+is checking the identical trust root that signed it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from kubernetes_tpu.api.types import ConfigMap, ObjectMeta
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.certificates import CA_KEY
+
+ROOT_CA_CONFIGMAP = "kube-root-ca.crt"
+
+
+def root_ca_bundle() -> str:
+    """The cluster's root CA in PEM shape (publisher.go publishes the
+    raw rootCA bytes; the stand-in CA's public fingerprint plays that
+    role here)."""
+    fp = hashlib.sha256(CA_KEY).hexdigest()
+    return (
+        "-----BEGIN CERTIFICATE-----\n"
+        f"cluster-root-ca-fingerprint: {fp}\n"
+        "-----END CERTIFICATE-----\n"
+    )
+
+
+class RootCACertPublisher(Controller):
+    name = "root-ca-cert-publisher"
+
+    def register(self) -> None:
+        self.factory.informer_for("Namespace").add_event_handler(
+            on_add=lambda ns: self.enqueue_key(ns.name),
+            on_update=lambda old, new: self.enqueue_key(new.name),
+        )
+        # deletion or drift of the published ConfigMap re-publishes
+        # (publisher.go cmAddedOrUpdated / cmDeleted handlers)
+        self.factory.informer_for("ConfigMap").add_event_handler(
+            on_add=self._cm_changed,
+            on_update=lambda old, new: self._cm_changed(new),
+            on_delete=self._cm_changed,
+        )
+
+    def _cm_changed(self, cm: ConfigMap) -> None:
+        if cm.name == ROOT_CA_CONFIGMAP:
+            self.enqueue_key(cm.namespace)
+
+    def sync(self, key: str) -> None:
+        ns = self.store.get_namespace(key)
+        if ns is None or ns.phase == "Terminating":
+            return
+        bundle = root_ca_bundle()
+        cm = self.store.get_object("ConfigMap", key, ROOT_CA_CONFIGMAP)
+        if cm is None:
+            self.store.create_object("ConfigMap", ConfigMap(
+                metadata=ObjectMeta(name=ROOT_CA_CONFIGMAP, namespace=key),
+                data={"ca.crt": bundle},
+            ))
+            return
+        if cm.data.get("ca.crt") != bundle:
+            def mutate(obj) -> bool:
+                obj.data = {"ca.crt": bundle}
+                return True
+
+            self.store.mutate_object("ConfigMap", key, ROOT_CA_CONFIGMAP,
+                                     mutate)

@@ -1,0 +1,276 @@
+"""ServiceAccount identity end-to-end (VERDICT r3 #4/#6/#7): the tokens
+controller mints token Secrets, the apiserver's bearer authn resolves
+them to ``system:serviceaccount:<ns>:<name>``, ServiceAccount admission
+injects the default account into pods, RBAC ServiceAccount subjects
+grant those identities, NodeRestriction confines node users, and the
+root-ca-cert-publisher provisions the per-namespace trust anchor.
+
+Reference: ``pkg/controller/serviceaccount/tokens_controller.go:124``,
+``plugin/pkg/admission/serviceaccount/admission.go:100``,
+``plugin/pkg/admission/noderestriction/admission.go:79``,
+``pkg/controller/certificates/rootcacertpublisher/publisher.go:56``.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Namespace,
+    ObjectMeta,
+    PolicyRule,
+    RBACSubject,
+    Role,
+    RoleBinding,
+    RoleRef,
+)
+from kubernetes_tpu.apiserver.rbac import provision_bootstrap_policy
+from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.rootcacertpublisher import ROOT_CA_CONFIGMAP
+from kubernetes_tpu.controllers.serviceaccounttoken import (
+    SA_TOKEN_TYPE,
+    sa_username,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _sa_token(store, namespace, name):
+    """The minted token for a service account, or None."""
+    for s in store.list_objects("Secret", namespace):
+        if s.type == SA_TOKEN_TYPE and s.metadata.annotations.get(
+                "kubernetes.io/service-account.name") == name:
+            return s.data.get("token")
+    return None
+
+
+class TestTokensController:
+    def _cluster(self):
+        store = ClusterStore()
+        cm = ControllerManager(
+            store, controllers=["serviceaccount", "serviceaccount-token"]
+        )
+        cm.start()
+        return store, cm
+
+    def test_mints_token_secret_and_links_it(self):
+        store, cm = self._cluster()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            # serviceaccount controller creates "default", tokens
+            # controller mints its secret and links it
+            assert wait_for(lambda: _sa_token(store, "dev", "default"))
+            sa = store.get_service_account("dev", "default")
+            assert len(sa.secrets) == 1
+            assert sa.secrets[0].startswith("default-token-")
+        finally:
+            cm.stop()
+
+    def test_recreated_account_invalidates_old_token(self):
+        store, cm = self._cluster()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            assert wait_for(lambda: _sa_token(store, "dev", "default"))
+            old = _sa_token(store, "dev", "default")
+            store.delete_object("ServiceAccount", "dev", "default")
+            # the SA controller recreates "default" (new uid); the old
+            # token secret must be replaced, not inherited
+            assert wait_for(
+                lambda: (_sa_token(store, "dev", "default") or old) != old
+            )
+            assert _sa_token(store, "dev", "default") != old
+        finally:
+            cm.stop()
+
+
+class TestServiceAccountIdentityEndToEnd:
+    """VERDICT r3 #4 done-condition: a pod created with no SA gets
+    ``default``, its token authenticates, and an RBAC RoleBinding to a
+    ServiceAccount subject actually grants."""
+
+    def _serve(self):
+        store = ClusterStore()
+        authz = provision_bootstrap_policy(store)
+        server = APIServer(
+            store=store, authorizer=authz,
+            tokens={"admin-token": "admin"},
+        ).start()
+        cm = ControllerManager(
+            store, controllers=["serviceaccount", "serviceaccount-token"]
+        )
+        cm.start()
+        return store, server, cm
+
+    def test_default_sa_injected_token_authenticates_rbac_grants(self):
+        store, server, cm = self._serve()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            assert wait_for(lambda: _sa_token(store, "dev", "default"))
+
+            # 1. admission injects the default account
+            admin = RestClient(server.url, token="admin-token")
+            pod = MakePod().name("app").uid("u-app").namespace("dev").obj()
+            admin.create(pod)
+            created = store.get_pod("dev", "app")
+            assert created.spec.service_account_name == "default"
+
+            # 2. the minted token authenticates as the SA identity...
+            token = _sa_token(store, "dev", "default")
+            sa_client = RestClient(server.url, token=token)
+            with pytest.raises(PermissionError):
+                sa_client.list("Pod", namespace="dev")  # no grant yet
+
+            # 3. ...and a RoleBinding to a ServiceAccount subject grants
+            store.add_role(Role(
+                metadata=ObjectMeta(name="pod-reader", namespace="dev"),
+                rules=[PolicyRule(verbs=["get", "list"],
+                                  resources=["pods"])],
+            ))
+            store.add_role_binding(RoleBinding(
+                metadata=ObjectMeta(name="default-reads", namespace="dev"),
+                subjects=[RBACSubject(kind="ServiceAccount",
+                                      name="default", namespace="dev")],
+                role_ref=RoleRef(kind="Role", name="pod-reader"),
+            ))
+            pods, _ = sa_client.list("Pod", namespace="dev")
+            assert any(p.metadata.name == "app" for p in pods)
+            # scoped to its verbs: delete stays forbidden
+            with pytest.raises(PermissionError):
+                sa_client.delete("Pod", "app", namespace="dev")
+        finally:
+            cm.stop()
+            server.shutdown_server()
+
+    def test_deleted_account_token_stops_authenticating(self):
+        store, server, cm = self._serve()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            assert wait_for(lambda: _sa_token(store, "dev", "default"))
+            token = _sa_token(store, "dev", "default")
+            assert server.resolve_sa_token(token) == sa_username(
+                "dev", "default")
+            cm.stop()  # freeze controllers: authn must not rely on them
+            store.delete_object("ServiceAccount", "dev", "default")
+            assert server.resolve_sa_token(token) is None
+        finally:
+            cm.stop()
+            server.shutdown_server()
+
+    def test_explicitly_named_missing_sa_rejected(self):
+        store, server, cm = self._serve()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            admin = RestClient(server.url, token="admin-token")
+            pod = MakePod().name("app2").uid("u-app2").namespace("dev").obj()
+            pod.spec.service_account_name = "no-such-sa"
+            with pytest.raises(PermissionError):
+                admin.create(pod)
+        finally:
+            cm.stop()
+            server.shutdown_server()
+
+
+class TestNodeRestriction:
+    """VERDICT r3 #7 done-condition: node A's token cannot patch node
+    B (nor B's pods), while its own node/pods stay writable."""
+
+    def _serve(self):
+        store = ClusterStore()
+        authz = provision_bootstrap_policy(store)
+        server = APIServer(
+            store=store, authorizer=authz,
+            tokens={"kubelet-a": "system:node:a",
+                    "kubelet-b": "system:node:b",
+                    "admin-token": "admin"},
+        ).start()
+        store.add_node(MakeNode().name("a")
+                       .capacity({"cpu": "8", "memory": "16Gi"}).obj())
+        store.add_node(MakeNode().name("b")
+                       .capacity({"cpu": "8", "memory": "16Gi"}).obj())
+        return store, server
+
+    def test_node_cannot_touch_other_node(self):
+        store, server = self._serve()
+        try:
+            a = RestClient(server.url, token="kubelet-a")
+            # its own node: the system:nodes RBAC grant + NodeRestriction
+            # both pass
+            own = a.get("Node", "a", namespace=None)
+            own.metadata.labels["touched"] = "yes"
+            a.update(own)
+            assert store.get_node("a").metadata.labels["touched"] == "yes"
+            # node b: RBAC grants nodes to the group, NodeRestriction
+            # rejects the cross-node write
+            other = a.get("Node", "b", namespace=None)
+            other.metadata.labels["touched"] = "yes"
+            with pytest.raises(PermissionError):
+                a.update(other)
+            assert "touched" not in store.get_node("b").metadata.labels
+        finally:
+            server.shutdown_server()
+
+    def test_node_confined_to_its_own_pods(self):
+        store, server = self._serve()
+        try:
+            for name, node in (("on-a", "a"), ("on-b", "b")):
+                p = MakePod().name(name).uid(f"u-{name}").obj()
+                store.create_pod(p)
+                store.bind("default", name, p.uid, node)
+            a = RestClient(server.url, token="kubelet-a")
+            # own pod: status update + delete (eviction) allowed
+            a.update_pod_status("default", "on-a", "Running")
+            # other node's pod: rejected by NodeRestriction
+            with pytest.raises(PermissionError):
+                a.update_pod_status("default", "on-b", "Failed")
+            with pytest.raises(PermissionError):
+                a.delete("Pod", "on-b", namespace="default")
+            assert a.delete("Pod", "on-a", namespace="default")
+        finally:
+            server.shutdown_server()
+
+
+class TestRootCACertPublisher:
+    def test_publishes_and_heals_the_trust_anchor(self):
+        store = ClusterStore()
+        cm = ControllerManager(
+            store, controllers=["root-ca-cert-publisher"]
+        )
+        cm.start()
+        try:
+            store.add_namespace(Namespace(
+                metadata=ObjectMeta(name="dev")))
+            assert wait_for(lambda: store.get_object(
+                "ConfigMap", "dev", ROOT_CA_CONFIGMAP) is not None)
+            bundle = store.get_object(
+                "ConfigMap", "dev", ROOT_CA_CONFIGMAP).data["ca.crt"]
+            assert "cluster-root-ca-fingerprint" in bundle
+            # deletion: recreated
+            store.delete_object("ConfigMap", "dev", ROOT_CA_CONFIGMAP)
+            assert wait_for(lambda: store.get_object(
+                "ConfigMap", "dev", ROOT_CA_CONFIGMAP) is not None)
+            # drift: healed back to the CA bundle
+            store.mutate_object(
+                "ConfigMap", "dev", ROOT_CA_CONFIGMAP,
+                lambda cm_: cm_.__setattr__(
+                    "data", {"ca.crt": "tampered"}) or True,
+            )
+            assert wait_for(lambda: store.get_object(
+                "ConfigMap", "dev", ROOT_CA_CONFIGMAP
+            ).data["ca.crt"] == bundle)
+        finally:
+            cm.stop()

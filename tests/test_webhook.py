@@ -218,3 +218,32 @@ class TestWebhooksComposeWithCRDs:
         with pytest.raises(PermissionError):
             client.create(bad)
         assert store.get_object("Widget", "default", "w2") is None
+
+
+class TestSubresourceRuleMatching:
+    """A validating rule naming "pods" must NOT intercept kubelet
+    status writes; "pods/status" is its own vocabulary entry
+    (reference rule-matching in admission/plugin/webhook/rules)."""
+
+    def _deny_all_cfg(self, url, resources):
+        return ValidatingWebhookConfiguration(
+            metadata=ObjectMeta(name=f"deny-{'-'.join(resources).replace('/', '-')}"),
+            webhooks=[Webhook(
+                name="deny.example.com", url=url + "/deny-bad",
+                rules=[WebhookRule(operations=["*"],
+                                   resources=list(resources))],
+            )],
+        )
+
+    def test_pods_rule_does_not_block_status_writes(self, hook_server, api):
+        store, server, client = api
+        pod = MakePod().name("w1").label("bad", "true").uid("u-w1").obj()
+        store.create_pod(pod)  # store-direct: no admission at create
+        client.create(self._deny_all_cfg(hook_server, ["pods"]))
+        # status write sails past the "pods" rule
+        client.update_pod_status("default", "w1", "Running")
+        assert store.get_pod("default", "w1").status.phase == "Running"
+        # a "pods/status" rule DOES gate it
+        client.create(self._deny_all_cfg(hook_server, ["pods/status"]))
+        with pytest.raises(PermissionError):
+            client.update_pod_status("default", "w1", "Failed")
