@@ -1056,6 +1056,28 @@ class ClusterStore:
             self._dispatch(Event(MODIFIED, "PersistentVolumeClaim", pvc))
             return True
 
+    def unbind_pv(self, pv_name: str, pvc_namespace: str,
+                  pvc_name: str) -> bool:
+        """Exact inverse of ``bind_pv`` for a pair it just bound — the
+        batch commit's partial-failure rollback (the serial path's
+        Unreserve analog). Refuses to touch a pair that is not bound to
+        each other."""
+        with self._lock:
+            pv = self._pvs.get(pv_name)
+            pvc = self._pvcs.get(f"{pvc_namespace}/{pvc_name}")
+            if pv is None or pvc is None:
+                return False
+            if pv.claim_ref != f"{pvc_namespace}/{pvc_name}" or \
+                    pvc.volume_name != pv_name:
+                return False
+            pv.claim_ref = None
+            pv.phase = "Available"
+            pvc.volume_name = ""
+            pvc.phase = "Pending"
+            self._dispatch(Event(MODIFIED, "PersistentVolume", pv))
+            self._dispatch(Event(MODIFIED, "PersistentVolumeClaim", pvc))
+            return True
+
     # ------------------------------------------------------------------
     # Lease objects (leader election; reference client-go leaderelection)
     def try_acquire_or_renew(

@@ -262,6 +262,9 @@ class BatchEncoder:
         self._attach_col: Dict[str, int] = {}
         # memoized pvc -> frozenset((driver, volume-key)) resolution
         self._pod_attach_cache: Dict[str, frozenset] = {}
+        # per-epoch wfc_class_batchable verdicts (PV/SC/CSINode events
+        # invalidate the session before the pool property can drift)
+        self._wfc_cache: Dict = {}
         # (driver, volume) pairs already attached somewhere — by
         # existing pods (full encode) or earlier batch pods this epoch.
         # A pod re-using one of these rides the serial path: csi.go
@@ -801,9 +804,21 @@ class BatchEncoder:
             if not v.persistent_volume_claim:
                 continue
             pvc = self._client.get_pvc(pod.namespace, v.persistent_volume_claim)
-            if pvc is None or not pvc.volume_name:
-                # host-only shapes; the identity only needs stability
-                ident.append(("unbound", v.persistent_volume_claim))
+            if pvc is None:
+                ident.append(("missing", v.persistent_volume_claim))
+                continue
+            if not pvc.volume_name:
+                if wfc_class_batchable(self._client,
+                                       pvc.storage_class_name,
+                                       self._wfc_cache):
+                    # node-independent pool: feasibility is a property
+                    # of the CLASS, so every such pod shares a profile
+                    # (a per-claim identity would explode U to one
+                    # profile per pod)
+                    ident.append(("wfc", pvc.storage_class_name))
+                else:
+                    # host-only shapes; identity only needs stability
+                    ident.append(("unbound", v.persistent_volume_claim))
                 continue
             pv = self._client.get_pv(pvc.volume_name)
             if pv is None:
@@ -840,7 +855,7 @@ class BatchEncoder:
         if (
             self._client is not None
             and any(v.persistent_volume_claim for v in pod.spec.volumes)
-            and not is_host_only(pod, self._client)
+            and not is_host_only(pod, self._client, self._wfc_cache)
         ):
             self._apply_volume_feasibility(pod, mask)
 
@@ -902,20 +917,68 @@ class BatchEncoder:
         return score
 
     def _is_inexpressible(self, pod: Pod) -> bool:
-        return is_host_only(pod, self._client)
+        return is_host_only(pod, self._client, self._wfc_cache)
 
 
-def is_host_only(pod: Pod, client=None) -> bool:
+def wfc_class_batchable(client, sc_name: str, cache=None) -> bool:
+    """True when an UNBOUND claim of this storage class is expressible
+    on the batch path:
+
+    - WaitForFirstConsumer binding (Immediate unbound claims are
+      unschedulable until the PV controller acts — host semantics);
+    - the provisioner has no published CSINode attach limit anywhere
+      (otherwise the claim consumes attach budget the columns must
+      track per claim);
+    - every candidate PV (Available, unclaimed, same class) is free of
+      node affinity — the match result is then identical on every
+      node, so scheduling carries NO volume constraint and the actual
+      PV assignment can happen at commit time.
+
+    O(PVs + CSINodes) per class; callers scanning many pods pass a
+    per-drain ``cache`` dict so one drain pays one scan per class."""
+    if not sc_name:
+        return False
+    if cache is not None and ("wfc", sc_name) in cache:
+        return cache[("wfc", sc_name)]
+    verdict = False
+    sc = client.get_storage_class(sc_name)
+    if sc is not None and \
+            sc.volume_binding_mode == "WaitForFirstConsumer":
+        limited = any(
+            d.name == sc.provisioner and d.allocatable_count is not None
+            for cn in client.list_csi_nodes() for d in cn.drivers
+        )
+        if not limited:
+            verdict = all(
+                pv.node_affinity is None
+                for pv in client.list_pvs()
+                if pv.phase == "Available" and pv.claim_ref is None
+                and pv.storage_class_name == sc_name
+            )
+    if cache is not None:
+        cache[("wfc", sc_name)] = verdict
+    return verdict
+
+
+def is_host_only(pod: Pod, client=None, cache=None) -> bool:
     """Pods needing host-only machinery take the serial path — the single
     source of truth shared by the encoder and the sidecar's partitioner.
 
     Host-only: inline cloud-disk volumes (``VolumeRestrictions``'
     node-pod conflict scan and the in-tree attach limits are dynamic
     host-side checks), host ports (``UsedPorts`` conflict tracking), and
-    PVC volumes that are NOT plainly bound — unbound claims need the
-    stateful ``VolumeBinding`` Reserve/PreBind match machinery, and
-    shared (RWX/ROX) claims would double-count in the attach-column
-    model. A bound RWO claim with a live PV is fully expressible:
+    PVC volumes that are NOT plainly bound — with one carve-out: an
+    unbound WaitForFirstConsumer claim whose class is attach-irrelevant
+    and whose candidate PV pool is NODE-INDEPENDENT (no candidate
+    carries node affinity) imposes no per-node constraint at all, so it
+    batches; the sidecar assigns an actual PV from the pool at COMMIT
+    time (the Reserve/PreBind moment) and falls back to the serial path
+    if the pool ran dry with no provisioner. Other unbound claims need
+    the stateful per-node ``VolumeBinding`` match machinery, and
+    CSI-attached shared (RWX/ROX) claims would double-count in the
+    attach-column model (a shared claim with no CSI driver consumes no
+    attach budget, so it batches). A bound claim with a live PV is
+    otherwise fully expressible:
     feasibility is the PV's static node affinity/zone plus the CSI
     attach-limit resource columns. Without a ``client`` every PVC pod is
     conservatively host-only (the pre-round-3 contract)."""
@@ -933,11 +996,23 @@ def is_host_only(pod: Pod, client=None) -> bool:
         if client is None:
             return True
         pvc = client.get_pvc(pod.namespace, v.persistent_volume_claim)
-        if pvc is None or not pvc.volume_name:
+        if pvc is None:
             return True
-        if any(m in SHARED_ACCESS_MODES for m in pvc.access_modes):
+        if not pvc.volume_name:
+            if not wfc_class_batchable(client, pvc.storage_class_name,
+                                       cache):
+                return True
+            continue
+        pv = client.get_pv(pvc.volume_name)
+        if pv is None:
             return True
-        if client.get_pv(pvc.volume_name) is None:
+        if any(m in SHARED_ACCESS_MODES for m in pvc.access_modes) and \
+                getattr(pv, "csi_driver", ""):
+            # a CSI-attached shared volume would double-count in the
+            # attach columns (one attachment, many pods); a shared PV
+            # with NO CSI driver consumes no attach budget at all, so
+            # its feasibility is purely the static PV affinity/zone
+            # masks — fully expressible on the batch path
             return True
     return False
 

@@ -39,18 +39,33 @@ ERR_REASON_PVC_NOT_FOUND = "persistentvolumeclaim not found"
 
 
 class _PodVolumes:
-    __slots__ = ("bound_claims", "claims_to_bind", "matches")
+    __slots__ = ("bound_claims", "claims_to_bind", "matches",
+                 "candidates", "node_independent", "cached_chosen")
 
     def __init__(self):
         self.bound_claims = []   # PVCs already bound to a PV
         self.claims_to_bind = []  # WaitForFirstConsumer PVCs needing a PV
         self.matches: Dict[str, Dict[str, str]] = {}  # node -> {pvc key: pv name}
+        # per-class candidate PV lists, built ONCE in PreFilter (the
+        # reference's volume binder keeps an indexed PV cache; a per-
+        # (pod, node) scan of every PV in the cluster is quadratic)
+        self.candidates: Dict[str, list] = {}
+        # True when no candidate carries node affinity: the match result
+        # is identical on every node, so Filter computes it once
+        self.node_independent = False
+        self.cached_chosen: Optional[Dict[str, str]] = None
 
     def clone(self):
         c = _PodVolumes()
         c.bound_claims = list(self.bound_claims)
         c.claims_to_bind = list(self.claims_to_bind)
         c.matches = {n: dict(m) for n, m in self.matches.items()}
+        c.candidates = {k: list(v) for k, v in self.candidates.items()}
+        c.node_independent = self.node_independent
+        c.cached_chosen = (
+            dict(self.cached_chosen)
+            if self.cached_chosen is not None else None
+        )
         return c
 
 
@@ -94,6 +109,20 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
                 return Status(
                     UNSCHEDULABLE_AND_UNRESOLVABLE, ERR_REASON_UNBOUND_IMMEDIATE
                 )
+        if pv.claims_to_bind:
+            # class-indexed candidate PVs, one pass over the PV table
+            # per CYCLE instead of one per (claim, node)
+            classes = {c.storage_class_name or "" for c in pv.claims_to_bind}
+            for p in client.list_pvs():
+                if p.phase != "Available" or p.claim_ref is not None:
+                    continue
+                cls = p.storage_class_name
+                if cls in classes:
+                    pv.candidates.setdefault(cls, []).append(p)
+            pv.node_independent = all(
+                p.node_affinity is None
+                for ps in pv.candidates.values() for p in ps
+            )
         state.write(PRE_FILTER_STATE_KEY, pv)
         return None
 
@@ -115,10 +144,16 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
 
         # delayed-binding claims: find a matching available PV per claim
         if pv_state.claims_to_bind:
+            if pv_state.node_independent and \
+                    pv_state.cached_chosen is not None:
+                # no candidate carries node affinity: the match from
+                # the first filtered node holds for every node
+                pv_state.matches[node.name] = pv_state.cached_chosen
+                return None
             chosen: Dict[str, str] = {}
             used = set()
             for pvc in pv_state.claims_to_bind:
-                match = self._find_matching_pv(client, pvc, node, used)
+                match = self._find_matching_pv(pv_state, pvc, node, used)
                 if match is not None:
                     chosen[f"{pvc.namespace}/{pvc.name}"] = match.name
                     used.add(match.name)
@@ -128,17 +163,15 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
                         return Status(UNSCHEDULABLE, ERR_REASON_BIND_CONFLICT)
                     # dynamic provisioning will satisfy it on this node
             pv_state.matches[node.name] = chosen
+            if pv_state.node_independent:
+                pv_state.cached_chosen = chosen
         return None
 
     @staticmethod
-    def _find_matching_pv(client, pvc, node, used):
+    def _find_matching_pv(pv_state, pvc, node, used):
         request = pvc.requests.get("storage")
-        for pv in client.list_pvs():
-            if pv.name in used or pv.phase != "Available":
-                continue
-            if pv.claim_ref is not None:
-                continue
-            if pv.storage_class_name != (pvc.storage_class_name or ""):
+        for pv in pv_state.candidates.get(pvc.storage_class_name or "", ()):
+            if pv.name in used:
                 continue
             if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes):
                 continue

@@ -42,6 +42,98 @@ from kubernetes_tpu.scheduler.scheduler import Scheduler
 from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo
 
 
+class _CommitVolumeBinder:
+    """Commit-time PV assignment for batched pods carrying
+    node-independent WaitForFirstConsumer claims — the batch path's
+    Reserve/PreBind moment (reference ``volume_binding.go`` PreBind →
+    BindPodVolumes). Such claims impose no per-node constraint
+    (``wfc_class_batchable``), so the solve ignores them and the
+    actual PV pops from the class's free pool here, while the store
+    lock still serializes against concurrent serial-path binders.
+    Lazily snapshots each pool once per commit batch."""
+
+    def __init__(self, client):
+        self.client = client
+        self._pools: Dict[str, list] = {}
+        self.bound = 0
+
+    def _pool(self, sc_name: str) -> list:
+        pool = self._pools.get(sc_name)
+        if pool is None:
+            # node_affinity filter: the drain-time verdict saw an
+            # affinity-free pool, but a zonal PV may have become
+            # Available since — binding it here would hand a pod a
+            # volume its (already chosen) node cannot access
+            pool = [
+                pv for pv in self.client.list_pvs()
+                if pv.phase == "Available" and pv.claim_ref is None
+                and pv.storage_class_name == sc_name
+                and pv.node_affinity is None
+            ]
+            # ascending capacity → each claim takes the smallest
+            # adequate PV (the reference's smallestPVForClaim ordering)
+            def cap_key(pv):
+                cap = pv.capacity.get("storage")
+                return (cap is None, 0 if cap is None else cap.value())
+
+            pool.sort(key=cap_key)
+            self._pools[sc_name] = pool
+        return pool
+
+    def finalize(self, pod) -> bool:
+        """Bind every still-unbound claim of the pod. False = a pool
+        ran dry with no provisioner — the assignment is void and the
+        pod must take the serial path for its real status. A partial
+        failure unwinds the pod's earlier binds (the serial path's
+        Unreserve contract): a pod that ends up pending must not keep
+        PVs the next batch needs."""
+        done: List[tuple] = []  # (pv name, pvc name) bound for THIS pod
+        for v in pod.spec.volumes:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self.client.get_pvc(pod.namespace,
+                                      v.persistent_volume_claim)
+            if pvc is None or pvc.volume_name:
+                continue      # bound claims were expressible statically
+            sc_name = pvc.storage_class_name or ""
+            pool = self._pool(sc_name)
+            request = pvc.requests.get("storage")
+            chosen = None
+            for i, pv in enumerate(pool):
+                if pvc.access_modes and not \
+                        set(pvc.access_modes) <= set(pv.access_modes):
+                    continue
+                cap = pv.capacity.get("storage")
+                if request is not None and (cap is None or cap < request):
+                    continue
+                chosen = i
+                break
+            if chosen is not None:
+                pv = pool.pop(chosen)
+                if not self.client.bind_pv(pv.name, pod.namespace,
+                                           pvc.name):
+                    self._rollback(pod, done)   # raced away mid-commit
+                    return False
+                done.append((pv.name, pvc.name))
+                self.bound += 1
+                continue
+            sc = self.client.get_storage_class(sc_name) if sc_name \
+                else None
+            if sc is None or not sc.provisioner:
+                self._rollback(pod, done)
+                return False
+            # dynamic provisioning satisfies the claim on any node
+        return True
+
+    def _rollback(self, pod, done: List[tuple]) -> None:
+        for pv_name, pvc_name in done:
+            try:
+                self.client.unbind_pv(pv_name, pod.namespace, pvc_name)
+            except Exception:  # noqa: BLE001 — unwind must not mask
+                _logger.exception("PV bind rollback failed: %s", pv_name)
+        self.bound -= len(done)
+
+
 class TPUBatchScheduler:
     # up to this many device-declined pods per batch take the serial
     # path (exact statuses/messages); above it, mass-decline fast path
@@ -165,7 +257,9 @@ class TPUBatchScheduler:
             qpis = self._drain(0.0 if prev is not None else pop_timeout)
         processed = len(qpis)
 
-        # partition: batchable vs serial-fallback
+        # partition: batchable vs serial-fallback (one wfc-class scan
+        # per drain, not one per pod)
+        host_only_cache: dict = {}
         for qpi, cycle in qpis:
             pod = qpi.pod
             fwk = sched.profiles.get(pod.spec.scheduler_name)
@@ -173,7 +267,8 @@ class TPUBatchScheduler:
                 continue
             if sched.skip_pod_schedule(fwk, pod):
                 continue
-            if fwk.profile_name != "default-scheduler" or self._needs_serial(pod):
+            if fwk.profile_name != "default-scheduler" or \
+                    self._needs_serial(pod, host_only_cache):
                 serial.append(qpi)
             else:
                 batchable.append((qpi, cycle))
@@ -328,8 +423,8 @@ class TPUBatchScheduler:
             _logger.exception("solver warmup failed (continuing cold)")
         return time.monotonic() - t0
 
-    def _needs_serial(self, pod) -> bool:
-        if is_host_only(pod, self.sched.client):
+    def _needs_serial(self, pod, cache=None) -> bool:
+        if is_host_only(pod, self.sched.client, cache):
             return True
         return any(
             ext.is_interested(pod) for ext in self.sched.algorithm.extenders
@@ -370,6 +465,7 @@ class TPUBatchScheduler:
         committed = 0
         declined: List[tuple] = []  # (batch index, qpi, cycle)
         commits: List[tuple] = []   # (qpi, result, cycle, start)
+        vol_binder = _CommitVolumeBinder(sched.client)
         for bi, ((qpi, cycle), assignment) in enumerate(
             zip(batchable, assignments)
         ):
@@ -379,6 +475,14 @@ class TPUBatchScheduler:
             node_name = cluster.node_names[assignment]
             if self.validate and not self._host_validates(fwk, qpi, node_name):
                 # the device state counts this pod but the host refused it
+                self.session.invalidate()
+                serial.append(qpi)
+                continue
+            if not vol_binder.finalize(qpi.pod):
+                # batched WFC claim whose pool ran dry with no
+                # provisioner: the device's assignment is void — the
+                # serial path will produce the proper unschedulable
+                # status (and the mirror no longer matches)
                 self.session.invalidate()
                 serial.append(qpi)
                 continue

@@ -369,11 +369,118 @@ class TestBatchVolumes:
         assert not is_host_only(pod("bound"), store)
         assert is_host_only(pod("bound"))            # no client → conservative
         assert is_host_only(pod("unbound"), store)
-        assert is_host_only(pod("shared"), store)
+        # a shared claim on a non-CSI PV consumes no attach budget:
+        # expressible (static PV affinity masks only)
+        assert not is_host_only(pod("shared"), store)
+        # ...while a CSI-attached shared claim would double-count the
+        # single attachment: host path
+        self._bound_pair(store, "shared-csi", "pv-csi", driver="csi.x")
+        store.get_pvc("default", "shared-csi").access_modes = [
+            "ReadWriteMany"]
+        assert is_host_only(pod("shared-csi"), store)
         assert is_host_only(pod("missing"), store)
         assert is_host_only(
             pod(inline=Volume(name="d", gce_persistent_disk="pd-1")), store
         )
+
+
+    def test_wfc_claims_batch_with_commit_time_binding(self):
+        """Node-independent WaitForFirstConsumer claims ride the BATCH
+        path; the sidecar pops a real PV per claim at commit (the
+        Reserve/PreBind moment). Pool depletion without a provisioner
+        routes the overflow pods to the serial path for their real
+        unschedulable status."""
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import (
+            ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+            StorageClass,
+        )
+        from kubernetes_tpu.ops.encode import is_host_only
+
+        store = ClusterStore()
+        for i in range(3):
+            store.add_node(MakeNode().name(f"n{i}")
+                           .capacity({"cpu": "16", "memory": "32Gi"}).obj())
+        # provisioner-less WFC class with a 4-PV affinity-free pool
+        store.add_storage_class(StorageClass(
+            metadata=ObjectMeta(name="wfc-sc"), provisioner="",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        for i in range(4):
+            store.add_pv(PersistentVolume(
+                metadata=ObjectMeta(name=f"wfc-pv-{i}"),
+                capacity={"storage": parse_quantity("1Gi")},
+                storage_class_name="wfc-sc",
+                phase="Available",
+            ))
+        pods = []
+        for i in range(6):
+            store.add_pvc(PersistentVolumeClaim(
+                metadata=ObjectMeta(name=f"wfc-c{i}", namespace="default"),
+                storage_class_name="wfc-sc",
+                requests={"storage": parse_quantity("1Gi")},
+            ))
+            p = MakePod().name(f"wp{i}").uid(f"wpu{i}") \
+                .req({"cpu": "100m"}).pvc(f"wfc-c{i}").obj()
+            pods.append(p)
+        # expressible on the batch path
+        assert not is_host_only(pods[0], store)
+        sched, bs = make_batch_scheduler(store)
+        try:
+            for p in pods:
+                store.create_pod(p)
+            drain_batches(sched, bs)
+            bound = [p for p in store.list_pods() if p.spec.node_name]
+            assert len(bound) == 4, "pool of 4 PVs binds exactly 4 pods"
+            # every scheduled pod's claim got a REAL PV at commit
+            for p in bound:
+                pvc = store.get_pvc("default",
+                                    p.spec.volumes[0].persistent_volume_claim)
+                assert pvc.volume_name, "claim left unbound after commit"
+                assert store.get_pv(pvc.volume_name).claim_ref == \
+                    f"default/{pvc.name}"
+            # the two overflow pods took the serial path and pend with
+            # the real bind-conflict status
+            pending = [p for p in store.list_pods() if not p.spec.node_name]
+            assert len(pending) == 2
+        finally:
+            sched.stop()
+
+    def test_wfc_with_node_affinity_stays_serial(self):
+        """A WFC pool containing ANY node-affine PV is node-dependent:
+        the per-node match machinery is required, so the claim stays on
+        the serial path."""
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import (
+            NodeSelector, NodeSelectorRequirement, NodeSelectorTerm,
+            ObjectMeta, PersistentVolume, PersistentVolumeClaim,
+            StorageClass,
+        )
+        from kubernetes_tpu.ops.encode import is_host_only
+
+        store = ClusterStore()
+        store.add_storage_class(StorageClass(
+            metadata=ObjectMeta(name="zonal-sc"), provisioner="",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        affinity = NodeSelector(node_selector_terms=[NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(
+                key="zone", operator="In", values=["z1"])],
+        )])
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name="zonal-pv"),
+            capacity={"storage": parse_quantity("1Gi")},
+            storage_class_name="zonal-sc",
+            phase="Available",
+            node_affinity=affinity,
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="zonal-c", namespace="default"),
+            storage_class_name="zonal-sc",
+            requests={"storage": parse_quantity("1Gi")},
+        ))
+        pod = MakePod().name("zp").uid("zpu").pvc("zonal-c").obj()
+        assert is_host_only(pod, store)
 
 
 class TestBatchPreemption:
