@@ -9,14 +9,25 @@ decode, before conversion) and a pair of conversion functions
 the wire-dict representation: the internal hub is the typed dataclass
 scheme (``api/serialization.py``), spokes are wire-shape transforms.
 
-Registered spokes (the demonstration group, mirroring upstream's most
-visibly version-split API):
+Registered spokes:
 
 - ``autoscaling/v1`` HorizontalPodAutoscaler — flat
   ``targetCpuUtilizationPercentage`` (the internal hub shape),
 - ``autoscaling/v2`` HorizontalPodAutoscaler — the ``metrics`` list
   with Resource/Utilization targets, converted losslessly to/from the
-  hub for the cpu-utilization metric the controller consumes.
+  hub for the cpu-utilization metric the controller consumes,
+- ``batch/v1beta1`` CronJob — the reference's nested
+  ``spec.jobTemplate.spec`` wire shape (``pkg/apis/batch/v1beta1``)
+  against the flat internal hub, with v1beta1 defaulting
+  (``defaults.go``: concurrencyPolicy/suspend/history limits),
+- ``policy/v1beta1`` PodDisruptionBudget — nested
+  ``spec.{selector,minAvailable,maxUnavailable}``
+  (``pkg/apis/policy/v1beta1``) against the flat hub.
+
+A versioned field with NO internal representation raises
+``UnconvertibleError`` (the reference's conversion functions return
+errors; the codec surfaces them as 400s) — version skew must fail
+loudly, not silently drop data.
 
 New versions register at runtime (``SCHEME_V.register_version``) — the
 same extension point the reference's scheme builders use.
@@ -29,6 +40,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from kubernetes_tpu.api.serialization import from_wire, to_wire
 
 INTERNAL_VERSION = "v1"  # the hub (legacy core routes serve it directly)
+
+
+class UnconvertibleError(ValueError):
+    """A versioned field has no internal representation — conversion
+    must reject rather than silently drop it."""
 
 Defaulter = Callable[[Dict[str, Any]], None]
 Converter = Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -178,6 +194,156 @@ def _hpa_v1_identity(d: Dict[str, Any]) -> Dict[str, Any]:
     return {k: v for k, v in d.items() if k != "apiVersion"}
 
 
+# ---------------------------------------------------------------------------
+# batch/v1beta1 spoke for CronJob (reference pkg/apis/batch/v1beta1:
+# nested spec.jobTemplate.spec wire shape vs the flat internal hub)
+
+_CJ_META = ("metadata", "kind", "apiVersion")
+
+
+def _cronjob_v1beta1_defaults(d: Dict[str, Any]) -> None:
+    """v1beta1 defaulting (pkg/apis/batch/v1beta1/defaults.go
+    SetDefaults_CronJob): concurrencyPolicy Allow, suspend false,
+    successfulJobsHistoryLimit 3, failedJobsHistoryLimit 1."""
+    spec = d.setdefault("spec", {})
+    if not spec.get("concurrencyPolicy"):
+        spec["concurrencyPolicy"] = "Allow"
+    if spec.get("suspend") is None:
+        spec["suspend"] = False
+    if spec.get("successfulJobsHistoryLimit") is None:
+        spec["successfulJobsHistoryLimit"] = 3
+    if spec.get("failedJobsHistoryLimit") is None:
+        spec["failedJobsHistoryLimit"] = 1
+
+
+def _reject_unknown(spec: Dict[str, Any], allowed: tuple,
+                    where: str) -> None:
+    """Conversion must fail loudly on fields with no hub
+    representation — a 201 that silently drops data is version skew's
+    worst failure mode."""
+    unknown = sorted(set(spec) - set(allowed))
+    if unknown:
+        raise UnconvertibleError(
+            f"{where} field(s) {', '.join(unknown)} have no internal "
+            f"representation"
+        )
+
+
+_CJ_SPEC_FIELDS = ("schedule", "suspend", "concurrencyPolicy",
+                   "startingDeadlineSeconds",
+                   "successfulJobsHistoryLimit",
+                   "failedJobsHistoryLimit", "jobTemplate")
+_CJ_JT_FIELDS = ("completions", "parallelism",
+                 "ttlSecondsAfterFinished", "template")
+
+
+def _cronjob_v1beta1_to_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: v for k, v in d.items() if k in _CJ_META and
+           k != "apiVersion"}
+    out["kind"] = "CronJob"
+    spec = d.get("spec") or {}
+    _reject_unknown(spec, _CJ_SPEC_FIELDS, "batch/v1beta1 CronJob spec")
+    _reject_unknown((spec.get("jobTemplate") or {}).get("spec") or {},
+                    _CJ_JT_FIELDS,
+                    "batch/v1beta1 CronJob spec.jobTemplate.spec")
+    for src, dst in (("schedule", "schedule"), ("suspend", "suspend"),
+                     ("concurrencyPolicy", "concurrencyPolicy"),
+                     ("startingDeadlineSeconds",
+                      "startingDeadlineSeconds")):
+        if src in spec:
+            out[dst] = spec[src]
+    # the hub carries no history-limit fields: the v1beta1 DEFAULTS are
+    # representable (they're implied), any OTHER value is data the hub
+    # would silently lose — reject it (weak #5's unconvertible path)
+    if spec.get("successfulJobsHistoryLimit") not in (None, 3):
+        raise UnconvertibleError(
+            "successfulJobsHistoryLimit has no internal representation "
+            "(only the v1beta1 default 3 round-trips)"
+        )
+    if spec.get("failedJobsHistoryLimit") not in (None, 1):
+        raise UnconvertibleError(
+            "failedJobsHistoryLimit has no internal representation "
+            "(only the v1beta1 default 1 round-trips)"
+        )
+    jt = spec.get("jobTemplate") or {}
+    jt_spec = jt.get("spec") or {}
+    for src, dst in (("completions", "completions"),
+                     ("parallelism", "parallelism"),
+                     ("ttlSecondsAfterFinished",
+                      "ttlSecondsAfterFinished")):
+        if src in jt_spec:
+            out[dst] = jt_spec[src]
+    if "template" in jt_spec:
+        out["jobTemplate"] = jt_spec["template"]
+    status = d.get("status") or {}
+    if "lastScheduleTime" in status:
+        out["lastScheduleTime"] = status["lastScheduleTime"]
+    return out
+
+
+def _cronjob_v1beta1_from_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: v for k, v in d.items() if k in _CJ_META}
+    jt_spec: Dict[str, Any] = {}
+    for src, dst in (("completions", "completions"),
+                     ("parallelism", "parallelism"),
+                     ("ttlSecondsAfterFinished",
+                      "ttlSecondsAfterFinished")):
+        if src in d:
+            jt_spec[dst] = d[src]
+    if "jobTemplate" in d:
+        jt_spec["template"] = d["jobTemplate"]
+    spec: Dict[str, Any] = {
+        "jobTemplate": {"spec": jt_spec},
+        "successfulJobsHistoryLimit": 3,
+        "failedJobsHistoryLimit": 1,
+    }
+    for key in ("schedule", "suspend", "concurrencyPolicy",
+                "startingDeadlineSeconds"):
+        if key in d:
+            spec[key] = d[key]
+    out["spec"] = spec
+    if "lastScheduleTime" in d:
+        out["status"] = {"lastScheduleTime": d["lastScheduleTime"]}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# policy/v1beta1 spoke for PodDisruptionBudget (reference
+# pkg/apis/policy/v1beta1: nested spec.{selector,minAvailable,
+# maxUnavailable} vs the flat hub)
+
+
+def _pdb_v1beta1_to_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: v for k, v in d.items()
+           if k not in ("spec", "apiVersion")}
+    spec = d.get("spec") or {}
+    _reject_unknown(spec, ("minAvailable", "maxUnavailable", "selector"),
+                    "policy/v1beta1 PodDisruptionBudget spec")
+    if "minAvailable" in spec:
+        out["minAvailable"] = spec["minAvailable"]
+    if "maxUnavailable" in spec:
+        out["maxUnavailable"] = spec["maxUnavailable"]
+    if "selector" in spec:
+        out["labelSelector"] = spec["selector"]
+    return out
+
+
+def _pdb_v1beta1_from_internal(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {k: v for k, v in d.items() if k not in (
+        "minAvailable", "maxUnavailable", "labelSelector",
+        "apiVersion", "kind",
+    )}
+    spec: Dict[str, Any] = {}
+    if "minAvailable" in d:
+        spec["minAvailable"] = d["minAvailable"]
+    if "maxUnavailable" in d:
+        spec["maxUnavailable"] = d["maxUnavailable"]
+    if "labelSelector" in d:
+        spec["selector"] = d["labelSelector"]
+    out["spec"] = spec
+    return out
+
+
 SCHEME_V = VersionedScheme()
 SCHEME_V.register_version(
     "autoscaling/v1", "HorizontalPodAutoscaler",
@@ -189,4 +355,15 @@ SCHEME_V.register_version(
     to_internal=_hpa_v2_to_internal,
     from_internal=_hpa_v2_from_internal,
     defaulter=_hpa_v2_defaults,
+)
+SCHEME_V.register_version(
+    "batch/v1beta1", "CronJob",
+    to_internal=_cronjob_v1beta1_to_internal,
+    from_internal=_cronjob_v1beta1_from_internal,
+    defaulter=_cronjob_v1beta1_defaults,
+)
+SCHEME_V.register_version(
+    "policy/v1beta1", "PodDisruptionBudget",
+    to_internal=_pdb_v1beta1_to_internal,
+    from_internal=_pdb_v1beta1_from_internal,
 )

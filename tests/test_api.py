@@ -291,3 +291,221 @@ class TestVersionedScheme:
             assert code == 404
         finally:
             server.shutdown_server()
+
+
+class TestBatchPolicySpokes:
+    """VERDICT r3 #8: two more versioned spokes (batch/v1beta1 CronJob,
+    policy/v1beta1 PodDisruptionBudget) with nested reference wire
+    shapes, the unconvertible-field error path, a hub<->spoke
+    round-trip fuzz over every registered kind, and one watch stream
+    per version serving the same store concurrently."""
+
+    def test_cronjob_v1beta1_nested_shape_round_trips(self):
+        from kubernetes_tpu.api.scheme import SCHEME_V
+
+        body = {
+            "metadata": {"name": "backup", "namespace": "default"},
+            "spec": {
+                "schedule": "*/10 * * * *",
+                "startingDeadlineSeconds": 120,
+                "jobTemplate": {"spec": {
+                    "completions": 2, "parallelism": 2,
+                    "template": {"spec": {"containers": []}},
+                }},
+            },
+        }
+        cj = SCHEME_V.decode(body, "CronJob", "batch/v1beta1")
+        assert cj.schedule == "*/10 * * * *"
+        assert cj.completions == 2 and cj.parallelism == 2
+        assert cj.starting_deadline_seconds == 120
+        assert cj.concurrency_policy == "Allow"  # v1beta1 defaulting
+        assert cj.suspend is False
+        out = SCHEME_V.encode(cj, "batch/v1beta1")
+        assert out["apiVersion"] == "batch/v1beta1"
+        assert out["spec"]["jobTemplate"]["spec"]["completions"] == 2
+        assert out["spec"]["successfulJobsHistoryLimit"] == 3
+
+    def test_cronjob_unconvertible_field_rejected(self):
+        import pytest
+
+        from kubernetes_tpu.api.scheme import SCHEME_V, UnconvertibleError
+
+        body = {
+            "metadata": {"name": "x", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",
+                     "successfulJobsHistoryLimit": 7},
+        }
+        with pytest.raises(UnconvertibleError):
+            SCHEME_V.decode(body, "CronJob", "batch/v1beta1")
+        # ...and over HTTP it is the client's 400, not a silent drop
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        server = APIServer(store=ClusterStore()).start()
+        try:
+            client = RestClient(server.url)
+            code, payload = client._request(
+                "POST",
+                "/apis/batch/v1beta1/namespaces/default/cronjobs",
+                dict(body, kind="CronJob", apiVersion="batch/v1beta1"),
+            )
+            assert code == 400
+            assert "successfulJobsHistoryLimit" in payload.get(
+                "message", "")
+        finally:
+            server.shutdown_server()
+
+    def test_pdb_v1beta1_nested_shape(self):
+        from kubernetes_tpu.api.scheme import SCHEME_V
+
+        body = {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {
+                "minAvailable": "50%",
+                "selector": {"matchLabels": {"app": "web"}},
+            },
+        }
+        pdb = SCHEME_V.decode(body, "PodDisruptionBudget",
+                              "policy/v1beta1")
+        assert pdb.min_available == "50%"
+        assert pdb.label_selector is not None
+        out = SCHEME_V.encode(pdb, "policy/v1beta1")
+        assert out["spec"]["minAvailable"] == "50%"
+        assert out["spec"]["selector"]["matchLabels"] == {"app": "web"}
+        assert "minAvailable" not in out  # nested, not flat
+
+    def test_roundtrip_fuzz_all_registered_kinds(self):
+        """Hub -> spoke -> hub must be the identity for every
+        registered (version, kind) over randomized objects (reference
+        roundtrip_test.go fuzzing)."""
+        import random
+
+        from kubernetes_tpu.api.scheme import SCHEME_V
+        from kubernetes_tpu.api.serialization import to_wire
+        from kubernetes_tpu.api.types import (
+            CronJob, HorizontalPodAutoscaler, ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        rng = random.Random(20260730)
+
+        def rand_meta(i):
+            return ObjectMeta(name=f"obj-{i}", namespace="default")
+
+        def rand_hpa(i):
+            return HorizontalPodAutoscaler(
+                metadata=rand_meta(i),
+                scale_target_ref={"kind": "Deployment",
+                                  "name": f"d{i}"},
+                min_replicas=rng.randint(1, 5),
+                max_replicas=rng.randint(5, 50),
+                target_cpu_utilization_percentage=rng.randint(1, 99),
+            )
+
+        def rand_cronjob(i):
+            return CronJob(
+                metadata=rand_meta(i),
+                schedule=f"*/{rng.randint(1, 59)} * * * *",
+                suspend=rng.random() < 0.5,
+                completions=rng.randint(1, 5),
+                parallelism=rng.randint(1, 5),
+                starting_deadline_seconds=(
+                    float(rng.randint(10, 600))
+                    if rng.random() < 0.5 else None),
+                concurrency_policy=rng.choice(
+                    ["Allow", "Forbid", "Replace"]),
+                job_template={"spec": {"containers": [
+                    {"name": "c", "image": f"img-{i}"}]}},
+            )
+
+        def rand_pdb(i):
+            pdb = PodDisruptionBudget(metadata=rand_meta(i))
+            if rng.random() < 0.5:
+                pdb.min_available = rng.choice(
+                    [rng.randint(1, 5), f"{rng.randint(1, 99)}%"])
+            else:
+                pdb.max_unavailable = rng.choice(
+                    [rng.randint(1, 5), f"{rng.randint(1, 99)}%"])
+            return pdb
+
+        makers = {
+            "HorizontalPodAutoscaler": rand_hpa,
+            "CronJob": rand_cronjob,
+            "PodDisruptionBudget": rand_pdb,
+        }
+        versions = sorted({v for (v, _k) in SCHEME_V._spokes})
+        assert len(versions) >= 4  # autoscaling x2, batch, policy
+        checked = 0
+        for version in versions:
+            for kind in SCHEME_V.kinds_for(version):
+                maker = makers[kind]
+                for i in range(25):
+                    obj = maker(i)
+                    hub = to_wire(obj)
+                    spoke = SCHEME_V.encode(obj, version)
+                    back = SCHEME_V.decode(spoke, kind, version)
+                    assert to_wire(back) == hub, (
+                        f"{version}/{kind} object {i} did not "
+                        f"round-trip"
+                    )
+                    checked += 1
+        assert checked >= 100
+
+    def test_concurrent_watch_streams_one_per_version(self):
+        """One store, one object stream, two watch connections — each
+        serving ITS version's wire shape (versioned-codec contract on
+        the watch path, weak #5)."""
+        import threading
+
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.api.types import (
+            ObjectMeta, PodDisruptionBudget,
+        )
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            frames = {"v1": [], "v1beta1": []}
+            seen = {"v1": threading.Event(),
+                    "v1beta1": threading.Event()}
+
+            def watcher(path, key):
+                import json as _json
+                import urllib.request
+
+                req = urllib.request.Request(server.url + path)
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    for line in resp:
+                        frames[key].append(_json.loads(line))
+                        seen[key].set()
+                        return
+
+            t1 = threading.Thread(
+                target=watcher,
+                args=("/api/v1/namespaces/default/"
+                      "poddisruptionbudgets?watch=1", "v1"),
+                daemon=True)
+            t2 = threading.Thread(
+                target=watcher,
+                args=("/apis/policy/v1beta1/namespaces/default/"
+                      "poddisruptionbudgets?watch=1", "v1beta1"),
+                daemon=True)
+            t1.start(); t2.start()
+            import time as _time
+
+            _time.sleep(0.3)  # both streams connected
+            client.create(PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb1", namespace="default"),
+                min_available=2,
+            ))
+            assert seen["v1"].wait(5) and seen["v1beta1"].wait(5)
+            flat = frames["v1"][0]["object"]
+            nested = frames["v1beta1"][0]["object"]
+            assert flat["minAvailable"] == 2 and "spec" not in flat
+            assert nested["spec"]["minAvailable"] == 2
+            assert nested["apiVersion"] == "policy/v1beta1"
+            assert "minAvailable" not in nested
+        finally:
+            server.shutdown_server()
