@@ -128,6 +128,9 @@ class Container:
     image: str = ""
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     ports: List[ContainerPort] = field(default_factory=list)
+    # "" = cluster default (IfNotPresent/Always by tag); the
+    # AlwaysPullImages admission plugin forces "Always"
+    image_pull_policy: str = ""
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Container":
@@ -138,6 +141,7 @@ class Container:
         c.resources = ResourceRequirements.from_dict(g("resources"))
         ports = g("ports")
         c.ports = [ContainerPort.from_dict(p) for p in ports] if ports else []
+        c.image_pull_policy = g("imagePullPolicy", "")
         return c
 
 

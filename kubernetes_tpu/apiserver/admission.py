@@ -324,6 +324,129 @@ class ServiceAccountAdmission(AdmissionPlugin):
             )
 
 
+class AlwaysPullImages(AdmissionPlugin):
+    """Force imagePullPolicy=Always on every container (reference
+    ``plugin/pkg/admission/alwayspullimages/admission.go``): in a
+    multi-tenant cluster a pod must not reuse another tenant's
+    node-cached private image just by naming it."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+    def validate(self, req: AdmissionRequest) -> None:
+        if req.kind != "Pod" or req.operation != CREATE:
+            return
+        pod: Pod = req.obj
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            if c.image_pull_policy != "Always":
+                raise AdmissionError(
+                    f"container {c.name!r}: imagePullPolicy must be "
+                    f"Always"
+                )
+
+
+class EventRateLimit(AdmissionPlugin):
+    """Server-side Event flood protection (reference
+    ``plugin/pkg/admission/eventratelimit/admission.go``): a token
+    bucket per source namespace; Events over the burst are rejected so
+    a crash-looping component cannot swamp the store. Only the Server
+    type limit is modeled (the reference's default config)."""
+
+    name = "EventRateLimit"
+
+    # bounded like the reference's LRU cache (eventratelimit defaults
+    # to 4096 keys) — namespaces churn; their buckets must not leak
+    MAX_BUCKETS = 4096
+
+    def __init__(self, qps: float = 50.0, burst: int = 100):
+        import threading
+        import time as _time
+        from collections import OrderedDict
+
+        self.qps = qps
+        self.burst = burst
+        self._lock = threading.Lock()
+        # ns -> (tokens, stamp), LRU-ordered
+        self._buckets: "OrderedDict[str, tuple]" = OrderedDict()
+        self._now = _time.monotonic
+
+    def validate(self, req: AdmissionRequest) -> None:
+        if req.kind != "Event" or req.operation != CREATE:
+            return
+        now = self._now()
+        with self._lock:
+            got = self._buckets.get(req.namespace)
+            if got is not None:
+                self._buckets.move_to_end(req.namespace)
+            tokens, stamp = got if got is not None else \
+                (float(self.burst), now)
+            while len(self._buckets) >= self.MAX_BUCKETS:
+                self._buckets.popitem(last=False)
+            tokens = min(float(self.burst),
+                         tokens + (now - stamp) * self.qps)
+            if tokens < 1.0:
+                self._buckets[req.namespace] = (tokens, now)
+                raise AdmissionError(
+                    f"event rate limit exceeded for namespace "
+                    f"{req.namespace!r}"
+                )
+            self._buckets[req.namespace] = (tokens - 1.0, now)
+
+
+POD_NODE_SELECTOR_ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """Merge a namespace-level node selector into every pod (reference
+    ``plugin/pkg/admission/podnodeselector/admission.go``): the
+    namespace annotation ``scheduler.alpha.kubernetes.io/node-selector``
+    ("k=v,k2=v2") confines the namespace's pods to matching nodes; a
+    pod whose own selector CONFLICTS with the namespace's is
+    rejected."""
+
+    name = "PodNodeSelector"
+
+    def __init__(self, store=None):
+        self.store = store
+
+    @staticmethod
+    def _parse(ann: str) -> Dict[str, str]:
+        out = {}
+        for part in ann.split(","):
+            part = part.strip()
+            if part and "=" in part:
+                k, _, v = part.partition("=")
+                out[k.strip()] = v.strip()
+        return out
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None or req.kind != "Pod" or \
+                req.operation != CREATE:
+            return
+        ns = self.store.get_namespace(req.namespace)
+        if ns is None:
+            return
+        ann = ns.metadata.annotations.get(POD_NODE_SELECTOR_ANNOTATION)
+        if not ann:
+            return
+        selector = self._parse(ann)
+        pod: Pod = req.obj
+        for k, v in selector.items():
+            have = pod.spec.node_selector.get(k)
+            if have is not None and have != v:
+                raise AdmissionError(
+                    f"pod node selector {k}={have!r} conflicts with "
+                    f"namespace selector {k}={v!r}"
+                )
+            pod.spec.node_selector[k] = v
+
+
 MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"
 
 

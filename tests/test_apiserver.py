@@ -293,3 +293,66 @@ class TestMutateObject:
         assert store.mutate_object("Node", "", "n1",
                                    lambda n: False) is None
         assert store.get_node("n1").metadata.resource_version == rv
+
+
+class TestAdmissionBreadth:
+    """Opt-in in-tree plugins (reference plugin/pkg/admission/
+    {alwayspullimages,eventratelimit,podnodeselector}) — available and
+    tested, not default-enabled, matching upstream's default plugin
+    set."""
+
+    def test_always_pull_images(self):
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionChain, AdmissionRequest, AlwaysPullImages, CREATE,
+        )
+        from kubernetes_tpu.testing import MakePod
+
+        chain = AdmissionChain([AlwaysPullImages()])
+        pod = MakePod().name("p").container(image="private/app").obj()
+        pod.spec.containers[0].image_pull_policy = "IfNotPresent"
+        chain.run(AdmissionRequest(CREATE, "Pod", "default", pod))
+        assert pod.spec.containers[0].image_pull_policy == "Always"
+
+    def test_event_rate_limit(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.api.types import Event as ApiEvent
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionError, AdmissionRequest, CREATE, EventRateLimit,
+        )
+
+        limiter = EventRateLimit(qps=0.0, burst=3)
+        ev = ApiEvent()
+        for _ in range(3):
+            limiter.validate(AdmissionRequest(
+                CREATE, "Event", "flood", ev))
+        with _pytest.raises(AdmissionError):
+            limiter.validate(AdmissionRequest(
+                CREATE, "Event", "flood", ev))
+        # other namespaces keep their own bucket
+        limiter.validate(AdmissionRequest(CREATE, "Event", "calm", ev))
+
+    def test_pod_node_selector_merge_and_conflict(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.api.types import Namespace, ObjectMeta
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionError, AdmissionRequest, CREATE, PodNodeSelector,
+        )
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        store.add_namespace(Namespace(metadata=ObjectMeta(
+            name="tenant-a",
+            annotations={"scheduler.alpha.kubernetes.io/node-selector":
+                         "pool=gold, region=us"},
+        )))
+        plugin = PodNodeSelector(store)
+        pod = MakePod().name("p").namespace("tenant-a").obj()
+        plugin.admit(AdmissionRequest(CREATE, "Pod", "tenant-a", pod))
+        assert pod.spec.node_selector == {"pool": "gold", "region": "us"}
+        # conflicting own selector: rejected
+        bad = MakePod().name("q").namespace("tenant-a").obj()
+        bad.spec.node_selector["pool"] = "silver"
+        with _pytest.raises(AdmissionError):
+            plugin.admit(AdmissionRequest(CREATE, "Pod", "tenant-a", bad))
