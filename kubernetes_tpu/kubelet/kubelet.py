@@ -56,6 +56,7 @@ class Kubelet:
         device_manager: Optional[DeviceManager] = None,
         labels: Optional[Dict[str, str]] = None,
         heartbeat_fn=None,
+        static_pod_manifests: Optional[List[dict]] = None,
     ):
         self.store = store
         self.node_name = node_name
@@ -94,6 +95,14 @@ class Kubelet:
         # optional image GC (kubelet/imagegc.py); housekeeping drives
         # maybe_garbage_collect()
         self.image_gc_manager = None
+        # static pods (reference pkg/kubelet/config/file.go: the
+        # /etc/kubernetes/manifests source): run directly from local
+        # manifests, never scheduled; each gets a MIRROR pod in the API
+        # so the control plane can observe it (pkg/kubelet/pod/
+        # mirror_client.go). The manifest set is fixed for this
+        # kubelet's lifetime.
+        self._static_manifests = list(static_pod_manifests or [])
+        self._static_pods: Dict[str, Pod] = {}   # uid -> local truth
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
         self._terminal: set = set()  # uids already reported Succeeded/Failed
@@ -126,6 +135,8 @@ class Kubelet:
     def start(self) -> "Kubelet":
         self.register_node()
         self.heartbeat()
+        self._adopt_runtime_state()
+        self._load_static_pods()
         # watch pod events for this node; initial list picks up existing
         for pod in self.store.list_pods():
             if pod.spec.node_name == self.node_name:
@@ -219,6 +230,103 @@ class Kubelet:
                 f"node-{self.node_name}", self.node_name, RealClock().now(), 40.0
             )
 
+    def _adopt_runtime_state(self) -> None:
+        """Rebuild the sandbox/container maps from the runtime's live
+        truth before any sync runs — a restarted kubelet over a
+        persistent runtime must ADOPT running workloads, never start a
+        second copy (the reference's startup reconciliation against the
+        CRI: kubelet.go HandlePodCleanups / pod-worker resurrection
+        from the runtime cache)."""
+        from kubernetes_tpu.kubelet.cri import SANDBOX_READY
+
+        try:
+            sandboxes = self.runtime.list_pod_sandboxes()
+            containers = self.runtime.list_containers()
+        except Exception:
+            _logger.exception("runtime-state adoption failed")
+            return
+        by_sandbox: Dict[str, list] = {}
+        for c in containers:
+            by_sandbox.setdefault(c.sandbox_id, []).append(c)
+        for sb in sandboxes:
+            if sb.state != SANDBOX_READY:
+                continue
+            self._sandbox_of[sb.pod_uid] = sb.id
+            self._containers_of[sb.pod_uid] = {
+                c.name: c.id for c in by_sandbox.get(sb.id, ())
+            }
+            self._key_of.setdefault(sb.pod_uid, (sb.namespace, sb.name))
+            self._mark_dirty(sb.pod_uid)
+
+    # -- static / mirror pods ------------------------------------------
+    MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+    def _load_static_pods(self) -> None:
+        for manifest in self._static_manifests:
+            try:
+                pod = Pod.from_dict(manifest)
+            except Exception:
+                _logger.exception("bad static pod manifest; skipped")
+                continue
+            if not pod.metadata.namespace:
+                pod.metadata.namespace = "kube-system"
+            pod.spec.node_name = self.node_name
+            # STABLE identity across kubelet restarts (the reference
+            # hashes the manifest source): a fresh random uid per start
+            # would make a surviving mirror look like a different pod
+            # and double-start the workload
+            pod.metadata.uid = (
+                f"static-{self.node_name}-{pod.namespace}-"
+                f"{pod.metadata.name}"
+            )
+            # the mirror annotation (kubernetes.io/config.mirror) is the
+            # reference's config hash; the uid stands in for it — and it
+            # is what NodeRestriction admission keys its mirror-pod
+            # carve-out on
+            pod.metadata.annotations.setdefault(
+                self.MIRROR_ANNOTATION, pod.uid)
+            self._static_pods[pod.uid] = pod
+            self._key_of[pod.uid] = (pod.namespace, pod.name)
+            self._mark_dirty(pod.uid)
+
+    def _ensure_mirror(self, pod: Pod) -> bool:
+        """Create (or recreate) the API mirror of a static pod — the
+        control plane's read-only view; deleting it never stops the
+        static pod, the kubelet just republishes (mirror_client.go
+        CreateMirrorPod semantics). A DIFFERENT pod's mirror squatting
+        the name (stale incarnation) is deleted and replaced, like the
+        reference's hash-mismatch path; an unrelated NON-mirror pod
+        blocks publication — returns False so the caller suppresses
+        API status writes that would clobber the impostor by name."""
+        existing = self.store.get_pod(pod.namespace, pod.name)
+        if existing is not None:
+            if existing.uid == pod.uid:
+                return True
+            if self.MIRROR_ANNOTATION in existing.metadata.annotations:
+                self.store.delete_pod(pod.namespace, pod.name)
+            else:
+                _logger.warning(
+                    "pod %s exists and is not this kubelet's mirror; "
+                    "static pod runs unpublished", pod.full_name(),
+                )
+                return False
+        from kubernetes_tpu.api.types import shallow_copy
+
+        mirror = shallow_copy(pod)
+        mirror.metadata = shallow_copy(pod.metadata)
+        mirror.metadata.resource_version = ""
+        mirror.status = shallow_copy(pod.status)
+        if pod.uid in self._sandbox_of:
+            # a republished mirror of an already-running static pod
+            # must not read as Pending
+            mirror.status.phase = RUNNING
+        try:
+            self.store.create_pod(mirror)
+        except Exception:
+            _logger.exception("mirror pod create failed: %s",
+                              pod.full_name())
+        return True
+
     # -- pod reconciliation --------------------------------------------
     def _find_pod(self, uid: str) -> Optional[Pod]:
         key = self._key_of.get(uid)
@@ -229,6 +337,20 @@ class Kubelet:
         return pod if pod is not None and pod.uid == uid else None
 
     def sync_pod(self, uid: str) -> None:
+        static = self._static_pods.get(uid)
+        if static is not None:
+            # local manifests are the source of truth: republish the
+            # mirror if it was deleted, and keep the containers running
+            # (even unpublished — the reference kubelet runs static
+            # pods with the API entirely down)
+            publish = self._ensure_mirror(static)
+            if uid in self._terminal:
+                return
+            if self._sandbox_of.get(uid) is None:
+                self._admit_and_start(static, publish=publish)
+            else:
+                self._reconcile_containers(static, publish=publish)
+            return
         pod = self._find_pod(uid)
         if pod is None or pod.spec.node_name != self.node_name:
             self._teardown(uid)
@@ -241,13 +363,16 @@ class Kubelet:
             return
         self._reconcile_containers(pod)
 
-    def _admit_and_start(self, pod: Pod) -> None:
+    def _admit_and_start(self, pod: Pod, publish: bool = True) -> None:
+        # publish=False (an impostor pod owns the static pod's name):
+        # run the containers, write nothing to the API by name
         # node-allocatable admission (cm enforcement): a pod the
         # scheduler raced past this node's allocatable fails here with
         # an OutOf* reason, like the reference kubelet's admit handlers
         reason = self.container_manager.admit(pod)
         if reason is not None:
-            self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
+            if publish:
+                self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
             self._terminal.add(pod.uid)
             _logger.warning("pod %s rejected: %s", pod.full_name(), reason)
             return
@@ -264,7 +389,8 @@ class Kubelet:
         except Exception as e:
             # roll back devices granted to earlier containers of this pod
             self.devices.free(pod.uid)
-            self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
+            if publish:
+                self.store.set_pod_phase(pod.namespace, pod.name, FAILED)
             self._terminal.add(pod.uid)
             _logger.warning("pod %s admission failed: %s", pod.full_name(), e)
             return
@@ -298,11 +424,12 @@ class Kubelet:
                 self.image_gc_manager.note_image_used(c.image)
         self._containers_of[pod.uid] = cids
         ip = getattr(self.runtime, "sandbox_ip", lambda s: "")(sid)
-        self.store.set_pod_phase(pod.namespace, pod.name, RUNNING, pod_ip=ip,
-                                 host_ip=self.node_name)
-        self._set_ready_condition(pod, True)
+        if publish:
+            self.store.set_pod_phase(pod.namespace, pod.name, RUNNING,
+                                     pod_ip=ip, host_ip=self.node_name)
+            self._set_ready_condition(pod, True)
 
-    def _reconcile_containers(self, pod: Pod) -> None:
+    def _reconcile_containers(self, pod: Pod, publish: bool = True) -> None:
         cids = self._containers_of.get(pod.uid, {})
         statuses = {
             name: self.runtime.container_status(cid) for name, cid in cids.items()
@@ -322,10 +449,10 @@ class Kubelet:
         if states and all(s == EXITED for s in states):
             if all(code == 0 for code in exit_codes):
                 if policy in ("Never", "OnFailure"):
-                    self._finish(pod, SUCCEEDED)
+                    self._finish(pod, SUCCEEDED, publish=publish)
                     return
             elif policy == "Never":
-                self._finish(pod, FAILED)
+                self._finish(pod, FAILED, publish=publish)
                 return
         # restart what policy says should run
         for name, st in statuses.items():
@@ -333,10 +460,12 @@ class Kubelet:
                 continue
             if policy == "Always" or (policy == "OnFailure" and st.exit_code != 0):
                 self.runtime.start_container(cids[name])
-        self._set_ready_condition(pod, self.probes.pod_ready(pod.uid))
+        if publish:
+            self._set_ready_condition(pod, self.probes.pod_ready(pod.uid))
 
-    def _finish(self, pod: Pod, phase: str) -> None:
-        self.store.set_pod_phase(pod.namespace, pod.name, phase)
+    def _finish(self, pod: Pod, phase: str, publish: bool = True) -> None:
+        if publish:
+            self.store.set_pod_phase(pod.namespace, pod.name, phase)
         self._terminal.add(pod.uid)
         self._release(pod.uid)
 

@@ -746,3 +746,91 @@ def test_local_volumes_mount_without_attach(cluster):
         lambda: store.get_pod("default", "scratch").status.phase == RUNNING
     )
     assert kubelet.volumes.mounted(pod.uid) == ["tmp"]
+
+
+# ---------------------------------------------------------------------------
+# Static / mirror pods (reference pkg/kubelet/config/file.go +
+# pkg/kubelet/pod/mirror_client.go)
+
+
+def test_static_pod_runs_and_publishes_mirror():
+    store = ClusterStore()
+    manifest = {
+        "metadata": {"name": "etcd", "namespace": "kube-system"},
+        "spec": {"containers": [{"name": "etcd", "image": "etcd:3"}]},
+    }
+    kl = Kubelet(store, "cp-1", static_pod_manifests=[manifest])
+    kl.start()
+    try:
+        # the mirror pod appears bound to this node with the mirror
+        # annotation, and reaches Running without any scheduler
+        assert wait_for(lambda: store.get_pod("kube-system", "etcd")
+                        is not None)
+        mirror = store.get_pod("kube-system", "etcd")
+        assert mirror.spec.node_name == "cp-1"
+        assert "kubernetes.io/config.mirror" in mirror.metadata.annotations
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "etcd").status.phase == RUNNING)
+        assert kl.running_pods()
+    finally:
+        kl.stop()
+
+
+def test_mirror_deletion_never_stops_the_static_pod():
+    store = ClusterStore()
+    manifest = {
+        "metadata": {"name": "apiserver", "namespace": "kube-system"},
+        "spec": {"containers": [{"name": "a", "image": "apiserver:1"}]},
+    }
+    kl = Kubelet(store, "cp-1", static_pod_manifests=[manifest])
+    kl.start()
+    try:
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "apiserver") is not None and store.get_pod(
+            "kube-system", "apiserver").status.phase == RUNNING)
+        sandboxes_before = kl.runtime.list_pod_sandboxes()
+        store.delete_pod("kube-system", "apiserver")
+        # republished, still Running, container never restarted
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "apiserver") is not None)
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "apiserver").status.phase == RUNNING)
+        assert kl.runtime.list_pod_sandboxes() == sandboxes_before
+    finally:
+        kl.stop()
+
+
+def test_static_pod_survives_kubelet_restart_without_duplication():
+    """A kubelet restart must adopt the surviving mirror (stable static
+    identity), not double-start the workload under a fresh uid."""
+    store = ClusterStore()
+    manifest = {
+        "metadata": {"name": "etcd", "namespace": "kube-system"},
+        "spec": {"containers": [{"name": "etcd", "image": "etcd:3"}]},
+    }
+    rt = FakeRuntime()
+    kl = Kubelet(store, "cp-1", runtime=rt,
+                 static_pod_manifests=[manifest])
+    kl.start()
+    try:
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "etcd") is not None and store.get_pod(
+            "kube-system", "etcd").status.phase == RUNNING)
+        uid_before = store.get_pod("kube-system", "etcd").uid
+    finally:
+        kl.stop()
+    # restart against the SAME store and runtime
+    kl2 = Kubelet(store, "cp-1", runtime=rt,
+                  static_pod_manifests=[manifest])
+    kl2.start()
+    try:
+        time.sleep(0.6)
+        mirror = store.get_pod("kube-system", "etcd")
+        assert mirror is not None and mirror.uid == uid_before
+        # exactly one copy of the workload (no duplicate sandbox)
+        assert len([s for s in kl2.runtime.list_pod_sandboxes()]) <= 1
+        pods = [p for p in store.list_pods()
+                if p.metadata.name == "etcd"]
+        assert len(pods) == 1
+    finally:
+        kl2.stop()
