@@ -1,3 +1,4 @@
 from kubernetes_tpu.proxy.dataplane import VirtualDataplane
 from kubernetes_tpu.proxy.ipallocator import IPAllocator, IPAllocatorFull
+from kubernetes_tpu.proxy.ipvs import IpvsProxier
 from kubernetes_tpu.proxy.proxier import Proxier, Rule

@@ -304,3 +304,99 @@ class TestVirtualDataplane:
         assert plane.route("10.96.0.10", 80, src_ip="a") == \
             "10.244.9.9:9999"
         assert plane.route("10.96.0.30", 5432, src_ip="a") is None
+
+
+# ---------------------------------------------------------------------------
+# ipvs mode (reference pkg/proxy/ipvs/proxier.go:342 +
+# graceful_termination.go)
+
+
+class TestIpvsProxier:
+    def _cluster(self, scheduler="rr", affinity="None"):
+        from kubernetes_tpu.proxy import IpvsProxier
+
+        store = ClusterStore()
+        store.add_service(_svc("web", {"app": "web"}, affinity=affinity))
+        store.upsert_endpoints(_ep("web", ["10.1.0.1", "10.1.0.2",
+                                        "10.1.0.3"]))
+        p = IpvsProxier(store, scheduler=scheduler).start()
+        return store, p
+
+    def test_round_robin_over_real_servers(self):
+        store, p = self._cluster()
+        try:
+            got = [p.route("10.96.0.10", 80) for _ in range(6)]
+            assert got == ["10.1.0.1:8080", "10.1.0.2:8080",
+                           "10.1.0.3:8080"] * 2
+            # virtual server table reads like ipvsadm -L
+            vs = p.virtual_servers()[0]
+            assert vs.scheduler == "rr" and len(vs.reals) == 3
+        finally:
+            p.stop()
+
+    def test_least_connection_scheduling(self):
+        store, p = self._cluster(scheduler="lc")
+        try:
+            # two long-lived connections pin .1 and .2; lc must send
+            # the next connections to the least-loaded real server
+            c1 = p.connect("10.96.0.10", 80)
+            c2 = p.connect("10.96.0.10", 80)
+            assert {c1.backend, c2.backend} == \
+                {"10.1.0.1:8080", "10.1.0.2:8080"}
+            c3 = p.connect("10.96.0.10", 80)
+            assert c3.backend == "10.1.0.3:8080"
+            c3.close()
+            c1.close()
+            # .1 and .3 now idle; .2 still busy — next goes to .1
+            assert p.connect("10.96.0.10", 80).backend == "10.1.0.1:8080"
+        finally:
+            p.stop()
+
+    def test_client_ip_persistence(self):
+        store, p = self._cluster(affinity="ClientIP")
+        try:
+            first = p.route("10.96.0.10", 80, client_ip="172.16.0.9")
+            for _ in range(5):
+                assert p.route("10.96.0.10", 80,
+                               client_ip="172.16.0.9") == first
+            # a different client advances the scheduler independently
+            other = p.route("10.96.0.10", 80, client_ip="172.16.0.10")
+            assert other != first or len(
+                p.virtual_servers()[0].reals) == 1
+        finally:
+            p.stop()
+
+    def test_graceful_termination_drains_connections(self):
+        store, p = self._cluster()
+        try:
+            conns = [p.connect("10.96.0.10", 80) for _ in range(3)]
+            victim = "10.1.0.3:8080"
+            held = next(c for c in conns if c.backend == victim)
+            # endpoint vanishes: real server drains instead of dying
+            store.upsert_endpoints(_ep("web", ["10.1.0.1", "10.1.0.2"]))
+            time.sleep(0.05)
+            p.sync()
+            vs = p.virtual_servers()[0]
+            assert vs.reals[victim].weight == 0, "no graceful drain"
+            # new traffic skips the draining server...
+            assert all(
+                p.route("10.96.0.10", 80) != victim for _ in range(6)
+            )
+            # ...and the entry disappears once the last connection closes
+            held.close()
+            vs = p.virtual_servers()[0]
+            assert victim not in vs.reals
+        finally:
+            p.stop()
+
+    def test_no_real_servers_rejects(self):
+        from kubernetes_tpu.proxy import IpvsProxier
+
+        store = ClusterStore()
+        store.add_service(_svc("lonely", {"app": "x"}, ip="10.96.0.77"))
+        p = IpvsProxier(store).start()
+        try:
+            assert p.route("10.96.0.77", 80) is None
+            assert p.connect("10.96.0.77", 80) is None
+        finally:
+            p.stop()
