@@ -103,6 +103,11 @@ class Kubelet:
         # kubelet's lifetime.
         self._static_manifests = list(static_pod_manifests or [])
         self._static_pods: Dict[str, Pod] = {}   # uid -> local truth
+        # init-phase tracking: uid -> index of the RUNNING init
+        # container (absent = init phase done or no init containers),
+        # and the created init container ids for teardown
+        self._init_progress: Dict[str, int] = {}
+        self._init_cids: Dict[str, List[str]] = {}
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
         self._terminal: set = set()  # uids already reported Succeeded/Failed
@@ -414,6 +419,105 @@ class Kubelet:
         self.container_manager.create_pod_cgroup(pod)
         sid = self.runtime.run_pod_sandbox(pod.uid, pod.name, pod.namespace)
         self._sandbox_of[pod.uid] = sid
+        if pod.spec.init_containers:
+            # init phase (reference kuberuntime_manager.go
+            # computePodActions: init containers run ONE at a time, each
+            # to successful completion, before any app container starts)
+            self._containers_of[pod.uid] = {}
+            self._init_progress[pod.uid] = 0
+            self._init_cids[pod.uid] = []
+            if publish:
+                self.store.patch_pod_condition(
+                    pod.namespace, pod.name,
+                    PodCondition("Initialized", "False",
+                                 "ContainersNotInitialized", ""),
+                )
+            self._start_next_init(pod)
+            return
+        self._start_main_containers(pod, publish)
+
+    def _start_next_init(self, pod: Pod) -> None:
+        idx = self._init_progress[pod.uid]
+        ic = pod.spec.init_containers[idx]
+        sid = self._sandbox_of[pod.uid]
+        cid = self.runtime.create_container(sid, ic.name, ic.image)
+        self.runtime.start_container(cid)
+        self._init_cids[pod.uid].append(cid)
+
+    def _drive_init(self, pod: Pod, publish: bool) -> None:
+        """One init-phase step: advance past completed init containers,
+        restart failed ones per policy (the reference restarts a failed
+        init container unless restartPolicy is Never, in which case the
+        pod fails: kuberuntime_manager.go + pod_workers)."""
+        uid = pod.uid
+        cid = self._init_cids[uid][-1]
+        st = self.runtime.container_status(cid)
+        if st is None or st.state != EXITED:
+            return                       # still running
+        if st.exit_code == 0:
+            nxt = self._init_progress[uid] + 1
+            if nxt < len(pod.spec.init_containers):
+                self._init_progress[uid] = nxt
+                self._start_next_init(pod)
+                return
+            # init phase complete: app containers start now
+            del self._init_progress[uid]
+            if publish:
+                self.store.patch_pod_condition(
+                    pod.namespace, pod.name,
+                    PodCondition("Initialized", "True", "", ""),
+                )
+            self._start_main_containers(pod, publish)
+            return
+        policy = getattr(pod.spec, "restart_policy", "Always")
+        if policy == "Never":
+            self._finish(pod, FAILED, publish=publish)
+        else:
+            self.runtime.start_container(cid)   # retry the failed init
+
+    def _rebuild_init_state(self, pod: Pod) -> None:
+        """Reconstruct _init_progress/_init_cids for a pod adopted from
+        a persistent runtime mid-init (the reference re-derives pod
+        actions from the runtime status every sync, so a restart cannot
+        confuse init and app containers)."""
+        uid = pod.uid
+        cids = self._containers_of.get(uid, {})
+        init_cids: List[str] = []
+        pending_idx: Optional[int] = None
+        for i, ic in enumerate(pod.spec.init_containers):
+            cid = cids.get(ic.name)
+            if cid is None:
+                pending_idx = i       # this init was never created
+                break
+            init_cids.append(cid)
+            st = self.runtime.container_status(cid)
+            if st is None or st.state != EXITED or st.exit_code != 0:
+                pending_idx = i       # running or failed: drive it
+                break
+        # app containers keep only their OWN entries
+        self._containers_of[uid] = {
+            c.name: cids[c.name]
+            for c in pod.spec.containers if c.name in cids
+        }
+        if pending_idx is None:
+            # init phase completed pre-restart; mains the crash window
+            # swallowed (restart between init-done and app-start) are
+            # created now, existing ones adopted as-is
+            sid = self._sandbox_of[uid]
+            for c in pod.spec.containers:
+                if c.name not in self._containers_of[uid]:
+                    cid = self.runtime.create_container(sid, c.name,
+                                                        c.image)
+                    self.runtime.start_container(cid)
+                    self._containers_of[uid][c.name] = cid
+            return
+        self._init_progress[uid] = pending_idx
+        self._init_cids[uid] = init_cids
+        if len(init_cids) <= pending_idx:
+            self._start_next_init(pod)
+
+    def _start_main_containers(self, pod: Pod, publish: bool) -> None:
+        sid = self._sandbox_of[pod.uid]
         cids = {}
         for c in pod.spec.containers:
             cid = self.runtime.create_container(sid, c.name, c.image)
@@ -430,6 +534,18 @@ class Kubelet:
             self._set_ready_condition(pod, True)
 
     def _reconcile_containers(self, pod: Pod, publish: bool = True) -> None:
+        if pod.spec.init_containers and \
+                pod.uid not in self._init_progress and any(
+                    ic.name in self._containers_of.get(pod.uid, {})
+                    for ic in pod.spec.init_containers):
+            # adopted pod (restart over a persistent runtime): the
+            # normal flow never maps init containers into
+            # _containers_of, so their presence means the init-phase
+            # bookkeeping must be re-derived from runtime truth
+            self._rebuild_init_state(pod)
+        if pod.uid in self._init_progress:
+            self._drive_init(pod, publish)
+            return
         cids = self._containers_of.get(pod.uid, {})
         statuses = {
             name: self.runtime.container_status(cid) for name, cid in cids.items()
@@ -483,6 +599,8 @@ class Kubelet:
             self.runtime.stop_pod_sandbox(sid)
             self.runtime.remove_pod_sandbox(sid)
         self._containers_of.pop(uid, None)
+        self._init_progress.pop(uid, None)
+        self._init_cids.pop(uid, None)
         self.devices.free(uid)
         # teardown ordering: the sandbox is stopped ABOVE, then the pod
         # leaves the volume manager's desired state; the reconcile

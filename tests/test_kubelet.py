@@ -834,3 +834,129 @@ def test_static_pod_survives_kubelet_restart_without_duplication():
         assert len(pods) == 1
     finally:
         kl2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Init containers (reference kuberuntime_manager.go computePodActions:
+# one at a time, each to successful completion, before app containers)
+
+
+def _pod_with_inits(store, name, inits, main="app", node="n1",
+                    restart_policy="Always"):
+    from kubernetes_tpu.api.types import Container
+
+    pod = MakePod().name(name).uid(f"u-{name}").container(image=main).obj()
+    pod.spec.init_containers = [
+        Container(name=f"init-{i}", image=img)
+        for i, img in enumerate(inits)
+    ]
+    pod.spec.restart_policy = restart_policy
+    store.create_pod(pod)
+    store.bind("default", name, pod.uid, node)
+    return pod
+
+
+def test_init_containers_run_sequentially_before_main():
+    store = ClusterStore()
+    rt = FakeRuntime(exit_after={"init-a": 0.1, "init-b": 0.1})
+    kl = Kubelet(store, "n1", runtime=rt)
+    kl.start()
+    try:
+        pod = _pod_with_inits(store, "web", ["init-a", "init-b"])
+        # pod stays Pending while inits run; Initialized=False published
+        assert wait_for(lambda: any(
+            c.type == "Initialized" and c.status == "False"
+            for c in store.get_pod("default", "web").status.conditions))
+        assert store.get_pod("default", "web").status.phase != RUNNING
+        # both inits complete -> main starts -> Running + Initialized
+        assert wait_for(lambda: store.get_pod(
+            "default", "web").status.phase == RUNNING, timeout=10)
+        conds = {c.type: c.status
+                 for c in store.get_pod("default", "web").status.conditions}
+        assert conds.get("Initialized") == "True"
+        # the two init containers ran to completion, one at a time
+        inits = [c for c in rt.list_containers()
+                 if c.image.startswith("init-")]
+        assert len(inits) == 2
+        assert all(c.state == "EXITED" and c.exit_code == 0
+                   for c in inits)
+        # sequencing: init-a finished before init-b started
+        a = next(c for c in inits if c.image == "init-a")
+        b = next(c for c in inits if c.image == "init-b")
+        assert a.finished_at <= b.started_at
+    finally:
+        kl.stop()
+
+
+def test_failed_init_container_fails_pod_with_never_policy():
+    store = ClusterStore()
+    rt = FakeRuntime(fail_images={"bad-init"})
+    kl = Kubelet(store, "n1", runtime=rt)
+    kl.start()
+    try:
+        _pod_with_inits(store, "doomed", ["bad-init"],
+                        restart_policy="Never")
+        assert wait_for(lambda: store.get_pod(
+            "default", "doomed").status.phase == FAILED, timeout=10)
+        # the main container never started
+        assert not any(c.image == "app" for c in rt.list_containers())
+    finally:
+        kl.stop()
+
+
+def test_failed_init_container_retries_under_always_policy():
+    store = ClusterStore()
+    rt = FakeRuntime(fail_images={"flaky-init"})
+    kl = Kubelet(store, "n1", runtime=rt)
+    kl.start()
+    try:
+        _pod_with_inits(store, "retrying", ["flaky-init"])
+        # the init container is restarted rather than the pod failing
+        def restarted():
+            cs = [c for c in rt.list_containers()
+                  if c.image == "flaky-init"]
+            return cs and cs[0].restarts >= 2
+        assert wait_for(restarted, timeout=10)
+        assert store.get_pod("default", "retrying").status.phase != FAILED
+    finally:
+        kl.stop()
+
+
+def test_init_phase_survives_kubelet_restart():
+    """A kubelet restart mid-init must resume the init sequence from
+    runtime truth — not reconcile init containers as app containers."""
+    store = ClusterStore()
+    rt = FakeRuntime()   # init never exits on its own: pod is mid-init
+    kl = Kubelet(store, "n1", runtime=rt)
+    kl.start()
+    pod = None
+    try:
+        pod = _pod_with_inits(store, "web", ["slow-init"])
+        assert wait_for(lambda: any(
+            c.image == "slow-init" for c in rt.list_containers()))
+    finally:
+        kl.stop()
+    kl2 = Kubelet(store, "n1", runtime=rt)
+    kl2.start()
+    try:
+        time.sleep(0.5)
+        # still exactly one init container, no app container, and the
+        # pod is still Pending (not Succeeded/restart-looped)
+        imgs = [c.image for c in rt.list_containers()]
+        assert imgs.count("slow-init") == 1
+        assert "app" not in imgs
+        assert store.get_pod("default", "web").status.phase != RUNNING
+        # init completes (simulated by stopping it with exit 0 via the
+        # runtime's batch hook): the adopted kubelet starts the main
+        init_cid = next(c.id for c in rt.list_containers()
+                        if c.image == "slow-init")
+        with rt._lock:
+            st = rt._containers[init_cid]
+            st.state = "EXITED"
+            st.exit_code = 0
+            st.finished_at = time.time()
+        assert wait_for(lambda: store.get_pod(
+            "default", "web").status.phase == RUNNING, timeout=10)
+        assert any(c.image == "app" for c in rt.list_containers())
+    finally:
+        kl2.stop()
