@@ -399,6 +399,44 @@ class EventRateLimit(AdmissionPlugin):
             self._buckets[req.namespace] = (tokens - 1.0, now)
 
 
+IS_DEFAULT_CLASS_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """Assign the cluster's default StorageClass to claims that name
+    none (reference ``plugin/pkg/admission/storage/storageclass/
+    setdefault/admission.go`` — default-enabled upstream): a PVC
+    created with no class gets the class annotated
+    ``storageclass.kubernetes.io/is-default-class``; with several
+    marked default, the NEWEST wins (the reference's current
+    tie-break)."""
+
+    name = "DefaultStorageClass"
+
+    def __init__(self, store=None):
+        self.store = store
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None or req.kind != "PersistentVolumeClaim" \
+                or req.operation != CREATE:
+            return
+        pvc = req.obj
+        # only a NIL class is defaulted — an explicit "" is the user
+        # asking for classless static provisioning (upstream semantics)
+        if pvc.storage_class_name is not None:
+            return
+        defaults = [
+            sc for sc in self.store.list_storage_classes()
+            if sc.metadata.annotations.get(
+                IS_DEFAULT_CLASS_ANNOTATION) == "true"
+        ]
+        if not defaults:
+            return
+        newest = max(defaults,
+                     key=lambda sc: sc.metadata.creation_timestamp)
+        pvc.storage_class_name = newest.name
+
+
 POD_NODE_SELECTOR_ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
 
 

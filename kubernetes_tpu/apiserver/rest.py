@@ -242,6 +242,100 @@ class _Handler(BaseHTTPRequestHandler):
             raise Forbidden(f"user {user!r} cannot {verb} {kind}")
         return user
 
+    # -- discovery (the client-go RESTMapper's server half:
+    # staging/src/k8s.io/apiserver/pkg/endpoints/discovery) -----------
+    @staticmethod
+    def _is_discovery_path(path: str) -> bool:
+        parts = [p for p in path.split("/") if p]
+        return (
+            (len(parts) == 2 and parts[0] == "api" and parts[1] == "v1")
+            or (len(parts) == 3 and parts[0] == "apis")
+        )
+
+    def _serve_discovery(self, path: str) -> None:
+        from kubernetes_tpu.api.scheme import SCHEME_V
+        from kubernetes_tpu.api.serialization import CLUSTER_SCOPED
+
+        parts = [p for p in path.split("/") if p]
+        if path == "/api":
+            self._send_json(200, {"kind": "APIVersions",
+                                  "versions": ["v1"]})
+            return
+        if path == "/apis":
+            groups: Dict[str, list] = {}
+            for (gv, _kind) in SCHEME_V._spokes:
+                group, _, version = gv.partition("/")
+                if version not in groups.setdefault(group, []):
+                    groups[group].append(version)
+
+            def version_priority(v: str):
+                # kube version ordering (apimachinery version.
+                # CompareKubeAwareVersionStrings): GA > beta > alpha,
+                # then numeric — "v1" must beat "v1beta1"
+                import re
+
+                m = re.match(r"^v(\d+)(alpha|beta)?(\d+)?$", v)
+                if not m:
+                    return (0, 0, 0)
+                stage = {"alpha": 1, "beta": 2, None: 3}[m.group(2)]
+                return (stage, int(m.group(1)), int(m.group(3) or 0))
+
+            def ordered(vs):
+                return sorted(vs, key=version_priority, reverse=True)
+
+            self._send_json(200, {
+                "kind": "APIGroupList",
+                "groups": [
+                    {
+                        "name": g,
+                        "versions": [
+                            {"groupVersion": f"{g}/{v}", "version": v}
+                            for v in ordered(vs)
+                        ],
+                        "preferredVersion": {
+                            "groupVersion": f"{g}/{ordered(vs)[0]}",
+                            "version": ordered(vs)[0],
+                        },
+                    }
+                    for g, vs in sorted(groups.items())
+                ],
+            })
+            return
+        if parts[0] == "api":                       # /api/v1
+            resources = [
+                {"name": plural, "kind": kind,
+                 "namespaced": kind not in CLUSTER_SCOPED}
+                for plural, kind in sorted(PLURALS.items())
+            ]
+            # CRD-registered kinds are part of live discovery
+            store = self.server.store
+            for kind in store.custom_kind_names():
+                plural = store.custom_kind_to_plural(kind)
+                if plural:
+                    resources.append({
+                        "name": plural, "kind": kind,
+                        "namespaced": store.kind_is_namespaced(kind),
+                    })
+            self._send_json(200, {
+                "kind": "APIResourceList", "groupVersion": "v1",
+                "resources": resources,
+            })
+            return
+        gv = f"{parts[1]}/{parts[2]}"               # /apis/<g>/<v>
+        kinds = SCHEME_V.kinds_for(gv)
+        if not kinds:
+            self._send_error(404, "NotFound", f"no group/version {gv!r}")
+            return
+        self._send_json(200, {
+            "kind": "APIResourceList", "groupVersion": gv,
+            "resources": [
+                {"name": KIND_TO_PLURAL.get(k, k.lower() + "s"),
+                 "kind": k,
+                 "namespaced": k not in CLUSTER_SCOPED}
+                for k in sorted(kinds)
+            ],
+        })
+
     # -- routing -------------------------------------------------------
     def _route(self) -> Tuple[Optional[str], Optional[str], Optional[str], Optional[str], Dict]:
         """→ (kind, namespace, name, subresource, query). Also resolves
@@ -311,6 +405,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if u.path in ("/api", "/apis") or self._is_discovery_path(u.path):
+            self._serve_discovery(u.path)
             return
         if u.path == "/metrics/resources":
             # reference cmd/kube-scheduler/app/server.go:243 +
@@ -710,12 +807,14 @@ class APIServer(ThreadingHTTPServer):
                 if isinstance(p, NamespaceLifecycle):
                     p.store = self.store
             from kubernetes_tpu.apiserver.admission import (
+                DefaultStorageClass,
                 NodeRestriction,
                 ServiceAccountAdmission,
             )
 
             admission.plugins.append(ServiceAccountAdmission(self.store))
             admission.plugins.append(NodeRestriction())
+            admission.plugins.append(DefaultStorageClass(self.store))
             admission.plugins.append(ResourceQuotaAdmission(self.store))
             # out-of-process extension point, last in the chain:
             # mutating webhooks run after the in-process mutators,

@@ -385,10 +385,25 @@ class Kubectl:
         return 0
 
     def api_resources(self) -> int:
-        rows = [
-            [plural, kind, str(is_namespaced(kind)).lower()]
-            for plural, kind in sorted(PLURALS.items())
-        ]
+        """Server discovery first (GET /api/v1 — includes live CRD
+        registrations, like real kubectl's discovery client); the local
+        table is the offline fallback."""
+        rows = []
+        try:
+            code, payload = self.client._request("GET", "/api/v1")
+            if code == 200:
+                rows = [
+                    [r["name"], r["kind"],
+                     str(bool(r.get("namespaced"))).lower()]
+                    for r in payload.get("resources", [])
+                ]
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            pass
+        if not rows:
+            rows = [
+                [plural, kind, str(is_namespaced(kind)).lower()]
+                for plural, kind in sorted(PLURALS.items())
+            ]
         _table(["NAME", "KIND", "NAMESPACED"], rows, self.out)
         return 0
 

@@ -356,3 +356,88 @@ class TestAdmissionBreadth:
         bad.spec.node_selector["pool"] = "silver"
         with _pytest.raises(AdmissionError):
             plugin.admit(AdmissionRequest(CREATE, "Pod", "tenant-a", bad))
+
+    def test_default_storage_class_assignment(self):
+        """DefaultStorageClass (default-enabled upstream): a PVC naming
+        no class gets the newest default-annotated class."""
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import (
+            ObjectMeta, PersistentVolumeClaim, StorageClass,
+        )
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+
+        store = ClusterStore()
+        ann = {"storageclass.kubernetes.io/is-default-class": "true"}
+        old = StorageClass(metadata=ObjectMeta(name="old-default",
+                                               annotations=dict(ann)),
+                           provisioner="x")
+        old.metadata.creation_timestamp = 100.0
+        new = StorageClass(metadata=ObjectMeta(name="new-default",
+                                               annotations=dict(ann)),
+                           provisioner="x")
+        new.metadata.creation_timestamp = 200.0
+        plain = StorageClass(metadata=ObjectMeta(name="plain"),
+                             provisioner="x")
+        for sc in (old, new, plain):
+            store.add_storage_class(sc)
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            client.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name="classless", namespace="default"),
+                requests={"storage": parse_quantity("1Gi")},
+            ))
+            got = store.get_pvc("default", "classless")
+            assert got.storage_class_name == "new-default"
+            # an explicit class is never overridden
+            client.create(PersistentVolumeClaim(
+                metadata=ObjectMeta(name="classed", namespace="default"),
+                storage_class_name="plain",
+                requests={"storage": parse_quantity("1Gi")},
+            ))
+            assert store.get_pvc(
+                "default", "classed").storage_class_name == "plain"
+        finally:
+            server.shutdown_server()
+
+    def test_discovery_endpoints(self):
+        """/api, /apis, /api/v1, /apis/<g>/<v> serve the discovery
+        documents kubectl/client-go RESTMappers consume — including
+        live CRD registrations."""
+        from kubernetes_tpu.api.types import (
+            CRDNames, CustomResourceDefinition, ObjectMeta,
+        )
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            code, versions = client._request("GET", "/api")
+            assert code == 200 and versions["versions"] == ["v1"]
+            code, groups = client._request("GET", "/apis")
+            assert code == 200
+            names = {g["name"] for g in groups["groups"]}
+            assert {"autoscaling", "batch", "policy"} <= names
+            auto = next(g for g in groups["groups"]
+                        if g["name"] == "autoscaling")
+            assert auto["preferredVersion"]["version"] == "v2"
+            code, core = client._request("GET", "/api/v1")
+            by_name = {r["name"]: r for r in core["resources"]}
+            assert by_name["pods"]["namespaced"] is True
+            assert by_name["nodes"]["namespaced"] is False
+            # CRD registration appears in discovery immediately
+            client.create(CustomResourceDefinition(
+                metadata=ObjectMeta(name="policies.example.com"),
+                names=CRDNames(plural="policies", kind="Policy"),
+            ))
+            code, core = client._request("GET", "/api/v1")
+            assert any(r["name"] == "policies"
+                       for r in core["resources"])
+            code, batch = client._request("GET", "/apis/batch/v1beta1")
+            assert code == 200 and batch["resources"][0]["name"] == \
+                "cronjobs"
+            code, _ = client._request("GET", "/apis/nope/v9")
+            assert code == 404
+        finally:
+            server.shutdown_server()
