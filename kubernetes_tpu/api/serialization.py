@@ -65,6 +65,8 @@ SCHEME: Dict[str, type] = {
         "Secret",
         "ConfigMap",
         "CertificateSigningRequest",
+        "PriorityClass",
+        "Lease",
     )
 }
 
@@ -72,6 +74,7 @@ SCHEME: Dict[str, type] = {
 # schema metadata: which kinds are namespace-scoped (clients need this to
 # build paths; it is API schema, not storage layout)
 CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
+                  "PriorityClass",
                   "Namespace", "ClusterRole", "ClusterRoleBinding",
                   "CustomResourceDefinition",
                   "MutatingWebhookConfiguration",

@@ -1129,6 +1129,45 @@ class ClusterRoleBinding:
 
 
 @dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass (``pkg/apis/scheduling``):
+    maps ``pod.spec.priorityClassName`` to the numeric priority
+    preemption orders by. The Priority admission plugin resolves these
+    from the store (``plugin/pkg/admission/priority``); one class may
+    be the cluster's global default for pods naming none."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease: the observability shape of the
+    store's internal lease table (leader election + node heartbeats) —
+    ``kubectl get leases`` parity."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 0.0
+    renew_time: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
 class CRDNames:
     """apiextensions CustomResourceDefinitionNames (plural + kind are
     the two the routing/storage layers need)."""

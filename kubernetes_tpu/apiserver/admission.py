@@ -147,11 +147,40 @@ class LimitRanger(AdmissionPlugin):
                     c.resources.requests[res] = qty
 
 
+# reference pkg/apis/scheduling/types.go built-ins
+SYSTEM_PRIORITY_CLASSES = {
+    "system-cluster-critical": 2000000000,
+    "system-node-critical": 2000001000,
+}
+
+
 class PodPriorityResolver(AdmissionPlugin):
+    """Priority admission (reference ``plugin/pkg/admission/priority/
+    admission.go``): resolve ``priorityClassName`` → numeric priority
+    from PriorityClass API objects (plus the two system built-ins); a
+    pod naming no class gets the cluster's globalDefault class when one
+    exists. A static dict may seed/override resolution (the harness's
+    offline mode)."""
+
     name = "Priority"
 
-    def __init__(self, priority_classes: Optional[Dict[str, int]] = None):
+    def __init__(self, priority_classes: Optional[Dict[str, int]] = None,
+                 store=None):
         self.classes = dict(priority_classes or {})
+        self.store = store
+
+    def _resolve(self, name: str) -> Optional[int]:
+        got = self.classes.get(name)
+        if got is not None:
+            return got
+        got = SYSTEM_PRIORITY_CLASSES.get(name)
+        if got is not None:
+            return got
+        if self.store is not None:
+            pc = self.store.get_object("PriorityClass", "", name)
+            if pc is not None:
+                return pc.value
+        return None
 
     def admit(self, req: AdmissionRequest) -> None:
         if req.kind != "Pod" or req.operation != CREATE:
@@ -159,9 +188,22 @@ class PodPriorityResolver(AdmissionPlugin):
         pod: Pod = req.obj
         cls = getattr(pod.spec, "priority_class_name", "")
         if cls:
-            if cls not in self.classes:
+            value = self._resolve(cls)
+            if value is None:
                 raise AdmissionError(f"no PriorityClass {cls!r}")
-            pod.spec.priority = self.classes[cls]
+            pod.spec.priority = value
+        elif pod.spec.priority is None and self.store is not None:
+            defaults = [
+                pc for pc in self.store.list_objects("PriorityClass")
+                if pc.global_default
+            ]
+            if defaults:
+                # upstream picks the LOWEST value among multiple
+                # globalDefault classes (admission.go: "we pick the one
+                # with the lowest priority value") — not the newest
+                chosen = min(defaults, key=lambda pc: pc.value)
+                pod.spec.priority_class_name = chosen.name
+                pod.spec.priority = chosen.value
 
     def validate(self, req: AdmissionRequest) -> None:
         pass

@@ -78,6 +78,8 @@ PLURALS: Dict[str, str] = {
     "secrets": "Secret",
     "configmaps": "ConfigMap",
     "certificatesigningrequests": "CertificateSigningRequest",
+    "priorityclasses": "PriorityClass",
+    "leases": "Lease",
 }
 KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
@@ -462,6 +464,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:
         kind, ns, name, sub, q = self._route()
+        if kind == "Lease":
+            self._send_error(405, "MethodNotAllowed",
+                             "Lease objects are read-only over REST")
+            return
         if kind is None:
             path = urlparse(self.path).path.rstrip("/")
             if path.endswith("/selfsubjectaccessreviews"):
@@ -581,6 +587,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self) -> None:
         kind, ns, name, sub, q = self._route()
+        if kind == "Lease":
+            self._send_error(405, "MethodNotAllowed",
+                             "Lease objects are read-only over REST")
+            return
         if kind is None or name is None:
             self._send_error(404, "NotFound", f"no route for {self.path}")
             return
@@ -686,6 +696,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self) -> None:
         kind, ns, name, sub, q = self._route()
+        if kind == "Lease":
+            self._send_error(405, "MethodNotAllowed",
+                             "Lease objects are read-only over REST")
+            return
         if kind is None or name is None:
             self._send_error(404, "NotFound", f"no route for {self.path}")
             return
@@ -803,8 +817,15 @@ class APIServer(ThreadingHTTPServer):
                 ResourceQuotaAdmission,
             )
 
+            from kubernetes_tpu.apiserver.admission import (
+                PodPriorityResolver,
+            )
+
             for p in admission.plugins:
                 if isinstance(p, NamespaceLifecycle):
+                    p.store = self.store
+                elif isinstance(p, PodPriorityResolver):
+                    # classes resolve from PriorityClass API objects
                     p.store = self.store
             from kubernetes_tpu.apiserver.admission import (
                 DefaultStorageClass,

@@ -113,6 +113,7 @@ class ClusterStore:
         self._mutating_webhooks: Dict[str, Any] = {}
         self._validating_webhooks: Dict[str, Any] = {}
         self._secrets: Dict[str, Any] = {}
+        self._priority_classes: Dict[str, Any] = {}
         self._config_maps: Dict[str, Any] = {}
         self._csrs: Dict[str, Any] = {}
         # CRD analog (apiextensions-apiserver): the CRD objects plus
@@ -757,6 +758,7 @@ class ClusterStore:
         "Secret": ("_secrets", True),
         "ConfigMap": ("_config_maps", True),
         "CertificateSigningRequest": ("_csrs", False),
+        "PriorityClass": ("_priority_classes", False),
     }
 
     # ------------------------------------------------------------------
@@ -896,6 +898,28 @@ class ClusterStore:
             self._dispatch(Event(ADDED, kind, obj))
             return obj
 
+    def create_objects_bulk(self, kind: str, objs: List[Any]) -> int:
+        """Bulk create for high-volume kinds (the event recorder's
+        flush): ONE lock acquisition and ONE batched watch delivery for
+        N objects, like ``create_pods``. Name collisions are skipped
+        (the single-object path's drop-on-ValueError semantics), other
+        objects still land. Returns the number created."""
+        events: List[Event] = []
+        with self._lock:
+            for obj in objs:
+                table, key = self._table_key(
+                    kind, obj.metadata.namespace, obj.metadata.name
+                )
+                if key in table:
+                    continue
+                if not obj.metadata.creation_timestamp:
+                    obj.metadata.creation_timestamp = time.time()
+                obj.metadata.resource_version = self._next_rv()
+                table[key] = obj
+                events.append(Event(ADDED, kind, obj))
+            self._dispatch_many(events)
+        return len(events)
+
     def update_object(self, kind: str, obj, expect_rv: Optional[str] = None) -> Any:
         """Optimistic-concurrency update: fails on missing object or, when
         expect_rv is given, on a resourceVersion conflict (HTTP 409 path —
@@ -1010,7 +1034,30 @@ class ClusterStore:
             self._dispatch(Event(MODIFIED, kind, updated, obj))
             return True
 
+    def _lease_object(self, name: str, lease: "_Lease"):
+        """Synthesize the coordination.k8s.io/v1 view of an internal
+        lease (leader election + node heartbeats) — `kubectl get
+        leases` observability; writes still go through
+        try_acquire_or_renew (the holders' fast path)."""
+        from kubernetes_tpu.api.types import Lease, ObjectMeta
+
+        return Lease(
+            metadata=ObjectMeta(name=name, namespace="kube-system"),
+            holder_identity=lease.holder,
+            lease_duration_seconds=lease.duration,
+            renew_time=lease.renew_time,
+        )
+
     def get_object(self, kind: str, namespace: str, name: str):
+        if kind == "Lease":
+            # synthesized leases all live in kube-system; a lookup
+            # scoped elsewhere must miss like any namespaced kind
+            if namespace not in ("", "kube-system"):
+                return None
+            with self._lock:
+                lease = self._leases.get(name)
+                return self._lease_object(name, lease) \
+                    if lease is not None else None
         with self._lock:
             table, key = self._table_key(kind, namespace, name)
             return table.get(key)
@@ -1024,6 +1071,15 @@ class ClusterStore:
         """List + the RV the list is consistent at, atomically — the
         List+Watch bootstrap contract (a watch from this RV misses
         nothing that isn't already in the list)."""
+        if kind == "Lease":
+            if namespace is not None and namespace != "kube-system":
+                with self._lock:
+                    return [], self._rv
+            with self._lock:
+                return [
+                    self._lease_object(name, lease)
+                    for name, lease in sorted(self._leases.items())
+                ], self._rv
         with self._lock:
             table, namespaced = self._kind_entry(kind)
             objs = list(table.values())

@@ -441,3 +441,64 @@ class TestAdmissionBreadth:
             assert code == 404
         finally:
             server.shutdown_server()
+
+    def test_priority_class_api_resolution(self):
+        """PriorityClass API objects drive the Priority admission
+        plugin (reference plugin/pkg/admission/priority): named class
+        resolves, globalDefault applies to classless pods, system
+        built-ins always exist."""
+        from kubernetes_tpu.api.types import ObjectMeta, PriorityClass
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            client.create(PriorityClass(
+                metadata=ObjectMeta(name="high"), value=1000))
+            client.create(PriorityClass(
+                metadata=ObjectMeta(name="workhorse"), value=50,
+                global_default=True))
+            p1 = MakePod().name("p1").obj()
+            p1.spec.priority_class_name = "high"
+            client.create(p1)
+            assert store.get_pod("default", "p1").spec.priority == 1000
+            # classless pod inherits the global default
+            client.create(MakePod().name("p2").obj())
+            got = store.get_pod("default", "p2")
+            assert got.spec.priority == 50
+            assert got.spec.priority_class_name == "workhorse"
+            # system built-in resolves without any object
+            p3 = MakePod().name("p3").obj()
+            p3.spec.priority_class_name = "system-cluster-critical"
+            client.create(p3)
+            assert store.get_pod(
+                "default", "p3").spec.priority == 2000000000
+            # unknown class still rejects
+            bad = MakePod().name("p4").obj()
+            bad.spec.priority_class_name = "nope"
+            import pytest as _pytest
+
+            with _pytest.raises(PermissionError):
+                client.create(bad)
+        finally:
+            server.shutdown_server()
+
+    def test_leases_are_observable(self):
+        """coordination.k8s.io view: leader-election/heartbeat leases
+        list through the API (kubectl get leases parity)."""
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+
+        store = ClusterStore()
+        store.try_acquire_or_renew("kube-scheduler", "sched-a",
+                                   100.0, 15.0)
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            leases, _ = client.list("Lease")
+            by_name = {ls.metadata.name: ls for ls in leases}
+            assert by_name["kube-scheduler"].holder_identity == "sched-a"
+            assert by_name["kube-scheduler"].lease_duration_seconds == 15.0
+        finally:
+            server.shutdown_server()
