@@ -110,12 +110,33 @@ class EventRecorder:
             return self._flush_locked()
 
     def _flush_locked(self) -> int:
+        """One flush pass: correlated occurrences update their existing
+        Event; everything fresh lands through ONE bulk store write (one
+        lock acquisition + one batched watch delivery for the whole
+        pass — a 9k pods/s commit stream records 9k Scheduled events/s,
+        and per-event store round-trips were a measured drag on the
+        scheduler's own GIL time)."""
         with self._lock:
             items = list(self._queue)
             self._queue.clear()
+        fresh: list = []
+        pending: dict = {}   # event name -> Event queued in THIS pass
+        bulk = getattr(self.client, "create_objects_bulk", None)
         for obj, etype, reason, fmt, args, ts in items:
             message = fmt % args if args else fmt
-            self._write(object_reference(obj), etype, reason, message, ts)
+            ev = self._build(object_reference(obj), etype, reason,
+                             message, ts, pending,
+                             immediate=bulk is None)
+            if ev is not None:
+                if bulk is None:
+                    try:
+                        self.client.create_object("Event", ev)
+                    except ValueError:
+                        pass  # name collision: drop
+                else:
+                    fresh.append(ev)
+        if fresh:
+            bulk("Event", fresh)
         now = time.time()
         if items and now - self._last_prune > _PRUNE_INTERVAL:
             self._last_prune = now
@@ -124,8 +145,13 @@ class EventRecorder:
                 prune(now)
         return len(items)
 
-    def _write(self, ref, etype: str, reason: str, message: str,
-               ts: float) -> None:
+    def _build(self, ref, etype: str, reason: str, message: str,
+               ts: float, pending: dict,
+               immediate: bool = False) -> Optional[Event]:
+        """Correlate or construct: returns the fresh Event to create
+        (caller batches the write), or None when an existing Event —
+        stored, or queued earlier in THIS pass (``pending``) — absorbed
+        the occurrence."""
         # cluster-scoped objects have no namespace; their events live in
         # "default" — the SAME namespace for create and re-lookup, or
         # aggregation silently never hits
@@ -134,12 +160,22 @@ class EventRecorder:
                       reason, message)
         name = self._correlated.get(key)
         if name is not None:
+            queued = pending.get(name)
+            if queued is not None:
+                queued.count += 1
+                queued.last_timestamp = ts
+                if immediate:
+                    # non-bulk client: the object was already created
+                    # this pass, so the bump must be WRITTEN, not just
+                    # applied to a local copy
+                    self.client.update_object("Event", queued)
+                return None
             existing = self.client.get_object("Event", ns, name)
             if existing is not None and existing.involved_object.uid == ref.uid:
                 existing.count += 1
                 existing.last_timestamp = ts
                 self.client.update_object("Event", existing)
-                return
+                return None
             del self._correlated[key]
         self._seq += 1
         name = f"{ref.name}.{int(ts * 1e6):x}.{self._seq:x}"
@@ -154,10 +190,8 @@ class EventRecorder:
             last_timestamp=ts,
             source_component=self.component,
         )
-        try:
-            self.client.create_object("Event", ev)
-            self._correlated[key] = name
-            if len(self._correlated) > 4096:   # bounded correlation cache
-                self._correlated.pop(next(iter(self._correlated)))
-        except ValueError:
-            pass  # name collision: drop (unique enough in practice)
+        self._correlated[key] = name
+        pending[name] = ev
+        if len(self._correlated) > 4096:   # bounded correlation cache
+            self._correlated.pop(next(iter(self._correlated)))
+        return ev
