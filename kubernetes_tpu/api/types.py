@@ -131,6 +131,10 @@ class Container:
     # "" = cluster default (IfNotPresent/Always by tag); the
     # AlwaysPullImages admission plugin forces "Always"
     image_pull_policy: str = ""
+    # core/v1 Lifecycle: {"postStart": {...}, "preStop": {...}} hook
+    # payloads, opaque to the control plane (the runtime executes them;
+    # the kubelet sequences them around start/termination)
+    lifecycle: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Container":
@@ -142,6 +146,7 @@ class Container:
         ports = g("ports")
         c.ports = [ContainerPort.from_dict(p) for p in ports] if ports else []
         c.image_pull_policy = g("imagePullPolicy", "")
+        c.lifecycle = g("lifecycle")
         return c
 
 
@@ -408,6 +413,8 @@ class PodSpec:
     # identity the pod runs as; the ServiceAccount admission plugin
     # injects "default" when unset (core/v1 spec.serviceAccountName)
     service_account_name: str = ""
+    # None = the cluster default (30s, core/v1); 0 = immediate kill
+    termination_grace_period_seconds: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "PodSpec":
@@ -447,6 +454,10 @@ class PodSpec:
         s.host_network = bool(g("hostNetwork"))
         s.restart_policy = g("restartPolicy") or "Always"
         s.service_account_name = g("serviceAccountName", "")
+        tg = g("terminationGracePeriodSeconds")
+        s.termination_grace_period_seconds = (
+            float(tg) if tg is not None else None
+        )
         return s
 
 

@@ -88,6 +88,16 @@ class RuntimeService:
     def container_status(self, container_id: str) -> Optional[ContainerStatus]:
         raise NotImplementedError
 
+    def exec_sync(self, container_id: str, payload) -> int:
+        """Run a lifecycle hook / probe command in the container
+        (CRI ExecSync); returns the exit code."""
+        raise NotImplementedError
+
+    def container_logs(self, container_id: str) -> List[str]:
+        """The container's log lines (the kubelet serves these through
+        the pods/log subresource)."""
+        raise NotImplementedError
+
 
 class ImageService:
     def pull_image(self, image: str) -> None:
@@ -117,6 +127,13 @@ class FakeRuntime(RuntimeService, ImageService):
         self.fail_images = set(fail_images or ())
         self._ip_prefix = pod_ip_prefix
         self._ip_counter = itertools.count(2)
+        # ExecSync record: (container id, payload) per lifecycle
+        # hook/probe invocation — the observable the hook tests assert
+        self.exec_records: List[tuple] = []
+        # synthetic per-container log streams (kubectl logs parity):
+        # lifecycle transitions append lines like a real runtime's
+        # stdout capture
+        self._logs: Dict[str, List[str]] = {}
 
     # -- sandboxes -----------------------------------------------------
     def run_pod_sandbox(self, pod_uid, name, namespace, labels=None) -> str:
@@ -173,6 +190,11 @@ class FakeRuntime(RuntimeService, ImageService):
             )
             return cid
 
+    def _log(self, container_id: str, line: str) -> None:
+        self._logs.setdefault(container_id, []).append(
+            f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {line}"
+        )
+
     def start_container(self, container_id: str) -> None:
         with self._lock:
             c = self._require(container_id)
@@ -183,10 +205,14 @@ class FakeRuntime(RuntimeService, ImageService):
             c.state = RUNNING
             c.started_at = time.time()
             c.exit_code = None
+            self._log(container_id,
+                      f"container started image={c.image} "
+                      f"restarts={c.restarts}")
             if c.image in self.fail_images:
                 c.state = EXITED
                 c.exit_code = 1
                 c.finished_at = time.time()
+                self._log(container_id, "container exited code=1")
 
     def stop_container(self, container_id: str, timeout_s: float = 30.0) -> None:
         with self._lock:
@@ -196,6 +222,8 @@ class FakeRuntime(RuntimeService, ImageService):
             c.state = EXITED
             c.exit_code = 137
             c.finished_at = time.time()
+            self._log(container_id,
+                      f"container stopped (grace {timeout_s:g}s) code=137")
 
     def remove_container(self, container_id: str) -> None:
         with self._lock:
@@ -235,6 +263,19 @@ class FakeRuntime(RuntimeService, ImageService):
                 c.state = EXITED
                 c.exit_code = 0
                 c.finished_at = now
+
+    def exec_sync(self, container_id: str, payload) -> int:
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None or c.state != RUNNING:
+                return 1   # nothing to exec into
+            self.exec_records.append((container_id, payload))
+            self._log(container_id, f"exec: {payload!r}")
+            return 0
+
+    def container_logs(self, container_id: str) -> List[str]:
+        with self._lock:
+            return list(self._logs.get(container_id, ()))
 
     # -- images --------------------------------------------------------
     def pull_image(self, image: str) -> None:

@@ -108,6 +108,12 @@ class Kubelet:
         # and the created init container ids for teardown
         self._init_progress: Dict[str, int] = {}
         self._init_cids: Dict[str, List[str]] = {}
+        # graceful termination (reference pod_workers terminating state
+        # + kuberuntime_container killContainer): per pod, the grace
+        # period and preStop hooks captured at start; uid -> force-kill
+        # deadline while draining
+        self._graceful: Dict[str, tuple] = {}
+        self._terminating: Dict[str, float] = {}
         self._sandbox_of: Dict[str, str] = {}  # pod uid -> sandbox id
         self._containers_of: Dict[str, Dict[str, str]] = {}  # uid -> {name: cid}
         self._terminal: set = set()  # uids already reported Succeeded/Failed
@@ -148,6 +154,9 @@ class Kubelet:
                 self._key_of[pod.uid] = (pod.namespace, pod.name)
                 self._mark_dirty(pod.uid)
         self._watch_handle = self.store.watch(self._on_event)
+        # pods/log provider (the apiserver proxies log requests to the
+        # node's kubelet; this registry is that connection in-process)
+        self.store.register_log_source(self.node_name, self.container_logs)
         self._thread = threading.Thread(
             target=self._sync_loop, daemon=True, name=f"kubelet-{self.node_name}"
         )
@@ -156,10 +165,62 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        self.store.unregister_log_source(self.node_name)
         if self._watch_handle is not None:
             self._watch_handle.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+    def container_logs(self, namespace: str, name: str,
+                       container: str = "") -> str:
+        """Log text for a pod on this node (kubectl logs; reference
+        kubelet server's /containerLogs endpoint → CRI log files).
+        Multi-container pods require ``container`` like real kubectl;
+        init container logs resolve by name too. Raises LookupError for
+        an unknown pod/container — the REST layer turns it into a
+        client error, never silent-empty success. Called from apiserver
+        handler threads: every kubelet map is read through a C-level
+        dict/list copy (atomic under the GIL) so the sync loop's
+        concurrent mutations cannot blow up the iteration."""
+        key_of = dict(self._key_of)
+        uid = next(
+            (u for u, key in key_of.items()
+             if key == (namespace, name)), None,
+        )
+        if uid is None:
+            raise LookupError(
+                f"pod {namespace}/{name} is not running on this node"
+            )
+        cids = dict(self._containers_of.get(uid, {}))
+        init_cids = list(self._init_cids.get(uid, ()))
+        if init_cids:
+            pod = self._static_pods.get(uid) or self._find_pod(uid)
+            if pod is not None:
+                for i, cid in enumerate(init_cids):
+                    if i < len(pod.spec.init_containers):
+                        cids.setdefault(
+                            pod.spec.init_containers[i].name, cid)
+        if container:
+            if container not in cids:
+                raise LookupError(
+                    f"container {container!r} is not valid for pod "
+                    f"{name} (containers: {sorted(cids) or 'none'})"
+                )
+            chosen = {container: cids[container]}
+        elif len(cids) == 1:
+            chosen = cids
+        else:
+            raise LookupError(
+                "a container name must be specified for pod "
+                f"{name} (choose one of {sorted(cids)})"
+            )
+        lines: List[str] = []
+        for cname, cid in sorted(chosen.items()):
+            try:
+                lines.extend(self.runtime.container_logs(cid))
+            except Exception:  # noqa: BLE001 — runtime without logs
+                pass
+        return "\n".join(lines) + ("\n" if lines else "")
 
     # -- event plumbing ------------------------------------------------
     def _on_event(self, event: Event) -> None:
@@ -509,6 +570,7 @@ class Kubelet:
                     cid = self.runtime.create_container(sid, c.name,
                                                         c.image)
                     self.runtime.start_container(cid)
+                    self._run_post_start(c, cid)
                     self._containers_of[uid][c.name] = cid
             return
         self._init_progress[uid] = pending_idx
@@ -516,13 +578,38 @@ class Kubelet:
         if len(init_cids) <= pending_idx:
             self._start_next_init(pod)
 
+    def _capture_graceful(self, pod: Pod) -> None:
+        """Record the pod's termination contract (grace period + preStop
+        hooks): the pod object may be GONE from the store by the time
+        teardown needs it. Also called for ADOPTED pods (restart over a
+        persistent runtime) — their contract must survive the restart."""
+        grace = pod.spec.termination_grace_period_seconds
+        self._graceful[pod.uid] = (
+            30.0 if grace is None else float(grace),
+            [(c.name, c.lifecycle["preStop"])
+             for c in pod.spec.containers
+             if c.lifecycle and c.lifecycle.get("preStop")],
+        )
+
+    def _run_post_start(self, c, cid: str) -> None:
+        """postStart runs immediately after the container starts
+        (lifecycle.go:52 — failures kill the container in the
+        reference; here best-effort, recorded by the runtime)."""
+        if c.lifecycle and c.lifecycle.get("postStart"):
+            try:
+                self.runtime.exec_sync(cid, c.lifecycle["postStart"])
+            except Exception:  # noqa: BLE001
+                _logger.exception("postStart hook failed: %s", c.name)
+
     def _start_main_containers(self, pod: Pod, publish: bool) -> None:
         sid = self._sandbox_of[pod.uid]
         cids = {}
+        self._capture_graceful(pod)
         for c in pod.spec.containers:
             cid = self.runtime.create_container(sid, c.name, c.image)
             self.runtime.start_container(cid)
             cids[c.name] = cid
+            self._run_post_start(c, cid)
             # image sighting feeds the GC manager's LRU order
             if self.image_gc_manager is not None and c.image:
                 self.image_gc_manager.note_image_used(c.image)
@@ -534,6 +621,10 @@ class Kubelet:
             self._set_ready_condition(pod, True)
 
     def _reconcile_containers(self, pod: Pod, publish: bool = True) -> None:
+        if pod.uid not in self._graceful:
+            # adopted pod (kubelet restart): re-derive the termination
+            # contract the old incarnation captured at start
+            self._capture_graceful(pod)
         if pod.spec.init_containers and \
                 pod.uid not in self._init_progress and any(
                     ic.name in self._containers_of.get(pod.uid, {})
@@ -586,10 +677,47 @@ class Kubelet:
         self._release(pod.uid)
 
     def _teardown(self, uid: str) -> None:
-        """Pod deleted or moved away: stop sandbox, release resources.
-        _release is idempotent and must run even without a sandbox —
-        admission-failed pods can still hold device/volume state."""
+        """Pod deleted or moved away: GRACEFUL termination (reference
+        pod_workers terminating state): preStop hooks run, containers
+        get a stop with the pod's grace period to drain, and only when
+        every container exited — or the force-kill deadline passed —
+        does the sandbox release. _release is idempotent and must run
+        even without a sandbox — admission-failed pods can still hold
+        device/volume state."""
+        import time as _time
+
+        cids = self._containers_of.get(uid, {})
+        if uid in self._sandbox_of and uid not in self._terminating \
+                and cids:
+            grace, hooks = self._graceful.get(uid, (0.0, []))
+            for cname, payload in hooks:
+                cid = cids.get(cname)
+                if cid is not None:
+                    try:
+                        self.runtime.exec_sync(cid, payload)
+                    except Exception:  # noqa: BLE001 — hooks are best-effort
+                        _logger.exception("preStop hook failed: %s", cname)
+            for cid in cids.values():
+                st = self.runtime.container_status(cid)
+                if st is not None and st.state == CRI_RUNNING:
+                    try:
+                        self.runtime.stop_container(cid, timeout_s=grace)
+                    except TypeError:
+                        self.runtime.stop_container(cid)
+                    except RuntimeError:
+                        pass       # exited between status and stop
+            self._terminating[uid] = _time.monotonic() + grace
+            self._work.set()
+        if uid in self._terminating:
+            statuses = [self.runtime.container_status(c)
+                        for c in cids.values()]
+            drained = all(s is None or s.state == EXITED
+                          for s in statuses)
+            if not drained and _time.monotonic() < self._terminating[uid]:
+                return             # grace window: containers draining
+            del self._terminating[uid]
         self._release(uid)
+        self._graceful.pop(uid, None)
         self._terminal.discard(uid)
         self._key_of.pop(uid, None)
 

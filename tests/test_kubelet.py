@@ -960,3 +960,72 @@ def test_init_phase_survives_kubelet_restart():
         assert any(c.image == "app" for c in rt.list_containers())
     finally:
         kl2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Graceful termination + lifecycle hooks (reference pod_workers
+# terminating state, kuberuntime lifecycle.go)
+
+
+def test_prestop_hook_and_graceful_stop_order(cluster):
+    store, kubelet = cluster
+    pod = MakePod().name("web").uid("u-grace").container(image="app").obj()
+    pod.spec.containers[0].lifecycle = {
+        "preStop": {"exec": {"command": ["/bin/drain"]}},
+        "postStart": {"exec": {"command": ["/bin/warm"]}},
+    }
+    store.create_pod(pod)
+    store.bind("default", "web", pod.uid, "n1")
+    assert wait_for(lambda: store.get_pod(
+        "default", "web").status.phase == RUNNING)
+    # postStart ran at container start
+    assert any(p[1] == {"exec": {"command": ["/bin/warm"]}}
+               for p in kubelet.runtime.exec_records)
+    cid = list(kubelet._containers_of[pod.uid].values())[0]
+    store.delete_pod("default", "web")
+    assert wait_for(lambda: not kubelet.running_pods())
+    # preStop ran IN the still-running container before the stop
+    pre = [(c, p) for c, p in kubelet.runtime.exec_records
+           if p == {"exec": {"command": ["/bin/drain"]}}]
+    assert pre == [(cid, {"exec": {"command": ["/bin/drain"]}})]
+
+
+def test_force_kill_after_grace_deadline():
+    """A runtime whose containers ignore the stop request drains until
+    the grace deadline, then the kubelet force-releases the sandbox."""
+    class StubbornRuntime(FakeRuntime):
+        def stop_container(self, container_id, timeout_s=30.0):
+            # SIGTERM ignored: the container keeps running
+            pass
+
+    store = ClusterStore()
+    kl = Kubelet(store, "n1", runtime=StubbornRuntime())
+    kl.sync_interval = 0.05
+    kl.start()
+    try:
+        pod = MakePod().name("stuck").uid("u-stuck") \
+            .container(image="app").obj()
+        pod.spec.termination_grace_period_seconds = 0.4
+        store.create_pod(pod)
+        store.bind("default", "stuck", pod.uid, "n1")
+        assert wait_for(lambda: kl.running_pods())
+        t0 = time.time()
+        store.delete_pod("default", "stuck")
+        # still draining inside the grace window
+        time.sleep(0.15)
+        assert kl.running_pods(), "released before the grace deadline"
+        assert wait_for(lambda: not kl.running_pods(), timeout=5)
+        assert time.time() - t0 >= 0.35, "force-kill fired early"
+    finally:
+        kl.stop()
+
+
+def test_zero_grace_kills_immediately(cluster):
+    store, kubelet = cluster
+    pod = MakePod().name("fast").uid("u-fast").container(image="app").obj()
+    pod.spec.termination_grace_period_seconds = 0
+    store.create_pod(pod)
+    store.bind("default", "fast", pod.uid, "n1")
+    assert wait_for(lambda: kubelet.running_pods())
+    store.delete_pod("default", "fast")
+    assert wait_for(lambda: not kubelet.running_pods(), timeout=3)
