@@ -443,6 +443,41 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._serve_watch(kind, ns, rv)
             return
+        if kind == "Pod" and sub == "log" and name is not None:
+            # pods/log subresource: proxy to the owning node's kubelet
+            # (reference registry/core/pod/rest/log.go -> kubelet
+            # /containerLogs); authz'd above under "get pods"
+            pod = store.get_pod(ns or "default", name)
+            if pod is None:
+                self._send_error(404, "NotFound", f"pod {name!r} not found")
+                return
+            source = store.log_source(pod.spec.node_name) \
+                if pod.spec.node_name else None
+            if source is None:
+                self._send_error(
+                    404, "NotFound",
+                    f"no log source for node {pod.spec.node_name!r} "
+                    "(pod not running on a registered kubelet)",
+                )
+                return
+            try:
+                text = source(ns or "default", name,
+                              q.get("container", ""))
+            except LookupError as e:
+                # unknown container / pod not yet synced on the node:
+                # the client's fault, never silent-empty success
+                self._send_error(400, "BadRequest", str(e))
+                return
+            except Exception as e:  # noqa: BLE001 — kubelet-side failure
+                self._send_error(500, "InternalError", str(e))
+                return
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if name is not None:
             obj = store.get_object(kind, ns or "default", name)
             if obj is None:
@@ -1119,6 +1154,30 @@ class RestClient:
         if code in (403, 422):
             self._raise_for(code, payload)
         return code == 200
+
+    def pod_logs(self, namespace: str, name: str,
+                 container: str = "") -> str:
+        """GET pods/{name}/log (text/plain, unlike the JSON verbs)."""
+        import urllib.request
+        from urllib.parse import quote
+
+        path = self._path("Pod", namespace, name, "log")
+        if container:
+            path += f"?container={quote(container)}"
+        req = urllib.request.Request(self.base_url + path)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                msg = json.loads(payload or b"{}").get("message", "")
+            except json.JSONDecodeError:
+                msg = payload.decode(errors="replace")
+            self._raise_for(e.code, {"message": msg})
+            raise
 
     def bind(self, namespace: str, name: str, uid: str, node_name: str) -> None:
         code, payload = self._request(

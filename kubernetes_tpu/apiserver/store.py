@@ -138,6 +138,10 @@ class ClusterStore:
         self.event_ttl = 3600.0
         self._watches: List[WatchHandle] = []
         self._assumed_pvs: Dict[str, str] = {}  # pv name -> pvc key (Reserve)
+        # node name -> log provider fn(ns, name, container) -> str: the
+        # in-process analog of the apiserver->kubelet log proxy
+        # connection (pods/log subresource); kubelets register on start
+        self._log_sources: Dict[str, Callable] = {}
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
@@ -1111,6 +1115,18 @@ class ClusterStore:
             self._dispatch(Event(MODIFIED, "PersistentVolume", pv))
             self._dispatch(Event(MODIFIED, "PersistentVolumeClaim", pvc))
             return True
+
+    def register_log_source(self, node_name: str, fn: Callable) -> None:
+        with self._lock:
+            self._log_sources[node_name] = fn
+
+    def unregister_log_source(self, node_name: str) -> None:
+        with self._lock:
+            self._log_sources.pop(node_name, None)
+
+    def log_source(self, node_name: str) -> Optional[Callable]:
+        with self._lock:
+            return self._log_sources.get(node_name)
 
     def unbind_pv(self, pv_name: str, pvc_namespace: str,
                   pvc_name: str) -> bool:

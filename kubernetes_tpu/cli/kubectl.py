@@ -202,6 +202,24 @@ class Kubectl:
         _table(headers, [row_fn(o, wide) for o in objs], self.out)
         return 0
 
+    def logs(self, name: str, namespace: str, container: str = "") -> int:
+        """kubectl logs: the pods/log subresource proxied through the
+        apiserver to the owning kubelet. Errors arrive as HTTP status
+        codes (400/403/404), never in-band in the log text."""
+        try:
+            text = self.client.pod_logs(namespace, name, container)
+        except KeyError as e:
+            print(f"Error from server (NotFound): {e}", file=self.err)
+            return 1
+        except PermissionError as e:
+            print(f"Error from server (Forbidden): {e}", file=self.err)
+            return 1
+        except RuntimeError as e:
+            print(f"Error from server: {e}", file=self.err)
+            return 1
+        self.out.write(text)
+        return 0
+
     def describe(self, kind_token: str, name: str, namespace: str) -> int:
         kind = _resolve_kind(kind_token)
         obj = self.client.get(kind, name, namespace)
@@ -415,6 +433,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token", default="", help="bearer token")
     sub = p.add_subparsers(dest="verb", required=True)
 
+    lg = sub.add_parser("logs")
+    lg.add_argument("pod_name")
+    lg.add_argument("-c", "--container", default="")
+    lg.add_argument("-n", "--namespace", default="default")
+
     g = sub.add_parser("get")
     g.add_argument("kind")
     g.add_argument("name", nargs="?")
@@ -509,6 +532,8 @@ def _dispatch(k: "Kubectl", args) -> int:
     if args.verb == "get":
         return k.get(args.kind, args.name, args.namespace, args.all_namespaces,
                      args.output)
+    if args.verb == "logs":
+        return k.logs(args.pod_name, args.namespace, args.container)
     if args.verb == "describe":
         return k.describe(args.kind, args.name, args.namespace)
     if args.verb == "create":

@@ -172,3 +172,43 @@ def test_cli_scale_and_top(cluster):
     assert rc == 0 and "CPU(requests)" in out
     rc, out, _ = ktpu(cluster, "version")
     assert rc == 0 and "Client Version" in out
+
+
+def test_kubectl_logs_end_to_end():
+    """kubectl logs -> apiserver pods/log -> owning kubelet -> CRI log
+    stream (reference registry/core/pod/rest/log.go)."""
+    import io
+    import time as _time
+
+    from kubernetes_tpu.apiserver.rest import APIServer
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.cli.kubectl import run_command
+    from kubernetes_tpu.kubelet import Kubelet
+    from kubernetes_tpu.testing import MakePod
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    kl = Kubelet(store, "n1", capacity={"cpu": "8", "memory": "16Gi"})
+    kl.start()
+    try:
+        pod = MakePod().name("web").uid("u-web").container(image="app").obj()
+        store.create_pod(pod)
+        store.bind("default", "web", pod.uid, "n1")
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                store.get_pod("default", "web").status.phase != "Running":
+            _time.sleep(0.05)
+        out = io.StringIO()
+        rc = run_command(["--server", server.url, "logs", "web"], out=out)
+        assert rc == 0
+        assert "container started image=app" in out.getvalue()
+        # pod without a kubelet: clean NotFound, not a crash
+        p2 = MakePod().name("ghost").uid("u-ghost").obj()
+        store.create_pod(p2)
+        err = io.StringIO()
+        rc = run_command(["--server", server.url, "logs", "ghost"],
+                         out=io.StringIO(), err=err)
+        assert rc == 1 and "NotFound" in err.getvalue()
+    finally:
+        kl.stop()
+        server.shutdown_server()
