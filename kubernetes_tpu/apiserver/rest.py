@@ -168,6 +168,70 @@ def resources_metrics_text(store: ClusterStore) -> str:
     return "\n".join(lines + limits_lines) + "\n"
 
 
+# per-kind selectable fields (reference ToSelectableFields:
+# pkg/registry/core/pod/strategy.go, node/strategy.go; every other kind
+# supports only the generic metadata pair) — an unlisted field is the
+# client's 400 regardless of whether any object exists to filter
+_GENERIC_FIELDS = {"metadata.name", "metadata.namespace"}
+_SELECTABLE_FIELDS = {
+    "Pod": _GENERIC_FIELDS | {
+        "spec.nodeName", "spec.restartPolicy", "spec.schedulerName",
+        "spec.serviceAccountName", "status.phase", "status.podIP",
+        "status.nominatedNodeName",
+    },
+    "Node": _GENERIC_FIELDS | {"spec.unschedulable"},
+    "Event": _GENERIC_FIELDS | {
+        "involvedObject.kind", "involvedObject.name", "reason", "type",
+    },
+}
+
+
+def _parse_field_selector(kind: str, expr: str) -> List[tuple]:
+    """Parse + VALIDATE a field selector ("k=v,k2!=v2") against the
+    kind's selectable-field set. Validation is unconditional — upstream
+    rejects unsupported selectors even when nothing would be filtered."""
+    allowed = _SELECTABLE_FIELDS.get(kind, _GENERIC_FIELDS)
+    checks: List[tuple] = []
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            key, _, val = part.partition("!=")
+            want_eq = False
+        elif "==" in part:
+            key, _, val = part.partition("==")
+            want_eq = True
+        elif "=" in part:
+            key, _, val = part.partition("=")
+            want_eq = True
+        else:
+            raise ValueError(f"invalid field selector clause {part!r}")
+        key = key.strip()
+        if key not in allowed:
+            raise ValueError(f"field label not supported: {key!r}")
+        checks.append((key, val.strip(), want_eq))
+    return checks
+
+
+def _field_checks_match(obj, checks: List[tuple]) -> bool:
+    import re
+
+    def resolve(path: str) -> str:
+        cur = obj
+        for seg in path.split("."):
+            snake = re.sub(r"(?<!^)(?=[A-Z])", "_", seg).lower()
+            cur = getattr(cur, snake, "")
+        if cur is None:
+            return ""
+        if isinstance(cur, bool):
+            return "true" if cur else "false"   # wire casing
+        return str(cur)
+
+    return all((resolve(key) == val) == want_eq
+               for key, val, want_eq in checks)
+
+
 Authorizer = Callable[[str, str, str, str], bool]  # (user, verb, kind, ns)
 
 
@@ -432,6 +496,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(403, "Forbidden", str(e))
             return
         store = self.server.store
+        # selectors parse BEFORE the list/watch split: both paths honor
+        # them, and both reject unsupported fields with 400
+        label_sel = None
+        field_checks = None
+        if q.get("labelSelector"):
+            from kubernetes_tpu.api.labels import parse_selector
+
+            try:
+                label_sel = parse_selector(q["labelSelector"])
+            except Exception as e:  # noqa: BLE001 — grammar error
+                self._send_error(400, "BadRequest",
+                                 f"invalid labelSelector: {e}")
+                return
+        if q.get("fieldSelector"):
+            try:
+                field_checks = _parse_field_selector(
+                    kind, q["fieldSelector"])
+            except ValueError as e:
+                self._send_error(400, "BadRequest", str(e))
+                return
         if q.get("watch") in ("true", "1"):
             try:
                 rv = int(q.get("resourceVersion") or 0)
@@ -441,7 +525,7 @@ class _Handler(BaseHTTPRequestHandler):
                     f"invalid resourceVersion {q.get('resourceVersion')!r}",
                 )
                 return
-            self._serve_watch(kind, ns, rv)
+            self._serve_watch(kind, ns, rv, label_sel, field_checks)
             return
         if kind == "Pod" and sub == "log" and name is not None:
             # pods/log subresource: proxy to the owning node's kubelet
@@ -487,6 +571,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # list + RV atomically: a watch from this RV misses nothing
         objs, rv = store.list_objects_with_rv(kind, ns)
+        if label_sel is not None:
+            objs = [o for o in objs
+                    if label_sel.matches(o.metadata.labels)]
+        if field_checks is not None:
+            objs = [o for o in objs
+                    if _field_checks_match(o, field_checks)]
         self._send_json(
             200,
             {
@@ -765,7 +855,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(404, "NotFound", f"{kind} {name!r} not found")
 
     # -- watch streaming ----------------------------------------------
-    def _serve_watch(self, kind: str, ns: Optional[str], rv: int) -> None:
+    def _serve_watch(self, kind: str, ns: Optional[str], rv: int,
+                     label_sel=None, field_checks=None) -> None:
         frames: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=10_000)
         # capture the REQUEST's api version: the sink runs on store
         # threads, and group-route watches must stream the same wire
@@ -777,6 +868,15 @@ class _Handler(BaseHTTPRequestHandler):
             if event.kind != kind:
                 return
             if ns is not None and getattr(event.obj.metadata, "namespace", None) != ns:
+                return
+            # selector-scoped watch (storage-level filtering; deviation
+            # from upstream: an object MODIFIED out of the selector is
+            # dropped rather than translated to a synthetic DELETED)
+            if label_sel is not None and not label_sel.matches(
+                    event.obj.metadata.labels):
+                return
+            if field_checks is not None and not _field_checks_match(
+                    event.obj, field_checks):
                 return
             frame = json.dumps(
                 {"type": event.type,
@@ -1129,9 +1229,23 @@ class RestClient:
         self._raise_for(code, payload)
         return from_wire(payload, kind)
 
-    def list(self, kind: str, namespace: Optional[str] = None) -> Tuple[List[Any], int]:
-        """→ (objects, listResourceVersion) for watch bootstrapping."""
-        code, payload = self._request("GET", self._path(kind, namespace))
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: str = "",
+             field_selector: str = "") -> Tuple[List[Any], int]:
+        """→ (objects, listResourceVersion) for watch bootstrapping.
+        Selectors filter SERVER-side (?labelSelector= / ?fieldSelector=),
+        like client-go ListOptions."""
+        from urllib.parse import urlencode
+
+        path = self._path(kind, namespace)
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        if params:
+            path += "?" + urlencode(params)
+        code, payload = self._request("GET", path)
         self._raise_for(code, payload)
         rv = int(payload.get("metadata", {}).get("resourceVersion") or 0)
         return [from_wire(item, kind) for item in payload.get("items", [])], rv

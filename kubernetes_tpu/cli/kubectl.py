@@ -179,9 +179,15 @@ class Kubectl:
 
     # -- verbs ---------------------------------------------------------
     def get(self, kind_token: str, name: Optional[str], namespace: Optional[str],
-            all_namespaces: bool, output: Optional[str]) -> int:
+            all_namespaces: bool, output: Optional[str],
+            selector: str = "", field_selector: str = "") -> int:
         kind = _resolve_kind(kind_token)
         ns = None if all_namespaces or not is_namespaced(kind) else (namespace or "default")
+        if name and (selector or field_selector):
+            # reference kubectl: selectors never combine with a name
+            print("error: selectors may not be used when a resource "
+                  "name is given", file=self.err)
+            return 1
         if name:
             obj = self.client.get(kind, name, ns or "default")
             if obj is None:
@@ -190,7 +196,8 @@ class Kubectl:
                 return 1
             objs = [obj]
         else:
-            objs, _ = self.client.list(kind, ns)
+            objs, _ = self.client.list(kind, ns, label_selector=selector,
+                                       field_selector=field_selector)
         if output == "json":
             docs = [to_wire(o) for o in objs]
             print(json.dumps(docs[0] if name else docs, indent=2), file=self.out)
@@ -444,6 +451,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("-n", "--namespace", default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true")
     g.add_argument("-o", "--output", choices=["wide", "json"], default=None)
+    g.add_argument("-l", "--selector", default="",
+                   help="label selector, e.g. app=web,tier!=cache")
+    g.add_argument("--field-selector", default="",
+                   help="field selector, e.g. spec.nodeName=n1")
 
     d = sub.add_parser("describe")
     d.add_argument("kind")
@@ -531,7 +542,7 @@ def run_command(argv: Sequence[str], client: Optional[RestClient] = None,
 def _dispatch(k: "Kubectl", args) -> int:
     if args.verb == "get":
         return k.get(args.kind, args.name, args.namespace, args.all_namespaces,
-                     args.output)
+                     args.output, args.selector, args.field_selector)
     if args.verb == "logs":
         return k.logs(args.pod_name, args.namespace, args.container)
     if args.verb == "describe":
