@@ -78,14 +78,19 @@ def _csi_nodes(store: ClusterStore, nodes):
 
 
 def _pvc_setup(store: ClusterStore, claim: str, variant: int = 0):
-    """A 1:1 PV/PVC pair in four variants (round-3 coverage — bound
-    claims are batch-expressible, VERDICT r2 #1):
+    """A 1:1 PV/PVC pair in six variants (round-3 coverage — bound
+    claims are batch-expressible, VERDICT r2 #1 — plus the round-4
+    carve-outs):
 
     0. bound, CSI driver (attach-limit columns), unconstrained PV
     1. bound, PV zone-labelled z0 (VolumeZone mask)
     2. bound, PV node-affinity to z1 (VolumeBinding bound-claim mask)
     3. unbound immediate — UnschedulableAndUnresolvable on both paths
        (the serial-fallback contract's original coverage)
+    4. SHARED RWX claim on a non-CSI PV (one claim, many pods) —
+       round-4 batchable (no attach budget)
+    5. unbound WaitForFirstConsumer claim over an affinity-free
+       Available PV — round-4 batchable with commit-time binding
     """
     from kubernetes_tpu.api.types import (
         NodeSelector, NodeSelectorRequirement, NodeSelectorTerm,
@@ -97,6 +102,45 @@ def _pvc_setup(store: ClusterStore, claim: str, variant: int = 0):
             provisioner="kubernetes.io/fake",
             volume_binding_mode="Immediate",
         ))
+    if variant == 4:
+        if store.get_pvc("default", claim) is not None:
+            return      # the shared claim exists once, consumed by many
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name=f"pv-{claim}"),
+            capacity={"storage": parse_quantity("100Gi")},
+            storage_class_name="diff-sc",
+            access_modes=["ReadWriteMany"],
+            claim_ref=f"default/{claim}",
+            phase="Bound",
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=claim, namespace="default"),
+            storage_class_name="diff-sc",
+            requests={"storage": parse_quantity("1Gi")},
+            access_modes=["ReadWriteMany"],
+            volume_name=f"pv-{claim}",
+            phase="Bound",
+        ))
+        return
+    if variant == 5:
+        if store.get_storage_class("diff-wfc-sc") is None:
+            store.add_storage_class(StorageClass(
+                metadata=ObjectMeta(name="diff-wfc-sc"),
+                provisioner="kubernetes.io/fake",
+                volume_binding_mode="WaitForFirstConsumer",
+            ))
+        store.add_pv(PersistentVolume(
+            metadata=ObjectMeta(name=f"pv-{claim}"),
+            capacity={"storage": parse_quantity("1Gi")},
+            storage_class_name="diff-wfc-sc",
+            phase="Available",
+        ))
+        store.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=claim, namespace="default"),
+            storage_class_name="diff-wfc-sc",
+            requests={"storage": parse_quantity("1Gi")},
+        ))
+        return
     if variant == 3:
         store.add_pv(PersistentVolume(
             metadata=ObjectMeta(name=f"pv-{claim}"),
@@ -201,8 +245,9 @@ def _random_pods(rng, count, store=None, gangs=False, pvcs=False,
         elif kind == 7:
             w.toleration(TAINT_KEY, TAINT_VAL, "NoSchedule")
         elif kind == 8 and pvcs and store is not None:
-            claim = f"claim-{i}"
-            _pvc_setup(store, claim, variant=i % 4)
+            variant = i % 6
+            claim = "claim-shared-rwx" if variant == 4 else f"claim-{i}"
+            _pvc_setup(store, claim, variant=variant)
             w.pvc(claim)
         # remaining kinds: plain fit pods
         pods.append(w.obj())
