@@ -45,41 +45,95 @@ def _hook_matches(hook, operation: str, resource: str) -> bool:
 
 
 def apply_json_patch(doc: Any, patch: List[Dict[str, Any]]) -> Any:
-    """RFC 6902 subset (add/replace/remove) — what admission webhooks
-    emit. Paths are '/'-separated with ~0/~1 escapes; '-' appends."""
-    for op in patch:
-        path = op.get("path", "")
+    """RFC 6902 JSON Patch: add / replace / remove / test / move /
+    copy, with the RFC's error semantics (replace and remove require
+    the path to exist; a failed test aborts the whole patch). Paths are
+    '/'-separated with ~0/~1 escapes; '-' appends. Shared by webhook
+    mutation responses and the apiserver's PATCH verb."""
+    def walk(path: str, create: bool = False):
         parts = [
             p.replace("~1", "/").replace("~0", "~")
             for p in path.split("/")[1:]
         ]
         if not parts:
-            raise AdmissionError(f"webhook patch: empty path in {op}")
+            raise AdmissionError(f"json patch: empty path {path!r}")
         parent = doc
         for p in parts[:-1]:
             if isinstance(parent, list):
                 parent = parent[int(p)]
-            else:
+            elif create:
                 parent = parent.setdefault(p, {})
-        leaf = parts[-1]
+            else:
+                if p not in parent:
+                    raise AdmissionError(
+                        f"json patch: path {path!r} does not exist")
+                parent = parent[p]
+        return parent, parts[-1]
+
+    def get_at(parent, leaf):
+        if isinstance(parent, list):
+            i = int(leaf)
+            if not 0 <= i < len(parent):
+                raise AdmissionError(
+                    f"json patch: index {leaf} out of range")
+            return parent[i]
+        if leaf not in parent:
+            raise AdmissionError(
+                f"json patch: member {leaf!r} does not exist")
+        return parent[leaf]
+
+    def remove_at(parent, leaf):
+        value = get_at(parent, leaf)
+        if isinstance(parent, list):
+            parent.pop(int(leaf))
+        else:
+            del parent[leaf]
+        return value
+
+    def add_at(parent, leaf, value):
+        if isinstance(parent, list):
+            if leaf == "-":
+                parent.append(value)
+            else:
+                parent.insert(int(leaf), value)
+        else:
+            parent[leaf] = value
+
+    for op in patch:
         kind = op.get("op")
-        if kind in ("add", "replace"):
+        path = op.get("path", "")
+        if kind == "add":
+            parent, leaf = walk(path, create=True)
+            add_at(parent, leaf, op["value"])
+        elif kind == "replace":
+            parent, leaf = walk(path)
+            get_at(parent, leaf)        # must exist (RFC 6902 §4.3)
             if isinstance(parent, list):
-                if leaf == "-":
-                    parent.append(op["value"])
-                elif kind == "add":
-                    parent.insert(int(leaf), op["value"])
-                else:
-                    parent[int(leaf)] = op["value"]
+                parent[int(leaf)] = op["value"]
             else:
                 parent[leaf] = op["value"]
         elif kind == "remove":
-            if isinstance(parent, list):
-                parent.pop(int(leaf))
+            parent, leaf = walk(path)
+            remove_at(parent, leaf)
+        elif kind == "test":
+            parent, leaf = walk(path)
+            if get_at(parent, leaf) != op.get("value"):
+                raise AdmissionError(
+                    f"json patch: test failed at {path!r}")
+        elif kind in ("move", "copy"):
+            from_path = op.get("from", "")
+            fparent, fleaf = walk(from_path)
+            value = get_at(fparent, fleaf)
+            if kind == "move":
+                remove_at(fparent, fleaf)
             else:
-                parent.pop(leaf, None)
+                import copy as _copy
+
+                value = _copy.deepcopy(value)
+            parent, leaf = walk(path, create=True)
+            add_at(parent, leaf, value)
         else:
-            raise AdmissionError(f"webhook patch: unsupported op {kind!r}")
+            raise AdmissionError(f"json patch: unsupported op {kind!r}")
     return doc
 
 

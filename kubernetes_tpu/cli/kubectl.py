@@ -209,6 +209,26 @@ class Kubectl:
         _table(headers, [row_fn(o, wide) for o in objs], self.out)
         return 0
 
+    def patch(self, kind_token: str, name: str, patch_str: str,
+              namespace: str, patch_type: str) -> int:
+        kind = _resolve_kind(kind_token)
+        try:
+            patch = json.loads(patch_str)
+        except json.JSONDecodeError as e:
+            print(f"error: invalid patch JSON: {e}", file=self.err)
+            return 1
+        try:
+            obj = self.client.patch(kind, name, patch, namespace,
+                                    patch_type)
+        except KeyError as e:
+            print(f"Error from server (NotFound): {e}", file=self.err)
+            return 1
+        except (PermissionError, ConflictError, RuntimeError) as e:
+            print(f"Error from server: {e}", file=self.err)
+            return 1
+        print(f"{kind.lower()}/{obj.metadata.name} patched", file=self.out)
+        return 0
+
     def logs(self, name: str, namespace: str, container: str = "") -> int:
         """kubectl logs: the pods/log subresource proxied through the
         apiserver to the owning kubelet. Errors arrive as HTTP status
@@ -440,6 +460,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token", default="", help="bearer token")
     sub = p.add_subparsers(dest="verb", required=True)
 
+    pa = sub.add_parser("patch")
+    pa.add_argument("kind")
+    pa.add_argument("name")
+    pa.add_argument("-p", "--patch", required=True,
+                    help="JSON merge patch (or RFC 6902 array with --type=json)")
+    pa.add_argument("--type", dest="patch_type", default="merge",
+                    choices=["merge", "json"])
+    pa.add_argument("-n", "--namespace", default="default")
+
     lg = sub.add_parser("logs")
     lg.add_argument("pod_name")
     lg.add_argument("-c", "--container", default="")
@@ -543,6 +572,9 @@ def _dispatch(k: "Kubectl", args) -> int:
     if args.verb == "get":
         return k.get(args.kind, args.name, args.namespace, args.all_namespaces,
                      args.output, args.selector, args.field_selector)
+    if args.verb == "patch":
+        return k.patch(args.kind, args.name, args.patch, args.namespace,
+                       args.patch_type)
     if args.verb == "logs":
         return k.logs(args.pod_name, args.namespace, args.container)
     if args.verb == "describe":

@@ -502,3 +502,136 @@ class TestAdmissionBreadth:
             assert by_name["kube-scheduler"].lease_duration_seconds == 15.0
         finally:
             server.shutdown_server()
+
+    def test_patch_merge_and_json(self):
+        """PATCH: RFC 7386 merge (nulls delete, dicts merge) and RFC
+        6902 json-patch, CAS'd on the read revision, through
+        admission."""
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            pod = MakePod().name("web").uid("u-web") \
+                .label("app", "web").label("tier", "x").obj()
+            client.create(pod)
+            # merge patch: set one label, delete another
+            got = client.patch("Pod", "web", {
+                "metadata": {"labels": {"env": "prod", "tier": None}},
+            })
+            assert got.metadata.labels.get("env") == "prod"
+            assert "tier" not in got.metadata.labels
+            assert got.metadata.labels.get("app") == "web"  # merged
+            # json patch
+            got = client.patch("Pod", "web", [
+                {"op": "replace", "path": "/metadata/labels/env",
+                 "value": "staging"},
+            ], patch_type="json")
+            assert got.metadata.labels["env"] == "staging"
+            live = store.get_pod("default", "web")
+            assert live.metadata.labels["env"] == "staging"
+            # identity immutable
+            got = client.patch("Pod", "web",
+                               {"metadata": {"name": "evil"}})
+            assert got.metadata.name == "web"
+        finally:
+            server.shutdown_server()
+
+    def test_patch_respects_versioned_routes(self):
+        """A patch against a group route applies to THAT version's wire
+        shape (nested v1beta1 spec), not the hub."""
+        import urllib.request
+        import json as _json
+
+        from kubernetes_tpu.api.types import CronJob, ObjectMeta
+        from kubernetes_tpu.apiserver.rest import APIServer
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            store.create_object("CronJob", CronJob(
+                metadata=ObjectMeta(name="backup", namespace="default"),
+                schedule="* * * * *",
+            ))
+            req = urllib.request.Request(
+                server.url + "/apis/batch/v1beta1/namespaces/default/"
+                             "cronjobs/backup",
+                data=_json.dumps(
+                    {"spec": {"schedule": "*/10 * * * *"}}).encode(),
+                method="PATCH",
+                headers={"Content-Type": "application/merge-patch+json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = _json.loads(resp.read())
+            assert payload["spec"]["schedule"] == "*/10 * * * *"
+            assert store.get_object(
+                "CronJob", "default", "backup").schedule == "*/10 * * * *"
+        finally:
+            server.shutdown_server()
+
+    def test_patch_hardening(self):
+        """Scalar bodies 400; uid/creationTimestamp pinned; Service
+        clusterIP immutable; RFC 6902 test/move/copy + strict errors."""
+        import pytest as _pytest
+
+        from kubernetes_tpu.api.types import Service, ServicePort
+        from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+        from kubernetes_tpu.testing import MakePod
+
+        store = ClusterStore()
+        server = APIServer(store=store).start()
+        try:
+            client = RestClient(server.url)
+            pod = MakePod().name("p").uid("u-p").label("a", "1").obj()
+            client.create(pod)
+            # scalar merge body -> 400, not a dropped connection
+            code, _ = client._request(
+                "PATCH", "/api/v1/namespaces/default/pods/p", 5,
+                content_type="application/merge-patch+json")
+            assert code == 400
+            # metadata null cannot regenerate identity
+            got = client.patch("Pod", "p", {"metadata": None})
+            assert got.metadata.uid == "u-p"
+            got = client.patch("Pod", "p", {"metadata": {"uid": "evil"}})
+            assert got.metadata.uid == "u-p"
+            # metadata:null wiped the labels (correct RFC semantics,
+            # identity pinned); restore them for the json-patch leg
+            client.patch("Pod", "p", {"metadata": {"labels": {"a": "1"}}})
+            # Service clusterIP immutable via PATCH like PUT
+            svc = Service(cluster_ip="10.96.0.9",
+                          ports=[ServicePort(name="http", port=80)])
+            svc.metadata.name = "svc"
+            svc.metadata.namespace = "default"
+            client.create(svc)
+            with _pytest.raises(PermissionError):
+                client.patch("Service", "svc", {"clusterIp": "10.96.0.77"})
+            # RFC 6902: test guards, strict replace
+            with _pytest.raises(RuntimeError):
+                client.patch("Pod", "p", [
+                    {"op": "test", "path": "/metadata/labels/a",
+                     "value": "WRONG"},
+                    {"op": "replace", "path": "/metadata/labels/a",
+                     "value": "2"},
+                ], patch_type="json")
+            assert store.get_pod(
+                "default", "p").metadata.labels["a"] == "1"
+            with _pytest.raises(RuntimeError):
+                client.patch("Pod", "p", [
+                    {"op": "replace", "path": "/metadata/labels/nope",
+                     "value": "x"},
+                ], patch_type="json")
+            got = client.patch("Pod", "p", [
+                {"op": "test", "path": "/metadata/labels/a",
+                 "value": "1"},
+                {"op": "copy", "from": "/metadata/labels/a",
+                 "path": "/metadata/labels/b"},
+                {"op": "move", "from": "/metadata/labels/b",
+                 "path": "/metadata/labels/c"},
+            ], patch_type="json")
+            assert got.metadata.labels.get("c") == "1"
+            assert "b" not in got.metadata.labels
+        finally:
+            server.shutdown_server()
