@@ -220,7 +220,8 @@ def _field_checks_match(obj, checks: List[tuple]) -> bool:
     def resolve(path: str) -> str:
         cur = obj
         for seg in path.split("."):
-            snake = re.sub(r"(?<!^)(?=[A-Z])", "_", seg).lower()
+            # collapse acronym runs: podIP -> pod_ip, not pod_i_p
+            snake = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", seg).lower()
             cur = getattr(cur, snake, "")
         if cur is None:
             return ""
@@ -507,7 +508,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(404, "NotFound", f"no route for {self.path}")
             return
         try:
-            self._check_authz("get" if name else "list", kind, ns or "")
+            if kind == "Pod" and sub == "log":
+                # pods/log is a distinct RBAC resource in the reference
+                # (a role granting only "get pods" must not leak logs)
+                self._check_authz("get", "pods/log", ns or "")
+            else:
+                self._check_authz("get" if name else "list", kind, ns or "")
         except Forbidden as e:
             self._send_error(403, "Forbidden", str(e))
             return
@@ -546,7 +552,8 @@ class _Handler(BaseHTTPRequestHandler):
         if kind == "Pod" and sub == "log" and name is not None:
             # pods/log subresource: proxy to the owning node's kubelet
             # (reference registry/core/pod/rest/log.go -> kubelet
-            # /containerLogs); authz'd above under "get pods"
+            # /containerLogs); authz'd above as its own "pods/log"
+            # resource — "get pods" alone must not leak logs
             pod = store.get_pod(ns or "default", name)
             if pod is None:
                 self._send_error(404, "NotFound", f"pod {name!r} not found")

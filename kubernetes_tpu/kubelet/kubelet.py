@@ -336,6 +336,10 @@ class Kubelet:
                 continue
             if not pod.metadata.namespace:
                 pod.metadata.namespace = "kube-system"
+            # per-node name suffix (reference kubelet config/common.go
+            # applyDefaults): two kubelets loading the same manifest
+            # must not fight over one (namespace, name) mirror slot
+            pod.metadata.name = f"{pod.metadata.name}-{self.node_name}"
             pod.spec.node_name = self.node_name
             # STABLE identity across kubelet restarts (the reference
             # hashes the manifest source): a fresh random uid per start

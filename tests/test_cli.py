@@ -285,6 +285,64 @@ def test_field_selector_validated_even_on_empty_results():
         server.shutdown_server()
 
 
+def test_field_selector_acronym_fields_resolve():
+    """status.podIP must resolve to the pod_ip attribute — the naive
+    per-capital underscore split produced 'pod_i_p', so '=' selectors
+    silently matched nothing and '!=' matched everything."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.testing import MakePod
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    try:
+        a = MakePod().name("a").uid("u-a").obj()
+        a.status.pod_ip = "10.0.0.5"
+        b = MakePod().name("b").uid("u-b").obj()
+        b.status.pod_ip = "10.0.0.6"
+        store.create_pod(a)
+        store.create_pod(b)
+        client = RestClient(server.url)
+        pods, _ = client.list(
+            "Pod", "default", field_selector="status.podIP=10.0.0.5")
+        assert [p.name for p in pods] == ["a"]
+        pods, _ = client.list(
+            "Pod", "default", field_selector="status.podIP!=10.0.0.5")
+        assert [p.name for p in pods] == ["b"]
+        # WATCH honors the same resolution
+        got, done = [], threading.Event()
+
+        def watcher():
+            req = urllib.request.Request(
+                server.url + "/api/v1/namespaces/default/pods"
+                "?watch=1&fieldSelector=status.podIP%3D10.0.0.7")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                for line in resp:
+                    got.append(_json.loads(line))
+                    done.set()
+                    return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        noise = MakePod().name("noise").uid("u-n").obj()
+        noise.status.pod_ip = "10.0.0.8"
+        client.create(noise)
+        signal = MakePod().name("signal").uid("u-s").obj()
+        signal.status.pod_ip = "10.0.0.7"
+        client.create(signal)
+        assert done.wait(5)
+        assert got[0]["object"]["metadata"]["name"] == "signal"
+    finally:
+        server.shutdown_server()
+
+
 def test_selector_scoped_watch_streams_only_matches():
     import json as _json
     import threading

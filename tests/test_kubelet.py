@@ -764,13 +764,13 @@ def test_static_pod_runs_and_publishes_mirror():
     try:
         # the mirror pod appears bound to this node with the mirror
         # annotation, and reaches Running without any scheduler
-        assert wait_for(lambda: store.get_pod("kube-system", "etcd")
+        assert wait_for(lambda: store.get_pod("kube-system", "etcd-cp-1")
                         is not None)
-        mirror = store.get_pod("kube-system", "etcd")
+        mirror = store.get_pod("kube-system", "etcd-cp-1")
         assert mirror.spec.node_name == "cp-1"
         assert "kubernetes.io/config.mirror" in mirror.metadata.annotations
         assert wait_for(lambda: store.get_pod(
-            "kube-system", "etcd").status.phase == RUNNING)
+            "kube-system", "etcd-cp-1").status.phase == RUNNING)
         assert kl.running_pods()
     finally:
         kl.stop()
@@ -786,15 +786,15 @@ def test_mirror_deletion_never_stops_the_static_pod():
     kl.start()
     try:
         assert wait_for(lambda: store.get_pod(
-            "kube-system", "apiserver") is not None and store.get_pod(
-            "kube-system", "apiserver").status.phase == RUNNING)
+            "kube-system", "apiserver-cp-1") is not None and store.get_pod(
+            "kube-system", "apiserver-cp-1").status.phase == RUNNING)
         sandboxes_before = kl.runtime.list_pod_sandboxes()
-        store.delete_pod("kube-system", "apiserver")
+        store.delete_pod("kube-system", "apiserver-cp-1")
         # republished, still Running, container never restarted
         assert wait_for(lambda: store.get_pod(
-            "kube-system", "apiserver") is not None)
+            "kube-system", "apiserver-cp-1") is not None)
         assert wait_for(lambda: store.get_pod(
-            "kube-system", "apiserver").status.phase == RUNNING)
+            "kube-system", "apiserver-cp-1").status.phase == RUNNING)
         assert kl.runtime.list_pod_sandboxes() == sandboxes_before
     finally:
         kl.stop()
@@ -814,9 +814,9 @@ def test_static_pod_survives_kubelet_restart_without_duplication():
     kl.start()
     try:
         assert wait_for(lambda: store.get_pod(
-            "kube-system", "etcd") is not None and store.get_pod(
-            "kube-system", "etcd").status.phase == RUNNING)
-        uid_before = store.get_pod("kube-system", "etcd").uid
+            "kube-system", "etcd-cp-1") is not None and store.get_pod(
+            "kube-system", "etcd-cp-1").status.phase == RUNNING)
+        uid_before = store.get_pod("kube-system", "etcd-cp-1").uid
     finally:
         kl.stop()
     # restart against the SAME store and runtime
@@ -825,14 +825,46 @@ def test_static_pod_survives_kubelet_restart_without_duplication():
     kl2.start()
     try:
         time.sleep(0.6)
-        mirror = store.get_pod("kube-system", "etcd")
+        mirror = store.get_pod("kube-system", "etcd-cp-1")
         assert mirror is not None and mirror.uid == uid_before
         # exactly one copy of the workload (no duplicate sandbox)
         assert len([s for s in kl2.runtime.list_pod_sandboxes()]) <= 1
         pods = [p for p in store.list_pods()
-                if p.metadata.name == "etcd"]
+                if p.metadata.name == "etcd-cp-1"]
         assert len(pods) == 1
     finally:
+        kl2.stop()
+
+
+def test_static_pod_mirrors_do_not_collide_across_kubelets():
+    """Two kubelets loading the SAME manifest get per-node mirror names
+    (reference suffixes static pod names with the node name) — without
+    the suffix each kubelet would see the other's mirror as a stale
+    incarnation and delete/recreate it forever."""
+    store = ClusterStore()
+    manifest = {
+        "metadata": {"name": "kube-proxy", "namespace": "kube-system"},
+        "spec": {"containers": [{"name": "p", "image": "proxy:1"}]},
+    }
+    kl1 = Kubelet(store, "n1", static_pod_manifests=[manifest])
+    kl2 = Kubelet(store, "n2", static_pod_manifests=[manifest])
+    kl1.start()
+    kl2.start()
+    try:
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "kube-proxy-n1") is not None)
+        assert wait_for(lambda: store.get_pod(
+            "kube-system", "kube-proxy-n2") is not None)
+        m1 = store.get_pod("kube-system", "kube-proxy-n1")
+        m2 = store.get_pod("kube-system", "kube-proxy-n2")
+        assert m1.spec.node_name == "n1" and m2.spec.node_name == "n2"
+        uid1, uid2 = m1.uid, m2.uid
+        # both mirrors remain stable (no delete/recreate fight)
+        time.sleep(0.6)
+        assert store.get_pod("kube-system", "kube-proxy-n1").uid == uid1
+        assert store.get_pod("kube-system", "kube-proxy-n2").uid == uid2
+    finally:
+        kl1.stop()
         kl2.stop()
 
 

@@ -175,6 +175,51 @@ class TestBootstrapPolicyIntegration:
         finally:
             server.shutdown_server()
 
+    def test_pods_log_is_its_own_rbac_resource(self):
+        """A role granting only "get pods" must NOT read container logs
+        — pods/log is a distinct RBAC resource in the reference
+        bootstrap policy (policy.go NodeRules / system:kubelet-api-admin)."""
+        store = ClusterStore()
+        authz = provision_bootstrap_policy(store)
+        store.add_cluster_role(ClusterRole(
+            metadata=ObjectMeta(name="pod-reader"),
+            rules=[PolicyRule(verbs=["get", "list"],
+                              resources=["pods"])],
+        ))
+        store.add_cluster_role_binding(ClusterRoleBinding(
+            metadata=ObjectMeta(name="bob-reads-pods"),
+            subjects=[RBACSubject(kind="User", name="bob")],
+            role_ref=RoleRef(kind="ClusterRole", name="pod-reader"),
+        ))
+        server = APIServer(
+            store=store, authorizer=authz,
+            tokens={"bob-token": "bob", "admin-token": "admin"},
+        ).start()
+        try:
+            store.create_pod(MakePod().name("w").uid("u-w").obj())
+            bob = RestClient(server.url, token="bob-token")
+            assert bob.get("Pod", "w") is not None   # pods: granted
+            code, _ = bob._request(
+                "GET", "/api/v1/namespaces/default/pods/w/log")
+            assert code == 403                       # pods/log: not
+            # granting pods/log unlocks it (404: no kubelet registered,
+            # but the request passed authorization)
+            store.add_cluster_role(ClusterRole(
+                metadata=ObjectMeta(name="log-reader"),
+                rules=[PolicyRule(verbs=["get"],
+                                  resources=["pods/log"])],
+            ))
+            store.add_cluster_role_binding(ClusterRoleBinding(
+                metadata=ObjectMeta(name="bob-reads-logs"),
+                subjects=[RBACSubject(kind="User", name="bob")],
+                role_ref=RoleRef(kind="ClusterRole", name="log-reader"),
+            ))
+            code, _ = bob._request(
+                "GET", "/api/v1/namespaces/default/pods/w/log")
+            assert code == 404
+        finally:
+            server.shutdown_server()
+
     def test_rbac_objects_have_rest_routes(self):
         store, server = self._serve()
         try:
