@@ -61,6 +61,11 @@ class Quantity:
         # would silently change every holder of the same request string
         raise AttributeError("Quantity is immutable")
 
+    def __reduce__(self):
+        # immutability blocks pickle's default __setstate__ path; the
+        # binary wire codec (apiserver/codec.py) pickles whole objects
+        return (Quantity, (self.nano,))
+
     # --- constructors -------------------------------------------------
     @classmethod
     def from_milli(cls, milli: int) -> "Quantity":

@@ -258,11 +258,67 @@ def allow_all(user: str, verb: str, kind: str, namespace: str) -> bool:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # a request/response ping-pong on a keep-alive connection stalls
+    # ~40ms per round trip under Nagle + delayed ACK; the reference
+    # apiserver's HTTP/2 stack never batches this way either
+    disable_nagle_algorithm = True
     server: "APIServer"
 
     # -- plumbing ------------------------------------------------------
     def log_message(self, fmt, *args):  # quiet
         pass
+
+    # -- max-in-flight gate (reference apiserver filters/maxinflight.go:
+    # separate readonly and mutating lanes; a full lane answers 429 with
+    # Retry-After so one hot client cannot starve the control plane).
+    # Long-running requests (watches) are exempt, as upstream's
+    # longRunningRequestCheck exempts them.
+    _UNGATED_PATHS = ("/healthz", "/livez", "/readyz")
+
+    def _gate(self) -> Optional[threading.Semaphore]:
+        if self.command in ("GET", "HEAD"):
+            if "watch=" in self.path:
+                return None      # long-running: never counts against a lane
+            if self.path in self._UNGATED_PATHS:
+                # flow control must never fail a liveness probe — 429
+                # under load would get the server restarted exactly when
+                # it's busy (reference exempts health paths likewise)
+                return None
+            return self.server.readonly_lane
+        return self.server.mutating_lane
+
+    def _handle_gated(self, inner) -> None:
+        lane = self._gate()
+        if lane is None:
+            try:
+                inner()
+            except Forbidden as e:
+                self._send_error(403, "Forbidden", str(e))
+            return
+        if not lane.acquire(blocking=False):
+            body = json.dumps({
+                "kind": "Status", "status": "Failure",
+                "reason": "TooManyRequests",
+                "message": "too many requests in flight, try again later",
+                "code": 429,
+            }).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        try:
+            try:
+                inner()
+            except Forbidden as e:
+                # raised before any bytes were written (body reads
+                # precede every send): e.g. a binary body from an
+                # unauthenticated client
+                self._send_error(403, "Forbidden", str(e))
+        finally:
+            lane.release()
 
     def _send_json(self, code: int, payload: Any) -> None:
         body = json.dumps(payload).encode()
@@ -285,10 +341,57 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
+    # -- binary codec negotiation (codec.py: the protobuf analog) ------
+    def _accepts_binary(self) -> bool:
+        from kubernetes_tpu.apiserver import codec
+
+        return codec.BINARY_CONTENT_TYPE in (self.headers.get("Accept") or "")
+
+    def _binary_decode_allowed(self) -> bool:
+        """Pickle bodies only from authenticated clients — codec.py's
+        trust model; anonymous callers never reach the unpickler. The
+        no-authn escape hatch additionally requires a LOOPBACK peer: a
+        tokenless server bound to a reachable interface must not be an
+        arbitrary-code-execution endpoint."""
+        if not self.server.tokens and self.server.authorizer is allow_all:
+            peer = self.client_address[0] if self.client_address else ""
+            return peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+        return self._user() != "system:anonymous"
+
     def _read_body(self) -> Any:
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b"{}"
+        ctype = self.headers.get("Content-Type") or ""
+        from kubernetes_tpu.apiserver import codec
+
+        if ctype.startswith(codec.BINARY_CONTENT_TYPE):
+            if not self._binary_decode_allowed():
+                raise Forbidden(
+                    "binary bodies require an authenticated client")
+            return codec.decode(raw)
         return json.loads(raw or b"{}")
+
+    def _send_bytes(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_negotiated(self, code: int, payload: Any,
+                         json_fallback: Optional[Callable[[], Any]] = None
+                         ) -> None:
+        """Send ``payload`` pickled when the client asked for binary;
+        otherwise the JSON shape (``json_fallback()`` when the JSON wire
+        differs from the binary payload, e.g. objects vs dicts)."""
+        from kubernetes_tpu.apiserver import codec
+
+        if self._accepts_binary():
+            self._send_bytes(code, codec.encode(payload),
+                             codec.BINARY_CONTENT_TYPE)
+        else:
+            self._send_json(
+                code, json_fallback() if json_fallback else payload)
 
     # -- versioned codec (scheme hub-and-spoke) ------------------------
     def _decode(self, body: Dict, kind: str) -> Any:
@@ -471,6 +574,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs ---------------------------------------------------------
     def do_GET(self) -> None:
+        self._handle_gated(self._do_GET)
+
+    def _do_GET(self) -> None:
         u = urlparse(self.path)
         if u.path in ("/healthz", "/livez", "/readyz"):
             body = b"ok"
@@ -590,7 +696,8 @@ class _Handler(BaseHTTPRequestHandler):
             if obj is None:
                 self._send_error(404, "NotFound", f"{kind} {name!r} not found")
                 return
-            self._send_json(200, self._encode(obj))
+            self._send_negotiated(200, obj,
+                                  json_fallback=lambda: self._encode(obj))
             return
         # list + RV atomically: a watch from this RV misses nothing
         objs, rv = store.list_objects_with_rv(kind, ns)
@@ -600,9 +707,10 @@ class _Handler(BaseHTTPRequestHandler):
         if field_checks is not None:
             objs = [o for o in objs
                     if _field_checks_match(o, field_checks)]
-        self._send_json(
+        self._send_negotiated(
             200,
-            {
+            {"kind": f"{kind}List", "resourceVersion": rv, "items": objs},
+            json_fallback=lambda: {
                 "kind": f"{kind}List",
                 "apiVersion": getattr(self, "_api_version", "v1"),
                 "metadata": {"resourceVersion": str(rv)},
@@ -610,7 +718,124 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
+    def _bulk_bindings(self, ns: Optional[str]) -> None:
+        """POST .../bindings with a BindingList: the batch-native wire
+        for the TPU commit path — one request, one store lock, one
+        batched watch delivery for N bindings (store.bind_many). Each
+        item is still its own transaction with the exact per-pod
+        semantics of POST pods/{name}/binding (reference
+        storage.go:159 BindingREST.Create); failures come back
+        positionally. The reference has no bulk verb — its Go scheduler
+        amortizes with 64 goroutines instead; a batch scheduler that
+        solves 4096 placements per device call would serialize on
+        per-pod round trips."""
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._send_error(400, "BadRequest", f"invalid JSON: {e}")
+            return
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(items, list):
+            self._send_error(400, "BadRequest",
+                             "BindingList body with items required")
+            return
+        bindings: List[Tuple[str, str, str, str]] = []
+        try:
+            for it in items:
+                if isinstance(it, (tuple, list)):
+                    bns, name, uid, node = it
+                else:
+                    bns = it.get("namespace") or ns or "default"
+                    name = it.get("name") or ""
+                    uid = it.get("uid") or ""
+                    node = (it.get("target") or {}).get("name") \
+                        or it.get("nodeName", "")
+                bindings.append((bns, name, uid, node))
+        except (ValueError, TypeError, AttributeError) as e:
+            self._send_error(400, "BadRequest", f"malformed binding: {e}")
+            return
+        try:
+            for bns in {b[0] for b in bindings}:
+                self._check_authz("create", "Binding", bns)
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        errors = self.server.store.bind_many(bindings)
+        failures = [
+            {"index": i,
+             "code": 404 if isinstance(err, KeyError) else 409,
+             "message": str(err)}
+            for i, err in enumerate(errors) if err is not None
+        ]
+        self._send_negotiated(201, {
+            "kind": "Status",
+            "status": "Success" if not failures else "Failure",
+            "bound": len(bindings) - len(failures),
+            "failures": failures,
+        })
+
+    def _bulk_create(self, kind: str, ns: Optional[str], body: dict,
+                     user: str) -> None:
+        """POST a {Kind}List to a collection: per-item admission, bulk
+        store insert (one lock + one batched watch delivery for pods),
+        positional failures. The QPS discipline lives client-side
+        (RestClusterClient charges its token bucket per OBJECT, so a
+        bulk request is rate-equivalent to N singles)."""
+        store = self.server.store
+        items = body.get("items")
+        if not isinstance(items, list):
+            self._send_error(400, "BadRequest", "List body without items")
+            return
+        failures: List[dict] = []
+        admitted: List[tuple] = []   # (orig index, AdmissionRequest, obj)
+        for i, item in enumerate(items):
+            try:
+                # binary bodies carry API objects; JSON carries dicts
+                obj = item if not isinstance(item, dict) \
+                    else self._decode(item, kind)
+                if ns is not None and store.kind_is_namespaced(kind):
+                    obj.metadata.namespace = ns
+                req = AdmissionRequest(
+                    CREATE, kind, obj.metadata.namespace, obj, user=user)
+                obj = self.server.admission.run(req)
+                admitted.append((i, req, obj))
+            except (ValueError, TypeError, AdmissionError) as e:
+                failures.append({"index": i, "code": 422,
+                                 "message": str(e)})
+        created = 0
+        if admitted and kind == "Pod":
+            try:
+                store.create_pods([obj for _, _, obj in admitted])
+                created = len(admitted)
+                admitted = []
+            except ValueError:
+                # mid-batch duplicate: create_pods inserted nothing
+                # (it validates the whole batch first) — fall through
+                # to per-item creates so the conflict is attributed
+                # and the rest of the batch still lands
+                pass
+        for i, req, obj in admitted:
+            try:
+                if kind == "Pod":
+                    store.create_pod(obj)
+                else:
+                    store.create_object(kind, obj)
+                created += 1
+            except ValueError as e:
+                self.server.admission.rollback(req)
+                failures.append({"index": i, "code": 409,
+                                 "message": str(e)})
+        self._send_negotiated(201, {
+            "kind": "Status",
+            "status": "Success" if not failures else "Failure",
+            "created": created,
+            "failures": failures,
+        })
+
     def do_POST(self) -> None:
+        self._handle_gated(self._do_POST)
+
+    def _do_POST(self) -> None:
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -618,6 +843,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if kind is None:
             path = urlparse(self.path).path.rstrip("/")
+            if path.endswith("/bindings"):
+                self._bulk_bindings(ns)
+                return
             if path.endswith("/selfsubjectaccessreviews"):
                 # virtual kind (reference authorization.k8s.io/v1
                 # SelfSubjectAccessReview): any authenticated user may
@@ -677,8 +905,15 @@ class _Handler(BaseHTTPRequestHandler):
         except Forbidden as e:
             self._send_error(403, "Forbidden", str(e))
             return
+        if name is None and isinstance(body, dict) \
+                and body.get("kind") == f"{kind}List":
+            self._bulk_create(kind, ns, body, user)
+            return
         try:
-            obj = self._decode(body, kind)
+            # binary bodies carry the API object itself; JSON carries
+            # the wire dict
+            obj = body if not isinstance(body, dict) \
+                else self._decode(body, kind)
         except (ValueError, TypeError) as e:
             # decode failure (bad quantity, wrong shape) is the client's
             # fault — 400, never the store-conflict 409
@@ -734,6 +969,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(409, "AlreadyExists", str(e))
 
     def do_PUT(self) -> None:
+        self._handle_gated(self._do_PUT)
+
+    def _do_PUT(self) -> None:
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -783,16 +1021,41 @@ class _Handler(BaseHTTPRequestHandler):
                 except AdmissionError as e:
                     self._send_error(422, "Invalid", str(e))
                     return
-            if store.set_pod_phase(
-                ns or "default",
-                name,
-                status.get("phase", ""),
-                status.get("podIP", ""),
-                status.get("hostIP", ""),
-            ):
-                self._send_json(200, {"kind": "Status", "status": "Success"})
-            else:
+            if live is None:
                 self._send_error(404, "NotFound", f"pod {name!r} not found")
+                return
+            if status.get("phase") or status.get("podIP") \
+                    or status.get("hostIP"):
+                store.set_pod_phase(
+                    ns or "default", name,
+                    status.get("phase", ""),
+                    status.get("podIP", ""),
+                    status.get("hostIP", ""),
+                )
+            # scheduler-owned status fields (reference pod/status
+            # strategy allows conditions + nominatedNodeName through the
+            # status subresource — the scheduler's Unschedulable
+            # condition and preemption nomination both write here)
+            if "nominatedNodeName" in status:
+                node = status["nominatedNodeName"]
+                if node:
+                    store.set_nominated_node_name(ns or "default", name,
+                                                  node)
+                else:
+                    store.clear_nominated_node_name(ns or "default", name)
+            for cond in status.get("conditions") or ():
+                from kubernetes_tpu.api.types import PodCondition
+
+                store.patch_pod_condition(
+                    ns or "default", name,
+                    cond if not isinstance(cond, dict)
+                    else PodCondition(
+                        type=cond.get("type", ""),
+                        status=cond.get("status", ""),
+                        reason=cond.get("reason", ""),
+                        message=cond.get("message", ""),
+                    ))
+            self._send_json(200, {"kind": "Status", "status": "Success"})
             return
         try:
             user = self._check_authz("update", kind, ns or "")
@@ -800,7 +1063,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(403, "Forbidden", str(e))
             return
         try:
-            obj = self._decode(body, kind)
+            obj = body if not isinstance(body, dict) \
+                else self._decode(body, kind)
         except (ValueError, TypeError) as e:
             self._send_error(400, "BadRequest", str(e))
             return
@@ -843,6 +1107,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(404, "NotFound", str(e))
 
     def do_PATCH(self) -> None:
+        self._handle_gated(self._do_PATCH)
+
+    def _do_PATCH(self) -> None:
         """PATCH with RFC 7386 JSON Merge Patch (the default and
         ``application/merge-patch+json``) or RFC 6902 JSON Patch
         (``application/json-patch+json``) — the reference's patch
@@ -935,6 +1202,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(404, "NotFound", str(e))
 
     def do_DELETE(self) -> None:
+        self._handle_gated(self._do_DELETE)
+
+    def _do_DELETE(self) -> None:
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -972,7 +1242,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- watch streaming ----------------------------------------------
     def _serve_watch(self, kind: str, ns: Optional[str], rv: int,
                      label_sel=None, field_checks=None) -> None:
-        frames: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=10_000)
+        binary = self._accepts_binary()
+        frames: "queue.Queue[Optional[Any]]" = queue.Queue(maxsize=10_000)
         # capture the REQUEST's api version: the sink runs on store
         # threads, and group-route watches must stream the same wire
         # shape their GETs serve (versioned-codec contract)
@@ -993,10 +1264,25 @@ class _Handler(BaseHTTPRequestHandler):
             if field_checks is not None and not _field_checks_match(
                     event.obj, field_checks):
                 return
-            frame = json.dumps(
-                {"type": event.type,
-                 "object": SCHEME_V.encode(event.obj, api_version)}
-            ).encode() + b"\n"
+            if binary:
+                # raw (type, obj, old) — pickled in batches by the
+                # writer; old_obj rides along because scheduler event
+                # handlers key bind/update detection on it (the
+                # reference's informers synthesize old from their local
+                # cache instead — our binary peers skip that cache)
+                frame = (event.type, event.obj, event.old_obj)
+            else:
+                # memoized per event: N watchers must not pay N encodes
+                # (reference cachingObject in the watch cache)
+                frame = event.__dict__.get("_v1_frame") \
+                    if api_version == "v1" else None
+                if frame is None:
+                    frame = json.dumps(
+                        {"type": event.type,
+                         "object": SCHEME_V.encode(event.obj, api_version)}
+                    ).encode() + b"\n"
+                    if api_version == "v1":
+                        event.__dict__["_v1_frame"] = frame
             try:
                 frames.put_nowait(frame)
             except queue.Full:
@@ -1014,8 +1300,12 @@ class _Handler(BaseHTTPRequestHandler):
         except TooOldResourceVersion as e:
             self._send_error(410, "Expired", str(e))
             return
+        from kubernetes_tpu.apiserver import codec
+
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header(
+            "Content-Type",
+            codec.BINARY_CONTENT_TYPE if binary else "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
@@ -1026,8 +1316,28 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if frame is None:
                     break
+                closing = False
+                if binary:
+                    # drain the backlog into ONE length-prefixed frame:
+                    # a pickled list of (type, obj) — the client hands
+                    # the whole batch to its handler in one call (the
+                    # store's own batched dispatch, kept batched on the
+                    # wire; reference streams length-delimited protobuf)
+                    batch = [frame]
+                    while len(batch) < 512:
+                        try:
+                            nxt = frames.get_nowait()
+                        except queue.Empty:
+                            break
+                        if nxt is None:
+                            closing = True
+                            break
+                        batch.append(nxt)
+                    frame = codec.frame(batch)
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
                 self.wfile.flush()
+                if closing:
+                    break
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
@@ -1053,8 +1363,17 @@ class APIServer(ThreadingHTTPServer):
         authorizer: Authorizer = allow_all,
         tokens: Optional[Dict[str, str]] = None,
         metrics_text_fn: Optional[Callable[[], str]] = None,
+        max_readonly_inflight: Optional[int] = 400,
+        max_mutating_inflight: Optional[int] = 200,
     ):
         super().__init__((host, port), _Handler)
+        # self-protection lanes (reference filters/maxinflight.go
+        # defaults: --max-requests-inflight 400,
+        # --max-mutating-requests-inflight 200); None = unlimited
+        self.readonly_lane = threading.Semaphore(max_readonly_inflight) \
+            if max_readonly_inflight else None
+        self.mutating_lane = threading.Semaphore(max_mutating_inflight) \
+            if max_mutating_inflight else None
         self.store = store if store is not None else ClusterStore()
         self.watch_cache = WatchCache(self.store)
         if admission is None:

@@ -29,7 +29,9 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
+import time
 from typing import Optional
 
 from kubernetes_tpu.api.serialization import from_wire, to_wire
@@ -41,12 +43,28 @@ SNAP_TMP = "snapshot.json.tmp"
 
 
 class WalHandle:
+    """``async_serialize=True`` (the default) moves serialization off
+    the store lock: the watch callback only enqueues the event (the
+    store hands watchers freshly-built objects that later mutations
+    never touch, so holding a reference is snapshot-safe) and a writer
+    thread serializes + appends in commit order. This is etcd's own
+    shape — raft appends are pipelined behind the apply loop, not paid
+    inside each request's critical section. ``fsync=True`` forces the
+    synchronous inline path (every mutation durable before its watch
+    event is visible)."""
+
     def __init__(self, store: ClusterStore, directory: str,
-                 snapshot_every: int = 20000, fsync: bool = False):
+                 snapshot_every: int = 20000, fsync: bool = False,
+                 async_serialize: Optional[bool] = None):
         self.store = store
         self.dir = directory
         self.snapshot_every = snapshot_every
         self.fsync = fsync
+        # conservative default: serialize inline (every mutation on disk
+        # before its watch event returns) — the chaos ring's WAL-equality
+        # invariant depends on it. High-throughput servers opt into the
+        # async writer and accept a queue-bounded loss window on crash.
+        self.async_serialize = bool(async_serialize)
         os.makedirs(directory, exist_ok=True)
         self._log_path = os.path.join(directory, LOG_NAME)
         self._log = open(self._log_path, "a", encoding="utf-8")
@@ -55,10 +73,46 @@ class WalHandle:
         # only guards against snapshot() racing an append from a
         # different store (not a supported topology, but cheap)
         self._lock = threading.Lock()
-        self._watch = store.watch(self._on_event)
+        self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        if self.async_serialize:
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True, name="wal-writer")
+            self._writer.start()
+        self._watch = store.watch(self._on_event,
+                                  batch_fn=self._on_events)
 
     # ------------------------------------------------------------------
+    def _on_events(self, events) -> None:
+        if self.async_serialize:
+            for event in events:
+                self._queue.put(event)
+        else:
+            for event in events:
+                self._append(event)
+
     def _on_event(self, event: Event) -> None:
+        self._on_events([event])
+
+    def _writer_loop(self) -> None:
+        while True:
+            event = self._queue.get()
+            if event is None:
+                return
+            try:
+                self._append(event)
+            except Exception:   # noqa: BLE001 — a bad record must not
+                pass            # kill durability for all that follow
+            if self._entries_since_snapshot >= self.snapshot_every:
+                # compaction between queue items, store→wal lock order
+                # (never from inside _append, whose wal→store order
+                # would invert against snapshot())
+                try:
+                    self.snapshot()
+                except Exception:   # noqa: BLE001
+                    pass
+
+    def _line_for(self, event: Event) -> str:
         obj = event.obj
         rv = getattr(obj.metadata, "resource_version", "") or "0"
         if event.type == DELETED:
@@ -70,23 +124,45 @@ class WalHandle:
         else:
             line = {"t": "PUT", "k": event.kind, "rv": int(rv),
                     "o": to_wire(obj)}
+        return json.dumps(line)
+
+    def _append(self, event: Event) -> None:
+        line = self._line_for(event)
         with self._lock:
-            self._log.write(json.dumps(line) + "\n")
+            self._log.write(line + "\n")
             self._log.flush()
             if self.fsync:
                 os.fsync(self._log.fileno())
             self._entries_since_snapshot += 1
-            if self._entries_since_snapshot >= self.snapshot_every:
+            if not self.async_serialize and \
+                    self._entries_since_snapshot >= self.snapshot_every:
+                # sync path runs under the (reentrant) store lock via
+                # the dispatch, so store→wal order holds here
                 self._snapshot_locked()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every enqueued event is on disk."""
+        deadline = time.monotonic() + timeout
+        while not self._queue.empty():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
 
     # ------------------------------------------------------------------
     def snapshot(self) -> None:
         """Cut a snapshot now and truncate the log (etcd compaction).
-        Lock order is store -> wal, matching _on_event (which runs under
-        the store lock via the synchronous dispatch) — the store lock is
-        reentrant, so taking it first here and again inside
-        _snapshot_locked is safe, and AB/BA inversion is impossible."""
+        Lock order is store -> wal everywhere (the sync dispatch path
+        holds the reentrant store lock already; the async writer calls
+        this between queue items, holding neither). With the store lock
+        held no new events can enqueue, and draining first keeps the
+        truncated log free of entries the snapshot already contains —
+        restore's per-object rv guard covers the writer's own calls,
+        which skip the drain (the writer cannot wait on itself)."""
         with self.store._lock:
+            if self._writer is not None and \
+                    threading.current_thread() is not self._writer:
+                self.drain()
             with self._lock:
                 self._snapshot_locked()
 
@@ -113,16 +189,21 @@ class WalHandle:
 
     def close(self) -> None:
         self._watch.stop()
+        if self._writer is not None:
+            self.drain()
+            self._queue.put(None)
+            self._writer.join(timeout=5.0)
         with self._lock:
             self._log.close()
 
 
 def attach_wal(store: ClusterStore, directory: str,
-               snapshot_every: int = 20000, fsync: bool = False) -> WalHandle:
+               snapshot_every: int = 20000, fsync: bool = False,
+               async_serialize: bool = False) -> WalHandle:
     """Make ``store`` durable: all subsequent mutations are logged.
     Cuts an initial snapshot so pre-existing state is captured too."""
     handle = WalHandle(store, directory, snapshot_every=snapshot_every,
-                       fsync=fsync)
+                       fsync=fsync, async_serialize=async_serialize)
     handle.snapshot()
     return handle
 
@@ -161,8 +242,21 @@ def restore_store(directory: str,
                     line = json.loads(raw)
                 except json.JSONDecodeError:
                     break  # torn tail write from the crash: stop replay
-                max_rv = max(max_rv, int(line.get("rv") or 0))
+                line_rv = int(line.get("rv") or 0)
+                max_rv = max(max_rv, line_rv)
                 kind = line["k"]
+
+                def newer_exists(table, key) -> bool:
+                    # per-object rv guard: the async writer may append
+                    # (after a compaction it didn't wait for) entries
+                    # the snapshot already contains — replaying them
+                    # must never regress a newer object
+                    cur = table.get(key)
+                    if cur is None:
+                        return False
+                    cur_rv = int(getattr(cur.metadata, "resource_version",
+                                         "") or 0)
+                    return cur_rv > line_rv
                 if line["t"] == "DEL":
                     try:
                         table, key = store._table_key(
@@ -170,6 +264,8 @@ def restore_store(directory: str,
                         )
                     except KeyError:
                         continue  # delete of an already-unregistered kind
+                    if newer_exists(table, key):
+                        continue
                     old = table.pop(key, None)
                     if kind == "CustomResourceDefinition" and \
                             old is not None:
@@ -181,6 +277,8 @@ def restore_store(directory: str,
                     table, key = store._table_key(
                         kind, obj.metadata.namespace, obj.metadata.name
                     )
+                    if newer_exists(table, key):
+                        continue
                     table[key] = obj
     with store._lock:
         store._rv = max(store._rv, max_rv)

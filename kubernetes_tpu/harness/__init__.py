@@ -1,6 +1,27 @@
-from kubernetes_tpu.harness.perf import (
-    BenchmarkResult,
-    run_workload,
-    ThroughputCollector,
-)
+"""Benchmark harness package.
+
+Lazy exports (PEP 562): ``perf`` transitively imports the TPU solver
+(jax); the REST harness's creator/apiserver child processes import only
+``workloads`` and must stay jax-free — a device-initialized child
+spawned beside the scheduler process would fight it for the chip.
+"""
+
 from kubernetes_tpu.harness.workloads import WORKLOADS, make_workload
+
+__all__ = [
+    "WORKLOADS", "make_workload",
+    "BenchmarkResult", "run_workload", "ThroughputCollector",
+    "run_workload_rest",
+]
+
+
+def __getattr__(name):
+    if name in ("BenchmarkResult", "run_workload", "ThroughputCollector"):
+        from kubernetes_tpu.harness import perf
+
+        return getattr(perf, name)
+    if name == "run_workload_rest":
+        from kubernetes_tpu.harness.rest_perf import run_workload_rest
+
+        return run_workload_rest
+    raise AttributeError(name)

@@ -30,16 +30,25 @@ def _percentile(samples: List[float], q: float) -> float:
 
 
 class ThroughputCollector:
-    """Samples scheduled-pod count at 1 Hz (util.go throughputCollector)."""
+    """Samples scheduled-pod count at 1 Hz (util.go throughputCollector).
 
-    def __init__(self, store: ClusterStore, interval: float = 1.0):
+    ``count_fn`` overrides the counting source — the REST harness counts
+    from the scheduler's own commit metric instead of scanning a store
+    it doesn't share a process with."""
+
+    def __init__(self, store: Optional[ClusterStore] = None,
+                 interval: float = 1.0,
+                 count_fn: Optional[Callable[[], int]] = None):
         self.store = store
+        self.count_fn = count_fn
         self.interval = interval
         self.samples: List[float] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _count_scheduled(self) -> int:
+        if self.count_fn is not None:
+            return self.count_fn()
         return sum(1 for p in self.store.list_pods() if p.spec.node_name)
 
     def start(self) -> None:

@@ -1,0 +1,367 @@
+"""scheduler_perf over the REAL API fabric (VERDICT r4 missing #1).
+
+The reference's scheduler_perf runs an in-process apiserver + real etcd
+and every client goes through REST at QPS/Burst 5000
+(``test/integration/scheduler_perf/util.go:61-68``,
+``test/integration/util/util.go:57``). The store-direct harness
+(``perf.py``) deliberately excludes that cost; this harness includes it:
+
+- **apiserver process**: ClusterStore + WAL (the etcd analog) served by
+  ``APIServer`` — authn (bearer tokens), RBAC bootstrap policy,
+  admission, watch cache, max-in-flight lanes all live.
+- **creator process(es)**: build workload objects from the same
+  declarative ops and POST them through ``RestClusterClient`` — bulk
+  {Kind}List bodies whose token bucket charges PER OBJECT, so the wire
+  discipline is the reference's per-client 5000 QPS regardless of
+  batching.
+- **scheduler (this process, owns the TPU)**: fed by watch-driven
+  list+watch streams over chunked HTTP, binds through the Binding
+  subresource (bulk BindingList for the batch commit), status writes
+  through pods/{name}/status — all via the binary codec.
+
+Process topology mirrors the reference deployment (apiserver, client,
+scheduler are separate processes); it also gives each Python runtime
+its own GIL, which is what the reference gets for free from Go.
+
+Throughput is counted from the scheduler's commit metric (successful
+REST binds); at the end the apiserver process REPORTS its own
+bound-pod count and the two must agree — the measured number is
+store-truth, not client-side optimism.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import tempfile
+import time
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.harness.workloads import make_workload
+
+SCHEDULER_TOKEN = "rest-perf-scheduler-token"
+CREATOR_TOKEN = "rest-perf-creator-token"
+
+
+# ---------------------------------------------------------------------------
+# child mains (spawned; must stay jax-free — see harness/__init__)
+
+
+def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
+    from kubernetes_tpu.apiserver.rbac import provision_bootstrap_policy
+    from kubernetes_tpu.apiserver.rest import APIServer
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.apiserver.wal import attach_wal
+    from kubernetes_tpu.utils.gctune import tune_for_throughput
+
+    tune_for_throughput()
+    store = ClusterStore()
+    # async WAL writer: serialization rides a background thread instead
+    # of every request's critical section (etcd pipelines raft appends
+    # the same way); bounded loss window on crash, same as fsync=False
+    wal = attach_wal(store, wal_dir, snapshot_every=200_000,
+                     async_serialize=True) if wal_dir else None
+    authz = provision_bootstrap_policy(store)
+    authz.add_user_to_group("perf-creator", "system:masters")
+    server = APIServer(
+        store=store,
+        authorizer=authz,
+        tokens={SCHEDULER_TOKEN: "system:kube-scheduler",
+                CREATOR_TOKEN: "perf-creator"},
+    ).start()
+    conn.send(server.url)
+    while True:
+        msg = conn.recv()
+        if msg == "stop":
+            break
+        if msg == "counts":
+            pods = store.list_pods()
+            conn.send({
+                "pods_total": len(pods),
+                "pods_bound": sum(1 for p in pods if p.spec.node_name),
+                "wal_entries": _wal_lines(wal_dir),
+            })
+    server.shutdown_server()
+    if wal is not None:
+        wal.close()
+    conn.send("stopped")
+
+
+def _wal_lines(wal_dir: Optional[str]) -> int:
+    if not wal_dir:
+        return 0
+    path = os.path.join(wal_dir, "wal.jsonl")
+    try:
+        with open(path, "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def _real_failures(resp) -> list:
+    """Bulk-create failures that are NOT 409s. The client retries a
+    dropped keep-alive; a create applied server-side before the drop
+    comes back AlreadyExists on the retry — for a creator whose goal is
+    'these pods exist', that IS success, not a row-aborting error."""
+    return [f for f in (resp.get("failures") or ())
+            if f.get("code") != 409]
+
+
+def _creator_main(conn, url: str, name: str, nodes: int, init_pods: int,
+                  measure_pods: int, qps: Optional[float],
+                  n_clients: int) -> None:
+    """Executes create ops on demand. ``n_clients`` round-robins pod
+    creation across that many QPS-capped clients (each with its OWN
+    5000-QPS bucket, the reference's per-client discipline)."""
+    from kubernetes_tpu.api.types import Node, Pod
+    from kubernetes_tpu.client.restcluster import RestClusterClient
+
+    clients = [RestClusterClient(url, token=CREATOR_TOKEN, qps=qps)
+               for _ in range(max(1, n_clients))]
+    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                        measure_pods=measure_pods)
+    CHUNK = 512
+    while True:
+        msg = conn.recv()
+        if msg == "stop":
+            break
+        op_idx = msg
+        op = ops[op_idx]
+        if op["opcode"] == "createNodes":
+            objs = [Node.from_dict(op["nodeTemplate"](i))
+                    for i in range(op["count"])]
+            for lo in range(0, len(objs), CHUNK):
+                chunk = objs[lo:lo + CHUNK]
+                code, resp = clients[0]._request(
+                    "POST", "/api/v1/nodes",
+                    {"kind": "NodeList", "items": chunk},
+                    charge=len(chunk))
+                if code >= 400 or _real_failures(resp):
+                    conn.send(("error", op_idx, str(resp)[:500]))
+                    break
+            else:
+                conn.send(("done", op_idx, len(objs)))
+            continue
+        if op["opcode"] == "createPods":
+            template = op["podTemplate"]
+            offset = op.get("offset", 0)
+            count = op["count"]
+            sent = 0
+            failed = None
+            for lo in range(0, count, CHUNK):
+                n = min(CHUNK, count - lo)
+                chunk = [Pod.from_dict(template(offset + lo + i))
+                         for i in range(n)]
+                client = clients[(lo // CHUNK) % len(clients)]
+                code, resp = client._request(
+                    "POST", "/api/v1/namespaces/default/pods",
+                    {"kind": "PodList", "items": chunk}, charge=n)
+                if code >= 400 or _real_failures(resp):
+                    failed = str(resp)[:500]
+                    break
+                sent += n
+            if failed is not None:
+                conn.send(("error", op_idx, failed))
+            else:
+                conn.send(("done", op_idx, sent))
+            continue
+        conn.send(("done", op_idx, 0))
+    conn.send("stopped")
+
+
+# ---------------------------------------------------------------------------
+# parent (scheduler + TPU)
+
+
+def run_workload_rest(
+    name: str,
+    nodes: int,
+    measure_pods: int,
+    init_pods: int = 0,
+    max_batch: int = 4096,
+    qps: Optional[float] = 5000.0,
+    n_creator_clients: int = 2,
+    use_batch: bool = True,
+    wait_timeout: float = 1200.0,
+    wal: bool = True,
+    progress: Optional[Callable[[str], None]] = None,
+    result_hook: Optional[Callable[[object, object], None]] = None,
+):
+    """Run one workload with every byte crossing the REST fabric.
+    Returns a ``BenchmarkResult`` whose ``metrics`` carry the apiserver
+    process's own final counts for cross-checking."""
+    from kubernetes_tpu.api.types import Pod
+    from kubernetes_tpu.client.restcluster import RestClusterClient
+    from kubernetes_tpu.config.feature_gates import FeatureGates
+    from kubernetes_tpu.harness.perf import (
+        BenchmarkResult,
+        ThroughputCollector,
+    )
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.sidecar import attach_batch_scheduler
+    from kubernetes_tpu.utils.gctune import tune_for_throughput
+
+    tune_for_throughput()
+    ctx = mp.get_context("spawn")
+    wal_dir = tempfile.mkdtemp(prefix="ktpu-wal-") if wal else None
+
+    api_conn, api_child = ctx.Pipe()
+    api_proc = ctx.Process(target=_apiserver_main,
+                           args=(api_child, wal_dir), daemon=True)
+    api_proc.start()
+    url = api_conn.recv()
+
+    cre_conn, cre_child = ctx.Pipe()
+    cre_proc = ctx.Process(
+        target=_creator_main,
+        args=(cre_child, url, name, nodes, init_pods, measure_pods, qps,
+              n_creator_clients),
+        daemon=True)
+    cre_proc.start()
+
+    client = RestClusterClient(url, token=SCHEDULER_TOKEN, qps=qps)
+    gates = FeatureGates({"TPUBatchScheduler": use_batch})
+    sched = Scheduler.create(client, feature_gates=gates,
+                             provider="GangSchedulingProvider")
+    bs = attach_batch_scheduler(sched, max_batch=max_batch) \
+        if use_batch else None
+    sched.start()
+
+    def bound_count() -> int:
+        s = sched.metrics.e2e_scheduling_duration._series.get(
+            ("scheduled",))
+        return s[2] if s else 0
+
+    def run_op(op_idx: int) -> int:
+        cre_conn.send(op_idx)
+        # pump the scheduler while the creator streams objects in
+        while not cre_conn.poll(0.0):
+            if bs is not None:
+                bs.run_batch(pop_timeout=0.01)
+            else:
+                if not sched.schedule_one(pop_timeout=0.01):
+                    time.sleep(0.002)
+        status, _idx, n = cre_conn.recv()
+        if status == "error":
+            raise RuntimeError(f"creator op {op_idx} failed: {n}")
+        return n
+
+    def pump_until(target: int, deadline: float) -> None:
+        while time.monotonic() < deadline:
+            sched.queue.flush_backoff_completed()
+            progressed = bs.run_batch(pop_timeout=0.01) if bs is not None \
+                else sched.schedule_one(pop_timeout=0.01)
+            if bound_count() >= target:
+                return
+            if not progressed:
+                time.sleep(0.002)
+        raise TimeoutError(
+            f"workload {name}: bound {bound_count()}/{target} "
+            f"before deadline")
+
+    collector = None
+    measure_start = 0.0
+    expected_bound = 0
+    created_pods = 0
+    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                        measure_pods=measure_pods)
+    try:
+        for i, op in enumerate(ops):
+            opcode = op["opcode"]
+            if opcode == "createNodes":
+                run_op(i)
+                # the cache learns nodes via the watch stream; solving
+                # before they land would decline the first batches
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and \
+                        sched.cache.node_count() < op["count"]:
+                    time.sleep(0.02)
+                if progress:
+                    progress(f"{name}/rest: {sched.cache.node_count()} "
+                             f"nodes")
+            elif opcode == "createPods":
+                collect = op.get("collectMetrics", False)
+                if collect and bs is not None:
+                    from kubernetes_tpu.ops.encode import is_host_only
+
+                    template = op["podTemplate"]
+                    offset = op.get("offset", 0)
+                    samples = [Pod.from_dict(template(offset + j))
+                               for j in range(min(200, op["count"]))]
+                    samples = [p for p in samples
+                               if not is_host_only(p, client)]
+                    warm = bs.warmup(sample_pods=samples) if samples \
+                        else 0.0
+                    if progress and warm > 0.05:
+                        progress(f"{name}/rest: solver warmup {warm:.1f}s")
+                if collect:
+                    collector = ThroughputCollector(count_fn=bound_count)
+                    measure_start = time.monotonic()
+                    collector.start()
+                n = run_op(i)
+                created_pods += n
+                if progress:
+                    progress(f"{name}/rest: {created_pods} pods created")
+                if not op.get("skipWaitToCompletion", False):
+                    expected_bound += n
+                    pump_until(expected_bound,
+                               time.monotonic() + wait_timeout)
+            elif opcode == "barrier":
+                pump_until(expected_bound, time.monotonic() + wait_timeout)
+        if bs is not None:
+            bs.flush()
+        sched.wait_for_inflight_bindings(timeout=30.0)
+        duration = time.monotonic() - measure_start if measure_start \
+            else 0.0
+        if result_hook is not None:
+            result_hook(sched, bs)
+    finally:
+        if collector:
+            collector.stop()
+        sched.stop()
+
+    # cross-check against the apiserver's own truth (and WAL durability)
+    api_conn.send("counts")
+    server_counts = api_conn.recv()
+    cre_conn.send("stop")
+    api_conn.send("stop")
+    for conn, proc in ((cre_conn, cre_proc), (api_conn, api_proc)):
+        try:
+            if conn.poll(5.0):
+                conn.recv()
+        except (EOFError, OSError):
+            pass
+        proc.join(timeout=5.0)
+        if proc.is_alive():
+            proc.terminate()
+    if wal_dir:
+        import shutil
+
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+    measured = sum(op["count"] for op in ops
+                   if op["opcode"] == "createPods"
+                   and op.get("collectMetrics"))
+    e2e = sched.metrics.e2e_scheduling_duration
+    metrics = {
+        "Perc50": e2e.quantile(0.50, "scheduled") * 1000,
+        "Perc90": e2e.quantile(0.90, "scheduled") * 1000,
+        "Perc99": e2e.quantile(0.99, "scheduled") * 1000,
+        "server_pods_bound": server_counts["pods_bound"],
+        "server_pods_total": server_counts["pods_total"],
+        "wal_entries": server_counts["wal_entries"],
+        "scheduler_bound": bound_count(),
+    }
+    if server_counts["pods_bound"] < expected_bound:
+        raise RuntimeError(
+            f"store truth disagrees: server bound "
+            f"{server_counts['pods_bound']} < expected {expected_bound}")
+    return BenchmarkResult(
+        name=f"{name}/rest",
+        total_pods=created_pods,
+        measured_pods=measured,
+        duration_seconds=duration,
+        pods_per_second=(measured / duration) if duration > 0 else 0.0,
+        throughput=collector.summary() if collector else {},
+        metrics=metrics,
+    )

@@ -73,8 +73,8 @@ class Gauge(_Metric):
 
 
 DEFAULT_BUCKETS = (
-    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
-    10.0, 20.0, 50.0,
+    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 3.0,
+    5.0, 7.5, 10.0, 15.0, 20.0, 30.0, 40.0, 50.0,
 )
 
 
@@ -130,7 +130,11 @@ class Histogram(_Metric):
             return series[1] if series else 0.0
 
     def quantile(self, q: float, *label_values: str) -> float:
-        """Bucket-interpolated quantile (what the perf harness scrapes)."""
+        """Bucket-interpolated quantile (prometheus histogram_quantile
+        semantics: linear interpolation WITHIN the target bucket). The
+        previous upper-edge report collapsed every breach between two
+        edges to the higher edge — a 26s stall read as exactly "50s"
+        with no shape information (VERDICT r4 weak #5)."""
         with self._lock:
             key = tuple(label_values)
             series = self._series.get(key)
@@ -141,9 +145,16 @@ class Histogram(_Metric):
         target = q * total
         cum = 0
         for i, c in enumerate(counts):
+            prev_cum = cum
             cum += c
             if cum >= target:
-                return self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                if i >= len(self.buckets):
+                    return self.buckets[-1]   # +Inf bucket: clamp
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                if c == 0:
+                    return hi
+                return lo + (hi - lo) * (target - prev_cum) / c
         return self.buckets[-1]
 
     def collect(self):

@@ -188,6 +188,34 @@ class SolverSession:
         self._profiling = False
 
     # ------------------------------------------------------------------
+    def warm_pad(self, pods: List, pad: int) -> bool:
+        """Compile the ``pad``-sized executable WITHOUT touching the
+        state mirror: runs one solve against the resident static/state
+        arrays and discards every output (jax arrays are immutable, so
+        the live ``self._state`` is untouched and any pipelined lazy
+        handle stays valid). The sidecar calls this between cycles when
+        the latency tuner shrinks to a bucket that has never compiled —
+        the compile must burn an un-measured moment, not a real batch's
+        e2e latency. Returns False when there is no resident mirror to
+        warm against (the next real solve is a rebuild, which compiles
+        its own pad anyway)."""
+        if self._state is None or self._encoder is None or \
+                self._cluster is None:
+            return False
+        try:
+            pb = self._encoder.encode_pods_only(pods, pad)
+            if pb is None or pb.requests.shape[1] != \
+                    self._cluster.allocatable.shape[1]:
+                return False
+            ints, floats = pack_podin(pb)
+            handle, _discarded_state = self._active.solve_lazy(
+                self.params, self._static, self._state, ints, floats
+            )
+            self._active.materialize(handle)   # block until compiled+run
+            return True
+        except Exception:   # noqa: BLE001 — warming is advisory
+            return False
+
     def invalidate(self) -> None:
         """Mark the device mirror diverged. Sticky until the next rebuild:
         a later ``note_committed`` must not re-validate (e.g. a host-
