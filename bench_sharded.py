@@ -46,7 +46,8 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _measure(name: str, nodes: int, pods: int, devices: int) -> dict:
+def _measure(name: str, nodes: int, pods: int, devices: int,
+             init_pods: int = 0) -> dict:
     """One end-to-end run; returns the JSON row. devices=1 uses the
     single-device planes scan, >1 the mesh-sharded backend."""
     from kubernetes_tpu.harness import make_workload, run_workload
@@ -98,7 +99,8 @@ def _measure(name: str, nodes: int, pods: int, devices: int) -> dict:
                     total_b += _shard_bytes(v)
         mem["per_device_bytes"] = total_b
 
-    ops = make_workload(name, nodes=nodes, init_pods=0, measure_pods=pods)
+    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
+                        measure_pods=pods)
     t0 = time.time()
     # adaptive_chunk=False: every mesh size must solve the IDENTICAL
     # batch partition (the latency tuner would shrink slow
@@ -233,6 +235,18 @@ def main(quick: bool = False, breakdown_only: bool = False) -> None:
             continue
         log(f"--- {devices} device(s) ---")
         rows.append(_measure(name, nodes, pods, devices))
+    # preemption-heavy scaling row (VERDICT r4 next #4): the mass-
+    # decline -> vectorized screen -> victim-planner flow on the mesh
+    # path; fillers exactly fill the cluster so every measured pod
+    # preempts
+    p_nodes, p_pods = (256, 256) if quick else (1000, 1000)
+    for devices in (1, 8):
+        if devices > n_dev or breakdown_only:
+            continue
+        log(f"--- Preemption, {devices} device(s) ---")
+        row = _measure("Preemption", p_nodes, p_pods, devices,
+                       init_pods=p_nodes)
+        print(json.dumps(row), flush=True)
     base = next((r for r in rows if r["devices"] == 1), None)
     for r in rows:
         if base and r["device_solve_s"] > 0:

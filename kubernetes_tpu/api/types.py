@@ -1188,10 +1188,27 @@ class CRDNames:
 
 
 @dataclass
+class CRDVersion:
+    """apiextensions CustomResourceDefinitionVersion
+    (``apiextensions/types.go:23-28``): one served/storage version of a
+    custom kind. Conversion strategy is None (the reference default):
+    every served version carries the same payload with its own
+    apiVersion stamp."""
+
+    name: str = ""
+    served: bool = True
+    storage: bool = False
+
+
+@dataclass
 class CustomResourceDefinition:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     group: str = ""
     names: CRDNames = field(default_factory=CRDNames)
+    # per-CRD version list (multi-version serving with None-conversion);
+    # empty = the legacy single-version registration (served under the
+    # core route, and under /apis/<group>/v1 when a group is set)
+    versions: List[CRDVersion] = field(default_factory=list)
     scope: str = "Namespaced"  # Namespaced | Cluster
     # opaque openAPIV3Schema-style validation payload (stored, not
     # enforced — the reference's structural-schema validation is a

@@ -81,6 +81,11 @@ class RBACAuthorizer:
         # (pkg/serviceaccount/util.go MakeGroupNames)
         if user.startswith("system:node:"):
             groups.add("system:nodes")
+        elif user.startswith("system:bootstrap:"):
+            # bootstrap-token identities (kubeadm TLS bootstrap;
+            # reference bootstrap token authenticator attaches
+            # system:bootstrappers)
+            groups.add("system:bootstrappers")
         elif user.startswith("system:serviceaccount:"):
             parts = user.split(":")
             if len(parts) == 4:
@@ -217,6 +222,15 @@ def bootstrap_cluster_roles() -> List[ClusterRole]:
                 _rule(READ, ["*"]),
             ],
         ),
+        # reference policy.go "system:node-bootstrapper": a bootstrap
+        # token may submit and watch its own CSR — nothing else
+        ClusterRole(
+            metadata=ObjectMeta(name="system:node-bootstrapper"),
+            rules=[
+                _rule(["create"] + list(READ),
+                      ["certificatesigningrequests"]),
+            ],
+        ),
         # reference policy.go "system:node" (kubelet)
         ClusterRole(
             metadata=ObjectMeta(name="system:node"),
@@ -253,6 +267,8 @@ def bootstrap_cluster_role_bindings() -> List[ClusterRoleBinding]:
                          name="system:kube-controller-manager")),
         bind("system:nodes", "system:node",
              RBACSubject(kind="Group", name="system:nodes")),
+        bind("kubeadm:node-bootstrappers", "system:node-bootstrapper",
+             RBACSubject(kind="Group", name="system:bootstrappers")),
     ]
 
 

@@ -41,7 +41,12 @@ from kubernetes_tpu.apiserver.admission import (
     AdmissionError,
     AdmissionRequest,
 )
-from kubernetes_tpu.apiserver.store import ClusterStore, ConflictError, Event
+from kubernetes_tpu.apiserver.store import (
+    ClusterStore,
+    ConflictError,
+    Event,
+    ValidationError,
+)
 from kubernetes_tpu.apiserver.watchcache import TooOldResourceVersion, WatchCache
 
 # plural route segment ↔ kind
@@ -86,6 +91,14 @@ KIND_TO_PLURAL = {k: p for p, k in PLURALS.items()}
 
 class Forbidden(Exception):
     pass
+
+
+def _encode_custom(obj, api_version: str) -> Dict:
+    """CustomObject → wire at a served version: None-conversion (the
+    apiextensions default) rewrites only the apiVersion stamp."""
+    d = to_wire(obj)
+    d["apiVersion"] = api_version
+    return d
 
 
 def resources_metrics_text(store: ClusterStore) -> str:
@@ -347,16 +360,27 @@ class _Handler(BaseHTTPRequestHandler):
 
         return codec.BINARY_CONTENT_TYPE in (self.headers.get("Accept") or "")
 
+    # identities allowed to speak the binary codec: the control plane
+    # itself (codec.py's trust envelope — "kubelet/scheduler/
+    # controller-manager speak it, kubectl speaks JSON"); a mere
+    # authenticated namespace SA token must NOT reach the unpickler
+    _BINARY_PREFIXES = ("system:kube-", "system:node:")
+
     def _binary_decode_allowed(self) -> bool:
-        """Pickle bodies only from authenticated clients — codec.py's
-        trust model; anonymous callers never reach the unpickler. The
-        no-authn escape hatch additionally requires a LOOPBACK peer: a
-        tokenless server bound to a reachable interface must not be an
-        arbitrary-code-execution endpoint."""
+        """Pickle bodies only from CONTROL-PLANE identities — codec.py's
+        trust model. The no-authn escape hatch requires a LOOPBACK
+        peer: a tokenless server bound to a reachable interface must
+        not be an arbitrary-code-execution endpoint."""
         if not self.server.tokens and self.server.authorizer is allow_all:
             peer = self.client_address[0] if self.client_address else ""
             return peer in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
-        return self._user() != "system:anonymous"
+        user = self._user()
+        if user.startswith(self._BINARY_PREFIXES):
+            return True
+        if user in self.server.binary_clients:
+            return True
+        groups = getattr(self.server.authorizer, "groups_for", None)
+        return groups is not None and "system:masters" in groups(user)
 
     def _read_body(self) -> Any:
         length = int(self.headers.get("Content-Length") or 0)
@@ -397,13 +421,21 @@ class _Handler(BaseHTTPRequestHandler):
     def _decode(self, body: Dict, kind: str) -> Any:
         from kubernetes_tpu.api.scheme import SCHEME_V
 
-        return SCHEME_V.decode(body, kind,
-                               getattr(self, "_api_version", "v1"))
+        api_version = getattr(self, "_api_version", "v1")
+        if self.server.store.custom_kind_to_plural(kind):
+            # custom kinds: None-conversion — every served version
+            # decodes the same payload (apiextensions default strategy)
+            return from_wire(body, kind)
+        return SCHEME_V.decode(body, kind, api_version)
 
     def _encode(self, obj: Any) -> Dict:
         from kubernetes_tpu.api.scheme import SCHEME_V
+        from kubernetes_tpu.api.types import CustomObject
 
-        return SCHEME_V.encode(obj, getattr(self, "_api_version", "v1"))
+        api_version = getattr(self, "_api_version", "v1")
+        if isinstance(obj, CustomObject):
+            return _encode_custom(obj, api_version)
+        return SCHEME_V.encode(obj, api_version)
 
     # -- authn/authz ---------------------------------------------------
     def _user(self) -> str:
@@ -413,6 +445,16 @@ class _Handler(BaseHTTPRequestHandler):
             user = self.server.tokens.get(token)
             if user is not None:
                 return user
+            # CSR-issued client certificates authenticate by
+            # fingerprint (the x509 request authenticator's role,
+            # reference apiserver/pkg/authentication/request/x509/
+            # x509.go CommonNameUserConversion — fingerprint-as-bearer
+            # stands in for the TLS handshake)
+            if token.startswith("cert:"):
+                user = self.server.resolve_cert_fingerprint(
+                    token[len("cert:"):])
+                if user is not None:
+                    return user
             # service-account tokens (minted by the tokens controller)
             # authenticate as system:serviceaccount:<ns>:<name> —
             # reference pkg/serviceaccount token authenticator
@@ -453,6 +495,14 @@ class _Handler(BaseHTTPRequestHandler):
                 group, _, version = gv.partition("/")
                 if version not in groups.setdefault(group, []):
                     groups[group].append(version)
+            # live CRD groups join discovery at their served versions
+            store = self.server.store
+            for kind in store.custom_kind_names():
+                group, served = store.custom_served_versions(kind)
+                if group:
+                    for v in served:
+                        if v not in groups.setdefault(group, []):
+                            groups[group].append(v)
 
             def version_priority(v: str):
                 # kube version ordering (apimachinery version.
@@ -509,17 +559,27 @@ class _Handler(BaseHTTPRequestHandler):
             return
         gv = f"{parts[1]}/{parts[2]}"               # /apis/<g>/<v>
         kinds = SCHEME_V.kinds_for(gv)
-        if not kinds:
+        resources = [
+            {"name": KIND_TO_PLURAL.get(k, k.lower() + "s"),
+             "kind": k,
+             "namespaced": k not in CLUSTER_SCOPED}
+            for k in sorted(kinds)
+        ]
+        store = self.server.store
+        for kind in store.custom_kind_names():
+            group, served = store.custom_served_versions(kind)
+            if group == parts[1] and parts[2] in served:
+                resources.append({
+                    "name": store.custom_kind_to_plural(kind),
+                    "kind": kind,
+                    "namespaced": store.kind_is_namespaced(kind),
+                })
+        if not resources:
             self._send_error(404, "NotFound", f"no group/version {gv!r}")
             return
         self._send_json(200, {
             "kind": "APIResourceList", "groupVersion": gv,
-            "resources": [
-                {"name": KIND_TO_PLURAL.get(k, k.lower() + "s"),
-                 "kind": k,
-                 "namespaced": k not in CLUSTER_SCOPED}
-                for k in sorted(kinds)
-            ],
+            "resources": resources,
         })
 
     # -- routing -------------------------------------------------------
@@ -548,7 +608,13 @@ class _Handler(BaseHTTPRequestHandler):
                 return None, ns, None, None, q
             kind = PLURALS.get(rest[0])
             if kind is None or not SCHEME_V.recognizes(api_version, kind):
-                return None, None, None, None, q
+                # CRD group routes: /apis/<group>/<version>/<plural>
+                # serves a custom kind at every version its CRD
+                # declares served (multi-version, None-conversion)
+                kind = self.server.store.custom_route(
+                    parts[1], parts[2], rest[0])
+                if kind is None:
+                    return None, None, None, None, q
             self._api_version = api_version
             name = rest[1] if len(rest) >= 2 else None
             sub = rest[2] if len(rest) >= 3 else None
@@ -886,6 +952,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(400, "BadRequest", f"invalid JSON: {e}")
             return
         store = self.server.store
+        # exec subresource: POST .../pods/{name}/exec with
+        # {"container": ..., "command": [...]} — proxied to the owning
+        # kubelet like pods/log (reference registry/core/pod/rest/
+        # subresources.go ExecREST → kubelet /exec → CRI ExecSync);
+        # its own RBAC vocabulary entry, like pods/log
+        if kind == "Pod" and sub == "exec" and name is not None:
+            try:
+                self._check_authz("create", "pods/exec", ns or "")
+            except Forbidden as e:
+                self._send_error(403, "Forbidden", str(e))
+                return
+            pod = store.get_pod(ns or "default", name)
+            if pod is None:
+                self._send_error(404, "NotFound", f"pod {name!r} not found")
+                return
+            source = store.exec_source(pod.spec.node_name) \
+                if pod.spec.node_name else None
+            if source is None:
+                self._send_error(
+                    404, "NotFound",
+                    f"no exec source for node {pod.spec.node_name!r} "
+                    "(pod not running on a registered kubelet)",
+                )
+                return
+            command = body.get("command") or []
+            if not isinstance(command, list) or not command:
+                self._send_error(400, "BadRequest",
+                                 "a non-empty command list is required")
+                return
+            try:
+                rc, out = source(ns or "default", name,
+                                 body.get("container", ""), command)
+            except LookupError as e:
+                self._send_error(400, "BadRequest", str(e))
+                return
+            except Exception as e:  # noqa: BLE001 — kubelet-side failure
+                self._send_error(500, "InternalError", str(e))
+                return
+            self._send_json(200, {"kind": "ExecResult",
+                                  "exitCode": rc, "output": out})
+            return
         # Binding subresource: POST .../pods/{name}/binding
         if kind == "Pod" and sub == "binding" and name is not None:
             try:
@@ -923,6 +1030,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if ns is not None and store.kind_is_namespaced(kind):
                 obj.metadata.namespace = ns
+            if kind == "CertificateSigningRequest":
+                # spec.username is the AUTHENTICATED requester, never
+                # client-claimed (reference registry/certificates
+                # strategy PrepareForCreate) — otherwise any caller
+                # could claim a bootstrap identity and mint node certs
+                obj.username = user
             adm_req = AdmissionRequest(
                 CREATE, kind, obj.metadata.namespace, obj, user=user
             )
@@ -960,6 +1073,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(201, self._encode(created))
         except AdmissionError as e:
             # admission.run already unwound its own plugins' charges
+            self._send_error(422, "Invalid", str(e))
+        except ValidationError as e:
+            # malformed object (e.g. CRD with no storage version): the
+            # client's 422, not a conflict to retry around
+            if adm_req is not None:
+                self.server.admission.rollback(adm_req)
             self._send_error(422, "Invalid", str(e))
         except ValueError as e:
             # create failed AFTER admission admitted (store conflict):
@@ -1277,9 +1396,13 @@ class _Handler(BaseHTTPRequestHandler):
                 frame = event.__dict__.get("_v1_frame") \
                     if api_version == "v1" else None
                 if frame is None:
+                    from kubernetes_tpu.api.types import CustomObject
+
+                    wire = _encode_custom(event.obj, api_version) \
+                        if isinstance(event.obj, CustomObject) \
+                        else SCHEME_V.encode(event.obj, api_version)
                     frame = json.dumps(
-                        {"type": event.type,
-                         "object": SCHEME_V.encode(event.obj, api_version)}
+                        {"type": event.type, "object": wire}
                     ).encode() + b"\n"
                     if api_version == "v1":
                         event.__dict__["_v1_frame"] = frame
@@ -1365,6 +1488,7 @@ class APIServer(ThreadingHTTPServer):
         metrics_text_fn: Optional[Callable[[], str]] = None,
         max_readonly_inflight: Optional[int] = 400,
         max_mutating_inflight: Optional[int] = 200,
+        binary_clients: Optional[set] = None,
     ):
         super().__init__((host, port), _Handler)
         # self-protection lanes (reference filters/maxinflight.go
@@ -1374,6 +1498,8 @@ class APIServer(ThreadingHTTPServer):
             if max_readonly_inflight else None
         self.mutating_lane = threading.Semaphore(max_mutating_inflight) \
             if max_mutating_inflight else None
+        # extra non-control-plane identities granted the binary codec
+        self.binary_clients = set(binary_clients or ())
         self.store = store if store is not None else ClusterStore()
         self.watch_cache = WatchCache(self.store)
         if admission is None:
@@ -1424,11 +1550,18 @@ class APIServer(ThreadingHTTPServer):
         # would keep authenticating until an unrelated Secret write).
         self._sa_tokens: Optional[Dict[str, tuple]] = None
         self._sa_gen = 0
+        # CSR-issued client-cert index (fingerprint -> CN identity),
+        # invalidated by CertificateSigningRequest events the same way
+        self._cert_index: Optional[Dict[str, str]] = None
+        self._cert_gen = 0
 
         def _maybe_invalidate(event) -> None:
             if event.kind == "Secret":
                 self._sa_gen += 1
                 self._sa_tokens = None
+            elif event.kind == "CertificateSigningRequest":
+                self._cert_gen += 1
+                self._cert_index = None
 
         self._sa_watch = self.store.watch(_maybe_invalidate)
         self.stopping = threading.Event()
@@ -1497,6 +1630,57 @@ class APIServer(ThreadingHTTPServer):
         )
 
         return sa_username(ns, name)
+
+    def _cert_index_map(self) -> Dict[str, str]:
+        """sha256(certificate) -> username, rebuilt lazily and
+        invalidated by CertificateSigningRequest events (the x509
+        authenticator's verified-chain lookup, with the CSR trio as the
+        CA). Only client signers participate; the identity is the CN of
+        the CSR's subject, exactly kubeadm's TLS-bootstrap contract
+        (CN=system:node:<name>, O=system:nodes)."""
+        import hashlib
+
+        gen = self._cert_gen
+        idx = self._cert_index
+        if idx is None:
+            from kubernetes_tpu.controllers.certificates import (
+                KUBE_APISERVER_CLIENT_KUBELET_SIGNER,
+                KUBE_APISERVER_CLIENT_SIGNER,
+                sign_request,
+            )
+
+            client_signers = (KUBE_APISERVER_CLIENT_KUBELET_SIGNER,
+                              KUBE_APISERVER_CLIENT_SIGNER)
+            idx = {}
+            for csr in self.store.list_objects(
+                    "CertificateSigningRequest", None):
+                if not csr.certificate or \
+                        csr.signer_name not in client_signers:
+                    continue
+                # only CA-issued bytes authenticate: a forged
+                # status.certificate that the signer never produced
+                # must not mint an identity
+                if csr.certificate != sign_request(csr.request,
+                                                   csr.signer_name):
+                    continue
+                cn = None
+                for part in csr.request.split(","):
+                    key, _, value = part.strip().partition("=")
+                    if key == "CN":
+                        cn = value
+                        break
+                if not cn:
+                    continue
+                fp = hashlib.sha256(csr.certificate.encode()).hexdigest()
+                idx[fp] = cn
+            if gen == self._cert_gen:
+                self._cert_index = idx
+        return idx
+
+    def resolve_cert_fingerprint(self, fingerprint: str) -> Optional[str]:
+        if not fingerprint:
+            return None
+        return self._cert_index_map().get(fingerprint)
 
     def metrics_text(self) -> str:
         if self._metrics_text_fn is not None:
@@ -1718,6 +1902,18 @@ class RestClient:
         )
         self._raise_for(code, payload)
         return from_wire(payload, kind)
+
+    def pod_exec(self, namespace: str, name: str, container: str,
+                 command: List[str]) -> Tuple[int, str]:
+        """POST pods/{name}/exec → (exit code, output) from the owning
+        kubelet's runtime (reference kubectl exec → ExecREST → kubelet
+        /exec)."""
+        code, payload = self._request(
+            "POST", self._path("Pod", namespace, name, "exec"),
+            {"container": container, "command": list(command)},
+        )
+        self._raise_for(code, payload)
+        return payload.get("exitCode", 1), payload.get("output", "")
 
     def pod_logs(self, namespace: str, name: str,
                  container: str = "") -> str:

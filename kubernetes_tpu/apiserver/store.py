@@ -54,6 +54,12 @@ from kubernetes_tpu.api.types import (
 ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
 
 
+class ValidationError(ValueError):
+    """A malformed object (e.g. a CRD version list with no storage
+    version) — the client's 422, never the conflict 409 that plain
+    ValueError means on the create path."""
+
+
 class ConflictError(Exception):
     """resourceVersion precondition failed (HTTP 409; reference
     apierrors.NewConflict from GuaranteedUpdate)."""
@@ -121,6 +127,8 @@ class ClusterStore:
         self._crds: Dict[str, Any] = {}
         self._custom_kinds: Dict[str, Tuple[Dict[str, Any], bool]] = {}
         self._custom_plurals: Dict[str, str] = {}
+        # kind -> (group, served version names) for group-route serving
+        self._custom_served: Dict[str, Tuple[str, tuple]] = {}
         self._endpoints: Dict[str, Endpoints] = {}
         self._deployments: Dict[str, Deployment] = {}
         self._daemon_sets: Dict[str, DaemonSet] = {}
@@ -142,6 +150,7 @@ class ClusterStore:
         # in-process analog of the apiserver->kubelet log proxy
         # connection (pods/log subresource); kubelets register on start
         self._log_sources: Dict[str, Callable] = {}
+        self._exec_sources: Dict[str, Callable] = {}
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
@@ -828,6 +837,24 @@ class ClusterStore:
         with self._lock:
             return self._custom_plurals.get(plural)
 
+    def custom_route(self, group: str, version: str,
+                     plural: str) -> Optional[str]:
+        """Resolve /apis/<group>/<version>/<plural> to a custom kind —
+        only when the CRD declares that group AND serves that version
+        (an unserved version is a 404, apiextensions serving rules)."""
+        with self._lock:
+            kind = self._custom_plurals.get(plural)
+            if kind is None:
+                return None
+            crd_group, served = self._custom_served.get(kind, ("", ()))
+            if crd_group != group or version not in served:
+                return None
+            return kind
+
+    def custom_served_versions(self, kind: str) -> Tuple[str, tuple]:
+        with self._lock:
+            return self._custom_served.get(kind, ("", ()))
+
     def custom_kind_to_plural(self, kind: str) -> Optional[str]:
         """Reverse plural lookup for a runtime-registered kind — the
         authoritative vocabulary for authz rules and webhook rule
@@ -843,18 +870,32 @@ class ClusterStore:
         kind = crd.names.kind
         plural = crd.names.plural
         if not kind:
-            raise ValueError("CRD names.kind is required")
+            raise ValidationError("CRD names.kind is required")
         if not plural:
             # the reference makes spec.names.plural mandatory
             # (apiextensions validation); guessing it here would put a
             # wrong word in the authz/webhook rule vocabulary
-            raise ValueError("CRD names.plural is required")
+            raise ValidationError("CRD names.plural is required")
         if kind in self._KIND_TABLES:
-            raise ValueError(f"kind {kind!r} shadows a built-in kind")
+            raise ValidationError(f"kind {kind!r} shadows a built-in kind")
+        versions = list(getattr(crd, "versions", ()) or ())
+        if versions:
+            # apiextensions validation: exactly one storage version,
+            # at least one served
+            if sum(1 for v in versions if v.storage) != 1:
+                raise ValidationError(
+                    "CRD must have exactly one storage version")
+            if not any(v.served for v in versions):
+                raise ValidationError(
+                    "CRD must serve at least one version")
         namespaced = crd.scope != "Cluster"
         existing = self._custom_kinds.get(kind)
         table = existing[0] if existing is not None else {}
         self._custom_kinds[kind] = (table, namespaced)
+        # group-route serving metadata: (group, served version names)
+        served = tuple(v.name for v in versions if v.served) \
+            if versions else (("v1",) if crd.group else ())
+        self._custom_served[kind] = (crd.group, served)
         # a re-registration (CRD update) may have renamed the plural
         self._custom_plurals = {
             p: k for p, k in self._custom_plurals.items() if k != kind
@@ -864,6 +905,7 @@ class ClusterStore:
     def _unregister_crd_locked(self, crd) -> None:
         kind = crd.names.kind
         got = self._custom_kinds.pop(kind, None)
+        self._custom_served.pop(kind, None)
         self._custom_plurals = {
             p: k for p, k in self._custom_plurals.items() if k != kind
         }
@@ -1127,6 +1169,20 @@ class ClusterStore:
     def log_source(self, node_name: str) -> Optional[Callable]:
         with self._lock:
             return self._log_sources.get(node_name)
+
+    # pods/exec providers (the apiserver proxies exec requests to the
+    # owning kubelet, like the reference's /exec SPDY dial to the node)
+    def register_exec_source(self, node_name: str, fn: Callable) -> None:
+        with self._lock:
+            self._exec_sources[node_name] = fn
+
+    def unregister_exec_source(self, node_name: str) -> None:
+        with self._lock:
+            self._exec_sources.pop(node_name, None)
+
+    def exec_source(self, node_name: str) -> Optional[Callable]:
+        with self._lock:
+            return self._exec_sources.get(node_name)
 
     def unbind_pv(self, pv_name: str, pvc_namespace: str,
                   pvc_name: str) -> bool:

@@ -36,6 +36,9 @@ class Cluster:
         self.nodes = None  # HollowCluster
         self.bootstrap_token: str = ""
         self.component_tokens: Dict[str, str] = {}
+        # node name -> "cert:<fingerprint>" bearer credential minted by
+        # the TLS bootstrap (kubeadm's kubelet.conf client cert analog)
+        self.node_credentials: Dict[str, str] = {}
         self._up = False
 
     # -- phases (kubeadm init) -----------------------------------------
@@ -89,7 +92,11 @@ class Cluster:
                          capacity: Optional[Dict[str, str]] = None,
                          tpu_chips: int = 0) -> List[HollowNode]:
         """kubeadm join: nodes authenticate with the bootstrap token,
-        register, and start heartbeating."""
+        complete the TLS bootstrap (CSR → auto-approve → signed client
+        cert → node identity credential), register, and heartbeat.
+        Per-node credentials land in ``self.node_credentials`` as
+        ``cert:<fingerprint>`` bearer tokens that authenticate as
+        ``system:node:<name>`` (kubeadm's kubelet.conf analog)."""
         if token and token != self.bootstrap_token:
             raise PermissionError("invalid bootstrap token")
         if self.nodes is None:
@@ -98,7 +105,56 @@ class Cluster:
                 self.store,
                 heartbeat_fn=nlc.heartbeat if nlc is not None else None,
             )
-        return self.nodes.start_nodes(count, capacity=capacity, tpu_chips=tpu_chips)
+        started = self.nodes.start_nodes(count, capacity=capacity,
+                                         tpu_chips=tpu_chips)
+        if token:
+            for node in started:
+                try:
+                    self.node_credentials[node.name] = \
+                        self.tls_bootstrap(node.name, token)
+                except Exception:  # noqa: BLE001 — joining stays usable
+                    # even when the CSR trio isn't running (subset
+                    # controller configs); the credential is then absent
+                    pass
+        return started
+
+    def tls_bootstrap(self, node_name: str, token: str,
+                      timeout: float = 15.0) -> str:
+        """The kubeadm TLS bootstrap, through the API: the bootstrap
+        token submits a client CSR (subject CN=system:node:<name>), the
+        csrapproving controller auto-approves it (bootstrap identity +
+        kubelet client signer), csrsigning issues the certificate, and
+        the certificate's fingerprint becomes the node's API credential
+        (x509 authn stand-in — rest.py resolve_cert_fingerprint)."""
+        import hashlib
+        import time as _time
+
+        from kubernetes_tpu.api.types import CertificateSigningRequest
+        from kubernetes_tpu.controllers.certificates import (
+            KUBE_APISERVER_CLIENT_KUBELET_SIGNER,
+        )
+
+        client = self.client(token)
+        csr = CertificateSigningRequest(
+            request=f"CN=system:node:{node_name},O=system:nodes",
+            signer_name=KUBE_APISERVER_CLIENT_KUBELET_SIGNER,
+            usages=["client auth"],
+        )
+        csr.metadata.name = f"node-csr-{node_name}"
+        try:
+            client.create(csr)
+        except ValueError:
+            pass   # rejoin: the CSR exists; wait for its certificate
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            live = client.get("CertificateSigningRequest",
+                              csr.metadata.name, namespace=None)
+            if live is not None and live.certificate:
+                fp = hashlib.sha256(live.certificate.encode()).hexdigest()
+                return f"cert:{fp}"
+            _time.sleep(0.05)
+        raise TimeoutError(
+            f"TLS bootstrap for {node_name}: CSR not signed in time")
 
     # -- porcelain ------------------------------------------------------
     @classmethod

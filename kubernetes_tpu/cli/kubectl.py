@@ -247,6 +247,134 @@ class Kubectl:
         self.out.write(text)
         return 0
 
+    def exec_cmd(self, name: str, namespace: str, container: str,
+                 command: list) -> int:
+        """kubectl exec: pods/exec proxied through the apiserver to the
+        owning kubelet's CRI (reference staging/src/k8s.io/kubectl/pkg/
+        cmd/exec/exec.go)."""
+        if not command:
+            print("error: you must specify a command (after --)",
+                  file=self.err)
+            return 1
+        try:
+            rc, output = self.client.pod_exec(namespace, name, container,
+                                              command)
+        except KeyError as e:
+            print(f"Error from server (NotFound): {e}", file=self.err)
+            return 1
+        except PermissionError as e:
+            print(f"Error from server (Forbidden): {e}", file=self.err)
+            return 1
+        except RuntimeError as e:
+            print(f"Error from server: {e}", file=self.err)
+            return 1
+        if output:
+            self.out.write(output)
+        return rc
+
+    # -- rollout (reference staging/src/k8s.io/kubectl/pkg/cmd/rollout/
+    # rollout.go: status/history/undo against the deployment
+    # controller's revision-annotated ReplicaSets) ----------------------
+    def _deployment_and_rses(self, name: str, namespace: str):
+        deploy = self.client.get("Deployment", name, namespace)
+        if deploy is None:
+            raise KeyError(f"deployment {name!r} not found")
+        rses, _rv = self.client.list("ReplicaSet", namespace)
+        owned = [
+            rs for rs in rses
+            if any(r.get("controller") and r.get("kind") == "Deployment"
+                   and r.get("uid") == deploy.metadata.uid
+                   for r in rs.metadata.owner_references)
+        ]
+        return deploy, owned
+
+    def rollout_status(self, name: str, namespace: str) -> int:
+        from kubernetes_tpu.controllers.deployment import template_hash
+
+        deploy, owned = self._deployment_and_rses(name, namespace)
+        want_hash = template_hash(deploy.template)
+        current = next(
+            (rs for rs in owned
+             if rs.metadata.labels.get("pod-template-hash") == want_hash),
+            None)
+        ready = current.status.ready_replicas if current else 0
+        old_live = sum(rs.status.replicas for rs in owned
+                       if current is None
+                       or rs.metadata.uid != current.metadata.uid)
+        if current is not None and ready >= deploy.replicas \
+                and old_live == 0:
+            print(f'deployment "{name}" successfully rolled out',
+                  file=self.out)
+            return 0
+        print(f'Waiting for deployment "{name}" rollout to finish: '
+              f'{ready} of {deploy.replicas} updated replicas are '
+              f'available...', file=self.out)
+        return 1
+
+    def rollout_history(self, name: str, namespace: str) -> int:
+        from kubernetes_tpu.controllers.deployment import (
+            CHANGE_CAUSE_ANNOTATION,
+            rs_revision,
+        )
+
+        _deploy, owned = self._deployment_and_rses(name, namespace)
+        print(f'deployment.apps/{name}', file=self.out)
+        print(f'{"REVISION":<10}CHANGE-CAUSE', file=self.out)
+        for rs in sorted(owned, key=rs_revision):
+            cause = rs.metadata.annotations.get(
+                CHANGE_CAUSE_ANNOTATION) or "<none>"
+            print(f'{rs_revision(rs):<10}{cause}', file=self.out)
+        return 0
+
+    def rollout_undo(self, name: str, namespace: str,
+                     to_revision: int = 0) -> int:
+        from kubernetes_tpu.controllers.deployment import rs_revision
+
+        deploy, owned = self._deployment_and_rses(name, namespace)
+        if not owned:
+            print(f"error: no rollout history found for deployment "
+                  f"{name!r}", file=self.err)
+            return 1
+        by_rev = sorted(owned, key=rs_revision)
+        if to_revision:
+            target = next((rs for rs in by_rev
+                           if rs_revision(rs) == to_revision), None)
+            if target is None:
+                print(f"error: unable to find revision {to_revision} "
+                      f"of deployment {name!r}", file=self.err)
+                return 1
+        else:
+            if len(by_rev) < 2:
+                print(f"error: no previous revision to roll back to "
+                      f"for deployment {name!r}", file=self.err)
+                return 1
+            target = by_rev[-2]   # the revision before current
+        import copy as _copy
+        import json as _json
+
+        from kubernetes_tpu.apiserver.store import ConflictError
+
+        template = _json.loads(_json.dumps(target.template or {}))
+        labels = dict(template.get("metadata", {}).get("labels") or {})
+        labels.pop("pod-template-hash", None)
+        template.setdefault("metadata", {})["labels"] = labels
+        # read-modify-write with conflict retry: the deployment
+        # controller's status writes race this PUT (real kubectl undoes
+        # via PATCH, which the server merges; retrying the PUT against
+        # a fresh read is the same fixed point)
+        for attempt in range(5):
+            updated = _copy.copy(deploy)
+            updated.template = template
+            try:
+                self.client.update(updated)
+                break
+            except ConflictError:
+                if attempt == 4:
+                    raise
+                deploy = self.client.get("Deployment", name, namespace)
+        print(f'deployment.apps/{name} rolled back', file=self.out)
+        return 0
+
     def describe(self, kind_token: str, name: str, namespace: str) -> int:
         kind = _resolve_kind(kind_token)
         obj = self.client.get(kind, name, namespace)
@@ -474,6 +602,20 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("-c", "--container", default="")
     lg.add_argument("-n", "--namespace", default="default")
 
+    ex = sub.add_parser("exec")
+    ex.add_argument("pod_name")
+    ex.add_argument("-c", "--container", default="")
+    ex.add_argument("-n", "--namespace", default="default")
+    ex.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run (after --)")
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("subverb", choices=["status", "history", "undo"])
+    ro.add_argument("resource", help='e.g. deployment/web (or "deployment web")')
+    ro.add_argument("res_name", nargs="?", default="")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.add_argument("-n", "--namespace", default="default")
+
     g = sub.add_parser("get")
     g.add_argument("kind")
     g.add_argument("name", nargs="?")
@@ -577,6 +719,28 @@ def _dispatch(k: "Kubectl", args) -> int:
                        args.patch_type)
     if args.verb == "logs":
         return k.logs(args.pod_name, args.namespace, args.container)
+    if args.verb == "exec":
+        command = list(args.command)
+        if command and command[0] == "--":
+            command = command[1:]
+        return k.exec_cmd(args.pod_name, args.namespace, args.container,
+                          command)
+    if args.verb == "rollout":
+        resource, name = args.resource, args.res_name
+        if "/" in resource:
+            resource, _, name = resource.partition("/")
+        if resource not in ("deployment", "deployments", "deploy"):
+            print(f"error: rollout supports deployments, got {resource!r}",
+                  file=k.err)
+            return 1
+        if not name:
+            print("error: a deployment name is required", file=k.err)
+            return 1
+        if args.subverb == "status":
+            return k.rollout_status(name, args.namespace)
+        if args.subverb == "history":
+            return k.rollout_history(name, args.namespace)
+        return k.rollout_undo(name, args.namespace, args.to_revision)
     if args.verb == "describe":
         return k.describe(args.kind, args.name, args.namespace)
     if args.verb == "create":

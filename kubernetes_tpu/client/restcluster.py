@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu.api.serialization import from_wire, to_wire
 from kubernetes_tpu.apiserver import codec
 from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL
-from kubernetes_tpu.apiserver.store import ADDED, Event
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, Event
 
 # kinds the scheduler's event handlers consume
 # (eventhandlers.py handle(); reference addAllEventHandlers)
@@ -476,31 +476,56 @@ class RestClusterClient:
 
     def _watch_loop(self, kind: str, fn, batch_fn) -> None:
         first = True
+        # objects this stream has shown the consumer, for reflector
+        # Replace semantics on reconnect: (ns, name) -> last-seen obj
+        known: Dict[tuple, Any] = {}
+
+        def key_of(obj) -> tuple:
+            return (getattr(obj.metadata, "namespace", ""),
+                    obj.metadata.name)
+
+        def deliver(events: List[Event]) -> None:
+            for e in events:
+                if e.type == DELETED:
+                    known.pop(key_of(e.obj), None)
+                else:
+                    known[key_of(e.obj)] = e.obj
+            if batch_fn is not None:
+                batch_fn(events)
+            else:
+                for e in events:
+                    fn(e)
+
         while not self._stopping.is_set():
             try:
                 objs, rv = self._list_with_rv(kind)
-                if not first and objs:
-                    # reflector Replace semantics: a dropped watch lost
-                    # an unknowable window of events, so the relisted
-                    # state replays as ADDED — consumers (cache/queue)
-                    # absorb re-adds, exactly like Scheduler.start()'s
-                    # initial replay. The FIRST list is skipped: start()
-                    # does that replay itself.
-                    events = [Event(ADDED, kind, o) for o in objs]
-                    if batch_fn is not None:
-                        batch_fn(events)
-                    else:
-                        for e in events:
-                            fn(e)
-                first = False
-                self._stream_watch(kind, rv, fn, batch_fn)
+                if first:
+                    # Scheduler.start() replays the first list itself;
+                    # this stream only has to remember what exists
+                    known.update((key_of(o), o) for o in objs)
+                    first = False
+                else:
+                    # reflector Replace: a dropped watch lost an
+                    # unknowable window — relisted state replays as
+                    # ADDED (consumers absorb re-adds), and everything
+                    # known that VANISHED becomes a synthetic DELETED
+                    # (DeletedFinalStateUnknown), or the cache schedules
+                    # against phantom nodes forever
+                    live = {key_of(o) for o in objs}
+                    events = [Event(DELETED, kind, obj)
+                              for key, obj in list(known.items())
+                              if key not in live]
+                    events.extend(Event(ADDED, kind, o) for o in objs)
+                    if events:
+                        deliver(events)
+                self._stream_watch(kind, rv, deliver)
             except (http.client.HTTPException, OSError, RuntimeError):
                 pass
             if self._stopping.is_set():
                 return
             time.sleep(0.2)   # relist-and-rewatch (reflector restart)
 
-    def _stream_watch(self, kind: str, rv: int, fn, batch_fn) -> None:
+    def _stream_watch(self, kind: str, rv: int, deliver) -> None:
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
         conn = http.client.HTTPConnection(self._host, self._port,
                                           timeout=300)
@@ -535,11 +560,7 @@ class RestClusterClient:
                     msg = json.loads(line)
                     events = [Event(msg["type"], kind,
                                     from_wire(msg["object"], kind))]
-                if batch_fn is not None:
-                    batch_fn(events)
-                else:
-                    for e in events:
-                        fn(e)
+                deliver(events)
         finally:
             try:
                 conn.close()

@@ -33,6 +33,18 @@ def template_hash(template: dict) -> str:
     ).hexdigest()[:10]
 
 
+# reference pkg/controller/deployment/util/deployment_util.go:38-44
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+CHANGE_CAUSE_ANNOTATION = "kubernetes.io/change-cause"
+
+
+def rs_revision(rs: ReplicaSet) -> int:
+    try:
+        return int(rs.metadata.annotations.get(REVISION_ANNOTATION, "0"))
+    except ValueError:
+        return 0
+
+
 class DeploymentController(Controller):
     name = "deployment"
 
@@ -71,11 +83,31 @@ class DeploymentController(Controller):
             if rs.metadata.labels.get("pod-template-hash") == want_hash:
                 current = rs
                 break
+        max_rev = max((rs_revision(rs) for rs in owned), default=0)
         if current is None:
-            current = self._new_rs(deploy, want_hash)
+            current = self._new_rs(deploy, want_hash, max_rev + 1)
             owned.append(current)
-        elif current.replicas != deploy.replicas:
-            current = self._scale_rs(current, deploy.replicas)
+        else:
+            # an old template re-becoming current (rollback) takes a
+            # FRESH max+1 revision, like the reference's
+            # SetNewReplicaSetAnnotations — history is a sequence of
+            # deploys, not a set of templates
+            if rs_revision(current) != max_rev:
+                bumped = copy.copy(current)
+                bumped.metadata = copy.copy(current.metadata)
+                bumped.metadata.annotations = dict(
+                    current.metadata.annotations)
+                bumped.metadata.annotations[REVISION_ANNOTATION] = str(
+                    max_rev + 1)
+                cause = deploy.metadata.annotations.get(
+                    CHANGE_CAUSE_ANNOTATION)
+                if cause:
+                    bumped.metadata.annotations[
+                        CHANGE_CAUSE_ANNOTATION] = cause
+                self.store.update_replica_set(bumped)
+                current = bumped
+            if current.replicas != deploy.replicas:
+                current = self._scale_rs(current, deploy.replicas)
         owned = [
             self._scale_rs(rs, 0)
             if rs.metadata.uid != current.metadata.uid and rs.replicas != 0
@@ -96,7 +128,8 @@ class DeploymentController(Controller):
         self.store.update_replica_set(scaled)
         return scaled
 
-    def _new_rs(self, deploy: Deployment, want_hash: str) -> ReplicaSet:
+    def _new_rs(self, deploy: Deployment, want_hash: str,
+                revision: int = 1) -> ReplicaSet:
         template = json.loads(json.dumps(deploy.template or {}))
         labels = dict(template.get("metadata", {}).get("labels") or {})
         labels["pod-template-hash"] = want_hash
@@ -114,5 +147,9 @@ class DeploymentController(Controller):
         rs.metadata.namespace = deploy.metadata.namespace
         rs.metadata.labels = labels
         rs.metadata.owner_references = [owner_ref("Deployment", deploy)]
+        rs.metadata.annotations[REVISION_ANNOTATION] = str(revision)
+        cause = deploy.metadata.annotations.get(CHANGE_CAUSE_ANNOTATION)
+        if cause:
+            rs.metadata.annotations[CHANGE_CAUSE_ANNOTATION] = cause
         self.store.add_replica_set(rs)
         return rs

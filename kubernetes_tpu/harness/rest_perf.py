@@ -76,6 +76,8 @@ def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
             break
         if msg == "counts":
             pods = store.list_pods()
+            if wal is not None:
+                wal.drain()   # async writer: count only settled bytes
             conn.send({
                 "pods_total": len(pods),
                 "pods_bound": sum(1 for p in pods if p.spec.node_name),
@@ -259,6 +261,32 @@ def run_workload_rest(
             f"workload {name}: bound {bound_count()}/{target} "
             f"before deadline")
 
+    def teardown_children() -> None:
+        """Always runs — a failed row must not leak an apiserver process
+        holding a 30k-pod store (or its WAL tempdir) into the next
+        matrix row."""
+        try:
+            cre_conn.send("stop")
+        except (BrokenPipeError, OSError):
+            pass
+        try:
+            api_conn.send("stop")
+        except (BrokenPipeError, OSError):
+            pass
+        for conn, proc in ((cre_conn, cre_proc), (api_conn, api_proc)):
+            try:
+                if conn.poll(5.0):
+                    conn.recv()
+            except (EOFError, OSError):
+                pass
+            proc.join(timeout=5.0)
+            if proc.is_alive():
+                proc.terminate()
+        if wal_dir:
+            import shutil
+
+            shutil.rmtree(wal_dir, ignore_errors=True)
+
     collector = None
     measure_start = 0.0
     expected_bound = 0
@@ -315,29 +343,20 @@ def run_workload_rest(
             else 0.0
         if result_hook is not None:
             result_hook(sched, bs)
+    except BaseException:
+        teardown_children()
+        raise
     finally:
         if collector:
             collector.stop()
         sched.stop()
 
     # cross-check against the apiserver's own truth (and WAL durability)
-    api_conn.send("counts")
-    server_counts = api_conn.recv()
-    cre_conn.send("stop")
-    api_conn.send("stop")
-    for conn, proc in ((cre_conn, cre_proc), (api_conn, api_proc)):
-        try:
-            if conn.poll(5.0):
-                conn.recv()
-        except (EOFError, OSError):
-            pass
-        proc.join(timeout=5.0)
-        if proc.is_alive():
-            proc.terminate()
-    if wal_dir:
-        import shutil
-
-        shutil.rmtree(wal_dir, ignore_errors=True)
+    try:
+        api_conn.send("counts")
+        server_counts = api_conn.recv()
+    finally:
+        teardown_children()
 
     measured = sum(op["count"] for op in ops
                    if op["opcode"] == "createPods"

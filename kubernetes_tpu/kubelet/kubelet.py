@@ -157,6 +157,7 @@ class Kubelet:
         # pods/log provider (the apiserver proxies log requests to the
         # node's kubelet; this registry is that connection in-process)
         self.store.register_log_source(self.node_name, self.container_logs)
+        self.store.register_exec_source(self.node_name, self.container_exec)
         self._thread = threading.Thread(
             target=self._sync_loop, daemon=True, name=f"kubelet-{self.node_name}"
         )
@@ -166,6 +167,7 @@ class Kubelet:
     def stop(self) -> None:
         self._stop.set()
         self.store.unregister_log_source(self.node_name)
+        self.store.unregister_exec_source(self.node_name)
         if self._watch_handle is not None:
             self._watch_handle.stop()
         if self._thread is not None:
@@ -221,6 +223,52 @@ class Kubelet:
             except Exception:  # noqa: BLE001 — runtime without logs
                 pass
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def container_exec(self, namespace: str, name: str, container: str,
+                       command: List[str]) -> tuple:
+        """Run a command in a pod's container (kubectl exec; reference
+        kubelet server /exec → CRI ExecSync). Returns (exit code,
+        output text). Resolution mirrors ``container_logs``: unknown
+        pod/container raises LookupError for the REST layer's 400."""
+        key_of = dict(self._key_of)
+        uid = next(
+            (u for u, key in key_of.items()
+             if key == (namespace, name)), None,
+        )
+        if uid is None:
+            raise LookupError(
+                f"pod {namespace}/{name} is not running on this node"
+            )
+        cids = dict(self._containers_of.get(uid, {}))
+        if container:
+            if container not in cids:
+                raise LookupError(
+                    f"container {container!r} is not valid for pod "
+                    f"{name} (containers: {sorted(cids) or 'none'})"
+                )
+            cid = cids[container]
+        elif len(cids) == 1:
+            cid = next(iter(cids.values()))
+        else:
+            raise LookupError(
+                "a container name must be specified for pod "
+                f"{name} (choose one of {sorted(cids)})"
+            )
+        before = []
+        try:
+            before = list(self.runtime.container_logs(cid))
+        except Exception:  # noqa: BLE001
+            pass
+        rc = self.runtime.exec_sync(cid, list(command))
+        # the fake CRI records exec output on the container's log
+        # stream; the delta is this exec's "stdout"
+        after = []
+        try:
+            after = list(self.runtime.container_logs(cid))
+        except Exception:  # noqa: BLE001
+            pass
+        out = "\n".join(after[len(before):])
+        return rc, out + ("\n" if out else "")
 
     # -- event plumbing ------------------------------------------------
     def _on_event(self, event: Event) -> None:

@@ -1,0 +1,120 @@
+"""The client-certificate identity loop (VERDICT r4 missing #6 /
+next #8): bootstrap token → CSR → auto-approve → signed cert →
+fingerprint authn → node RBAC identity — kubeadm's TLS bootstrap
+(reference ``apiserver/pkg/authentication/request/x509/x509.go``,
+``bootstrappolicy`` node-bootstrapper, csrapproving/csrsigning
+controllers)."""
+
+import hashlib
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import CertificateSigningRequest
+from kubernetes_tpu.apiserver.rest import RestClient
+from kubernetes_tpu.bootstrap import Cluster
+from kubernetes_tpu.testing import MakePod
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster.up(nodes=2, capacity={"cpu": "8", "memory": "16Gi"})
+    yield c
+    c.down()
+
+
+def test_join_mints_node_credentials(cluster):
+    """phase_join_nodes completes the TLS bootstrap for every node."""
+    assert set(cluster.node_credentials) == {"hollow-0", "hollow-1"}
+    for cred in cluster.node_credentials.values():
+        assert cred.startswith("cert:")
+
+
+def test_cert_credential_authenticates_as_node_identity(cluster):
+    cred = cluster.node_credentials["hollow-0"]
+    node_client = RestClient(cluster.apiserver.url, token=cred)
+    # the node role reads pods and services cluster-wide
+    pods, _ = node_client.list("Pod", "default")
+    assert isinstance(pods, list)
+    # ...but cannot delete nodes (no such verb in system:node)
+    with pytest.raises(PermissionError):
+        node_client.delete("Node", "hollow-1", namespace=None)
+    # auth can-i through the API agrees on the identity's shape
+    code, payload = node_client._request(
+        "POST", "/api/v1/selfsubjectaccessreviews",
+        {"spec": {"resourceAttributes": {
+            "verb": "get", "resource": "pods", "namespace": "default"}}})
+    assert payload["status"]["allowed"] is True
+    code, payload = node_client._request(
+        "POST", "/api/v1/selfsubjectaccessreviews",
+        {"spec": {"resourceAttributes": {
+            "verb": "delete", "resource": "nodes"}}})
+    assert payload["status"]["allowed"] is False
+
+
+def test_bootstrap_token_is_csr_only(cluster):
+    """The bootstrap token may run the CSR flow and NOTHING else
+    (reference system:node-bootstrapper)."""
+    boot = cluster.client(cluster.bootstrap_token)
+    csrs, _ = boot.list("CertificateSigningRequest")
+    assert any(c.metadata.name.startswith("node-csr-") for c in csrs)
+    with pytest.raises(PermissionError):
+        boot.list("Pod", "default")
+    with pytest.raises(PermissionError):
+        boot.create(MakePod().name("sneak").uid("u-sneak").obj())
+
+
+def test_csr_username_is_server_stamped(cluster):
+    """A client-claimed spec.username must not survive: the server
+    stamps the AUTHENTICATED requester (reference CSR strategy
+    PrepareForCreate) — otherwise any identity could impersonate a
+    bootstrap token and mint node certs."""
+    admin = cluster.client(cluster.component_tokens["admin"])
+    csr = CertificateSigningRequest(
+        request="CN=system:node:evil,O=system:nodes",
+        signer_name="kubernetes.io/kube-apiserver-client-kubelet",
+        username="system:bootstrap:node",   # claimed — must be ignored
+    )
+    csr.metadata.name = "evil-claim"
+    admin.create(csr)
+    live = admin.get("CertificateSigningRequest", "evil-claim",
+                     namespace=None)
+    assert live.username == "admin"
+    # and the approver refuses it (admin is not a bootstrap/node user)
+    time.sleep(0.5)
+    live = admin.get("CertificateSigningRequest", "evil-claim",
+                     namespace=None)
+    assert not live.approved and not live.certificate
+
+
+def test_forged_certificate_does_not_authenticate(cluster):
+    """A CSR object whose status.certificate was never produced by the
+    cluster CA must not mint an identity, even if written into the
+    store directly."""
+    forged = CertificateSigningRequest(
+        request="CN=system:node:forged,O=system:nodes",
+        signer_name="kubernetes.io/kube-apiserver-client-kubelet",
+        username="system:bootstrap:node",
+        certificate="-----BEGIN CERTIFICATE-----\nnot-from-the-ca\n"
+                    "-----END CERTIFICATE-----\n",
+    )
+    forged.metadata.name = "forged"
+    cluster.store.create_object("CertificateSigningRequest", forged)
+    fp = hashlib.sha256(forged.certificate.encode()).hexdigest()
+    attacker = RestClient(cluster.apiserver.url, token=f"cert:{fp}")
+    with pytest.raises(PermissionError):
+        attacker.list("Pod", "default")
+
+
+def test_deleted_csr_revokes_the_credential(cluster):
+    """Certificate revocation: the csrcleaner (or an admin delete)
+    removing the CSR removes the fingerprint's authn entry."""
+    token = cluster.bootstrap_token
+    cred = cluster.tls_bootstrap("revoked-node", token)
+    node_client = RestClient(cluster.apiserver.url, token=cred)
+    node_client.list("Pod", "default")   # authenticates
+    admin = cluster.client(cluster.component_tokens["admin"])
+    admin.delete("CertificateSigningRequest", "node-csr-revoked-node",
+                 namespace=None)
+    with pytest.raises(PermissionError):
+        node_client.list("Pod", "default")

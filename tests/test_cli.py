@@ -379,3 +379,140 @@ def test_selector_scoped_watch_streams_only_matches():
         assert got[0]["object"]["metadata"]["name"] == "signal"
     finally:
         server.shutdown_server()
+
+
+def test_kubectl_exec_round_trip_over_http():
+    """kubectl exec -> apiserver pods/exec -> owning kubelet -> CRI
+    ExecSync (VERDICT r4 next #6; reference kubectl/pkg/cmd/exec)."""
+    import io
+    import time as _time
+
+    from kubernetes_tpu.apiserver.rest import APIServer
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.cli.kubectl import run_command
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.testing import MakePod
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    kl = Kubelet(store, "n1", capacity={"cpu": "8", "memory": "16Gi"})
+    kl.start()
+    try:
+        pod = MakePod().name("sh").uid("u-sh").container(image="app").obj()
+        store.create_pod(pod)
+        store.bind("default", "sh", pod.uid, "n1")
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                store.get_pod("default", "sh").status.phase != "Running":
+            _time.sleep(0.05)
+        out = io.StringIO()
+        rc = run_command(["--server", server.url, "exec", "sh", "--",
+                          "ls", "/tmp"], out=out)
+        assert rc == 0
+        assert "exec:" in out.getvalue() and "ls" in out.getvalue()
+        # the CRI recorded the exec
+        assert any("ls" in str(p) for _, p in kl.runtime.exec_records)
+        # unknown pod: clean NotFound
+        err = io.StringIO()
+        rc = run_command(["--server", server.url, "exec", "ghost", "--",
+                          "true"], out=io.StringIO(), err=err)
+        assert rc == 1 and "NotFound" in err.getvalue()
+        # missing command: client-side error
+        err = io.StringIO()
+        rc = run_command(["--server", server.url, "exec", "sh"],
+                         out=io.StringIO(), err=err)
+        assert rc == 1 and "command" in err.getvalue()
+    finally:
+        kl.stop()
+        server.shutdown_server()
+
+
+def test_kubectl_rollout_status_history_undo_over_http():
+    """rollout status/history/undo wired to the deployment controller's
+    revision-annotated ReplicaSets (VERDICT r4 next #6; reference
+    kubectl/pkg/cmd/rollout/rollout.go)."""
+    import io
+    import time as _time
+
+    from kubernetes_tpu.api.labels import LabelSelector
+    from kubernetes_tpu.api.types import Deployment
+    from kubernetes_tpu.apiserver.rest import APIServer, RestClient
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.cli.kubectl import run_command
+    from kubernetes_tpu.controllers import ControllerManager
+
+    def wait_for(cond, timeout=10.0):
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if cond():
+                return True
+            _time.sleep(0.05)
+        return False
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    cm = ControllerManager(store, controllers=["deployment", "replicaset"])
+    cm.start()
+    try:
+        d = Deployment(
+            selector=LabelSelector(match_labels={"app": "web"}),
+            replicas=2,
+            template={"metadata": {"labels": {"app": "web"}},
+                      "spec": {"containers": [{"name": "c",
+                                               "image": "app:v1"}]}},
+        )
+        d.metadata.name = "web"
+        d.metadata.annotations["kubernetes.io/change-cause"] = "deploy v1"
+        client = RestClient(server.url)
+        client.create(d)
+        assert wait_for(lambda: len(store.list_pods()) == 2)
+
+        # status: not rolled out until the RS reports ready replicas
+        out = io.StringIO()
+        run_command(["--server", server.url, "rollout", "status",
+                     "deployment/web"], out=out)
+        assert "web" in out.getvalue()
+
+        # roll to v2 (a second revision)
+        live = client.get("Deployment", "web")
+        live.template = {"metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [{"name": "c",
+                                                  "image": "app:v2"}]}}
+        live.metadata.annotations["kubernetes.io/change-cause"] = \
+            "deploy v2"
+        client.update(live)
+        assert wait_for(
+            lambda: len(store.list_all_replica_sets()) == 2)
+
+        out = io.StringIO()
+        rc = run_command(["--server", server.url, "rollout", "history",
+                          "deployment/web"], out=out)
+        got = out.getvalue()
+        assert rc == 0
+        assert "deploy v1" in got and "deploy v2" in got
+
+        # undo: back to v1's template, stamped as revision 3
+        out = io.StringIO()
+        rc = run_command(["--server", server.url, "rollout", "undo",
+                          "deployment/web"], out=out)
+        assert rc == 0 and "rolled back" in out.getvalue()
+        assert wait_for(lambda: (
+            client.get("Deployment", "web").template["spec"]
+            ["containers"][0]["image"] == "app:v1"
+        ))
+        # the controller re-activates the v1 RS under a FRESH revision
+        from kubernetes_tpu.controllers.deployment import rs_revision
+
+        assert wait_for(lambda: max(
+            (rs_revision(rs) for rs in store.list_all_replica_sets()),
+            default=0) >= 3)
+
+        # undo --to-revision targets an explicit entry
+        err = io.StringIO()
+        rc = run_command(["--server", server.url, "rollout", "undo",
+                          "deployment/web", "--to-revision", "99"],
+                         out=io.StringIO(), err=err)
+        assert rc == 1 and "unable to find revision" in err.getvalue()
+    finally:
+        cm.stop()
+        server.shutdown_server()

@@ -790,3 +790,48 @@ class TestCrashRecovery:
             per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
         assert all(c <= 16 for c in per_node.values())
         sched2.stop()
+
+
+class TestShardedFullMix:
+    """VERDICT r4 next #4: the mesh-sharded backend under the FULL
+    constraint mix — priorities+preemption, gangs, and PVCs at 200
+    nodes / 2000 pods across 5 seeds. Three-way: sharded placements
+    must be IDENTICAL to single-chip batch; both must match serial on
+    bound sets; every placement passes the first-principles checker."""
+
+    @pytest.mark.parametrize("seed", [7, 23, 131, 442, 787])
+    def test_three_way_full_mix(self, seed):
+        def make(seed):
+            rng = random.Random(seed)
+            nodes = _random_cluster(rng, 200)
+            store = ClusterStore()
+            _csi_nodes(store, nodes)
+            pods = _random_pods(rng, 2000, store=store, gangs=True,
+                                pvcs=True, priorities=True)
+            return nodes, pods, store
+
+        nodes, pods, store_s = make(seed)
+        serial_bound, serial_store = _run(nodes, pods, "serial",
+                                          store=store_s)
+        nodes, pods, store_b = make(seed)
+        batch_bound, batch_store = _run(nodes, pods, "batch",
+                                        store=store_b)
+        nodes, pods, store_m = make(seed)
+        sharded_bound, sharded_store = _run(nodes, pods, "sharded",
+                                            store=store_m)
+        diverged = [
+            (k, batch_bound.get(k), sharded_bound.get(k))
+            for k in set(batch_bound) | set(sharded_bound)
+            if batch_bound.get(k) != sharded_bound.get(k)
+        ]
+        assert not diverged, (
+            f"seed {seed}: batch vs sharded diverge on "
+            f"{len(diverged)} pods: {diverged[:10]}"
+        )
+        assert set(serial_bound) == set(batch_bound), (
+            f"seed {seed}: serial vs batch bound sets differ: "
+            f"{sorted(set(serial_bound) ^ set(batch_bound))[:20]}"
+        )
+        _assert_valid(serial_bound, serial_store)
+        _assert_valid(batch_bound, batch_store)
+        _assert_valid(sharded_bound, sharded_store)

@@ -59,28 +59,34 @@ class TestBinaryCodec:
         finally:
             server.shutdown_server()
 
-    def test_binary_body_requires_authn_when_configured(self):
-        """codec.py trust model: anonymous remote callers must never
-        reach the unpickler on a server with authn configured."""
-        store, server = _serve(tokens={"tok": "alice"})
+    def test_binary_body_requires_control_plane_identity(self):
+        """codec.py trust model: only control-plane identities reach
+        the unpickler on a server with authn configured — neither
+        anonymous callers nor ordinary authenticated users (a leaked
+        namespace token must not become code execution)."""
+        store, server = _serve(tokens={"tok": "alice",
+                                       "sched": "system:kube-scheduler"})
         try:
             host, port = server.url.replace("http://", "").split(":")
             conn = http.client.HTTPConnection(host, int(port))
             body = codec.encode({"kind": "PodList", "items": [
                 MakePod().name("x").uid("ux").obj()]})
-            conn.request("POST", "/api/v1/namespaces/default/pods",
-                         body=body,
-                         headers={"Content-Type":
-                                  codec.BINARY_CONTENT_TYPE})
-            resp = conn.getresponse()
-            assert resp.status == 403
-            resp.read()
-            # the same body with the token lands
+            for headers in (
+                {"Content-Type": codec.BINARY_CONTENT_TYPE},
+                {"Content-Type": codec.BINARY_CONTENT_TYPE,
+                 "Authorization": "Bearer tok"},      # plain user: no
+            ):
+                conn.request("POST", "/api/v1/namespaces/default/pods",
+                             body=body, headers=headers)
+                resp = conn.getresponse()
+                assert resp.status == 403
+                resp.read()
+            # a control-plane identity lands
             conn.request("POST", "/api/v1/namespaces/default/pods",
                          body=body,
                          headers={"Content-Type":
                                   codec.BINARY_CONTENT_TYPE,
-                                  "Authorization": "Bearer tok"})
+                                  "Authorization": "Bearer sched"})
             resp = conn.getresponse()
             assert resp.status == 201
             resp.read()
