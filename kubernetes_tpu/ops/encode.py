@@ -183,6 +183,13 @@ class EncodedCluster:
     topo_keys: List[str] = field(default_factory=list)
     topo_codes: Optional[np.ndarray] = None   # [N, K] int32, V = missing
     topo_num_values: Optional[np.ndarray] = None  # [K] int32
+    # shared-volume attach planes (VERDICT r4 next #5): slot s of a
+    # shared CSI volume; sv_attached[s, n] = 1 when that volume is
+    # already attached on node n — a pod re-using it there consumes NO
+    # further attach budget (csi.go len(in_use | wanted) set semantics,
+    # tensorized as conditional per-node demand carried in solver state)
+    sv_attached: Optional[np.ndarray] = None  # [SV, N] int32 (0/1)
+    sv_keys: Optional[np.ndarray] = None      # [SV] int64 stable hashes
 
 
 @dataclass
@@ -218,6 +225,10 @@ class EncodedBatch:
     pref_weight: np.ndarray        # [B, T] float32 — preferred term weights
 
     num_values: int                # V (shared topo-value space size)
+    # per-pod shared-volume reference: [B, 2] int32 (slot or SV
+    # sentinel, attach resource column); None when the epoch has no
+    # shared CSI volumes (layout & compiled shapes identical to before)
+    pod_sv: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -238,6 +249,7 @@ class EncodedPodBatch:
     own_aff: np.ndarray            # [B, T] bool
     own_anti: np.ndarray           # [B, T] bool
     pref_weight: np.ndarray        # [B, T] float32
+    pod_sv: Optional[np.ndarray] = None   # [B, 2] int32
 
 
 class BatchEncoder:
@@ -271,6 +283,16 @@ class BatchEncoder:
         # counts len(in_use | wanted) (set semantics), the additive
         # column model would double-count the share and diverge.
         self._attached_volumes: set = set()
+        # SHARED-volume slots: (driver, volume) -> slot. Shared claims
+        # get a per-volume attach plane in solver state instead of the
+        # additive column demand — their demand is per-NODE conditional
+        # (1 only where the volume isn't attached yet). Enumerated from
+        # the cluster's PVs at full encode; a pod whose shared volume
+        # isn't slotted forces a rebuild (encode_pods_only → None).
+        self._sv_slots: Dict[tuple, int] = {}
+        self._sv_keys: List[tuple] = []
+        self._sv_pad: int = 0
+        self._vol_shared_cache: Dict[str, bool] = {}
         # encoding space retained by the last full encode()
         self._resource_names: Optional[List[str]] = None
         self._key_index: Optional[Dict[str, int]] = None
@@ -318,8 +340,25 @@ class BatchEncoder:
             )
             pod_count[i] = len(ni.pods)
             max_pods[i] = ni.allocatable.allowed_pod_number or 1_000_000
+        sv_attached = None
+        sv_keys = None
         if self._attach_col:
-            self._fill_attach_node_columns(allocatable, requested)
+            self._collect_shared_volume_slots()
+            if self._sv_slots:
+                # pad the slot axis (power-of-2, min 8): new shared PVs
+                # within the pad reuse the compiled executable
+                sv_pad = max(8, 1 << (len(self._sv_slots) - 1).bit_length())
+                self._sv_pad = sv_pad
+                sv_attached = np.zeros((sv_pad, n_pad), dtype=np.int32)
+            self._fill_attach_node_columns(allocatable, requested,
+                                           sv_attached)
+            if sv_attached is not None:
+                import zlib
+
+                keys = np.zeros(sv_attached.shape[0], dtype=np.int64)
+                for i, (d, v) in enumerate(self._sv_keys):
+                    keys[i] = zlib.crc32(f"{d}\x00{v}".encode())
+                sv_keys = keys
 
         cluster = EncodedCluster(
             node_names=[ni.node.name for ni in nis],
@@ -330,6 +369,8 @@ class BatchEncoder:
             nonzero_requested=nonzero_req,
             pod_count=pod_count,
             max_pods=max_pods,
+            sv_attached=sv_attached,
+            sv_keys=sv_keys,
         )
 
         batch = self._encode_pods(cluster, pods, pod_infos, n_pad, pad_pods)
@@ -390,8 +431,40 @@ class BatchEncoder:
             self._pod_attach_cache[key] = got
         return got
 
+    def _volume_is_shared(self, driver: str, vol_key: str) -> bool:
+        """Is (driver, vol_key) a SHARED volume? Memoized per epoch
+        (PV/PVC churn rebuilds); the predicate itself is the module's
+        single shared-volume rule (``pv_is_shared``), shared with
+        ``is_host_only`` so partitioner and encoder can never
+        disagree."""
+        got = self._vol_shared_cache.get(vol_key)
+        if got is None:
+            pv = self._client.get_pv(vol_key)
+            got = pv is not None and pv_is_shared(self._client, pv)
+            self._vol_shared_cache[vol_key] = got
+        return got
+
+    def _collect_shared_volume_slots(self) -> None:
+        """Per-epoch slots for every SHARED CSI volume the cluster could
+        schedule against (the per-claim attach planes' index space).
+        Enumerated from PVs so slots are stable for the whole epoch —
+        PV/PVC churn bumps the cache mutation seq and rebuilds."""
+        self._sv_slots = {}
+        self._sv_keys = []
+        self._vol_shared_cache = {}
+        for pv in self._client.list_pvs():
+            driver = getattr(pv, "csi_driver", "")
+            if not driver or driver not in self._attach_col:
+                continue
+            if pv_is_shared(self._client, pv):
+                key = (driver, pv.name)
+                if key not in self._sv_slots:
+                    self._sv_slots[key] = len(self._sv_keys)
+                    self._sv_keys.append(key)
+
     def _fill_attach_node_columns(self, allocatable: np.ndarray,
-                                  requested: np.ndarray) -> None:
+                                  requested: np.ndarray,
+                                  sv_attached=None) -> None:
         """Per-node attach budgets: allocatable = the CSINode limit (or
         the NO_LIMIT sentinel), requested = distinct in-use volumes,
         CLAMPED to the limit — an already-over-limit node must reject
@@ -416,6 +489,20 @@ class BatchEncoder:
                 limit = limits.get(dname, NO_LIMIT)
                 allocatable[i, col] = limit
                 requested[i, col] = min(len(in_use.get(dname, ())), limit)
+            if sv_attached is not None:
+                for d, vols in in_use.items():
+                    # an already-OVER-limit node keeps its attached
+                    # bits CLEAR: the shared pod's demand then reads 1
+                    # and the clamped column rejects it — matching the
+                    # host filter, which refuses ANY csi-volume pod on
+                    # an over-limit node (csi.go attached+new > limit);
+                    # a demand-0 pass-through would diverge
+                    if len(vols) > limits.get(d, NO_LIMIT):
+                        continue
+                    for v in vols:
+                        slot = self._sv_slots.get((d, v))
+                        if slot is not None:
+                            sv_attached[slot, i] = 1
 
     # ------------------------------------------------------------------
     def _encode_pods(self, cluster: EncodedCluster, pods: List[Pod],
@@ -618,6 +705,7 @@ class BatchEncoder:
             own_aff=pb.own_aff,
             own_anti=pb.own_anti,
             pref_weight=pb.pref_weight,
+            pod_sv=pb.pod_sv,
             num_values=num_values,
         )
 
@@ -658,6 +746,11 @@ class BatchEncoder:
         own_aff = np.zeros((b_pad, t_n), dtype=bool)
         own_anti = np.zeros((b_pad, t_n), dtype=bool)
         pref_weight = np.zeros((b_pad, t_n), dtype=np.float32)
+        pod_sv = None
+        if self._sv_pad:
+            # sentinel slot = the padded dim (never a real plane)
+            pod_sv = np.full((b_pad, 2), (self._sv_pad, 0),
+                             dtype=np.int32)
 
         for bi, pod in enumerate(pods):
             pi = PodInfo.of(pod)
@@ -682,9 +775,31 @@ class BatchEncoder:
                     (d, v) for d, v in self._pod_attach(pod)
                     if d in self._attach_col
                 }
-                if relevant & self._attached_volumes:
-                    # volume shared with an existing or earlier-batch
-                    # pod: serial path for exact set-union semantics
+                shared = {p for p in relevant if p in self._sv_slots}
+                unslotted_shared = {
+                    (d, v) for d, v in relevant - shared
+                    if self._volume_is_shared(d, v)
+                }
+                if unslotted_shared:
+                    # a shared volume that post-dates this epoch's slot
+                    # enumeration: rebuild so it gets a plane (the PV
+                    # write that created it bumped the mutation seq)
+                    return None
+                relevant -= shared
+                if len(shared) > 1:
+                    # one conditional-demand plane per pod per step; a
+                    # multi-shared-volume pod keeps the host path
+                    inexpressible[bi] = True
+                elif shared:
+                    d, v = next(iter(shared))
+                    pod_sv[bi] = (self._sv_slots[(d, v)],
+                                  self._attach_col[d])
+                if inexpressible[bi]:
+                    pass
+                elif relevant & self._attached_volumes:
+                    # NON-shared volume reused by an existing or
+                    # earlier-batch pod: serial path for exact
+                    # set-union semantics
                     inexpressible[bi] = True
                 else:
                     self._attached_volumes |= relevant
@@ -752,6 +867,7 @@ class BatchEncoder:
             own_aff=own_aff,
             own_anti=own_anti,
             pref_weight=pref_weight,
+            pod_sv=pod_sv,
         )
 
     # ------------------------------------------------------------------
@@ -960,6 +1076,24 @@ def wfc_class_batchable(client, sc_name: str, cache=None) -> bool:
     return verdict
 
 
+def pv_is_shared(client, pv) -> bool:
+    """THE shared-volume predicate (single rule for is_host_only, slot
+    enumeration, and the incremental encoder): a PV is shared when it —
+    or the claim its ``claim_ref`` names — carries a RWX/ROX access
+    mode. A one-sided binding (PVC shared, PV silent with no
+    claim_ref) is deliberately NOT shared under this rule everywhere
+    at once: such pods stay on the additive/serial path consistently
+    instead of flapping between classifiers."""
+    if any(m in SHARED_ACCESS_MODES for m in pv.access_modes):
+        return True
+    if pv.claim_ref:
+        ns, _, nm = pv.claim_ref.partition("/")
+        pvc = client.get_pvc(ns, nm)
+        return pvc is not None and any(
+            m in SHARED_ACCESS_MODES for m in pvc.access_modes)
+    return False
+
+
 def is_host_only(pod: Pod, client=None, cache=None) -> bool:
     """Pods needing host-only machinery take the serial path — the single
     source of truth shared by the encoder and the sidecar's partitioner.
@@ -990,6 +1124,7 @@ def is_host_only(pod: Pod, client=None, cache=None) -> bool:
             return True
     if any(p.host_port > 0 for c in pod.spec.containers for p in c.ports):
         return True
+    shared_csi = 0
     for v in pod.spec.volumes:
         if not v.persistent_volume_claim:
             continue
@@ -1006,14 +1141,15 @@ def is_host_only(pod: Pod, client=None, cache=None) -> bool:
         pv = client.get_pv(pvc.volume_name)
         if pv is None:
             return True
-        if any(m in SHARED_ACCESS_MODES for m in pvc.access_modes) and \
-                getattr(pv, "csi_driver", ""):
-            # a CSI-attached shared volume would double-count in the
-            # attach columns (one attachment, many pods); a shared PV
-            # with NO CSI driver consumes no attach budget at all, so
-            # its feasibility is purely the static PV affinity/zone
-            # masks — fully expressible on the batch path
-            return True
+        if getattr(pv, "csi_driver", "") and pv_is_shared(client, pv):
+            # CSI-attached shared volumes batch via the per-volume
+            # attach planes (conditional per-node demand carried in
+            # solver state — csi.go's len(in_use | wanted) set
+            # semantics). One plane reference per pod per step: a pod
+            # with SEVERAL shared CSI volumes keeps the host path.
+            shared_csi += 1
+            if shared_csi > 1:
+                return True
     return False
 
 

@@ -39,6 +39,11 @@ class CppBackend:
             raise RuntimeError("native solver library unavailable")
 
     def prepare(self, cluster, batch):
+        if cluster.sv_attached is not None:
+            # the C++ step has no shared-volume planes; the chain falls
+            # to the planes scan for such epochs
+            raise ValueError(
+                "native solver does not carry shared-volume planes")
         return prepare(cluster, batch, device=False)
 
     def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):

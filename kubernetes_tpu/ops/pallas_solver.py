@@ -58,6 +58,7 @@ class PStatic(NamedTuple):
     u: int
     v: int
     nb: int
+    sv: int = 0   # shared-volume attach plane count (0 = no sv planes)
 
 
 class PState(NamedTuple):
@@ -83,7 +84,7 @@ def _static_planes(r: int, sc: int, t: int, u: int):
     return o, i
 
 
-def _state_planes(r: int, sc: int, t: int):
+def _state_planes(r: int, sc: int, t: int, sv: int = 0):
     o = {}
     i = 0
     o["requested"] = i; i += r
@@ -92,6 +93,10 @@ def _state_planes(r: int, sc: int, t: int):
     o["sc_counts"] = i; i += sc
     o["term_counts"] = i; i += t
     o["term_owners"] = i; i += t
+    # shared-volume attach planes (0/1 per node), sv = 0 for epochs
+    # without shared CSI volumes — the layout is then bit-identical to
+    # the pre-sv contract and no executable recompiles
+    o["sv_attached"] = i; i += sv
     o["totals"] = i; i += 1          # lane t holds term t's real-column total
     return o, i
 
@@ -152,11 +157,12 @@ def prepare(cluster: EncodedCluster, batch: EncodedBatch,
     )                                                         # [2, SC]
 
     put = jax.device_put if device else (lambda a: a)
+    svn = 0 if cluster.sv_attached is None else cluster.sv_attached.shape[0]
     pstatic = PStatic(
         ints=put(_to_planes(ints, nb)),
         f32s=put(_to_planes(batch.static_scores.astype(np.float32), nb)),
         sc_meta=put(sc_meta),
-        r=r, sc=scn, t=tn, u=u, v=v, nb=nb,
+        r=r, sc=scn, t=tn, u=u, v=v, nb=nb, sv=svn,
     )
     pstate = prepare_state(cluster, batch, device=device)
     return pstatic, pstate
@@ -183,8 +189,9 @@ def prepare_state(cluster: EncodedCluster, batch: EncodedBatch,
         cluster.topo_codes[:, batch.term_key_idx].T, v
     ).astype(np.int32)
 
+    svn = 0 if cluster.sv_attached is None else cluster.sv_attached.shape[0]
     # dynamic state: counts translated to the per-node representation
-    do, cd = _state_planes(r, scn, tn)
+    do, cd = _state_planes(r, scn, tn, svn)
     planes = np.zeros((cd, n), dtype=np.int32)
     planes[do["requested"]:do["requested"] + r] = cluster.requested.T
     planes[do["nonzero"]:do["nonzero"] + 2] = cluster.nonzero_requested.T
@@ -198,6 +205,9 @@ def prepare_state(cluster: EncodedCluster, batch: EncodedBatch,
     planes[do["term_owners"]:do["term_owners"] + tn] = np.take_along_axis(
         batch.term_owners, term_codes, axis=1
     )
+    if svn:
+        planes[do["sv_attached"]:do["sv_attached"] + svn] = \
+            cluster.sv_attached
     if tn > n:
         raise ValueError(
             f"planes layout holds per-term totals in one node-sized plane "
@@ -502,13 +512,13 @@ def _run(params: SolverParams, pstatic: PStatic, pstate: PState,
 # spaces the pallas kernel cannot.
 
 @functools.partial(
-    jax.jit, static_argnames=("params", "r", "sc", "t", "u", "v")
+    jax.jit, static_argnames=("params", "r", "sc", "t", "u", "v", "sv")
 )
 def _xla_planes_solve(params: SolverParams, r: int, sc: int, t: int,
                       u: int, v: int, sc_meta, static_ints, static_f32s,
-                      planes, pod_ints, pod_floats):
+                      planes, pod_ints, pod_floats, sv: int = 0):
     so, _ = _static_planes(r, sc, t, u)
-    do, cd = _state_planes(r, sc, t)
+    do, cd = _state_planes(r, sc, t, sv)
     nb, lanes = planes.shape[1], planes.shape[2]
 
     node_valid = static_ints[so["node_valid"]] > 0
@@ -535,6 +545,7 @@ def _xla_planes_solve(params: SolverParams, r: int, sc: int, t: int,
     c_match_by, c_own_aff, c_own_anti = (
         r + 4 + 2 * sc, r + 4 + 2 * sc + t, r + 4 + 2 * sc + 2 * t,
     )
+    c_sv = r + 4 + 2 * sc + 3 * t   # (slot, attach col), sv epochs only
 
     def step(carry, pod):
         state, totals = carry
@@ -551,6 +562,21 @@ def _xla_planes_solve(params: SolverParams, r: int, sc: int, t: int,
         requested = state[do["requested"]:do["requested"] + r]
         fit = jnp.all(requested + req[:, None, None] <= alloc, axis=0)
         fit &= state[do["pod_count"]] < max_pods
+        if sv:
+            # shared-volume attach: demand is CONDITIONAL per node —
+            # 1 only where this pod's shared volume isn't attached yet
+            # (csi.go len(in_use | wanted) set semantics)
+            sv_planes = state[do["sv_attached"]:do["sv_attached"] + sv]
+            sv_slot = row[c_sv]
+            sv_col = row[c_sv + 1]
+            sv_is_shared = sv_slot < sv
+            slot_c = jnp.minimum(sv_slot, sv - 1)
+            att = jnp.take(sv_planes, slot_c, axis=0)      # [nb, lanes]
+            sv_demand = jnp.where(sv_is_shared, 1 - att, 0)
+            col_alloc = jnp.take(alloc, sv_col, axis=0)
+            col_req = jnp.take(requested, sv_col, axis=0)
+            col_pod = jnp.take(req, sv_col)
+            fit &= col_req + col_pod + sv_demand <= col_alloc
         static_ok = masks[profile] > 0
 
         counts = state[do["sc_counts"]:do["sc_counts"] + sc]
@@ -641,15 +667,24 @@ def _xla_planes_solve(params: SolverParams, r: int, sc: int, t: int,
         t_inc = t_same * (match_by.astype(jnp.int32) * valid_i)[:, None, None]
         o_inc = t_same * (own_anti.astype(jnp.int32) * valid_i)[:, None, None]
 
-        new_state = jnp.concatenate([
-            requested + inc[None] * req[:, None, None],
+        new_requested = requested + inc[None] * req[:, None, None]
+        pieces = [
+            new_requested,
             nz + inc[None] * row[c_nonzero:c_nonzero + 2][:, None, None],
             (state[do["pod_count"]] + inc)[None],
             counts + sc_inc,
             tcounts + t_inc,
             towners + o_inc,
-            state[do["totals"]][None],
-        ])
+        ]
+        if sv:
+            # consume the attach slot only where it wasn't already
+            # attached, and mark the volume attached on the chosen node
+            sv_add = inc * sv_demand
+            pieces[0] = new_requested.at[sv_col].add(sv_add)
+            shared_i = jnp.where(sv_is_shared, 1, 0)
+            pieces.append(sv_planes.at[slot_c].max(inc * shared_i))
+        pieces.append(state[do["totals"]][None])
+        new_state = jnp.concatenate(pieces)
         new_totals = totals + (
             match_by.astype(jnp.int32) * valid_i * (t_code_j < v)
         )
@@ -905,7 +940,10 @@ class XlaPlanesBackend:
         device array the caller materializes later (jax dispatch is
         async, so host work can overlap the device solve)."""
         t = pstatic.t
-        if t >= SPARSE_MIN_T:
+        if t >= SPARSE_MIN_T and pstatic.sv == 0:
+            # the sparse term-slot variant predates the sv planes; sv
+            # epochs take the dense scan (wide-term + shared-volume
+            # workloads are not a measured combination)
             sparse = pack_sparse_slots(
                 np.asarray(pod_ints), np.asarray(pod_floats),
                 pstatic.r, pstatic.sc, t,
@@ -924,6 +962,7 @@ class XlaPlanesBackend:
             params, pstatic.r, pstatic.sc, pstatic.t, pstatic.u,
             pstatic.v, pstatic.sc_meta, pstatic.ints, pstatic.f32s,
             pstate.planes, jnp.asarray(pod_ints), jnp.asarray(pod_floats),
+            sv=pstatic.sv,
         )
         return assignments, PState(planes=new_planes)
 
@@ -946,6 +985,11 @@ class PallasBackend:
         self.interpret = interpret
 
     def prepare(self, cluster, batch):
+        if cluster.sv_attached is not None:
+            # the unrolled kernel has no sv planes; the chain falls to
+            # the planes scan for shared-volume epochs
+            raise ValueError(
+                "pallas kernel does not carry shared-volume planes")
         return prepare(cluster, batch)
 
     def prepare_state_only(self, cluster, batch):

@@ -52,6 +52,12 @@ class XlaBackend:
     name = "xla-legacy"
 
     def prepare(self, cluster, batch):
+        if cluster.sv_attached is not None:
+            # silently ignoring shared-volume planes would let a shared
+            # claim double-count; shared-volume epochs must solve on
+            # the planes scan (or serial-fall-back loudly)
+            raise ValueError(
+                "legacy scan does not carry shared-volume planes")
         return (build_static(cluster, batch, device=True),
                 build_state(cluster, batch, device=True))
 
@@ -125,6 +131,8 @@ def _pallas_fits(batch) -> bool:
         batch.sc_counts.shape[0] <= PALLAS_MAX_SC
         and batch.term_counts.shape[0] <= PALLAS_MAX_TERMS
         and batch.static_masks.shape[0] <= PALLAS_MAX_PROFILES
+        # shared-volume epochs need the sv planes (planes scan only)
+        and getattr(batch, "pod_sv", None) is None
     )
 
 
@@ -310,11 +318,16 @@ class SolverSession:
     )
 
     def _static_fingerprint(self, cluster, batch):
+        # sv_keys: the shared-volume slot mapping is part of the static
+        # identity — a changed slot order re-keys every pod_sv index
+        sv_keys = cluster.sv_keys if cluster.sv_keys is not None \
+            else np.empty(0, dtype=np.int64)
         return (
             [np.asarray(getattr(cluster, k))
              for k in self._STATIC_FP_CLUSTER]
             + [np.asarray(getattr(batch, k))
-               for k in self._STATIC_FP_BATCH],
+               for k in self._STATIC_FP_BATCH]
+            + [sv_keys],
             (cluster.resource_names, batch.num_values,
              cluster.num_real_nodes),
         )
@@ -403,6 +416,14 @@ class SolverSession:
             if self.backend.name != "xla-planes":
                 chain.append(XlaPlanesBackend())
             chain.append(XlaBackend())
+        if cluster.sv_attached is not None:
+            # shared-volume epochs solve on the planes scan only — a
+            # structural routing decision like _pallas_fits, NOT an
+            # exception: letting cpp/sharded/legacy raise here would
+            # demote the preferred backend for sv-free epochs too and
+            # log a designed-for case as a failure
+            chain = [b for b in chain if b.name == "xla-planes"] \
+                or [XlaPlanesBackend()]
         t0 = time.monotonic()
         for i, backend in enumerate(chain):
             try:

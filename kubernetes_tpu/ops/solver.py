@@ -261,21 +261,23 @@ def pack_podin(batch) -> Tuple[np.ndarray, np.ndarray]:
     valid = np.zeros(b, dtype=bool)
     valid[: batch.num_real_pods] = True
     valid &= ~batch.inexpressible
-    ints = np.concatenate(
-        [
-            batch.requests,
-            batch.nonzero_requests,
-            batch.profile_idx.reshape(b, 1),
-            valid.reshape(b, 1).astype(np.int32),
-            batch.pod_sc.astype(np.int32),
-            batch.pod_sc_match.astype(np.int32),
-            batch.match_by.astype(np.int32),
-            batch.own_aff.astype(np.int32),
-            batch.own_anti.astype(np.int32),
-        ],
-        axis=1,
-        dtype=np.int32,
-    )
+    cols = [
+        batch.requests,
+        batch.nonzero_requests,
+        batch.profile_idx.reshape(b, 1),
+        valid.reshape(b, 1).astype(np.int32),
+        batch.pod_sc.astype(np.int32),
+        batch.pod_sc_match.astype(np.int32),
+        batch.match_by.astype(np.int32),
+        batch.own_aff.astype(np.int32),
+        batch.own_anti.astype(np.int32),
+    ]
+    pod_sv = getattr(batch, "pod_sv", None)
+    if pod_sv is not None:
+        # shared-volume epochs append (slot, attach column) — absent
+        # otherwise, so non-sv workloads keep their compiled shapes
+        cols.append(pod_sv)
+    ints = np.concatenate(cols, axis=1, dtype=np.int32)
     return ints, np.asarray(batch.pref_weight, dtype=np.float32)
 
 

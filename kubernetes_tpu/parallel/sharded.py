@@ -404,6 +404,13 @@ class ShardedBackend:
         self.mesh = mesh or make_mesh()
 
     def prepare(self, cluster, batch):
+        if cluster.sv_attached is not None:
+            # the sharded step has no shared-volume planes yet; the
+            # chain demotes such epochs to the single-device planes
+            # scan (exactness over parallelism — a misaligned plane
+            # layout would corrupt every offset after sv_attached)
+            raise ValueError(
+                "sharded solver does not carry shared-volume planes")
         return _prepare_sharded(cluster, batch, self.mesh)
 
     def solve_lazy(self, params, sstatic, sstate, pod_ints, pod_floats):

@@ -372,12 +372,21 @@ class TestBatchVolumes:
         # a shared claim on a non-CSI PV consumes no attach budget:
         # expressible (static PV affinity masks only)
         assert not is_host_only(pod("shared"), store)
-        # ...while a CSI-attached shared claim would double-count the
-        # single attachment: host path
+        # a CSI-attached shared claim batches via the per-volume attach
+        # planes (round 5; csi.go set semantics carried in solver
+        # state) — but only ONE plane reference per pod per step, so a
+        # pod with TWO shared CSI volumes keeps the host path
         self._bound_pair(store, "shared-csi", "pv-csi", driver="csi.x")
         store.get_pvc("default", "shared-csi").access_modes = [
             "ReadWriteMany"]
-        assert is_host_only(pod("shared-csi"), store)
+        assert not is_host_only(pod("shared-csi"), store)
+        self._bound_pair(store, "shared-csi2", "pv-csi2", driver="csi.x")
+        store.get_pvc("default", "shared-csi2").access_modes = [
+            "ReadWriteMany"]
+        double = pod("shared-csi")
+        double.spec.volumes.append(
+            Volume(name="d2", persistent_volume_claim="shared-csi2"))
+        assert is_host_only(double, store)
         assert is_host_only(pod("missing"), store)
         assert is_host_only(
             pod(inline=Volume(name="d", gce_persistent_disk="pd-1")), store
