@@ -993,6 +993,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"kind": "ExecResult",
                                   "exitCode": rc, "output": out})
             return
+        # portforward subresource: POST .../pods/{name}/portforward
+        # with {"port": N, "data": base64} → one exchange with the
+        # owning kubelet's runtime port (reference ExecREST sibling
+        # PortForwardREST → kubelet /portForward; the SPDY stream
+        # collapses to request/response); own RBAC vocabulary entry
+        if kind == "Pod" and sub == "portforward" and name is not None:
+            import base64
+
+            try:
+                self._check_authz("create", "pods/portforward", ns or "")
+            except Forbidden as e:
+                self._send_error(403, "Forbidden", str(e))
+                return
+            pod = store.get_pod(ns or "default", name)
+            if pod is None:
+                self._send_error(404, "NotFound", f"pod {name!r} not found")
+                return
+            source = store.portforward_source(pod.spec.node_name) \
+                if pod.spec.node_name else None
+            if source is None:
+                self._send_error(
+                    404, "NotFound",
+                    f"no portforward source for node "
+                    f"{pod.spec.node_name!r}",
+                )
+                return
+            try:
+                payload = base64.b64decode(body.get("data", "") or "")
+                out = source(ns or "default", name,
+                             int(body.get("port") or 0), payload)
+            except (LookupError, ValueError) as e:
+                self._send_error(400, "BadRequest", str(e))
+                return
+            except Exception as e:  # noqa: BLE001 — kubelet-side failure
+                self._send_error(500, "InternalError", str(e))
+                return
+            self._send_json(200, {
+                "kind": "PortForwardResult",
+                "data": base64.b64encode(out).decode(),
+            })
+            return
         # Binding subresource: POST .../pods/{name}/binding
         if kind == "Pod" and sub == "binding" and name is not None:
             try:

@@ -151,6 +151,7 @@ class ClusterStore:
         # connection (pods/log subresource); kubelets register on start
         self._log_sources: Dict[str, Callable] = {}
         self._exec_sources: Dict[str, Callable] = {}
+        self._portforward_sources: Dict[str, Callable] = {}
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
@@ -1183,6 +1184,21 @@ class ClusterStore:
     def exec_source(self, node_name: str) -> Optional[Callable]:
         with self._lock:
             return self._exec_sources.get(node_name)
+
+    # pods/portforward providers (apiserver → owning kubelet → runtime
+    # port, the SPDY stream dial collapsed to request/response)
+    def register_portforward_source(self, node_name: str,
+                                    fn: Callable) -> None:
+        with self._lock:
+            self._portforward_sources[node_name] = fn
+
+    def unregister_portforward_source(self, node_name: str) -> None:
+        with self._lock:
+            self._portforward_sources.pop(node_name, None)
+
+    def portforward_source(self, node_name: str) -> Optional[Callable]:
+        with self._lock:
+            return self._portforward_sources.get(node_name)
 
     def unbind_pv(self, pv_name: str, pvc_namespace: str,
                   pvc_name: str) -> bool:

@@ -405,6 +405,144 @@ class Kubectl:
                         for e in related], self.out)
         return 0
 
+    def edit(self, kind_token: str, name: str, namespace: str) -> int:
+        """kubectl edit: dump the live object to a temp YAML file, run
+        $EDITOR on it, PUT the result back (conflict-retried like
+        rollout undo; reference kubectl/pkg/cmd/editor). An unchanged
+        buffer is a no-op ("Edit cancelled")."""
+        import os
+        import subprocess
+        import tempfile
+
+        import yaml
+
+        from kubernetes_tpu.apiserver.store import ConflictError
+
+        kind = _resolve_kind(kind_token)
+        obj = self.client.get(kind, name, namespace)
+        if obj is None:
+            print(f"Error from server (NotFound): {kind.lower()} "
+                  f"{name!r} not found", file=self.err)
+            return 1
+        editor = os.environ.get("EDITOR") or os.environ.get("VISUAL") \
+            or "vi"
+        original = yaml.safe_dump(to_wire(obj), sort_keys=False,
+                                  default_flow_style=False)
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yaml", prefix=f"ktpu-edit-{name}-",
+                delete=False) as f:
+            f.write(original)
+            path = f.name
+        try:
+            import shlex
+
+            rc = subprocess.call(f"{editor} {shlex.quote(path)}",
+                                 shell=True)
+            if rc != 0:
+                print(f"error: editor {editor!r} exited {rc}",
+                      file=self.err)
+                return 1
+            with open(path) as f:
+                edited = f.read()
+            if edited == original:
+                print("Edit cancelled, no changes made.", file=self.out)
+                return 0
+            try:
+                doc = yaml.safe_load(edited)
+            except yaml.YAMLError as e:
+                saved = path + ".rej"
+                os.replace(path, saved)
+                path = None   # preserved for the user, skip unlink
+                print(f"error: edited buffer is not valid YAML ({e}); "
+                      f"your edits are saved at {saved}", file=self.err)
+                return 1
+            updated = from_wire(doc, kind)
+            for attempt in range(5):
+                try:
+                    self.client.update(updated)
+                    break
+                except ConflictError as e:
+                    if attempt == 4:
+                        print(f"Error from server (Conflict): {e}",
+                              file=self.err)
+                        return 1
+                    live = self.client.get(kind, name, namespace)
+                    if live is None:
+                        print(f"Error from server (NotFound): "
+                              f"{kind.lower()} {name!r} was deleted "
+                              f"while being edited", file=self.err)
+                        return 1
+                    updated.metadata.resource_version = \
+                        live.metadata.resource_version
+            print(f"{kind.lower()}/{name} edited", file=self.out)
+            return 0
+        finally:
+            if path is not None:
+                os.unlink(path)
+
+    def port_forward(self, name: str, namespace: str, local_port: int,
+                     remote_port: int, once: bool = False) -> int:
+        """kubectl port-forward: a local listener proxies each
+        connection's payload through the apiserver's pods/{name}/
+        portforward subresource to the owning kubelet's runtime
+        (reference kubectl/pkg/cmd/portforward over SPDY streams; this
+        analog exchanges one request/response per connection).
+        ``once`` serves a single connection then returns (tests)."""
+        import base64
+        import socket as socketlib
+
+        srv = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+        srv.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", local_port))
+        bound_port = srv.getsockname()[1]
+        srv.listen(4)
+        print(f"Forwarding from 127.0.0.1:{bound_port} -> "
+              f"{remote_port}", file=self.out)
+        self.forwarding_port = bound_port   # tests read the ephemeral port
+        try:
+            while True:
+                conn, _addr = srv.accept()
+                try:
+                    conn.settimeout(2.0)
+                    chunks = []
+                    while True:
+                        try:
+                            data = conn.recv(65536)
+                        except socketlib.timeout:
+                            # TCP has no message boundaries: EOF (the
+                            # client's shutdown) or silence ends the
+                            # request — never a short recv, which would
+                            # truncate multi-segment payloads
+                            break
+                        if not data:
+                            break
+                        chunks.append(data)
+                    payload = b"".join(chunks)
+                    code, resp = self.client._request(
+                        "POST",
+                        self.client._path("Pod", namespace, name,
+                                          "portforward"),
+                        {"port": remote_port,
+                         "data": base64.b64encode(payload).decode()},
+                    )
+                    if code >= 400:
+                        msg = resp.get("message", "") if isinstance(
+                            resp, dict) else str(resp)
+                        conn.sendall(f"error: {msg}".encode())
+                        failed = True
+                    else:
+                        conn.sendall(base64.b64decode(
+                            resp.get("data", "")))
+                        failed = False
+                finally:
+                    conn.close()
+                if once:
+                    return 1 if failed else 0
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            srv.close()
+
     def _load_manifests(self, path: str) -> List[Any]:
         import yaml
 
@@ -609,6 +747,19 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("command", nargs=argparse.REMAINDER,
                     help="command to run (after --)")
 
+    ed = sub.add_parser("edit")
+    ed.add_argument("kind")
+    ed.add_argument("name")
+    ed.add_argument("-n", "--namespace", default="default")
+
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("pod_name")
+    pf.add_argument("ports", help="LOCAL:REMOTE (0 picks an ephemeral "
+                    "local port) or REMOTE")
+    pf.add_argument("-n", "--namespace", default="default")
+    pf.add_argument("--once", action="store_true",
+                    help="serve one connection, then exit")
+
     ro = sub.add_parser("rollout")
     ro.add_argument("subverb", choices=["status", "history", "undo"])
     ro.add_argument("resource", help='e.g. deployment/web (or "deployment web")')
@@ -725,6 +876,22 @@ def _dispatch(k: "Kubectl", args) -> int:
             command = command[1:]
         return k.exec_cmd(args.pod_name, args.namespace, args.container,
                           command)
+    if args.verb == "edit":
+        return k.edit(args.kind, args.name, args.namespace)
+    if args.verb == "port-forward":
+        spec = args.ports
+        try:
+            if ":" in spec:
+                local_s, _, remote_s = spec.partition(":")
+                local, remote = int(local_s), int(remote_s)
+            else:
+                local = remote = int(spec)
+        except ValueError:
+            print(f"error: invalid port specification {spec!r} "
+                  "(want LOCAL:REMOTE or REMOTE)", file=k.err)
+            return 1
+        return k.port_forward(args.pod_name, args.namespace, local,
+                              remote, once=args.once)
     if args.verb == "rollout":
         resource, name = args.resource, args.res_name
         if "/" in resource:

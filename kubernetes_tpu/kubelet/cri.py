@@ -277,6 +277,18 @@ class FakeRuntime(RuntimeService, ImageService):
         with self._lock:
             return list(self._logs.get(container_id, ()))
 
+    def serve_port(self, sandbox_id: str, port: int,
+                   data: bytes) -> bytes:
+        """Fake application endpoint for port-forward: a deterministic
+        echo naming the sandbox and port (the reference forwards to the
+        real container socket; the fake CRI answers for it)."""
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            if sb is None:
+                raise LookupError(f"sandbox {sandbox_id!r} not found")
+            name = sb.name
+        return (f"pod {name} port {port} echo: ".encode() + data)
+
     # -- images --------------------------------------------------------
     def pull_image(self, image: str) -> None:
         with self._lock:

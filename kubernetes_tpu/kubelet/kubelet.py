@@ -158,6 +158,8 @@ class Kubelet:
         # node's kubelet; this registry is that connection in-process)
         self.store.register_log_source(self.node_name, self.container_logs)
         self.store.register_exec_source(self.node_name, self.container_exec)
+        self.store.register_portforward_source(self.node_name,
+                                               self.forward_port)
         self._thread = threading.Thread(
             target=self._sync_loop, daemon=True, name=f"kubelet-{self.node_name}"
         )
@@ -168,6 +170,7 @@ class Kubelet:
         self._stop.set()
         self.store.unregister_log_source(self.node_name)
         self.store.unregister_exec_source(self.node_name)
+        self.store.unregister_portforward_source(self.node_name)
         if self._watch_handle is not None:
             self._watch_handle.stop()
         if self._thread is not None:
@@ -223,6 +226,22 @@ class Kubelet:
             except Exception:  # noqa: BLE001 — runtime without logs
                 pass
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def forward_port(self, namespace: str, name: str, port: int,
+                     data: bytes) -> bytes:
+        """Exchange one payload with a pod's port (kubectl
+        port-forward; reference kubelet server /portForward → CRI).
+        Raises LookupError for an unknown pod — the REST layer's 400."""
+        key_of = dict(self._key_of)
+        uid = next(
+            (u for u, key in key_of.items()
+             if key == (namespace, name)), None,
+        )
+        if uid is None or uid not in self._sandbox_of:
+            raise LookupError(
+                f"pod {namespace}/{name} is not running on this node"
+            )
+        return self.runtime.serve_port(self._sandbox_of[uid], port, data)
 
     def container_exec(self, namespace: str, name: str, container: str,
                        command: List[str]) -> tuple:

@@ -516,3 +516,113 @@ def test_kubectl_rollout_status_history_undo_over_http():
     finally:
         cm.stop()
         server.shutdown_server()
+
+
+def test_kubectl_edit_round_trip_over_http(tmp_path):
+    """kubectl edit: live object -> $EDITOR -> PUT back (reference
+    kubectl/pkg/cmd/editor). A scripted EDITOR stands in for vi."""
+    import io
+    import os
+
+    from kubernetes_tpu.apiserver.rest import APIServer
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.cli.kubectl import run_command
+    from kubernetes_tpu.testing import MakePod
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    try:
+        store.create_pod(MakePod().name("editable").uid("u-e")
+                         .label("app", "old").obj())
+        editor = tmp_path / "editor.sh"
+        editor.write_text("#!/bin/sh\nsed -i 's/old/new/' \"$1\"\n")
+        os.chmod(editor, 0o755)
+        os.environ["EDITOR"] = str(editor)
+        try:
+            out = io.StringIO()
+            rc = run_command(["--server", server.url, "edit", "pod",
+                              "editable"], out=out)
+            assert rc == 0 and "edited" in out.getvalue()
+            assert store.get_pod("default", "editable") \
+                .metadata.labels["app"] == "new"
+            # no-change editor: cancelled, object untouched
+            noop = tmp_path / "noop.sh"
+            noop.write_text("#!/bin/sh\ntrue\n")
+            os.chmod(noop, 0o755)
+            os.environ["EDITOR"] = str(noop)
+            rv = store.get_pod("default",
+                               "editable").metadata.resource_version
+            out = io.StringIO()
+            rc = run_command(["--server", server.url, "edit", "pod",
+                              "editable"], out=out)
+            assert rc == 0 and "cancelled" in out.getvalue()
+            assert store.get_pod(
+                "default", "editable").metadata.resource_version == rv
+        finally:
+            os.environ.pop("EDITOR", None)
+    finally:
+        server.shutdown_server()
+
+
+def test_kubectl_port_forward_round_trip():
+    """kubectl port-forward: local socket -> apiserver pods/{name}/
+    portforward -> owning kubelet -> CRI port endpoint, echo verified
+    end-to-end."""
+    import io
+    import socket
+    import threading
+    import time as _time
+
+    from kubernetes_tpu.apiserver.rest import APIServer
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.cli.kubectl import Kubectl, run_command
+    from kubernetes_tpu.apiserver.rest import RestClient
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.testing import MakePod
+
+    store = ClusterStore()
+    server = APIServer(store=store).start()
+    kl = Kubelet(store, "n1", capacity={"cpu": "8", "memory": "16Gi"})
+    kl.start()
+    try:
+        pod = MakePod().name("web").uid("u-w").container(image="app").obj()
+        store.create_pod(pod)
+        store.bind("default", "web", pod.uid, "n1")
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                store.get_pod("default", "web").status.phase != "Running":
+            _time.sleep(0.05)
+        out = io.StringIO()
+        k = Kubectl(RestClient(server.url), out=out, err=io.StringIO())
+        t = threading.Thread(
+            target=k.port_forward,
+            args=("web", "default", 0, 8080), kwargs={"once": True},
+            daemon=True)
+        t.start()
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                not hasattr(k, "forwarding_port"):
+            _time.sleep(0.02)
+        with socket.create_connection(
+                ("127.0.0.1", k.forwarding_port), timeout=5) as c:
+            c.sendall(b"GET / HTTP/1.0")
+            c.shutdown(socket.SHUT_WR)
+            got = b""
+            while True:
+                chunk = c.recv(65536)
+                if not chunk:
+                    break
+                got += chunk
+        assert b"port 8080 echo: GET / HTTP/1.0" in got
+        assert b"web" in got
+        t.join(timeout=5)
+        # unknown pod: clean 400/404 over the wire, not a crash
+        err = io.StringIO()
+        k2 = Kubectl(RestClient(server.url), out=io.StringIO(), err=err)
+        code, resp = k2.client._request(
+            "POST", "/api/v1/namespaces/default/pods/ghost/portforward",
+            {"port": 80, "data": ""})
+        assert code == 404
+    finally:
+        kl.stop()
+        server.shutdown_server()
