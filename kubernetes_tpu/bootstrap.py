@@ -36,6 +36,9 @@ class Cluster:
         self.nodes = None  # HollowCluster
         self.bootstrap_token: str = ""
         self.component_tokens: Dict[str, str] = {}
+        self.pki: Dict[str, str] = {}
+        self.kubeconfigs: Dict[str, Dict[str, str]] = {}
+        self.preflight_warnings: List[str] = []
         # node name -> "cert:<fingerprint>" bearer credential minted by
         # the TLS bootstrap (kubeadm's kubelet.conf client cert analog)
         self.node_credentials: Dict[str, str] = {}
@@ -156,15 +159,219 @@ class Cluster:
         raise TimeoutError(
             f"TLS bootstrap for {node_name}: CSR not signed in time")
 
+    # -- additional init phases (cmd/kubeadm/app/cmd/phases/init) ------
+    def phase_preflight(self) -> List[str]:
+        """kubeadm init preflight: environment checks, returned as
+        warnings (reference preflight.go runs ~30 system checks; the
+        in-process analogs are the ones that can actually fail here)."""
+        warnings: List[str] = []
+        if self.apiserver is not None:
+            warnings.append("control plane already running "
+                            "(phase order: preflight precedes it)")
+        try:
+            import jax  # noqa: F401
+        except Exception:  # pragma: no cover — jax is baked in
+            warnings.append("jax unavailable: TPU batch path disabled")
+        return warnings
+
+    def phase_certs(self) -> Dict[str, str]:
+        """kubeadm init certs: the cluster CA signs one client cert per
+        control-plane component (reference certs.go writes the pki/
+        tree; here the CSR machinery's CA issues, and the blobs are the
+        pki dict — fingerprints of these authenticate like any
+        CSR-issued cert once pushed through the CSR flow)."""
+        from kubernetes_tpu.controllers.certificates import (
+            KUBE_APISERVER_CLIENT_SIGNER,
+            sign_request,
+        )
+
+        self.pki = {}
+        for component in ("kube-apiserver", "kube-scheduler",
+                          "kube-controller-manager", "admin"):
+            subject = f"CN=system:{component},O=system:masters" \
+                if component == "admin" \
+                else f"CN=system:{component}"
+            self.pki[component] = sign_request(
+                subject, KUBE_APISERVER_CLIENT_SIGNER)
+        return self.pki
+
+    def phase_kubeconfig(self) -> Dict[str, Dict[str, str]]:
+        """kubeadm init kubeconfig: one {server, token} credential
+        record per component (admin.conf / scheduler.conf /
+        controller-manager.conf analogs — reference kubeconfig.go)."""
+        if self.apiserver is None:
+            raise RuntimeError("kubeconfig phase needs the control plane")
+        self.kubeconfigs = {
+            name: {"server": self.apiserver.url, "token": tok}
+            for name, tok in self.component_tokens.items()
+        }
+        return self.kubeconfigs
+
+    def phase_wait_control_plane(self, timeout: float = 10.0) -> None:
+        """kubeadm init wait-control-plane: poll /healthz until it
+        answers (reference waitcontrolplane.go)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        client = RestClient(self.apiserver.url)
+        while _time.time() < deadline:
+            if client.healthz():
+                return
+            _time.sleep(0.05)
+        raise TimeoutError("control plane not healthy in time")
+
+    def phase_upload_config(self) -> None:
+        """kubeadm init upload-config: the cluster configuration lands
+        in the kubeadm-config ConfigMap in kube-system so later joins/
+        upgrades read one source of truth (reference uploadconfig.go)."""
+        from kubernetes_tpu.api.types import ConfigMap, ObjectMeta
+
+        cm = ConfigMap(
+            metadata=ObjectMeta(name="kubeadm-config",
+                                namespace="kube-system"),
+            data={
+                "ClusterConfiguration": (
+                    f"apiServer: {self.apiserver.url}\n"
+                    f"controllers: "
+                    f"{len(self.controller_manager.controllers)}\n"
+                    "schedulerName: default-scheduler\n"
+                ),
+            },
+        )
+        try:
+            self.store.create_object("ConfigMap", cm)
+        except ValueError:
+            self.store.update_object("ConfigMap", cm)
+
+    def phase_mark_control_plane(
+            self, name: str = "control-plane-0") -> None:
+        """kubeadm init mark-control-plane: the control-plane node gets
+        its role label and NoSchedule taint so workloads stay off it
+        (reference markcontrolplane.go)."""
+        from kubernetes_tpu.api.resource import parse_quantity
+        from kubernetes_tpu.api.types import Node, NodeStatus, ObjectMeta, Taint
+
+        caps = {"cpu": parse_quantity("4"),
+                "memory": parse_quantity("8Gi"), "pods": parse_quantity("110")}
+        node = Node(
+            metadata=ObjectMeta(
+                name=name,
+                labels={"node-role.kubernetes.io/control-plane": ""},
+            ),
+            status=NodeStatus(capacity=dict(caps),
+                              allocatable=dict(caps)),
+        )
+        node.spec.taints = [Taint(
+            key="node-role.kubernetes.io/control-plane",
+            effect="NoSchedule",
+        )]
+        if self.store.get_node(name) is not None:
+            return   # idempotent: never clobber live node status
+        self.store.add_node(node)
+        # the real control-plane node's kubelet heartbeats; without one
+        # the nodelifecycle controller would mark it NotReady after the
+        # grace period and start evicting — heartbeat on its behalf
+        nlc = self.controller_manager.controllers.get("nodelifecycle") \
+            if self.controller_manager else None
+        if nlc is not None:
+            stop = threading.Event()
+            self._cp_heartbeat_stop = stop
+
+            def beat() -> None:
+                while not stop.is_set():
+                    try:
+                        nlc.heartbeat(name)
+                    except Exception:  # noqa: BLE001 — teardown races
+                        pass
+                    stop.wait(5.0)
+
+            threading.Thread(target=beat, daemon=True,
+                             name="cp-heartbeat").start()
+
+    def phase_addons(self) -> None:
+        """kubeadm init addons: kube-proxy as a DaemonSet (one pod per
+        node, tolerating the control-plane taint) and CoreDNS as a
+        2-replica Deployment + kube-dns ClusterIP Service — installed
+        through the API and reconciled by THIS cluster's own
+        controllers (reference addons.go applies the same two)."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.types import (
+            DaemonSet,
+            Deployment,
+            ObjectMeta,
+            Service,
+            ServicePort,
+        )
+
+        proxy = DaemonSet(
+            metadata=ObjectMeta(name="kube-proxy",
+                                namespace="kube-system"),
+            selector=LabelSelector(match_labels={"k8s-app": "kube-proxy"}),
+            template={
+                "metadata": {"labels": {"k8s-app": "kube-proxy"}},
+                "spec": {
+                    "containers": [{
+                        "name": "kube-proxy", "image": "kube-proxy",
+                        "resources": {"requests": {"cpu": "10m"}},
+                    }],
+                    # the reference kube-proxy manifest tolerates
+                    # EVERYTHING (`- operator: Exists`) — control-plane
+                    # NoSchedule and unreachable NoExecute alike
+                    "tolerations": [{"operator": "Exists"}],
+                },
+            },
+        )
+        dns = Deployment(
+            metadata=ObjectMeta(name="coredns", namespace="kube-system"),
+            selector=LabelSelector(match_labels={"k8s-app": "kube-dns"}),
+            replicas=2,
+            template={
+                "metadata": {"labels": {"k8s-app": "kube-dns"}},
+                "spec": {"containers": [{
+                    "name": "coredns", "image": "coredns",
+                    "resources": {"requests": {"cpu": "100m",
+                                               "memory": "70Mi"}},
+                }]},
+            },
+        )
+        svc = Service(
+            metadata=ObjectMeta(name="kube-dns", namespace="kube-system"),
+            selector={"k8s-app": "kube-dns"},
+            ports=[ServicePort(name="dns", port=53, target_port=53)],
+        )
+        from kubernetes_tpu.apiserver.store import ConflictError
+
+        client = self.client()
+        for obj in (proxy, dns, svc):
+            try:
+                client.create(obj)
+            except (ValueError, ConflictError):
+                pass   # addon phase is idempotent (409 AlreadyExists)
+
     # -- porcelain ------------------------------------------------------
     @classmethod
     def up(cls, nodes: int = 3, capacity: Optional[Dict[str, str]] = None,
            tpu_chips: int = 0, leader_elect: bool = False,
-           controllers: Optional[List[str]] = None) -> "Cluster":
-        """kubeadm init && kubeadm join ×nodes."""
+           controllers: Optional[List[str]] = None,
+           full_init: bool = False) -> "Cluster":
+        """kubeadm init && kubeadm join ×nodes. ``full_init=True`` runs
+        the complete phase sequence (preflight → certs → control-plane
+        → wait → kubeconfig → upload-config → mark-control-plane →
+        addons → token → join), adding the control-plane Node and
+        kube-system addons the reference installs; the default keeps
+        the minimal test topology."""
         cluster = cls()
+        if full_init:
+            cluster.preflight_warnings = cluster.phase_preflight()
+            cluster.phase_certs()
         cluster.phase_control_plane(leader_elect=leader_elect,
                                     controllers=controllers)
+        if full_init:
+            cluster.phase_wait_control_plane()
+            cluster.phase_kubeconfig()
+            cluster.phase_upload_config()
+            cluster.phase_mark_control_plane()
+            cluster.phase_addons()
         token = cluster.phase_bootstrap_token()
         if nodes:
             cluster.phase_join_nodes(nodes, token=token, capacity=capacity,
@@ -186,6 +393,9 @@ class Cluster:
 
     def down(self) -> None:
         """kubeadm reset."""
+        stop = getattr(self, "_cp_heartbeat_stop", None)
+        if stop is not None:
+            stop.set()
         if self.nodes is not None:
             self.nodes.stop()
         if self.scheduler is not None:

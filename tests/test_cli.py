@@ -626,3 +626,58 @@ def test_kubectl_port_forward_round_trip():
     finally:
         kl.stop()
         server.shutdown_server()
+
+
+def test_kubeadm_full_init_phase_sequence():
+    """Cluster.up(full_init=True) runs the complete kubeadm phase
+    sequence (reference cmd/kubeadm/app/cmd/phases/init): certs,
+    wait-control-plane, kubeconfig, upload-config, mark-control-plane
+    (labeled + tainted Node), and addons (kube-proxy DaemonSet on every
+    node incl. the tainted control plane, CoreDNS Deployment + kube-dns
+    Service) — reconciled by the cluster's OWN controllers."""
+    import time as _time
+
+    from kubernetes_tpu.bootstrap import Cluster
+
+    c = Cluster.up(nodes=2, capacity={"cpu": "8", "memory": "16Gi"},
+                   full_init=True)
+    try:
+        def wait_for(cond, timeout=20.0):
+            deadline = _time.time() + timeout
+            while _time.time() < deadline:
+                if cond():
+                    return True
+                _time.sleep(0.1)
+            return cond()
+
+        # certs + kubeconfigs minted
+        assert "admin" in c.pki and "BEGIN CERTIFICATE" in c.pki["admin"]
+        assert c.kubeconfigs["admin"]["server"] == c.apiserver.url
+        # upload-config
+        cm = c.store.get_object("ConfigMap", "kube-system",
+                                "kubeadm-config")
+        assert cm is not None and "apiServer" in cm.data[
+            "ClusterConfiguration"]
+        # mark-control-plane: labeled + tainted
+        cp = c.client().get("Node", "control-plane-0", namespace=None)
+        assert "node-role.kubernetes.io/control-plane" in \
+            cp.metadata.labels
+        assert any(t.effect == "NoSchedule" for t in cp.spec.taints)
+        # addons: kube-proxy lands on ALL 3 nodes (toleration lets it
+        # onto the control plane); coredns only on the workers
+        assert wait_for(lambda: len([
+            p for p in c.store.list_pods()
+            if p.metadata.labels.get("k8s-app") == "kube-proxy"
+            and p.spec.node_name]) == 3)
+        assert wait_for(lambda: len([
+            p for p in c.store.list_pods()
+            if p.metadata.labels.get("k8s-app") == "kube-dns"
+            and p.spec.node_name]) == 2)
+        for p in c.store.list_pods():
+            if p.metadata.labels.get("k8s-app") == "kube-dns":
+                assert p.spec.node_name != "control-plane-0"
+        # kube-dns Service got a ClusterIP from the registry
+        assert c.client().get("Service", "kube-dns",
+                              "kube-system").cluster_ip
+    finally:
+        c.down()
