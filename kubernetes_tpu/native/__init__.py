@@ -83,7 +83,7 @@ def load():
             i32p, f32p, i32p, i32p, i32p, i32p, f32p, i32p, f32p,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ]
         _lib = lib
         return _lib

@@ -21,10 +21,17 @@
 //                         | sc_domain[U*SC] | term_codes[T] | node_valid
 //   state planes [CD, N]: requested[R] | nonzero[2] | pod_count
 //                         | sc_counts[SC] | term_counts[T]
-//                         | term_owners[T] | totals (flat [0..T) slots)
+//                         | term_owners[T] | sv_attached[SV]
+//                         | totals (flat [0..T) slots)
 //   pod ints     [B, C]:  req[R] | nonzero[2] | profile | valid
 //                         | pod_sc[SC] | sc_match[SC] | match_by[T]
-//                         | own_aff[T] | own_anti[T]   (pack_podin)
+//                         | own_aff[T] | own_anti[T]
+//                         | [sv_slot, sv_col]  (sv > 0 epochs only)
+//
+// Shared-volume attach planes (sv > 0): a shared CSI volume's attach
+// demand is CONDITIONAL per node — 1 only where sv_attached[slot] is
+// still 0 (csi.go len(in_use | wanted) set semantics); committing sets
+// the chosen node's bit. Mirrors _xla_planes_solve's sv branch.
 //
 // Built as a shared library; loaded with ctypes (no pybind11 in this
 // environment). The runtime gracefully falls back to the JAX backends
@@ -55,7 +62,7 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
                const int32_t* pod_ints, const float* pod_floats,
                int32_t* assignments, const float* weights,
                int32_t r, int32_t sc, int32_t t, int32_t u, int32_t v,
-               int64_t n, int32_t b, int32_t c_cols) {
+               int64_t n, int32_t b, int32_t c_cols, int32_t sv) {
   // static plane offsets
   const int64_t so_alloc = 0;
   const int64_t so_max_pods = so_alloc + r;
@@ -71,6 +78,7 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
   const int64_t do_sc_counts = do_pod_count + 1;
   const int64_t do_term_counts = do_sc_counts + sc;
   const int64_t do_term_owners = do_term_counts + t;
+  const int64_t do_sv = do_term_owners + t;
   // pod column offsets (pack_podin)
   const int32_t c_req = 0;
   const int32_t c_nonzero = r;
@@ -81,6 +89,7 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
   const int32_t c_match_by = r + 4 + 2 * sc;
   const int32_t c_own_aff = r + 4 + 2 * sc + t;
   const int32_t c_own_anti = r + 4 + 2 * sc + 2 * t;
+  const int32_t c_sv = r + 4 + 2 * sc + 3 * t;
 
   const int32_t* node_valid = static_ints + so_node_valid * n;
   const int32_t* max_pods = static_ints + so_max_pods * n;
@@ -114,6 +123,13 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
       min_c[sci] = any ? m : 0;
     }
 
+    // shared-volume reference (sv epochs only)
+    const bool sv_shared = sv > 0 && row[c_sv] < sv;
+    const int32_t sv_slot = sv_shared ? row[c_sv] : 0;
+    const int32_t sv_col = sv_shared ? row[c_sv + 1] : 0;
+    const int32_t* sv_att =
+        sv_shared ? state + (do_sv + sv_slot) * n : nullptr;
+
     // affinity batch-level predicates (match _step's first-pod rule)
     bool has_aff = false, no_any = true, self_all = true;
     for (int32_t ti = 0; ti < t; ++ti) {
@@ -131,6 +147,12 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
       for (int32_t ri = 0; ok && ri < r; ++ri) {
         ok = state[(do_requested + ri) * n + i] + row[c_req + ri] <=
              static_ints[(so_alloc + ri) * n + i];
+      }
+      if (ok && sv_shared) {
+        const int32_t demand = 1 - sv_att[i];
+        ok = state[(do_requested + sv_col) * n + i] +
+                 row[c_req + sv_col] + demand <=
+             static_ints[(so_alloc + sv_col) * n + i];
       }
       if (ok) {
         for (int32_t sci = 0; sci < sc; ++sci) {
@@ -209,6 +231,11 @@ int ktpu_solve(const int32_t* static_ints, const float* static_f32s,
     // ---- commit ----------------------------------------------------
     for (int32_t ri = 0; ri < r; ++ri) {
       state[(do_requested + ri) * n + chosen] += row[c_req + ri];
+    }
+    if (sv_shared) {
+      int32_t* att = state + (do_sv + sv_slot) * n;
+      state[(do_requested + sv_col) * n + chosen] += 1 - att[chosen];
+      att[chosen] = 1;
     }
     state[do_nonzero * n + chosen] += row[c_nonzero];
     state[(do_nonzero + 1) * n + chosen] += row[c_nonzero + 1];

@@ -39,11 +39,6 @@ class CppBackend:
             raise RuntimeError("native solver library unavailable")
 
     def prepare(self, cluster, batch):
-        if cluster.sv_attached is not None:
-            # the C++ step has no shared-volume planes; the chain falls
-            # to the planes scan for such epochs
-            raise ValueError(
-                "native solver does not carry shared-volume planes")
         return prepare(cluster, batch, device=False)
 
     def solve_lazy(self, params, pstatic, pstate, pod_ints, pod_floats):
@@ -58,9 +53,11 @@ class CppBackend:
               pod_floats):
         planes = pstate.planes  # [CD, NB, 128] int32, C-contiguous
         n = planes.shape[1] * planes.shape[2]
-        do, _ = _state_planes(pstatic.r, pstatic.sc, pstatic.t)
+        sv = getattr(pstatic, "sv", 0)
+        do, _ = _state_planes(pstatic.r, pstatic.sc, pstatic.t, sv)
         b, c_cols = pod_ints.shape
-        expected = pstatic.r + 4 + 2 * pstatic.sc + 3 * pstatic.t
+        expected = pstatic.r + 4 + 2 * pstatic.sc + 3 * pstatic.t \
+            + (2 if sv else 0)
         if c_cols != expected:
             # mirror _unpack_podin's loud failure: misaligned columns
             # would silently corrupt every assignment
@@ -91,7 +88,7 @@ class CppBackend:
             assignments.ctypes.data_as(_I32P),
             weights.ctypes.data_as(_F32P),
             pstatic.r, pstatic.sc, pstatic.t, pstatic.u, pstatic.v,
-            n, b, c_cols,
+            n, b, c_cols, sv,
         )
         if rc != 0:
             raise RuntimeError(f"ktpu_solve failed (rc={rc})")

@@ -131,7 +131,8 @@ def _pallas_fits(batch) -> bool:
         batch.sc_counts.shape[0] <= PALLAS_MAX_SC
         and batch.term_counts.shape[0] <= PALLAS_MAX_TERMS
         and batch.static_masks.shape[0] <= PALLAS_MAX_PROFILES
-        # shared-volume epochs need the sv planes (planes scan only)
+        # shared-volume epochs need the sv planes (the planes scan and
+        # the native C++ mirror carry them; the pallas kernel doesn't)
         and getattr(batch, "pod_sv", None) is None
     )
 
@@ -417,12 +418,14 @@ class SolverSession:
                 chain.append(XlaPlanesBackend())
             chain.append(XlaBackend())
         if cluster.sv_attached is not None:
-            # shared-volume epochs solve on the planes scan only — a
-            # structural routing decision like _pallas_fits, NOT an
-            # exception: letting cpp/sharded/legacy raise here would
+            # shared-volume epochs solve on the backends that carry the
+            # sv planes (the planes scan and the native C++ mirror) —
+            # a structural routing decision like _pallas_fits, NOT an
+            # exception: letting pallas/sharded/legacy raise here would
             # demote the preferred backend for sv-free epochs too and
             # log a designed-for case as a failure
-            chain = [b for b in chain if b.name == "xla-planes"] \
+            chain = [b for b in chain
+                     if b.name in ("xla-planes", "cpp")] \
                 or [XlaPlanesBackend()]
         t0 = time.monotonic()
         for i, backend in enumerate(chain):

@@ -102,7 +102,7 @@ class TestSharedVolumePlanes:
         pods = [_pod(f"p{i}", f"claim{i % 4}") for i in range(40)]
         placements, backend = _run_batch(store, pods)
         assert len(placements) == 40
-        assert backend == "xla-planes"   # sv epochs demote native/pallas
+        assert backend in ("xla-planes", "cpp")   # the sv-capable backends
         for node, vols in _attach_sets(store).items():
             assert len(vols) <= 2, (node, vols)
 
