@@ -53,7 +53,7 @@ class CppBackend:
               pod_floats):
         planes = pstate.planes  # [CD, NB, 128] int32, C-contiguous
         n = planes.shape[1] * planes.shape[2]
-        sv = getattr(pstatic, "sv", 0)
+        sv = pstatic.sv
         do, _ = _state_planes(pstatic.r, pstatic.sc, pstatic.t, sv)
         b, c_cols = pod_ints.shape
         expected = pstatic.r + 4 + 2 * pstatic.sc + 3 * pstatic.t \

@@ -131,8 +131,9 @@ def _pallas_fits(batch) -> bool:
         batch.sc_counts.shape[0] <= PALLAS_MAX_SC
         and batch.term_counts.shape[0] <= PALLAS_MAX_TERMS
         and batch.static_masks.shape[0] <= PALLAS_MAX_PROFILES
-        # shared-volume epochs need the sv planes (the planes scan and
-        # the native C++ mirror carry them; the pallas kernel doesn't)
+        # shared-volume epochs need the sv planes (the planes scan,
+        # the native C++ mirror, and the mesh-sharded scan carry them;
+        # the pallas kernel doesn't)
         and getattr(batch, "pod_sv", None) is None
     )
 
@@ -419,13 +420,13 @@ class SolverSession:
             chain.append(XlaBackend())
         if cluster.sv_attached is not None:
             # shared-volume epochs solve on the backends that carry the
-            # sv planes (the planes scan and the native C++ mirror) —
-            # a structural routing decision like _pallas_fits, NOT an
-            # exception: letting pallas/sharded/legacy raise here would
-            # demote the preferred backend for sv-free epochs too and
-            # log a designed-for case as a failure
+            # sv planes (the planes scan, the native C++ mirror, and
+            # the mesh-sharded scan) — a structural routing decision
+            # like _pallas_fits, NOT an exception: letting pallas/
+            # legacy raise here would demote the preferred backend for
+            # sv-free epochs too and log a designed-for case as failure
             chain = [b for b in chain
-                     if b.name in ("xla-planes", "cpp")] \
+                     if b.name in ("xla-planes", "cpp", "sharded")] \
                 or [XlaPlanesBackend()]
         t0 = time.monotonic()
         for i, backend in enumerate(chain):

@@ -78,6 +78,8 @@ class SStatic(NamedTuple):
     u: int
     v: int
     n: int
+    # shared-volume attach plane count (0 = none)
+    sv: int = 0
     # True iff any encoded spread constraint is hard (DoNotSchedule):
     # compile-time branch — soft-only batches skip the per-pod domain-min
     # pmin collective entirely
@@ -104,9 +106,9 @@ def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
     build: every cross-shard op replaced by a local stand-in of the same
     arithmetic shape, so full-minus-ablated wall time isolates pure
     collective cost — results are garbage, never use for scheduling)."""
-    r, sc, t, u, v, shards, any_hard, collectives = dims
+    r, sc, t, u, v, shards, any_hard, collectives, sv = dims
     c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match, \
-        c_match_by, c_own_aff, c_own_anti = cols
+        c_match_by, c_own_aff, c_own_anti, c_sv = cols
     state, totals = carry
     row, pref_w = pod
     n_local = static_l.shape[1]
@@ -134,6 +136,22 @@ def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
     requested = state[do["requested"]:do["requested"] + r]
     fit = jnp.all(requested + req[:, None] <= alloc, axis=0)
     fit &= state[do["pod_count"]] < static_l[so["max_pods"]]
+    if sv:
+        # shared-volume attach demand is CONDITIONAL per node (1 only
+        # where this pod's volume isn't attached yet) — entirely LOCAL:
+        # the sv planes shard over nodes like every other plane, and
+        # the winner update below touches only the chosen node's shard
+        sv_planes = state[do["sv_attached"]:do["sv_attached"] + sv]
+        sv_slot = row[c_sv]
+        sv_col = row[c_sv + 1]
+        sv_is_shared = sv_slot < sv
+        slot_c = jnp.minimum(sv_slot, sv - 1)
+        att = jnp.take(sv_planes, slot_c, axis=0)         # [n_local]
+        sv_demand = jnp.where(sv_is_shared, 1 - att, 0)
+        col_alloc = jnp.take(alloc, sv_col, axis=0)
+        col_req = jnp.take(requested, sv_col, axis=0)
+        col_pod = jnp.take(req, sv_col)
+        fit &= col_req + col_pod + sv_demand <= col_alloc
     static_ok = static_l[so["masks"] + profile] > 0
 
     counts = state[do["sc_counts"]:do["sc_counts"] + sc]
@@ -252,15 +270,22 @@ def _step(params, dims, so, do, cols, sc_meta, static_l, f32_l, has_dom_r,
     t_inc = t_same * (match_by.astype(jnp.int32) * valid_i)[:, None]
     o_inc = t_same * (own_anti.astype(jnp.int32) * valid_i)[:, None]
 
-    new_state = jnp.concatenate([
-        requested + inc[None] * req[:, None],
+    new_requested = requested + inc[None] * req[:, None]
+    pieces = [
+        new_requested,
         nz + inc[None] * row[c_nonzero:c_nonzero + 2][:, None],
         (state[do["pod_count"]] + inc)[None],
         counts + sc_inc,
         tcounts + t_inc,
         towners + o_inc,
-        state[do["totals"]][None],
-    ])
+    ]
+    if sv:
+        sv_add = inc * sv_demand
+        pieces[0] = new_requested.at[sv_col].add(sv_add)
+        shared_i = jnp.where(sv_is_shared, 1, 0)
+        pieces.append(sv_planes.at[slot_c].max(inc * shared_i))
+    pieces.append(state[do["totals"]][None])
+    new_state = jnp.concatenate(pieces)
     new_totals = totals + (
         match_by.astype(jnp.int32) * valid_i * (t_code_j < v)
     )
@@ -292,7 +317,8 @@ def _batched_static_feasibility(so, r, u, c_req, c_profile, static_l,
 @lru_cache(maxsize=32)
 def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
                  u: int, v: int, with_counts: bool = True,
-                 any_hard: bool = True, collectives: bool = True):
+                 any_hard: bool = True, collectives: bool = True,
+                 sv: int = 0):
     """Build (and cache) the jitted shard_map solve for one
     (mesh, params, shape) signature. Session rebuilds within the same
     constraint space reuse the compiled executable. ``with_counts=False``
@@ -303,15 +329,17 @@ def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
     ``collectives=False`` builds the timing-ablation variant (local
     stand-ins for every cross-shard op; results are garbage)."""
     so, _ = _static_planes(r, sc, t, u)
-    do, _ = _state_planes(r, sc, t)
+    do, _ = _state_planes(r, sc, t, sv)
     c_req, c_nonzero, c_profile, c_valid = 0, r, r + 2, r + 3
     c_pod_sc, c_sc_match = r + 4, r + 4 + sc
     c_match_by = r + 4 + 2 * sc
     c_own_aff = r + 4 + 2 * sc + t
     c_own_anti = r + 4 + 2 * sc + 2 * t
+    c_sv = r + 4 + 2 * sc + 3 * t
     cols = (c_req, c_nonzero, c_profile, c_valid, c_pod_sc, c_sc_match,
-            c_match_by, c_own_aff, c_own_anti)
-    dims = (r, sc, t, u, v, mesh.shape["nodes"], any_hard, collectives)
+            c_match_by, c_own_aff, c_own_anti, c_sv)
+    dims = (r, sc, t, u, v, mesh.shape["nodes"], any_hard, collectives,
+            sv)
 
     node_sharded = P(None, "nodes")
 
@@ -369,8 +397,9 @@ def _prepare_sharded(cluster: EncodedCluster, batch: EncodedBatch,
             f"padded node count {n} not divisible by mesh nodes axis "
             f"{shards}"
         )
+    sv = pstatic.sv
     _, cs = _static_planes(r, sc, t, u)
-    do, cd = _state_planes(r, sc, t)
+    do, cd = _state_planes(r, sc, t, sv)
     static2 = np.asarray(pstatic.ints).reshape(cs, n)
     f32s2 = np.asarray(pstatic.f32s).reshape(u, n)
     planes2 = np.asarray(pstate.planes).reshape(cd, n)
@@ -383,7 +412,7 @@ def _prepare_sharded(cluster: EncodedCluster, batch: EncodedBatch,
         ints=jnp.asarray(static2),
         f32s=jnp.asarray(f32s2),
         has_dom=jnp.asarray(has_dom),
-        r=r, sc=sc, t=t, u=u, v=v, n=n,
+        r=r, sc=sc, t=t, u=u, v=v, n=n, sv=sv,
         any_hard=bool(np.asarray(batch.sc_hard).any()),
     )
     sstate = SState(planes=jnp.asarray(planes2), totals=jnp.asarray(totals0))
@@ -404,19 +433,13 @@ class ShardedBackend:
         self.mesh = mesh or make_mesh()
 
     def prepare(self, cluster, batch):
-        if cluster.sv_attached is not None:
-            # the sharded step has no shared-volume planes yet; the
-            # chain demotes such epochs to the single-device planes
-            # scan (exactness over parallelism — a misaligned plane
-            # layout would corrupt every offset after sv_attached)
-            raise ValueError(
-                "sharded solver does not carry shared-volume planes")
         return _prepare_sharded(cluster, batch, self.mesh)
 
     def solve_lazy(self, params, sstatic, sstate, pod_ints, pod_floats):
         run = _build_solve(self.mesh, params, sstatic.r, sstatic.sc,
                            sstatic.t, sstatic.u, sstatic.v,
-                           with_counts=False, any_hard=sstatic.any_hard)
+                           with_counts=False, any_hard=sstatic.any_hard,
+                           sv=sstatic.sv)
         ints = jnp.asarray(pod_ints)
         floats = jnp.asarray(pod_floats)
         with self.mesh:
@@ -447,7 +470,8 @@ def solve_scan_sharded(
     Matches the single-chip solvers exactly (differential tests)."""
     sstatic, sstate = _prepare_sharded(cluster, batch, mesh)
     run = _build_solve(mesh, params, sstatic.r, sstatic.sc, sstatic.t,
-                       sstatic.u, sstatic.v, any_hard=sstatic.any_hard)
+                       sstatic.u, sstatic.v, any_hard=sstatic.any_hard,
+                       sv=sstatic.sv)
     pod_ints, pod_floats = pack_podin(batch)
     b_axis = mesh.shape["batch"]
     if pod_ints.shape[0] % b_axis != 0:

@@ -215,3 +215,57 @@ class TestSharedVolumePlanes:
         # n0 is over-limit (2 > 1): host refuses it; n1 takes the pod
         # with a fresh attachment
         assert placements.get("j") == "n1"
+
+    def test_sharded_matches_single_chip_on_shared_volumes(self):
+        """The mesh-sharded backend carries the sv planes too (node-
+        sharded, fully local update): placements are IDENTICAL to the
+        single-chip batch path on a shared-volume workload."""
+        import jax
+        import pytest
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices (virtual CPU mesh)")
+        from kubernetes_tpu.parallel import ShardedBackend, make_mesh
+
+        def build():
+            store = _cluster(n_nodes=8, limit=2)
+            for c in range(4):
+                _shared_claim(store, f"claim{c}")
+            pods = [_pod(f"p{i}", f"claim{i % 4}") for i in range(48)]
+            return store, pods
+
+        store_b, pods = build()
+        batch_placements, _ = _run_batch(store_b, pods)
+
+        store_m, pods = build()
+        gates = FeatureGates({"TPUBatchScheduler": True})
+        sched = Scheduler.create(store_m, feature_gates=gates,
+                                 provider="GangSchedulingProvider")
+        bs = attach_batch_scheduler(
+            sched, max_batch=64,
+            backend=ShardedBackend(make_mesh(8, batch_axis=2)))
+        sched.start()
+        store_m.create_pods(pods)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            bs.run_batch(pop_timeout=0.05)
+            sched.queue.flush_backoff_completed()
+            if sum(1 for p in store_m.list_pods()
+                   if p.spec.node_name) >= 48:
+                break
+        bs.flush()
+        sched.wait_for_inflight_bindings()
+        sharded_placements = {
+            p.metadata.name: p.spec.node_name
+            for p in store_m.list_pods() if p.spec.node_name
+        }
+        assert bs.session._active.name == "sharded"
+        sched.stop()
+        diverged = [
+            (k, batch_placements.get(k), sharded_placements.get(k))
+            for k in set(batch_placements) | set(sharded_placements)
+            if batch_placements.get(k) != sharded_placements.get(k)
+        ]
+        assert not diverged, diverged[:10]
+        for node, vols in _attach_sets(store_m).items():
+            assert len(vols) <= 2, (node, vols)
