@@ -73,6 +73,9 @@ EXTRA_MATRIX = {
     # one per node); every measured high-priority pod must preempt. More
     # init pods than fit would deadlock the init op's wait-for-scheduled.
     "preemption": ("Preemption", 5000, 5000, 5000),
+    # preemptors carrying PVCs (victim eviction + volume feasibility in
+    # one flow; reference performance-config.yaml:399)
+    "preemptionpvs": ("PreemptionPVs", 5000, 5000, 5000),
     # 1000 impossible pods stay pending (skipWaitToCompletion) while the
     # measured pods schedule around them
     "unschedulable": ("Unschedulable", 5000, 1000, 10000),
