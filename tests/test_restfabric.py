@@ -349,3 +349,19 @@ class TestRestPerfHarness:
         # WAL carried every mutation (nodes + creates + binds + ...)
         assert result.metrics["wal_entries"] >= 20 + 150 * 2
         assert result.pods_per_second > 0
+
+    @pytest.mark.slow
+    def test_harness_generalizes_beyond_basic(self):
+        """The REST harness walks any declarative workload: a
+        TopologySpreading run (spread constraints + zoned nodes over
+        the wire) completes with store truth agreeing."""
+        from kubernetes_tpu.harness.rest_perf import run_workload_rest
+
+        result = run_workload_rest(
+            "TopologySpreading", nodes=20, measure_pods=120,
+            use_batch=False, qps=5000, wal=False, wait_timeout=120,
+        )
+        assert result.metrics["server_pods_bound"] == \
+            result.metrics["scheduler_bound"]
+        assert result.metrics["server_pods_bound"] >= 120
+        assert result.pods_per_second > 0
