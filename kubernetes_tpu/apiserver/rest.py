@@ -27,12 +27,16 @@ from __future__ import annotations
 
 import json
 import queue
+import socket
+import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.api.serialization import SCHEME, from_wire, is_namespaced, to_wire
+from kubernetes_tpu.apiserver.faults import FaultGate, resource_of
 from kubernetes_tpu.apiserver.admission import (
     CREATE,
     DELETE,
@@ -269,6 +273,80 @@ def allow_all(user: str, verb: str, kind: str, namespace: str) -> bool:
     return True
 
 
+class _DevNullWriter:
+    """Stands in for wfile after a fault aborted the connection, so the
+    base handler's post-request flush/close never touches the dead
+    socket (which would traceback on every injected reset)."""
+
+    closed = False
+
+    def write(self, data) -> int:
+        return len(data)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _TruncatingWriter:
+    """Passes through the first ``limit`` bytes, then RSTs the
+    connection and swallows the rest — the 'response cut mid-body'
+    failure mode (a proxy died, a socket buffer was torn down)."""
+
+    closed = False
+
+    def __init__(self, handler: "_Handler", inner, limit: int):
+        self._handler = handler
+        self._inner = inner
+        self._remaining = max(0, int(limit))
+        self._aborted = False
+
+    def write(self, data) -> int:
+        if self._aborted:
+            return len(data)
+        take = data[:self._remaining]
+        if take:
+            try:
+                self._inner.write(take)
+            except OSError:
+                self._aborted = True
+                return len(data)
+            self._remaining -= len(take)
+        if self._remaining <= 0:
+            try:
+                self._inner.flush()
+            except OSError:
+                pass
+            self._aborted = True
+            self._handler._abort_socket()
+        return len(data)
+
+    def flush(self) -> None:
+        if not self._aborted:
+            try:
+                self._inner.flush()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        pass
+
+    def finish_request(self) -> None:
+        """The faulted request is over: a truncation fault always ends
+        the connection — even when the response fit under the byte
+        limit — so the writer never leaks into the next keep-alive
+        request with leftover budget."""
+        if not self._aborted:
+            try:
+                self._inner.flush()
+            except OSError:
+                pass
+            self._aborted = True
+            self._handler._abort_socket()
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     # a request/response ping-pong on a keep-alive connection stalls
@@ -300,7 +378,87 @@ class _Handler(BaseHTTPRequestHandler):
             return self.server.readonly_lane
         return self.server.mutating_lane
 
+    # -- fault injection (faults.py FaultGate; the chaos-over-REST
+    # middleware). Runs BEFORE the in-flight lanes so an injected reset
+    # never consumes a lane slot; health probes, metrics scrapes, and
+    # the fault admin endpoint itself are exempt — chaos must not get
+    # the server restarted, blind its observers, or lock itself out.
+    _FAULT_EXEMPT = ("/healthz", "/livez", "/readyz", "/debug/faults",
+                     "/metrics", "/metrics/resources")
+
+    _sock_aborted = False   # instance flag set by _abort_socket
+
+    def _abort_socket(self) -> None:
+        """RST the client (SO_LINGER 1,0 → no FIN, no more bytes) and
+        neuter wfile so the base class's final flush is a no-op."""
+        self._sock_aborted = True
+        self.close_connection = True
+        try:
+            self.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            self.connection.close()
+        except OSError:
+            pass
+        self.wfile = _DevNullWriter()
+
+    def _inject_fault(self) -> bool:
+        """Consult the FaultGate for this request. True = the request
+        was fully consumed by the fault (aborted or answered); False =
+        continue normal handling (possibly slowed or truncated)."""
+        gate = self.server.fault_gate
+        if gate is None or not gate._rules:
+            return False
+        path = self.path.split("?", 1)[0]
+        if path in self._FAULT_EXEMPT:
+            return False
+        rule = gate.decide(self.command, resource_of(self.path))
+        if rule is None:
+            return False
+        if rule.fault == "latency":
+            time.sleep(rule.latency)
+            return False
+        if rule.fault == "truncate":
+            self.wfile = _TruncatingWriter(self, self.wfile,
+                                           rule.truncate_bytes)
+            return False
+        if rule.fault == "reset":
+            self._abort_socket()
+            return True
+        # "error": overload pushback burst — drain the body first so
+        # keep-alive framing stays intact for the client's retry
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            self.rfile.read(length)
+        body = json.dumps({
+            "kind": "Status", "status": "Failure",
+            "reason": "TooManyRequests" if rule.code == 429
+            else "ServiceUnavailable",
+            "message": "injected fault: overload pushback",
+            "code": rule.code,
+        }).encode()
+        self.send_response(rule.code)
+        self.send_header("Retry-After", str(rule.retry_after))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True
+
     def _handle_gated(self, inner) -> None:
+        if self._inject_fault():
+            return
+        try:
+            self._dispatch_gated(inner)
+        finally:
+            wfile = self.wfile
+            if isinstance(wfile, _TruncatingWriter):
+                wfile.finish_request()
+
+    def _dispatch_gated(self, inner) -> None:
         lane = self._gate()
         if lane is None:
             try:
@@ -642,8 +800,39 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         self._handle_gated(self._do_GET)
 
+    def _serve_faults_admin(self, verb: str) -> None:
+        """/debug/faults: runtime fault-injection control surface.
+        GET → config + injection counters; POST/PUT → replace rule set
+        (``{"seed": S, "rules": [...]}``); DELETE → clear. Guarded by
+        the binary codec's control-plane trust envelope: loopback on a
+        tokenless server, control-plane identity otherwise — an
+        ordinary namespace token must not be able to break the wire."""
+        if not self._binary_decode_allowed():
+            self._send_error(403, "Forbidden",
+                             "fault admin requires a control-plane identity")
+            return
+        gate = self.server.fault_gate
+        if verb == "GET":
+            self._send_json(200, gate.snapshot())
+            return
+        if verb == "DELETE":
+            gate.clear()
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            gate.configure(json.loads(raw or b"{}"))
+        except (ValueError, TypeError) as e:
+            self._send_error(400, "BadRequest", f"invalid fault spec: {e}")
+            return
+        self._send_json(200, gate.snapshot())
+
     def _do_GET(self) -> None:
         u = urlparse(self.path)
+        if u.path == "/debug/faults":
+            self._serve_faults_admin("GET")
+            return
         if u.path in ("/healthz", "/livez", "/readyz"):
             body = b"ok"
             self.send_response(200)
@@ -902,6 +1091,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_POST)
 
     def _do_POST(self) -> None:
+        if urlparse(self.path).path == "/debug/faults":
+            self._serve_faults_admin("POST")
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -1132,6 +1324,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_PUT)
 
     def _do_PUT(self) -> None:
+        if urlparse(self.path).path == "/debug/faults":
+            self._serve_faults_admin("PUT")
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -1256,7 +1451,14 @@ class _Handler(BaseHTTPRequestHandler):
                     UPDATE, kind, obj.metadata.namespace, obj, old_obj=old, user=user
                 )
             )
-            expect = body.get("metadata", {}).get("resourceVersion") or None
+            # CAS expectation: the JSON wire carries it in metadata;
+            # a binary body IS the object, so its stamped rv serves
+            # (body.get on a pickled object would crash the handler)
+            if isinstance(body, dict):
+                expect = body.get("metadata", {}).get(
+                    "resourceVersion") or None
+            else:
+                expect = obj.metadata.resource_version or None
             updated = store.update_object(kind, obj, expect_rv=expect)
             self._send_json(200, self._encode(updated))
         except AdmissionError as e:
@@ -1365,6 +1567,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_DELETE)
 
     def _do_DELETE(self) -> None:
+        if urlparse(self.path).path == "/debug/faults":
+            self._serve_faults_admin("DELETE")
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -1472,14 +1677,33 @@ class _Handler(BaseHTTPRequestHandler):
             codec.BINARY_CONTENT_TYPE if binary else "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        gate = self.server.fault_gate
+        plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
         try:
             while not self.server.stopping.is_set():
+                if self._sock_aborted:
+                    # an injected fault (truncation mid-stream) killed
+                    # the socket: writes now land in _DevNullWriter and
+                    # never raise, so exit explicitly or this thread
+                    # would drain a dead subscription forever
+                    break
                 try:
                     frame = frames.get(timeout=0.5)
                 except queue.Empty:
                     continue
                 if frame is None:
                     break
+                if gate is not None and gate._rules:
+                    # per-frame watch faults: stalls delay delivery,
+                    # drops abort mid-stream with no terminating chunk
+                    # (the client must detect the loss and relist)
+                    rule = gate.decide("GET", plural, watch=True)
+                    if rule is not None:
+                        if rule.fault == "watch_stall":
+                            time.sleep(rule.duration)
+                        elif rule.fault == "watch_drop":
+                            self._abort_socket()
+                            break
                 closing = False
                 if binary:
                     # drain the backlog into ONE length-prefixed frame:
@@ -1530,8 +1754,14 @@ class APIServer(ThreadingHTTPServer):
         max_readonly_inflight: Optional[int] = 400,
         max_mutating_inflight: Optional[int] = 200,
         binary_clients: Optional[set] = None,
+        fault_gate: Optional[FaultGate] = None,
     ):
         super().__init__((host, port), _Handler)
+        # chaos middleware: always present (a rule-less gate costs one
+        # attribute read per request) so /debug/faults can arm it at
+        # runtime without a server restart
+        self.fault_gate = fault_gate if fault_gate is not None \
+            else FaultGate()
         # self-protection lanes (reference filters/maxinflight.go
         # defaults: --max-requests-inflight 400,
         # --max-mutating-requests-inflight 200); None = unlimited

@@ -78,6 +78,30 @@ def _meta_key(kind: str, obj: Any) -> str:
     return f"{meta.namespace}/{meta.name}"
 
 
+def replace_diff(kind: str, known: Dict[Any, Any],
+                 live: Dict[Any, Any]) -> List[Event]:
+    """Reflector Replace as a DIFF (shared by SharedInformer._relist and
+    RestClusterClient's watch relist): against ``known`` (what the
+    consumer last saw), ``live`` (the fresh list) yields — nothing for
+    unchanged objects (same resourceVersion: replays dedupe), MODIFIED
+    carrying the last-known old for rv changes (a bind missed during
+    the outage still reads as a bind transition), ADDED for new keys,
+    and synthetic DELETED for vanished ones (DeletedFinalStateUnknown),
+    or caches schedule against phantom objects forever."""
+    events: List[Event] = [
+        Event(DELETED, kind, obj)
+        for key, obj in known.items() if key not in live
+    ]
+    for key, obj in live.items():
+        old = known.get(key)
+        if old is None:
+            events.append(Event(ADDED, kind, obj))
+        elif (old.metadata.resource_version
+              != obj.metadata.resource_version):
+            events.append(Event(MODIFIED, kind, obj, old))
+    return events
+
+
 class Indexer:
     """Thread-safe key→object map with namespace listing."""
 
@@ -110,6 +134,10 @@ class Indexer:
         with self._lock:
             return list(self._items.keys())
 
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._items)
+
 
 class SharedInformer:
     """One kind's informer: indexer + handler fan-out."""
@@ -136,6 +164,18 @@ class SharedInformer:
         self.indexer.replace(objs)
         self._synced = True
         return [Event(ADDED, self.kind, o) for o in objs]
+
+    def _relist(self) -> List[Event]:
+        """Reflector Replace after a dropped watch or an expired
+        resourceVersion (410 Gone): RELIST — never resume — and emit
+        only the diff against the indexer (see ``replace_diff``)."""
+        objs = self._list_fn()
+        events = replace_diff(
+            self.kind, self.indexer.snapshot(),
+            {_meta_key(self.kind, o): o for o in objs})
+        self.indexer.replace(objs)
+        self._synced = True
+        return events
 
     def _apply(self, event: Event) -> None:
         if event.type == DELETED:
@@ -214,6 +254,7 @@ class SharedInformerFactory:
         self._stopped = False
         self._synced_event = threading.Event()
         self._pending_sync: List[SharedInformer] = []
+        self._pending_resync: List[SharedInformer] = []
 
     def informer_for(self, kind: str) -> SharedInformer:
         with self._cond:
@@ -239,6 +280,23 @@ class SharedInformerFactory:
 
     def lister_for(self, kind: str) -> Lister:
         return Lister(self.informer_for(kind))
+
+    def resync(self, kind: str) -> None:
+        """Force a relist of one kind on the dispatch thread — the
+        recovery entry point when the watch source reports an expired
+        or unknown resourceVersion (HTTP 410 over REST, compaction on
+        the watch cache). Handlers observe only the diff; events that
+        also arrive through the live feed dedupe against the indexer's
+        resourceVersion like initial-sync replays do."""
+        with self._cond:
+            inf = self._informers.get(kind)
+            if inf is None or self._stopped:
+                return
+            if self._thread is None:
+                # not started yet: the initial sync will list anyway
+                return
+            self._pending_resync.append(inf)
+            self._cond.notify()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -266,14 +324,23 @@ class SharedInformerFactory:
         while True:
             with self._cond:
                 while (not self._deltas and not self._pending_sync
+                       and not self._pending_resync
                        and not self._stopped):
                     self._cond.wait(0.5)
                 if self._stopped and not self._deltas:
                     return
                 pending, self._pending_sync = self._pending_sync, []
+                resyncs, self._pending_resync = self._pending_resync, []
                 event = self._deltas.popleft() if self._deltas else None
             for inf in pending:  # informers registered after start()
                 self._sync_one(inf)
+            for inf in resyncs:  # relist-not-resume recovery (410 Gone)
+                try:
+                    for ev in inf._relist():
+                        self._dispatch_guarded(inf, ev)
+                except Exception:  # noqa: BLE001 — dispatch must survive
+                    _logger.exception("informer %s relist failed",
+                                      inf.kind)
             if event is None:
                 continue
             inf = self._informers.get(event.kind)
@@ -284,6 +351,17 @@ class SharedInformerFactory:
             if event.type == ADDED:
                 existing = inf.indexer.get(_meta_key(inf.kind, event.obj))
                 if (existing is not None
+                        and existing.metadata.resource_version
+                        == event.obj.metadata.resource_version):
+                    continue
+            # a MODIFIED that raced a relist dedupes the same way, but
+            # ONLY for a distinct instance: the in-process store mutates
+            # and redispatches the very object the indexer holds, where
+            # an rv comparison against itself would swallow every update
+            elif event.type == MODIFIED:
+                existing = inf.indexer.get(_meta_key(inf.kind, event.obj))
+                if (existing is not None
+                        and existing is not event.obj
                         and existing.metadata.resource_version
                         == event.obj.metadata.resource_version):
                     continue

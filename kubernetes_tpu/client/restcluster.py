@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import socket
 import threading
 import time
@@ -37,7 +38,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu.api.serialization import from_wire, to_wire
 from kubernetes_tpu.apiserver import codec
 from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL
-from kubernetes_tpu.apiserver.store import ADDED, DELETED, Event
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, Event
+from kubernetes_tpu.client.backoff import Backoff, CircuitBreaker, RetryBudget
 
 # kinds the scheduler's event handlers consume
 # (eventhandlers.py handle(); reference addAllEventHandlers)
@@ -100,6 +102,12 @@ class RestClusterClient:
         binary: bool = True,
         watch_kinds: Tuple[str, ...] = SCHEDULER_WATCH_KINDS,
         cache_ttl: float = 1.0,
+        max_retries: int = 5,
+        retry_after_cap: float = 2.0,
+        backoff: Optional[Backoff] = None,
+        retry_budget: Optional[RetryBudget] = None,
+        breaker_threshold: int = 5,
+        retry_seed: Optional[int] = None,
     ):
         self.base_url = base_url.rstrip("/")
         rest = self.base_url.split("://", 1)[1]
@@ -114,6 +122,34 @@ class RestClusterClient:
         self._ttl_cache: Dict[str, tuple] = {}
         self._stopping = threading.Event()
         self._watch_threads: List[threading.Thread] = []
+        # resilience stack: jittered exponential backoff between retries
+        # (deterministic under retry_seed for chaos replay), a per-client
+        # retry budget so a sick server costs bounded extra load, and a
+        # circuit breaker whose listener the scheduler wires to degraded
+        # mode (reference client-go's rest.Config backoff + the
+        # apiserver's Retry-After contract)
+        self.max_retries = int(max_retries)
+        self.retry_after_cap = float(retry_after_cap)
+        rng = random.Random(retry_seed) if retry_seed is not None else None
+        self._backoff = backoff if backoff is not None else \
+            Backoff(base=0.05, factor=2.0, cap=2.0, jitter=0.4, rng=rng)
+        self._retry_budget = retry_budget if retry_budget is not None \
+            else RetryBudget(budget=32.0, refill_per_second=4.0)
+        self.breaker = CircuitBreaker(failure_threshold=breaker_threshold)
+        # resourceVersion monotonicity watchdog: list RVs per kind must
+        # never regress (a WAL-restored server that lost committed
+        # revisions would show up here); violations are recorded, never
+        # raised — the chaos suite asserts the list stays empty
+        self._rv_lock = threading.Lock()
+        self._last_rv: Dict[str, int] = {}
+        self.rv_regressions: List[Tuple[str, int, int]] = []
+
+    def set_degraded_listener(
+            self, listener: Callable[[bool], None]) -> None:
+        """``listener(degraded)`` fires when the circuit breaker opens
+        (transport to the apiserver is gone) and again when it closes.
+        The scheduler uses this to pause binding and resume cleanly."""
+        self.breaker.set_listener(listener)
 
     # -- transport -----------------------------------------------------
     def _conn(self) -> http.client.HTTPConnection:
@@ -145,6 +181,13 @@ class RestClusterClient:
             h["Authorization"] = f"Bearer {self.token}"
         return h
 
+    @staticmethod
+    def _note_retry(verb: str, reason: str) -> None:
+        # cold path only (a retry already costs a sleep)
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        fabric_metrics().client_retries_total.inc(verb, reason)
+
     def _request(self, method: str, path: str, payload: Any = None,
                  charge: float = 1.0, body_binary: Optional[bool] = None
                  ) -> Tuple[int, Any]:
@@ -155,7 +198,8 @@ class RestClusterClient:
         if payload is not None:
             data = codec.encode(payload) if body_binary \
                 else json.dumps(payload).encode()
-        for attempt in range(3):
+        attempt = 0
+        while True:
             try:
                 conn = self._conn()
                 conn.request(method, path, body=data,
@@ -163,22 +207,42 @@ class RestClusterClient:
                 resp = conn.getresponse()
                 raw = resp.read()
             except (http.client.HTTPException, OSError):
-                # dropped keep-alive (server restart, idle timeout):
-                # reconnect and retry — requests here are idempotent or
-                # conflict-detected server-side
+                # dropped/reset keep-alive or truncated response (server
+                # restart, idle timeout, injected wire fault): reconnect
+                # with jittered backoff — requests here are idempotent
+                # or conflict-detected server-side. Budget exhaustion
+                # surfaces the ORIGINAL transport error.
                 self._drop_conn()
-                if attempt == 2:
+                self.breaker.record_failure()
+                if attempt >= self.max_retries \
+                        or not self._retry_budget.try_spend():
                     raise
+                self._note_retry(method, "transport")
+                time.sleep(self._backoff.delay(attempt))
+                attempt += 1
                 continue
-            if resp.status == 429 and attempt < 2:
-                # max-in-flight pushback: honor Retry-After
-                time.sleep(float(resp.headers.get("Retry-After") or 1.0))
+            if resp.status in (429, 503) and attempt < self.max_retries \
+                    and self._retry_budget.try_spend():
+                # overload pushback: honor Retry-After, CAPPED — a
+                # misbehaving server advertising an hour must not stall
+                # this client unboundedly
+                try:
+                    advertised = float(
+                        resp.headers.get("Retry-After") or 0.0)
+                except ValueError:
+                    advertised = 0.0
+                self._note_retry(method, f"http_{resp.status}")
+                time.sleep(min(max(advertised,
+                                   self._backoff.delay(attempt)),
+                               self.retry_after_cap))
+                attempt += 1
                 continue
+            # any HTTP response means the transport is healthy
+            self.breaker.record_success()
             ctype = resp.headers.get("Content-Type") or ""
             if ctype.startswith(codec.BINARY_CONTENT_TYPE):
                 return resp.status, codec.decode(raw)
             return resp.status, (json.loads(raw) if raw else {})
-        raise RuntimeError("unreachable")
 
     @staticmethod
     def _raise_for(code: int, payload: Any) -> None:
@@ -225,7 +289,14 @@ class RestClusterClient:
         rv = payload.get("resourceVersion")
         if rv is None:
             rv = (payload.get("metadata") or {}).get("resourceVersion", 0)
-        return self._items(payload, kind), int(rv)
+        rv = int(rv)
+        with self._rv_lock:
+            last = self._last_rv.get(kind, 0)
+            if rv < last:
+                self.rv_regressions.append((kind, last, rv))
+            else:
+                self._last_rv[kind] = rv
+        return self._items(payload, kind), rv
 
     def _get(self, kind: str, namespace: Optional[str],
              name: str) -> Optional[Any]:
@@ -506,16 +577,21 @@ class RestClusterClient:
                     first = False
                 else:
                     # reflector Replace: a dropped watch lost an
-                    # unknowable window — relisted state replays as
-                    # ADDED (consumers absorb re-adds), and everything
-                    # known that VANISHED becomes a synthetic DELETED
-                    # (DeletedFinalStateUnknown), or the cache schedules
-                    # against phantom nodes forever
-                    live = {key_of(o) for o in objs}
-                    events = [Event(DELETED, kind, obj)
-                              for key, obj in list(known.items())
-                              if key not in live]
-                    events.extend(Event(ADDED, kind, o) for o in objs)
+                    # unknowable window — deliver only the diff against
+                    # what this stream already showed the consumer
+                    # (replace_diff: dedupe unchanged, MODIFIED with
+                    # last-known old, synthetic DELETED for vanished)
+                    from kubernetes_tpu.client.informers import (
+                        replace_diff,
+                    )
+                    from kubernetes_tpu.metrics.fabric_metrics import (
+                        fabric_metrics,
+                    )
+
+                    fabric_metrics().client_relists_total.inc(kind)
+                    events = replace_diff(
+                        kind, dict(known),
+                        {key_of(o): o for o in objs})
                     if events:
                         deliver(events)
                 self._stream_watch(kind, rv, deliver)
@@ -543,6 +619,11 @@ class RestClusterClient:
             resp = conn.getresponse()
             if resp.status != 200:
                 resp.read()
+                if resp.status == 410:
+                    # expired resourceVersion (watch-cache compaction or
+                    # a server restart): the caller's relist IS the
+                    # 410-Gone recovery; count it for observability
+                    self._note_retry("WATCH", "http_410")
                 return
             binary = (resp.headers.get("Content-Type") or "").startswith(
                 codec.BINARY_CONTENT_TYPE)
@@ -557,9 +638,16 @@ class RestClusterClient:
                     line = resp.readline()
                     if not line:
                         return
-                    msg = json.loads(line)
-                    events = [Event(msg["type"], kind,
-                                    from_wire(msg["object"], kind))]
+                    try:
+                        msg = json.loads(line)
+                        obj = from_wire(msg["object"], kind)
+                    except (ValueError, KeyError, TypeError):
+                        # torn frame: the stream was cut mid-line
+                        # (injected truncation, server death) — relist.
+                        # Scoped to PARSING only: a consumer error in
+                        # deliver() must surface, not loop forever.
+                        return
+                    events = [Event(msg["type"], kind, obj)]
                 deliver(events)
         finally:
             try:

@@ -46,8 +46,18 @@ class EventHandlers:
         bind_run = []    # Pods newly assigned (MODIFIED, old unassigned)
         add_run = []     # unassigned schedulable ADDED pods
         delete_run = []  # assigned DELETED pods (mass preemption)
+        node_run = []    # ADDED nodes (relist replay / mass registration)
 
         def flush():
+            if node_run:
+                # a relist replaying N nodes must cost ONE queue wakeup,
+                # not N move-alls over every pending pod
+                for n in node_run:
+                    sched.cache.add_node(n)
+                sched.queue.move_all_to_active_or_backoff_queue(
+                    ev.NODE_ADD
+                )
+                node_run.clear()
             if bind_run:
                 sched.cache.add_pods(bind_run)
                 sched.queue.delete_many(bind_run)
@@ -76,16 +86,17 @@ class EventHandlers:
                 )
                 delete_run.clear()
 
+        runs = (bind_run, add_run, delete_run, node_run)
+
         def run_for(target):
-            if target is not bind_run and bind_run:
-                flush()
-            elif target is not add_run and add_run:
-                flush()
-            elif target is not delete_run and delete_run:
+            if any(r for r in runs if r is not target):
                 flush()
             return target
 
         for event in events:
+            if event.kind == "Node" and event.type == ADDED:
+                run_for(node_run).append(event.obj)
+                continue
             if event.kind == "Pod":
                 pod = event.obj
                 if (

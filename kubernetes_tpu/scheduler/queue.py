@@ -203,11 +203,16 @@ class SchedulingQueue(PodNominator):
         return QueuedPodInfo(pod, timestamp=self._clock.now())
 
     def add_unschedulable_if_not_present(
-        self, qpi: QueuedPodInfo, pod_scheduling_cycle: int
+        self, qpi: QueuedPodInfo, pod_scheduling_cycle: int,
+        prefer_backoff: bool = False,
     ) -> None:
         """Failed-cycle requeue (scheduling_queue.go:297-329): if a move
         request arrived during this pod's scheduling cycle, the cluster may
-        already have changed — send it to backoff instead of unschedulable."""
+        already have changed — send it to backoff instead of unschedulable.
+        ``prefer_backoff`` routes the pod to backoff unconditionally: a
+        cycle that failed on a SCHEDULER error (transport loss, plugin
+        crash) isn't evidence the pod doesn't fit, so it must retry on
+        the backoff curve, not park for the unschedulable timeout."""
         with self._cond:
             key = get_pod_key(qpi.pod)
             if (
@@ -217,7 +222,8 @@ class SchedulingQueue(PodNominator):
             ):
                 raise ValueError(f"pod {key} already present in a queue")
             qpi.timestamp = self._clock.now()
-            if self._move_request_cycle >= pod_scheduling_cycle:
+            if prefer_backoff \
+                    or self._move_request_cycle >= pod_scheduling_cycle:
                 self._backoff_q.add(qpi)
                 if self._metrics:
                     self._metrics.pods_added("backoff", "ScheduleAttemptFailure")

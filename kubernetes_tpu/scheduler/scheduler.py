@@ -16,6 +16,7 @@ an ``IsIgnorable`` extender — SURVEY.md section 5).
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -41,6 +42,8 @@ from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo
 from kubernetes_tpu.utils.clock import RealClock
 
 PLUGIN_METRICS_SAMPLE_PERCENT = 10  # scheduler.go:56
+
+_logger = logging.getLogger("kubernetes_tpu.scheduler")
 
 
 class _Deps:
@@ -103,6 +106,15 @@ class Scheduler:
         self._inflight_zero = threading.Condition(self._inflight_lock)
         self.batch_scheduler = None  # set by kubernetes_tpu.sidecar when gated on
         self._watch_handle = None
+        # degraded mode: set while the client's circuit breaker is open
+        # (apiserver unreachable). Binding pauses — the loop stops
+        # popping — while watch ingestion keeps the cache warm; in-flight
+        # binding cycles fail against the dead server and requeue through
+        # the normal error function. Recovery clears the flag and wakes
+        # every parked pod.
+        self._degraded = threading.Event()
+        self._degraded_since = 0.0
+        self._degraded_lock = threading.Lock()
         self.event_handlers = EventHandlers(self)
         from kubernetes_tpu.client.events import EventRecorder
 
@@ -189,6 +201,11 @@ class Scheduler:
                 self.event_handlers.handle,
                 batch_fn=self.event_handlers.handle_many,
             )
+        # remote clients expose a circuit breaker; wire it to degraded
+        # mode (the in-process store has no transport to lose)
+        set_listener = getattr(self.client, "set_degraded_listener", None)
+        if set_listener is not None:
+            set_listener(self.set_degraded)
         # replay current state (the initial List of ListAndWatch)
         for node in self.client.list_nodes():
             self.cache.add_node(node)
@@ -284,6 +301,36 @@ class Scheduler:
         self.recorder.stop()
         self._bind_pool.shutdown(wait=False)
 
+    # -- degraded mode -------------------------------------------------
+    def is_degraded(self) -> bool:
+        return self._degraded.is_set()
+
+    def set_degraded(self, degraded: bool) -> None:
+        """Flip degraded mode (idempotent; the client's circuit-breaker
+        listener). Entering pauses binding — new pops stop, in-flight
+        binds fail-and-requeue on their own. Leaving accounts the
+        outage into ``degraded_mode_seconds`` and moves every parked
+        pod back to active so recovery is immediate, not
+        backoff-delayed."""
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        with self._degraded_lock:
+            if degraded == self._degraded.is_set():
+                return
+            if degraded:
+                self._degraded_since = time.monotonic()
+                self._degraded.set()
+                fabric_metrics().degraded_mode.set(1.0)
+                return
+            self._degraded.clear()
+            elapsed = time.monotonic() - self._degraded_since
+            fabric_metrics().degraded_mode.set(0.0)
+            fabric_metrics().degraded_mode_seconds.inc(amount=elapsed)
+        # outside the lock: queue wakeup can take the queue lock
+        from kubernetes_tpu.scheduler import events as ev
+
+        self.queue.move_all_to_active_or_backoff_queue(ev.CLIENT_RECOVERED)
+
     def wait_for_inflight_bindings(self, timeout: float = 30.0) -> bool:
         with self._inflight_zero:
             deadline = time.monotonic() + timeout
@@ -317,6 +364,11 @@ class Scheduler:
     def schedule_one(self, pop_timeout: Optional[float] = None) -> bool:
         """One scheduling cycle (scheduler.go:427). Returns False when the
         queue yielded nothing."""
+        if self._degraded.is_set():
+            # circuit open: binding is paused. Don't pop — a popped pod
+            # would only fail its bind and burn a backoff round.
+            time.sleep(min(pop_timeout or 0.05, 0.05))
+            return False
         qpi = self.queue.pop(timeout=pop_timeout)
         if qpi is None:
             return False
@@ -452,10 +504,20 @@ class Scheduler:
                                        pod_scheduling_cycle, start)
         else:
             # binding cycle runs async (scheduler.go:540): the loop continues
-            self._bind_pool.submit(
-                self._binding_cycle, fwk, state, qpi, assumed_pod, result,
-                pod_scheduling_cycle, start,
-            )
+            try:
+                self._bind_pool.submit(
+                    self._binding_cycle, fwk, state, qpi, assumed_pod,
+                    result, pod_scheduling_cycle, start,
+                )
+            except RuntimeError:
+                # pool already shut down (stop() raced a late commit):
+                # release the in-flight slot; the pod's state dies with
+                # this scheduler instance
+                self.metrics.goroutines.dec("binding")
+                with self._inflight_zero:
+                    self._inflight_bindings -= 1
+                    if self._inflight_bindings == 0:
+                        self._inflight_zero.notify_all()
         return False
 
     def commit_assignments_bulk(
@@ -715,9 +777,16 @@ class Scheduler:
         nominated_node = ""
         if fwk.has_post_filter_plugins():
             self.metrics.preemption_attempts.inc()
-            result, status = fwk.run_post_filter_plugins(
-                state, qpi.pod, fit_err.filtered_nodes_statuses
-            )
+            # preemption drives client writes (victim deletes, status);
+            # a transport failure mid-dry-run must still fall through to
+            # record + REQUEUE, not lose the pod
+            try:
+                result, status = fwk.run_post_filter_plugins(
+                    state, qpi.pod, fit_err.filtered_nodes_statuses
+                )
+            except Exception as post_err:  # noqa: BLE001
+                result, status = None, fw.Status(
+                    fw.ERROR, f"PostFilter failed: {post_err}")
             if fw.Status.is_ok(status) and result is not None:
                 nominated_node = result.nominated_node_name
         self._record_failure(fwk, qpi, fit_err, "Unschedulable",
@@ -749,19 +818,44 @@ class Scheduler:
         # the operator-facing record (scheduler.go:331 recordSchedulingFailure
         # → FailedScheduling event)
         self.recorder.event(pod, "Warning", "FailedScheduling", str(err))
-        self.client.patch_pod_condition(
-            pod.namespace, pod.name,
-            PodCondition("PodScheduled", "False", reason, str(err)),
-        )
+        # status writes are BEST-EFFORT: over REST they can fail (server
+        # down, overload pushback, retry budget spent) and an exception
+        # here must never skip the requeue below — a pod dropped between
+        # queues is parked forever, the exact lost-pod failure the chaos
+        # ring exists to catch
+        try:
+            self.client.patch_pod_condition(
+                pod.namespace, pod.name,
+                PodCondition("PodScheduled", "False", reason, str(err)),
+            )
+            if nominated_node:
+                self.client.set_nominated_node_name(pod.namespace,
+                                                    pod.name,
+                                                    nominated_node)
+        except Exception:  # noqa: BLE001 — usually transport loss; a
+            # real defect must still be visible in the logs
+            _logger.debug("status write failed for %s/%s (requeueing "
+                          "regardless)", pod.namespace, pod.name,
+                          exc_info=True)
         if nominated_node:
-            self.client.set_nominated_node_name(pod.namespace, pod.name,
-                                                nominated_node)
             pod.status.nominated_node_name = nominated_node
             self.queue.add_nominated_pod(pod, nominated_node)
-        # requeue only pods that still exist unassigned (factory.go:340)
-        current = self.client.get_pod(pod.namespace, pod.name)
+        # requeue only pods that still exist unassigned (factory.go:340);
+        # when the existence check itself fails, assume the pod lives and
+        # requeue — a later cycle re-checks against recovered state
+        try:
+            current = self.client.get_pod(pod.namespace, pod.name)
+        except Exception:  # noqa: BLE001 — transport failure
+            _logger.debug("existence check failed for %s/%s (assuming "
+                          "it lives)", pod.namespace, pod.name,
+                          exc_info=True)
+            current = pod
         if current is not None and not assigned(current):
             try:
-                self.queue.add_unschedulable_if_not_present(qpi, cycle)
+                # scheduler-internal failures retry on the backoff curve;
+                # only genuine fit failures park for an unblocking event
+                self.queue.add_unschedulable_if_not_present(
+                    qpi, cycle,
+                    prefer_backoff=(reason == "SchedulerError"))
             except ValueError:
                 pass

@@ -243,6 +243,14 @@ class TPUBatchScheduler:
         so single-shot callers see their pods bound in the same call.
         Returns the number of pods worked on this cycle."""
         sched = self.sched
+        if sched.is_degraded():
+            # circuit open: the batch path pauses exactly like the
+            # serial loop — solved-but-uncommitted work stays pending
+            # and commits on the first cycle after recovery. Always
+            # sleep: flush() drives this with pop_timeout=0.0 in a
+            # while-_pending loop, which must not become a busy spin.
+            time.sleep(min(pop_timeout, 0.05) if pop_timeout else 0.01)
+            return 0
         prev = self._pending
         self._pending = None
 
@@ -372,12 +380,16 @@ class TPUBatchScheduler:
         self.session.note_committed(committed, seq_anchor)
         return processed
 
-    def flush(self) -> int:
+    def flush(self, timeout: float = 60.0) -> int:
         """Commit any held solved-but-uncommitted batch (the pipelining
         tail): a run that stops pumping mid-stream must not strand popped
-        pods in ``_pending``. Returns the number of pods processed."""
+        pods in ``_pending``. Returns the number of pods processed.
+        Bounded by ``timeout``: in degraded mode the commit is paused,
+        and a shutdown-path flush must not wait forever on a server
+        that may never come back."""
         total = 0
-        while self._pending is not None:
+        deadline = time.monotonic() + timeout
+        while self._pending is not None and time.monotonic() < deadline:
             total += self.run_batch(pop_timeout=0.0)
         return total
 

@@ -18,3 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 "
+        "(`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection suites (wire-level seeds are "
+        "also marked slow so tier-1 stays fast)")

@@ -1,0 +1,146 @@
+"""Client resilience primitives (client/backoff.py): deterministic
+jittered backoff, retry budget semantics, circuit-breaker transitions,
+and retry_call's original-error contract."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.client.backoff import (
+    Backoff,
+    CircuitBreaker,
+    RetryBudget,
+    retry_call,
+)
+
+
+class TestBackoff:
+    def test_deterministic_under_seeded_rng(self):
+        a = Backoff(base=0.05, factor=2.0, cap=5.0, jitter=0.4,
+                    rng=random.Random(42))
+        b = Backoff(base=0.05, factor=2.0, cap=5.0, jitter=0.4,
+                    rng=random.Random(42))
+        assert [a.delay(i) for i in range(10)] == \
+            [b.delay(i) for i in range(10)]
+
+    def test_jitter_stays_within_bounds(self):
+        bo = Backoff(base=0.1, factor=2.0, cap=3.0, jitter=0.3,
+                     rng=random.Random(7))
+        for attempt in range(12):
+            raw = min(0.1 * 2.0 ** attempt, 3.0)
+            d = bo.delay(attempt)
+            assert raw * 0.7 - 1e-12 <= d <= raw * 1.3 + 1e-12
+            assert d > 0
+
+    def test_no_jitter_is_exact_exponential(self):
+        bo = Backoff(base=0.5, factor=2.0, cap=3.0, jitter=0.0)
+        assert [bo.delay(i) for i in range(4)] == [0.5, 1.0, 2.0, 3.0]
+
+    def test_steps_iterator_matches_delay_sequence(self):
+        bo = Backoff(base=0.1, factor=3.0, cap=10.0, jitter=0.0)
+        steps = bo.steps()
+        assert [next(steps) for _ in range(4)] == \
+            [0.1, pytest.approx(0.3), pytest.approx(0.9),
+             pytest.approx(2.7)]
+
+    def test_rejects_full_jitter(self):
+        # jitter=1.0 could produce a zero delay — a hot retry loop
+        with pytest.raises(ValueError):
+            Backoff(jitter=1.0)
+
+
+class TestRetryBudget:
+    def test_spend_down_then_refuse(self):
+        budget = RetryBudget(budget=3, refill_per_second=0.0)
+        assert [budget.try_spend() for _ in range(4)] == \
+            [True, True, True, False]
+
+    def test_refills_over_time(self):
+        budget = RetryBudget(budget=1, refill_per_second=1000.0)
+        assert budget.try_spend()
+        import time
+
+        time.sleep(0.01)
+        assert budget.try_spend()
+
+
+class TestRetryCall:
+    def test_budget_exhaustion_raises_original_error(self):
+        budget = RetryBudget(budget=2, refill_per_second=0.0)
+        boom = ValueError("the original failure")
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise boom
+
+        with pytest.raises(ValueError) as exc:
+            retry_call(fn, retryable=(ValueError,), budget=budget,
+                       max_attempts=10, sleep=lambda s: None)
+        # the ORIGINAL exception object, not a wrapper
+        assert exc.value is boom
+        # first attempt is free, each RETRY spends a token: 2 retries
+        # land, the 3rd call's failure finds an empty budget and
+        # surfaces immediately
+        assert len(calls) == 3
+
+    def test_max_attempts_raises_original_error(self):
+        boom = OSError("conn reset")
+
+        def fn():
+            raise boom
+
+        with pytest.raises(OSError) as exc:
+            retry_call(fn, max_attempts=3, sleep=lambda s: None)
+        assert exc.value is boom
+
+    def test_sleeps_follow_backoff_sequence(self):
+        bo = Backoff(base=0.1, factor=2.0, cap=5.0, jitter=0.0)
+        slept = []
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            if len(attempts) < 4:
+                raise OSError("flaky")
+            return "ok"
+
+        assert retry_call(fn, backoff=bo, max_attempts=5,
+                          sleep=slept.append) == "ok"
+        assert slept == [0.1, pytest.approx(0.2), pytest.approx(0.4)]
+
+    def test_non_retryable_errors_pass_through(self):
+        def fn():
+            raise KeyError("not transport")
+
+        with pytest.raises(KeyError):
+            retry_call(fn, retryable=(OSError,), sleep=lambda s: None)
+
+
+class TestCircuitBreaker:
+    def test_opens_at_threshold_and_closes_on_success(self):
+        states = []
+        cb = CircuitBreaker(failure_threshold=3, listener=states.append)
+        for _ in range(2):
+            cb.record_failure()
+        assert not cb.is_open and states == []
+        cb.record_failure()
+        assert cb.is_open and states == [True]
+        cb.record_failure()           # already open: no duplicate event
+        assert states == [True]
+        cb.record_success()
+        assert not cb.is_open and states == [True, False]
+
+    def test_success_resets_consecutive_count(self):
+        cb = CircuitBreaker(failure_threshold=2)
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        assert not cb.is_open   # never two CONSECUTIVE failures
+
+    def test_late_listener_replays_current_state(self):
+        cb = CircuitBreaker(failure_threshold=1)
+        cb.record_failure()
+        states = []
+        cb.set_listener(states.append)
+        assert states == [True]

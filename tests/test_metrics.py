@@ -22,6 +22,54 @@ class TestHistogramBulk:
         assert h.count() == 0
 
 
+class TestFabricMetrics:
+    def test_retry_fault_degraded_counters_register_and_expose(self):
+        from kubernetes_tpu.metrics.fabric_metrics import FabricMetrics
+        from kubernetes_tpu.metrics.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        fm = FabricMetrics(reg)
+        fm.client_retries_total.inc("GET", "transport")
+        fm.client_retries_total.inc("GET", "transport")
+        fm.client_retries_total.inc("POST", "http_429")
+        fm.faults_injected_total.inc("reset", "pods")
+        fm.degraded_mode_seconds.inc(amount=1.5)
+        fm.degraded_mode.set(1.0)
+        assert fm.client_retries_total.get("GET", "transport") == 2
+        assert fm.faults_injected_total.get("reset", "pods") == 1
+        assert fm.degraded_mode_seconds.get() == 1.5
+        text = reg.expose()
+        assert 'client_retries_total{verb="GET",reason="transport"} 2' \
+            in text
+        assert 'faults_injected_total{fault="reset",resource="pods"} 1' \
+            in text
+        assert "degraded_mode_seconds 1.5" in text
+        assert "degraded_mode 1.0" in text
+
+    def test_second_instance_shares_series(self):
+        """Server gate + N clients in one process must share counters,
+        not clobber each other's registrations."""
+        from kubernetes_tpu.metrics.fabric_metrics import FabricMetrics
+        from kubernetes_tpu.metrics.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        a = FabricMetrics(reg)
+        a.client_retries_total.inc("GET", "transport")
+        b = FabricMetrics(reg)
+        assert b.client_retries_total is a.client_retries_total
+        b.client_retries_total.inc("GET", "transport")
+        assert a.client_retries_total.get("GET", "transport") == 2
+
+    def test_default_registry_singleton(self):
+        from kubernetes_tpu.metrics import default_registry
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        fm = fabric_metrics()
+        assert fm is fabric_metrics()
+        assert default_registry().get("client_retries_total") \
+            is fm.client_retries_total
+
+
 class TestLazyEvents:
     def test_eventf_defers_formatting_to_flush(self):
         from kubernetes_tpu.apiserver.store import ClusterStore
