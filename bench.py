@@ -112,12 +112,29 @@ def _diagnose(sched, bs) -> None:
     """Per-run solver diagnostics on stderr (kept permanently: when a
     row's p99 blows its budget, the root cause — a slow batch absorbing
     a rebuild/recompile, tunnel stall, chunk collapse — must be readable
-    from the run's own log, not re-derived by a fresh profiling run)."""
+    from the run's own log, not re-derived by a fresh profiling run).
+    Phase breakdowns come from the flight-recorder tracer (the ONE
+    instrumentation layer feeding logs, /metrics, Perfetto dumps and
+    this line), not hand-rolled counters."""
     try:
+        from kubernetes_tpu.observability import get_tracer
+
+        tracer = get_tracer()
         segs = []
-        for key, (_c, total, count) in sorted(
-                sched.metrics.batch_solve_duration._series.items()):
-            segs.append(f"{key[0]}={total:.2f}s/{count}")
+        if tracer.enabled:
+            stats = tracer.phase_stats()
+            for phase in sorted(stats):
+                s = stats[phase]
+                segs.append(f"{phase}={s['total_s']:.2f}s/{s['count']}"
+                            f"~p99 {s['p99_s'] * 1000:.0f}ms")
+        else:
+            # tracer off (e.g. the A/B's off arm): the solver-segment
+            # histogram still holds the breakdown — a blown p99 must be
+            # explainable from this run's log either way
+            segs.append("tracer=off")
+            for key, (_c, total, count) in sorted(
+                    sched.metrics.batch_solve_duration._series.items()):
+                segs.append(f"{key[0]}={total:.2f}s/{count}")
         e2e = sched.metrics.e2e_scheduling_duration
         series = e2e._series.get(("scheduled",))
         buckets = ""
@@ -186,6 +203,13 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
     }
     if repeat > 1:
         row["runs"] = [round(b.pods_per_second, 1) for b in samples]
+    if key == "headline":
+        # provenance for the trace-overhead tracking (--config traceab):
+        # which sampling config this headline number was measured under
+        from kubernetes_tpu.observability import get_tracer
+
+        t = get_tracer()
+        row["trace_sample_rate"] = t.sample_rate if t.enabled else 0.0
     return row
 
 
@@ -235,6 +259,68 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
     return row
 
 
+def run_trace_ab(nodes: int, measure_pods: int, repeat: int = 1) -> dict:
+    """Tracer-on vs tracer-off headline A/B: the observability layer's
+    steady-state overhead, tracked as a BENCH_* row across PRs (the
+    <3% budget is an acceptance bar, so it must be measured, not
+    assumed). Tracer-on uses the DEFAULT sampling config. Modes are
+    INTERLEAVED per round behind one unmeasured warmup run — a blocked
+    on-then-off order would hand all the process warm-state (JIT cache,
+    allocator) to the second mode and misattribute it as tracer cost."""
+    import gc
+
+    from kubernetes_tpu.observability import get_tracer
+
+    def one_run(mode: str):
+        ops = make_workload("SchedulingBasic", nodes=nodes,
+                            init_pods=0, measure_pods=measure_pods)
+        res = run_workload(f"SchedulingBasic/trace-{mode}", ops,
+                           use_batch=True,
+                           max_batch=min(measure_pods, 4096),
+                           wait_timeout=1200, progress=log)
+        gc.collect()
+        return res.pods_per_second
+
+    from kubernetes_tpu.observability.tracer import DEFAULT_SAMPLE_RATE
+
+    tracer = get_tracer()
+    prev_enabled, prev_rate = tracer.enabled, tracer.sample_rate
+    samples = {"on": [], "off": []}
+    try:
+        # the tracked row must measure the DEFAULT sampling config, not
+        # whatever KTPU_TRACE_SAMPLE happens to be live — otherwise the
+        # cross-PR overhead trend compares incomparable configurations
+        tracer.configure(sample_rate=DEFAULT_SAMPLE_RATE)
+        one_run("warm")   # unmeasured: absorbs compile/allocator warmup
+        for r in range(repeat):
+            # alternate the pair order per round: residual warm-state
+            # drift across the run would otherwise always favor the
+            # second arm and bias the tracked overhead number
+            for mode in (("off", "on") if r % 2 == 0 else ("on", "off")):
+                tracer.configure(enabled=(mode == "on"))
+                samples[mode].append(one_run(mode))
+    finally:
+        tracer.configure(enabled=prev_enabled, sample_rate=prev_rate)
+    rates = {}
+    for mode, vals in samples.items():
+        vals.sort()
+        rates[mode] = vals[len(vals) // 2]
+        log(f"[trace-ab] tracer {mode}: {rates[mode]:.1f} pods/s "
+            f"(runs {[round(v, 1) for v in vals]})")
+    overhead_pct = 0.0
+    if rates["off"] > 0:
+        overhead_pct = 100.0 * (1.0 - rates["on"] / rates["off"])
+    return {
+        "metric": f"trace_overhead_pct[SchedulingBasic {nodes}nodes/"
+                  f"{measure_pods}pods, default sampling "
+                  f"1/{round(1 / DEFAULT_SAMPLE_RATE)}]",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "tracer_on_pods_per_sec": round(rates["on"], 1),
+        "tracer_off_pods_per_sec": round(rates["off"], 1),
+    }
+
+
 def measure_serial(name: str, nodes: int, measure_pods: int,
                    serial_pods: int) -> float:
     serial_pods = min(serial_pods, measure_pods)
@@ -252,7 +338,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
-                    + ["rest"])
+                    + ["rest", "traceab"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -275,6 +361,13 @@ def main() -> None:
         if args.quick:
             cmd.append("--quick")
         raise SystemExit(subprocess.run(cmd).returncode)
+
+    if args.config == "traceab":
+        nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
+        print(json.dumps(run_trace_ab(
+            nodes, measure_pods, repeat=1 if args.quick else 3)),
+            flush=True)
+        return
 
     if args.config == "rest":
         nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)

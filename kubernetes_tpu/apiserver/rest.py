@@ -359,6 +359,18 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
+    # -- admin-route registry: the debug control surfaces, declared once
+    # so every cross-cutting policy (FaultGate exemption, lane-slot
+    # exemption, verb dispatch) derives from the same table instead of
+    # hand-rolled path checks per verb handler. All admin routes share
+    # the control-plane trust envelope and must stay reachable while the
+    # server is sick — chaos must not lock out its own controls, and a
+    # full lane must not block the postmortem dump.
+    ADMIN_ROUTES = {
+        "/debug/faults": "_serve_faults_admin",
+        "/debug/trace": "_serve_trace_admin",
+    }
+
     # -- max-in-flight gate (reference apiserver filters/maxinflight.go:
     # separate readonly and mutating lanes; a full lane answers 429 with
     # Retry-After so one hot client cannot starve the control plane).
@@ -367,6 +379,12 @@ class _Handler(BaseHTTPRequestHandler):
     _UNGATED_PATHS = ("/healthz", "/livez", "/readyz")
 
     def _gate(self) -> Optional[threading.Semaphore]:
+        path = self.path.split("?", 1)[0]
+        if path in self.ADMIN_ROUTES:
+            # admin surfaces never consume a lane slot: /debug/trace is
+            # exactly for when the server is overloaded, and /debug/
+            # faults must stay operable mid-chaos
+            return None
         if self.command in ("GET", "HEAD"):
             if "watch=" in self.path:
                 return None      # long-running: never counts against a lane
@@ -381,9 +399,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- fault injection (faults.py FaultGate; the chaos-over-REST
     # middleware). Runs BEFORE the in-flight lanes so an injected reset
     # never consumes a lane slot; health probes, metrics scrapes, and
-    # the fault admin endpoint itself are exempt — chaos must not get
+    # the admin endpoints (ADMIN_ROUTES) are exempt — chaos must not get
     # the server restarted, blind its observers, or lock itself out.
-    _FAULT_EXEMPT = ("/healthz", "/livez", "/readyz", "/debug/faults",
+    _FAULT_EXEMPT = ("/healthz", "/livez", "/readyz",
                      "/metrics", "/metrics/resources")
 
     _sock_aborted = False   # instance flag set by _abort_socket
@@ -413,7 +431,7 @@ class _Handler(BaseHTTPRequestHandler):
         if gate is None or not gate._rules:
             return False
         path = self.path.split("?", 1)[0]
-        if path in self._FAULT_EXEMPT:
+        if path in self._FAULT_EXEMPT or path in self.ADMIN_ROUTES:
             return False
         rule = gate.decide(self.command, resource_of(self.path))
         if rule is None:
@@ -451,8 +469,29 @@ class _Handler(BaseHTTPRequestHandler):
     def _handle_gated(self, inner) -> None:
         if self._inject_fault():
             return
+        tracer = self.server.tracer
+        span = None
+        if tracer is not None and tracer.enabled \
+                and "watch=" not in self.path:
+            # watches are long-running: a span per watch would never
+            # close while the stream lives (upstream's longRunning
+            # exemption, applied to tracing too). Request spans are
+            # 1-in-N sampled at the tracer's rate — an unsampled span
+            # per request would wrap the ring in seconds at bench
+            # request rates and evict the sampled pod traces the
+            # recorder exists to keep.
+            rate = tracer.sample_rate
+            if rate >= 1.0 or (rate > 0.0 and
+                               next(self.server._req_seq)
+                               % max(1, round(1.0 / rate)) == 0):
+                span = tracer.span(f"rest.{self.command}",
+                                   path=self.path.split("?", 1)[0])
         try:
-            self._dispatch_gated(inner)
+            if span is not None:
+                with span:
+                    self._dispatch_gated(inner)
+            else:
+                self._dispatch_gated(inner)
         finally:
             wfile = self.wfile
             if isinstance(wfile, _TruncatingWriter):
@@ -800,6 +839,54 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         self._handle_gated(self._do_GET)
 
+    def _dispatch_admin(self, verb: str) -> bool:
+        """Route an admin path through the ADMIN_ROUTES registry.
+        True = the request was an admin request and has been answered."""
+        handler = self.ADMIN_ROUTES.get(urlparse(self.path).path)
+        if handler is None:
+            return False
+        getattr(self, handler)(verb)
+        return True
+
+    def _serve_trace_admin(self, verb: str) -> None:
+        """/debug/trace: the flight recorder's control surface. GET →
+        Chrome/Perfetto trace_event JSON of the trailing retention
+        window (``?window=SECONDS`` overrides it); DELETE → clear the
+        ring. Same control-plane trust envelope as /debug/faults, and
+        like it exempt from FaultGate and the in-flight lanes (via
+        ADMIN_ROUTES) — the dump must be reachable exactly when the
+        server is sick."""
+        if not self._binary_decode_allowed():
+            self._send_error(403, "Forbidden",
+                             "trace admin requires a control-plane identity")
+            return
+        tracer = self.server.tracer
+        if tracer is None or not tracer.enabled:
+            # KTPU_TRACE=off yields a disabled (never None) tracer: an
+            # explicit 404 beats a 200 empty dump an operator can't
+            # tell apart from "nothing happened in the last 60s"
+            self._send_error(404, "NotFound", "tracing is not enabled")
+            return
+        if verb == "GET":
+            q = {k: v[0] for k, v in
+                 parse_qs(urlparse(self.path).query).items()}
+            window = None
+            if q.get("window"):
+                try:
+                    window = float(q["window"])
+                except ValueError:
+                    self._send_error(400, "BadRequest",
+                                     f"invalid window {q['window']!r}")
+                    return
+            self._send_json(200, tracer.export_perfetto(window))
+            return
+        if verb == "DELETE":
+            tracer.clear()
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+            return
+        self._send_error(405, "MethodNotAllowed",
+                         "/debug/trace supports GET and DELETE")
+
     def _serve_faults_admin(self, verb: str) -> None:
         """/debug/faults: runtime fault-injection control surface.
         GET → config + injection counters; POST/PUT → replace rule set
@@ -819,6 +906,11 @@ class _Handler(BaseHTTPRequestHandler):
             gate.clear()
             self._send_json(200, {"kind": "Status", "status": "Success"})
             return
+        if verb not in ("POST", "PUT"):
+            self._send_error(405, "MethodNotAllowed",
+                             "/debug/faults supports GET, POST, PUT, "
+                             "and DELETE")
+            return
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b"{}"
         try:
@@ -830,8 +922,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_GET(self) -> None:
         u = urlparse(self.path)
-        if u.path == "/debug/faults":
-            self._serve_faults_admin("GET")
+        if self._dispatch_admin("GET"):
             return
         if u.path in ("/healthz", "/livez", "/readyz"):
             body = b"ok"
@@ -1029,6 +1120,20 @@ class _Handler(BaseHTTPRequestHandler):
             "failures": failures,
         })
 
+    def _trace_ingest(self, pods) -> None:
+        """Stamp a ``rest.ingest`` instant event for each SAMPLED pod:
+        the first hop of a pod's causal trace (REST → queue → solve →
+        bind), keyed by pod uid so the scheduler-side spans stitch."""
+        tracer = self.server.tracer
+        if tracer is None or not tracer.enabled:
+            return
+        for p in pods:
+            uid = p.metadata.uid
+            if uid and tracer.sampled(uid):
+                tracer.event(
+                    "rest.ingest", trace=uid,
+                    pod=f"{p.metadata.namespace}/{p.metadata.name}")
+
     def _bulk_create(self, kind: str, ns: Optional[str], body: dict,
                      user: str) -> None:
         """POST a {Kind}List to a collection: per-item admission, bulk
@@ -1062,6 +1167,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 store.create_pods([obj for _, _, obj in admitted])
                 created = len(admitted)
+                self._trace_ingest([obj for _, _, obj in admitted])
                 admitted = []
             except ValueError:
                 # mid-batch duplicate: create_pods inserted nothing
@@ -1073,6 +1179,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 if kind == "Pod":
                     store.create_pod(obj)
+                    self._trace_ingest([obj])
                 else:
                     store.create_object(kind, obj)
                 created += 1
@@ -1091,8 +1198,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_POST)
 
     def _do_POST(self) -> None:
-        if urlparse(self.path).path == "/debug/faults":
-            self._serve_faults_admin("POST")
+        if self._dispatch_admin("POST"):
             return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
@@ -1303,6 +1409,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if allocated_ip is not None:
                     self.server.ip_allocator.release(allocated_ip)
                 raise
+            if kind == "Pod":
+                self._trace_ingest([created])
             self._send_json(201, self._encode(created))
         except AdmissionError as e:
             # admission.run already unwound its own plugins' charges
@@ -1324,8 +1432,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_PUT)
 
     def _do_PUT(self) -> None:
-        if urlparse(self.path).path == "/debug/faults":
-            self._serve_faults_admin("PUT")
+        if self._dispatch_admin("PUT"):
             return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
@@ -1479,6 +1586,8 @@ class _Handler(BaseHTTPRequestHandler):
         (``apiserver/pkg/endpoints/handlers/patch.go``). The patch
         applies to the WIRE shape of the ROUTE's version, so a
         v1beta1 route patches the nested v1beta1 document."""
+        if self._dispatch_admin("PATCH"):
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -1567,8 +1676,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle_gated(self._do_DELETE)
 
     def _do_DELETE(self) -> None:
-        if urlparse(self.path).path == "/debug/faults":
-            self._serve_faults_admin("DELETE")
+        if self._dispatch_admin("DELETE"):
             return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
@@ -1762,6 +1870,16 @@ class APIServer(ThreadingHTTPServer):
         # runtime without a server restart
         self.fault_gate = fault_gate if fault_gate is not None \
             else FaultGate()
+        # flight recorder (observability layer): the process-wide tracer
+        # so an in-process scheduler's spans and this server's request
+        # spans land in ONE ring — /debug/trace then serves the stitched
+        # REST→queue→solve→bind picture
+        from kubernetes_tpu.observability import get_tracer
+
+        self.tracer = get_tracer()
+        import itertools
+
+        self._req_seq = itertools.count()   # 1-in-N request-span sampling
         # self-protection lanes (reference filters/maxinflight.go
         # defaults: --max-requests-inflight 400,
         # --max-mutating-requests-inflight 200); None = unlimited

@@ -130,9 +130,13 @@ def run_workload(
     mesh-sharded planes backend for the multi-chip scaling bench);
     ``result_hook(sched, bs)`` runs after the workload completes, before
     teardown — the scaling bench reads solver-segment histograms there."""
+    from kubernetes_tpu.observability import get_tracer
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
     tune_for_throughput()
+    # fresh flight-recorder window per row: the result_hook's diag line
+    # reads phase stats from the ring, which must describe THIS workload
+    get_tracer().clear()
     store = ClusterStore()
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
     # gang scheduling is first-class in this harness (BASELINE config #5):

@@ -199,11 +199,13 @@ def run_workload_rest(
         BenchmarkResult,
         ThroughputCollector,
     )
+    from kubernetes_tpu.observability import get_tracer
     from kubernetes_tpu.scheduler.scheduler import Scheduler
     from kubernetes_tpu.sidecar import attach_batch_scheduler
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
     tune_for_throughput()
+    get_tracer().clear()   # per-row flight-recorder window (diag source)
     ctx = mp.get_context("spawn")
     wal_dir = tempfile.mkdtemp(prefix="ktpu-wal-") if wal else None
 

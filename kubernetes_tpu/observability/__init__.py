@@ -1,0 +1,17 @@
+"""End-to-end scheduling tracer + in-memory flight recorder.
+
+One instrumentation layer feeding four consumers: structured logs
+(``utils/trace.py`` LogIfLong compat shim), Prometheus histograms
+(``schedtrace_phase_duration_seconds{phase=...}`` on ``/metrics``),
+bench diagnostics (``bench.py``'s ``diag:`` line), and Chrome/Perfetto
+``trace_event`` dumps (``/debug/trace``, degraded-mode entry, crash).
+"""
+
+from kubernetes_tpu.observability.tracer import (
+    Span,
+    Tracer,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = ["Span", "Tracer", "get_tracer", "set_tracer"]

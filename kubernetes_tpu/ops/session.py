@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from kubernetes_tpu.observability import get_tracer
 from kubernetes_tpu.ops.encode import BatchEncoder, EncodedCluster
 from kubernetes_tpu.ops.solver import (
     SolverParams,
@@ -179,6 +180,9 @@ class SolverSession:
         self.incremental_hits = 0
         self.rebuilds = 0
         self.state_only_rebuilds = 0
+        # scheduling-cycle id stamped by the sidecar before each solve so
+        # the per-cycle phase spans correlate with the pods' queue cycles
+        self.trace_cycle = -1
         # optional device profiling (SURVEY.md section 5: JAX profiler /
         # xplane dumps per solve batch): KTPU_PROFILE_DIR starts a trace
         # at the first non-warming solve and stops it after
@@ -291,8 +295,11 @@ class SolverSession:
                     self._cluster.allocatable.shape[1]:
                 self.last_profile_idx = pb.profile_idx
                 self.last_inexpressible = pb.inexpressible
+                t_pack = time.monotonic()
                 ints, floats = pack_podin(pb)
-                self._observe("encode", time.monotonic() - t0)
+                t_done = time.monotonic()
+                self._observe("encode", t_pack - t0, end_mono=t_pack)
+                self._observe("pack", t_done - t_pack, end_mono=t_done)
                 t0 = time.monotonic()
                 handle, self._state = self._active.solve_lazy(
                     self.params, self._static, self._state, ints, floats
@@ -361,8 +368,11 @@ class SolverSession:
         self._static_masks_host = batch.static_masks
         self.last_profile_idx = batch.profile_idx
         self.last_inexpressible = batch.inexpressible
+        t_pack = time.monotonic()
         ints, floats = pack_podin(batch)
-        self._observe("encode", time.monotonic() - t0)
+        t_done = time.monotonic()
+        self._observe("encode", t_pack - t0, end_mono=t_pack)
+        self._observe("pack", t_done - t_pack, end_mono=t_done)
 
         # a demoted backend earns retries of the preferred one FIRST —
         # the state-only fast path below must not starve the cooldown
@@ -511,10 +521,25 @@ class SolverSession:
         self._profiling = False
         self._profile_dir = None
 
-    def _observe(self, segment: str, seconds: float) -> None:
+    def _observe(self, segment: str, seconds: float,
+                 end_mono: Optional[float] = None) -> None:
         if self._warming:
             return
         try:
             self.sched.metrics.batch_solve_duration.observe(seconds, segment)
         except Exception:  # pragma: no cover — metrics must never break solves
+            pass
+        # per-cycle solver phase span (solve.pack/encode/device): the
+        # latency-breakdown backbone the bench diag, /metrics histogram,
+        # and Perfetto dumps all read from. ``end_mono`` places a phase
+        # that ended BEFORE this call correctly on the dump's timeline
+        # (deriving start from observe time would shift it late).
+        try:
+            tracer = get_tracer()
+            if tracer.enabled:
+                end = end_mono if end_mono is not None \
+                    else time.monotonic()
+                tracer.record(f"solve.{segment}", end - seconds, end,
+                              cycle=self.trace_cycle)
+        except Exception:  # pragma: no cover
             pass

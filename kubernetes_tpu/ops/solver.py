@@ -256,7 +256,10 @@ def pack_podin(batch) -> Tuple[np.ndarray, np.ndarray]:
     Every device buffer upload pays the full host↔device round-trip
     latency (~tens of ms over a TPU tunnel), so shipping ten small
     arrays costs more than the solve — two packed buffers amortize it.
-    Unpacked on device by ``_unpack_podin`` (slicing fuses for free)."""
+    Unpacked on device by ``_unpack_podin`` (slicing fuses for free).
+    Timed by the CALLER (SolverSession observes the ``pack`` phase):
+    warming solves must stay out of the measured series, and only the
+    session knows whether a solve is warming."""
     b = batch.requests.shape[0]
     valid = np.zeros(b, dtype=bool)
     valid[: batch.num_real_pods] = True

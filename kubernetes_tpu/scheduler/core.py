@@ -60,34 +60,40 @@ class GenericScheduler:
     ) -> ScheduleResult:
         """Reference Schedule (generic_scheduler.go:97-146). Raises FitError
         when no node fits."""
-        trace = Trace("Scheduling", pod=pod.full_name())
-        self.update_snapshot()
-        trace.step("Snapshotting scheduler cache and node infos done")
-        if self.snapshot.num_nodes() == 0:
-            raise fw.FitError(pod=pod, num_all_nodes=0)
+        trace = Trace("Scheduling", pod=pod.full_name(), uid=pod.uid)
+        # finally, not just the success exits: a FitError attempt is
+        # exactly the slow, retried case a postmortem wants to see —
+        # it must still reach the threshold log and the flight recorder
+        try:
+            self.update_snapshot()
+            trace.step("Snapshotting scheduler cache and node infos done")
+            if self.snapshot.num_nodes() == 0:
+                raise fw.FitError(pod=pod, num_all_nodes=0)
 
-        feasible, statuses = self.find_nodes_that_fit_pod(state, fwk, pod)
-        trace.step("Computing predicates done")
-        if not feasible:
-            raise fw.FitError(
-                pod=pod,
-                num_all_nodes=self.snapshot.num_nodes(),
-                filtered_nodes_statuses=statuses,
-            )
-        if len(feasible) == 1:
+            feasible, statuses = self.find_nodes_that_fit_pod(state, fwk,
+                                                              pod)
+            trace.step("Computing predicates done")
+            if not feasible:
+                raise fw.FitError(
+                    pod=pod,
+                    num_all_nodes=self.snapshot.num_nodes(),
+                    filtered_nodes_statuses=statuses,
+                )
+            if len(feasible) == 1:
+                return ScheduleResult(
+                    feasible[0].node.name,
+                    self.snapshot.num_nodes(),
+                    1,
+                )
+
+            priority_list = self.prioritize_nodes(state, fwk, pod, feasible)
+            trace.step("Prioritizing done")
+            host = self.select_host(priority_list)
+            trace.step("Selecting host done")
+            return ScheduleResult(host, self.snapshot.num_nodes(),
+                                  len(feasible))
+        finally:
             trace.log_if_long(0.1)
-            return ScheduleResult(
-                feasible[0].node.name,
-                self.snapshot.num_nodes(),
-                1,
-            )
-
-        priority_list = self.prioritize_nodes(state, fwk, pod, feasible)
-        trace.step("Prioritizing done")
-        host = self.select_host(priority_list)
-        trace.step("Selecting host done")
-        trace.log_if_long(0.1)
-        return ScheduleResult(host, self.snapshot.num_nodes(), len(feasible))
 
     # ------------------------------------------------------------------
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:

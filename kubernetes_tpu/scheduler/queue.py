@@ -16,6 +16,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.observability import get_tracer
 from kubernetes_tpu.scheduler.heap import Heap
 from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo, get_pod_key
 from kubernetes_tpu.utils.clock import RealClock
@@ -234,6 +235,23 @@ class SchedulingQueue(PodNominator):
             self.add_nominated_pod(qpi.pod)
             self._cond.notify_all()
 
+    def _trace_popped(self, items: List[QueuedPodInfo]) -> None:
+        """Record a ``queue.wait`` span (enqueue → pop) for each SAMPLED
+        popped pod — the second hop of a pod's causal trace. Runs
+        OUTSIDE the queue lock. BOTH endpoints come from the queue
+        clock: qpi.timestamp was stamped by it, so the end must be too
+        (monotonic under RealClock; under an injected FakeClock mixing
+        in time.monotonic() would record hours-long garbage spans)."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        now = self._clock.now()
+        for qpi in items:
+            uid = qpi.pod.uid
+            if uid and tracer.sampled(uid):
+                tracer.record("queue.wait", qpi.timestamp, now, trace=uid,
+                              attempts=qpi.attempts)
+
     def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
         """Blocks until a pod is available (scheduling_queue.go:379-399)."""
         with self._cond:
@@ -245,7 +263,8 @@ class SchedulingQueue(PodNominator):
             qpi: QueuedPodInfo = self._active_q.pop()
             qpi.attempts += 1
             self.scheduling_cycle += 1
-            return qpi
+        self._trace_popped((qpi,))
+        return qpi
 
     def pop_batch(self, max_n: int, timeout: Optional[float] = None,
                   ) -> Tuple[List[QueuedPodInfo], int]:
@@ -279,7 +298,8 @@ class SchedulingQueue(PodNominator):
                 qpi.attempts += 1
             first_cycle = self.scheduling_cycle + 1
             self.scheduling_cycle += len(items)
-            return items, first_cycle
+        self._trace_popped(items)
+        return items, first_cycle
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         with self._cond:

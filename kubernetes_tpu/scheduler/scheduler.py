@@ -28,6 +28,7 @@ from kubernetes_tpu.apiserver.store import ClusterStore
 from kubernetes_tpu.config.feature_gates import FeatureGates
 from kubernetes_tpu.config.types import KubeSchedulerConfiguration
 from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.observability import get_tracer
 from kubernetes_tpu.scheduler.cache import SchedulerCache
 from kubernetes_tpu.scheduler.core import GenericScheduler, ScheduleResult
 from kubernetes_tpu.scheduler.eventhandlers import EventHandlers, assigned
@@ -321,11 +322,21 @@ class Scheduler:
                 self._degraded_since = time.monotonic()
                 self._degraded.set()
                 fabric_metrics().degraded_mode.set(1.0)
-                return
-            self._degraded.clear()
-            elapsed = time.monotonic() - self._degraded_since
-            fabric_metrics().degraded_mode.set(0.0)
-            fabric_metrics().degraded_mode_seconds.inc(amount=elapsed)
+            else:
+                self._degraded.clear()
+                elapsed = time.monotonic() - self._degraded_since
+                fabric_metrics().degraded_mode.set(0.0)
+                fabric_metrics().degraded_mode_seconds.inc(amount=elapsed)
+        if degraded:
+            # outside the lock (dump is disk I/O — a recovery flip must
+            # not wait on it): postmortem-before-the-mortem — degraded
+            # entry means the apiserver is unreachable and a crash may
+            # follow, so flush the flight recorder NOW (best-effort)
+            tracer = get_tracer()
+            tracer.event("sched.degraded_enter")
+            if tracer.enabled and len(tracer):
+                tracer.dump(reason="degraded", min_interval_s=5.0)
+            return
         # outside the lock: queue wakeup can take the queue lock
         from kubernetes_tpu.scheduler import events as ev
 
@@ -641,10 +652,12 @@ class Scheduler:
             else:
                 bulk.append(item)
         if bulk:
+            t_bind = time.monotonic()
             statuses = fwk.run_bind_plugins_bulk(
                 [i[5] for i in bulk], [i[4] for i in bulk],
                 [i[1].suggested_host for i in bulk],
             )
+            get_tracer().record("bind.bulk", t_bind, pods=len(bulk))
             bound: List[Pod] = []
             observed: List[tuple] = []
             for item, status in zip(bulk, statuses):
@@ -674,6 +687,12 @@ class Scheduler:
         self.metrics.pod_scheduling_duration.observe(
             now - qpi.initial_attempt_timestamp, str(qpi.attempts))
         pod = qpi.pod
+        tracer = get_tracer()
+        if tracer.enabled and pod.uid and tracer.sampled(pod.uid):
+            # the bind-completing hop of the pod's causal trace:
+            # pop → algorithm/solve → commit → bound
+            tracer.record("sched.bind", start, now, trace=pod.uid,
+                          node=node_name, attempts=qpi.attempts)
         self.recorder.eventf(
             pod, "Normal", "Scheduled",
             "Successfully assigned %s/%s to %s",
@@ -702,6 +721,13 @@ class Scheduler:
                 now - qpi.initial_attempt_timestamp)
         for attempts, values in by_attempts.items():
             m.pod_scheduling_duration.observe_many(values, str(attempts))
+        tracer = get_tracer()
+        if tracer.enabled:
+            for qpi, start, node_name in observed:
+                uid = qpi.pod.uid
+                if uid and tracer.sampled(uid):
+                    tracer.record("sched.bind", start, now, trace=uid,
+                                  node=node_name, attempts=qpi.attempts)
         recorder = self.recorder
         for qpi, _, node_name in observed:
             pod = qpi.pod

@@ -312,6 +312,9 @@ class TPUBatchScheduler:
         committed = 0
         seq_anchor = sched.cache.mutation_seq
         if batchable:
+            # correlate this batch's solver phase spans with its pods'
+            # scheduling cycles (the flight recorder's cycle id)
+            self.session.trace_cycle = batchable[0][1]
             try:
                 res = self.session.solve(
                     [q.pod for q, _ in batchable], lazy=True,
@@ -688,6 +691,17 @@ class TPUBatchScheduler:
                 )
         now = time.monotonic()
         sched.metrics.batch_solve_duration.observe(now - t0, "commit")
+        try:
+            from kubernetes_tpu.observability import get_tracer
+
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.record("solve.commit", t0, now,
+                              pods=len(batchable), committed=committed,
+                              cycle=batchable[0][1] if batchable else -1,
+                              pad=pending.get("pad", self.max_batch))
+        except Exception:   # noqa: BLE001 — tracing must not break commits
+            pass
         self.max_cycle_s = max(self.max_cycle_s, now - start)
         self._tune_chunk(pending.get("pad", self.max_batch), now - start)
         return committed

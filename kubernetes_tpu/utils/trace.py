@@ -1,6 +1,20 @@
 """Step-span tracing (re-implementation of the vendored
 ``k8s.io/utils/trace`` used at ``generic_scheduler.go:98-104``): spans with
-steps, logged only when total duration exceeds a threshold."""
+steps, logged only when total duration exceeds a threshold.
+
+Since the observability layer landed this is a thin compat shim over
+``kubernetes_tpu.observability.Tracer``: every ``Trace`` records a real
+span (with its steps as instant events) into the flight recorder, so
+``log_if_long`` callers keep their threshold-gated log line AND the same
+data shows up in ``/debug/trace`` Perfetto dumps.
+
+Step-delta fix: steps are sorted by timestamp before deltas are
+computed. Helper code can append steps out of order (a sub-call stamped
+its step before the caller stamped an earlier one), and the old
+previous-APPENDED-step accounting then reported negative or wildly
+inflated deltas after long gaps; chronological order is the only
+ordering under which "+Nms" is the true time between adjacent steps.
+"""
 
 from __future__ import annotations
 
@@ -18,18 +32,51 @@ class Trace:
         self.start = time.monotonic()
         self.steps: List[Tuple[float, str]] = []
         self._logged = False
+        self._recorded = False
 
     def step(self, msg: str) -> None:
         self.steps.append((time.monotonic(), msg))
 
+    def _record_span(self, end: float) -> None:
+        """Fold this trace onto the flight recorder (once). Pod-scoped
+        traces key by UID — the same trace id every other hop uses, so
+        the serial scheduling span stitches into the pod's causal trace
+        — and are HEAD-SAMPLED like every other per-pod span (the serial
+        path creates a Trace per pod; unsampled recording would flood
+        the ring and take the histogram lock per pod). Traces with no
+        uid (rare, not per-pod) record unconditionally."""
+        if self._recorded:
+            return
+        self._recorded = True
+        try:
+            from kubernetes_tpu.observability import get_tracer
+
+            tracer = get_tracer()
+            if not tracer.enabled:
+                return
+            uid = str(self.fields.get("uid", ""))
+            if uid and not tracer.sampled(uid):
+                return
+            tracer.record(f"trace.{self.name}", self.start, end,
+                          trace=uid, steps=len(self.steps),
+                          pod=str(self.fields.get("pod", "")))
+            for ts, msg in self.steps:
+                tracer.event(f"step.{msg}", trace=uid, at_mono=ts)
+        except Exception:   # pragma: no cover — shim must never raise
+            pass
+
     def log_if_long(self, threshold: float) -> None:
-        total = time.monotonic() - self.start
+        now = time.monotonic()
+        self._record_span(now)
+        total = now - self.start
         if total < threshold:
             return
         self._logged = True
         parts = [f'"{self.name}" {self.fields} total={total * 1000:.1f}ms']
         prev = self.start
-        for ts, msg in self.steps:
+        # chronological order, not append order: deltas between adjacent
+        # steps are only meaningful when the timestamps are sorted
+        for ts, msg in sorted(self.steps):
             parts.append(f"  step {msg}: +{(ts - prev) * 1000:.1f}ms")
             prev = ts
         logger.info("\n".join(parts))
@@ -38,4 +85,5 @@ class Trace:
         return self
 
     def __exit__(self, *exc):
+        self._record_span(time.monotonic())
         return False

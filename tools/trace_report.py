@@ -1,0 +1,153 @@
+"""Flight-recorder dump analyzer.
+
+Ingests a Chrome/Perfetto ``trace_event`` JSON dump (produced by
+``/debug/trace``, a degraded-mode entry, or ``Tracer.dump``) and prints:
+
+- a per-phase latency-breakdown table (count, total, p50, p99 per span
+  name), and
+- the top-N slowest pods (by end-to-end trace extent) with their span
+  trees, indented by containment.
+
+Usage::
+
+    python tools/trace_report.py dump.json [--top 5]
+
+Also invoked as a smoke check from the slow-marker bench-path test
+(``tests/test_tracer.py``) so a dump-format regression fails fast, before
+a postmortem needs it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a trace_event dump "
+                         "(no traceEvents array)")
+    for ev in events:
+        for field in ("ph", "ts", "pid", "tid"):
+            if field not in ev:
+                raise ValueError(
+                    f"{path}: malformed event (missing {field!r}): {ev}")
+    return events
+
+
+def phase_table(events: List[dict]) -> str:
+    durs: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev["ph"] != "X":
+            continue
+        durs.setdefault(ev["name"], []).append(ev.get("dur", 0.0) / 1000.0)
+    lines = [f"{'phase':<24}{'count':>8}{'total_ms':>12}"
+             f"{'p50_ms':>10}{'p99_ms':>10}"]
+    for name in sorted(durs):
+        vals = sorted(durs[name])
+        lines.append(
+            f"{name:<24}{len(vals):>8}{sum(vals):>12.1f}"
+            f"{_percentile(vals, 0.50):>10.2f}"
+            f"{_percentile(vals, 0.99):>10.2f}")
+    return "\n".join(lines)
+
+
+def _pod_traces(events: List[dict]) -> Dict[str, List[dict]]:
+    """trace id (pod uid) -> that pod's events, chronological."""
+    by_trace: Dict[str, List[dict]] = {}
+    for ev in events:
+        trace = (ev.get("args") or {}).get("trace")
+        if trace:
+            by_trace.setdefault(trace, []).append(ev)
+    for evs in by_trace.values():
+        evs.sort(key=lambda e: e["ts"])
+    return by_trace
+
+
+def _span_tree(evs: List[dict]) -> List[str]:
+    """Indent spans by time containment (instant events at their
+    position). ``evs`` must be chronological."""
+    out: List[str] = []
+    open_spans: List[dict] = []   # stack of enclosing X spans
+    for ev in evs:
+        start = ev["ts"]
+        while open_spans and \
+                open_spans[-1]["ts"] + open_spans[-1].get("dur", 0) < start:
+            open_spans.pop()
+        indent = "  " * len(open_spans)
+        if ev["ph"] == "X":
+            dur_ms = ev.get("dur", 0.0) / 1000.0
+            out.append(f"{indent}{ev['name']}  {dur_ms:.2f}ms")
+            open_spans.append(ev)
+        else:
+            out.append(f"{indent}@ {ev['name']}")
+    return out
+
+
+def slowest_pods(events: List[dict], top: int = 5) -> str:
+    by_trace = _pod_traces(events)
+    extents = []
+    for trace, evs in by_trace.items():
+        t0 = min(e["ts"] for e in evs)
+        t1 = max(e["ts"] + e.get("dur", 0.0) for e in evs)
+        extents.append((t1 - t0, trace, evs))
+    extents.sort(reverse=True)
+    lines: List[str] = []
+    for extent_us, trace, evs in extents[:top]:
+        pod = next((e["args"].get("pod") for e in evs
+                    if e.get("args", {}).get("pod")), "")
+        node = next((e["args"].get("node") for e in evs
+                     if e.get("args", {}).get("node")), "")
+        head = f"pod {trace}"
+        if pod:
+            head += f" ({pod})"
+        if node:
+            head += f" -> {node}"
+        lines.append(f"{head}  e2e {extent_us / 1000.0:.2f}ms")
+        lines.extend("  " + ln for ln in _span_tree(evs))
+    return "\n".join(lines) if lines else "(no pod-level traces in dump)"
+
+
+def report(path: str, top: int = 5) -> str:
+    events = load_events(path)
+    spans = sum(1 for e in events if e["ph"] == "X")
+    pods = len(_pod_traces(events))
+    return "\n".join([
+        f"flight-recorder dump: {path}",
+        f"{len(events)} events, {spans} spans, {pods} pod traces",
+        "",
+        "== per-phase latency breakdown ==",
+        phase_table(events),
+        "",
+        f"== top-{top} slowest pods ==",
+        slowest_pods(events, top),
+    ])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dump", help="path to a flight-recorder JSON dump")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many slowest pods to show")
+    args = ap.parse_args(argv)
+    try:
+        print(report(args.dump, top=args.top))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
