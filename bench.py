@@ -154,7 +154,23 @@ def _diagnose(sched, bs) -> None:
                     f"chunk={bs._chunk} "
                     f"max_cycle={bs.max_cycle_s:.2f}s "
                     f"pad_warms={bs.pad_warms}")
-        log(f"    diag: {' '.join(segs)}{sess}{buckets}")
+        # node-churn segment, only when churn actually happened this
+        # process (chaos_nodes harness / a churn-enabled run): the
+        # eviction/stale-reject/rescue numbers explain a degraded row
+        # the same way the session counters explain a slow one
+        churn = ""
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        fm = fabric_metrics()
+        evictions = sum(v for _, _, v in fm.node_evictions_total.collect())
+        stale = sum(
+            v for _, _, v in fm.stale_binds_rejected_total.collect())
+        if evictions or stale:
+            p99 = fm.pod_rescue_seconds.quantile(0.99)
+            churn = (f" churn[evictions={evictions:.0f} "
+                     f"stale_rejected={stale:.0f} "
+                     f"rescue_p99={p99 * 1000:.0f}ms]")
+        log(f"    diag: {' '.join(segs)}{sess}{churn}{buckets}")
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 

@@ -22,6 +22,7 @@ scheduling path actually feels:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -409,7 +410,6 @@ class EventRateLimit(AdmissionPlugin):
     def __init__(self, qps: float = 50.0, burst: int = 100):
         import threading
         import time as _time
-        from collections import OrderedDict
 
         self.qps = qps
         self.burst = burst

@@ -14,11 +14,16 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-from kubernetes_tpu.api.types import Node, PodCondition, Taint
+from kubernetes_tpu.api.types import (
+    TAINT_NODE_UNREACHABLE,
+    Node,
+    PodCondition,
+    Taint,
+)
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.utils.clock import RealClock
 
-UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+UNREACHABLE_TAINT = TAINT_NODE_UNREACHABLE
 
 
 class NodeLifecycleController(Controller):
@@ -75,13 +80,18 @@ class NodeLifecycleController(Controller):
         for node in self.node_lister.list():
             # a node that has never heartbeated gets the full grace period
             # from first observation (reference grants
-            # nodeMonitorGracePeriod from node creation)
+            # nodeMonitorGracePeriod from node creation). The lease
+            # OUTLIVES node deletion, so "never heartbeated" must mean
+            # "not since THIS incarnation registered" — a node deleted
+            # and recreated under the same name (flap re-registration)
+            # would otherwise inherit the old incarnation's stale renew
+            # time and be tainted/evicted on the first monitor tick.
             first_seen = self._first_seen.setdefault(node.name, now)
-            fresh = (
-                self._lease_fresh(node.name, now)
-                or (self.store.lease_info(f"node-{node.name}") is None
-                    and now - first_seen <= self.grace_period)
-            )
+            fresh = self._lease_fresh(node.name, now)
+            if not fresh:
+                info = self.store.lease_info(f"node-{node.name}")
+                if info is None or info[1] <= first_seen:
+                    fresh = now - first_seen <= self.grace_period
             if fresh:
                 if node.name in self._not_ready_since:
                     del self._not_ready_since[node.name]
@@ -131,6 +141,9 @@ class NodeLifecycleController(Controller):
         return new
 
     def _evict_pods(self, node: Node) -> None:
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        evicted = 0
         for pod in self.pod_lister.list():
             if pod.spec.node_name != node.name:
                 continue
@@ -139,6 +152,10 @@ class NodeLifecycleController(Controller):
                    for t in pod.spec.tolerations):
                 continue  # tolerates unreachable forever (e.g. daemons)
             self.store.delete_pod(pod.namespace, pod.name)
+            evicted += 1
+        if evicted:
+            fabric_metrics().node_evictions_total.inc(
+                "unreachable", amount=evicted)
 
     def sync(self, key: str) -> None:  # queue unused; monitor loop drives
         pass

@@ -62,11 +62,18 @@ class PodGCController(Controller):
             for p in terminated[:excess]:
                 self.store.delete_pod(p.namespace, p.name)
 
+        orphaned = 0
         for p in pods:
             # gcOrphaned: bound to a node that no longer exists
             if p.spec.node_name and p.spec.node_name not in nodes:
                 self.store.delete_pod(p.namespace, p.name)
+                orphaned += 1
             # gcUnscheduledTerminating
             elif not p.spec.node_name and \
                     p.metadata.deletion_timestamp is not None:
                 self.store.delete_pod(p.namespace, p.name)
+        if orphaned:
+            from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+            fabric_metrics().node_evictions_total.inc(
+                "orphaned", amount=orphaned)

@@ -15,13 +15,25 @@ Three series matter operationally:
 - ``degraded_mode_seconds`` — cumulative wall-clock the scheduler spent
   with binding paused because its client's circuit breaker was open
   (plus a 0/1 ``degraded_mode`` gauge for live dashboards).
+
+The node-churn resilience layer (harness/chaos_nodes.py) adds three:
+``node_evictions_total{reason}`` (pods deleted off unreachable or
+vanished nodes), ``stale_binds_rejected_total{path}`` (commit-time
+guards refusing an assignment whose target node died, was cordoned, or
+went unreachable between solve and commit), and ``pod_rescue_seconds``
+(eviction → replacement-bound latency through the rescue pipeline).
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from kubernetes_tpu.metrics.registry import Counter, Gauge, MetricsRegistry
+from kubernetes_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
 
 
 def _counter(registry: MetricsRegistry, name: str, help_text: str,
@@ -38,6 +50,17 @@ def _gauge(registry: MetricsRegistry, name: str, help_text: str,
     if isinstance(existing, Gauge):
         return existing
     return registry.register(Gauge(name, help_text, labels))
+
+
+def _histogram(registry: MetricsRegistry, name: str, help_text: str,
+               labels=(), buckets=None) -> Histogram:
+    existing = registry.get(name)
+    if isinstance(existing, Histogram):
+        return existing
+    if buckets is None:
+        return registry.register(Histogram(name, help_text, labels))
+    return registry.register(
+        Histogram(name, help_text, labels, buckets=buckets))
 
 
 class FabricMetrics:
@@ -77,6 +100,29 @@ class FabricMetrics:
             "Full relists performed by watch clients after a dropped "
             "stream or an expired resourceVersion",
             ("kind",),
+        )
+        # -- node-churn resilience (harness/chaos_nodes.py) ------------
+        self.node_evictions_total = _counter(
+            registry, "node_evictions_total",
+            "Pods evicted off dead nodes, by reason (unreachable = "
+            "nodelifecycle grace expiry, orphaned = pod bound to a "
+            "node that no longer exists)",
+            ("reason",),
+        )
+        self.stale_binds_rejected_total = _counter(
+            registry, "stale_binds_rejected_total",
+            "Solved assignments refused at commit time because the "
+            "target node was deleted, cordoned, or unreachable-tainted "
+            "between snapshot and commit, by rejecting path "
+            "(batch = sidecar pre-commit, bulk = commit_assignments_bulk, "
+            "serial = per-pod commit)",
+            ("path",),
+        )
+        self.pod_rescue_seconds = _histogram(
+            registry, "pod_rescue_seconds",
+            "Eviction-to-rescheduled latency: time from a workload pod "
+            "being deleted off a dead node to its replacement being "
+            "bound somewhere live",
         )
 
 

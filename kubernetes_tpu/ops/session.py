@@ -171,6 +171,12 @@ class SolverSession:
         self.last_profile_idx = None     # [B] int32
         self.last_inexpressible = None   # [B] bool
         self._last_seq: int = -1
+        # node-SET epoch the resident encoding was built over. The
+        # mutation arithmetic alone can be laundered by compensating
+        # bumps; an encoding whose node columns describe another epoch
+        # (chaos_nodes: mass node death) must rebuild, not keep
+        # declining/misassigning against ghost nodes.
+        self._node_epoch: int = -1
         self._poisoned = False
         self._warming = False
         # materializer for the LAST lazy solve's handle (None when the
@@ -238,6 +244,18 @@ class SolverSession:
         self._last_seq = -1
         self._poisoned = True
 
+    def note_drift(self) -> None:
+        """Snapshot-drift trigger (chaos_nodes): a commit-time guard
+        just refused assignments because their target nodes died, were
+        cordoned, or went unreachable after this encoding was built.
+        Beyond invalidating, drop the static fingerprint — the NODE
+        PLANES themselves are what drifted, so the next rebuild must
+        re-encode and re-upload the static arrays rather than take the
+        state-only path and keep solving against ghost columns (the
+        mass-decline spin this exists to break)."""
+        self.invalidate()
+        self._static_fp = None
+
     def mirror_current(self) -> bool:
         """True when the device mirror is still consistent with the host
         cache RIGHT NOW (no unsanctioned mutations since it was last
@@ -246,6 +264,7 @@ class SolverSession:
         return (
             not self._poisoned
             and self._last_seq == self.sched.cache.mutation_seq
+            and self._node_epoch == self.sched.cache.node_set_seq
         )
 
     def note_committed(self, expected_mutations: int, seq_before: int) -> None:
@@ -288,7 +307,8 @@ class SolverSession:
         self._profile_tick()
         pad = pad_to or self.max_batch
         seq_before = self.sched.cache.mutation_seq
-        if self._state is not None and seq_before == self._last_seq:
+        if self._state is not None and seq_before == self._last_seq \
+                and self._node_epoch == self.sched.cache.node_set_seq:
             t0 = time.monotonic()
             pb = self._encoder.encode_pods_only(pods, pad)
             if pb is not None and pb.requests.shape[1] == \
@@ -356,6 +376,10 @@ class SolverSession:
             self.rebuilds += 1
         self._poisoned = False
         t0 = time.monotonic()
+        # captured BEFORE the snapshot refresh: a node-set change that
+        # races the rebuild bumps mutation_seq too, so the next solve
+        # re-validates either way
+        self._node_epoch = self.sched.cache.node_set_seq
         self.sched.algorithm.update_snapshot()
         self._encoder = BatchEncoder(
             self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes,

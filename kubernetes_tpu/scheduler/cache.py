@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
-from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.api.types import TAINT_NODE_UNREACHABLE, Node, Pod
 from kubernetes_tpu.scheduler.node_tree import NodeTree
 from kubernetes_tpu.scheduler.snapshot import Snapshot
 from kubernetes_tpu.scheduler.types import (
@@ -101,6 +101,14 @@ class SchedulerCache:
         # nodeName) change nothing the device mirror tracks, so they do
         # not bump it.
         self._mutation_seq = 0
+        # Counter of node-SET changes only (a node appearing or
+        # vanishing, not updates). The solver session anchors its
+        # encoded node planes to this: mutation_seq arithmetic can be
+        # laundered by compensating bumps, but an encoding built over a
+        # node set from another epoch must never serve the incremental
+        # path (chaos_nodes: mass deletion must force a re-encode, not
+        # a spin of declines against ghost columns).
+        self._node_set_seq = 0
         self._nodes: Dict[str, _NodeInfoListItem] = {}
         self._head: Optional[_NodeInfoListItem] = None
         self._node_tree = NodeTree()
@@ -154,6 +162,35 @@ class SchedulerCache:
     def mutation_seq(self) -> int:
         with self._lock:
             return self._mutation_seq
+
+    @property
+    def node_set_seq(self) -> int:
+        with self._lock:
+            return self._node_set_seq
+
+    def commit_target_flags(self, names) -> Dict[str, Optional[Node]]:
+        """Commit-time liveness probe for a batch of bind targets: ONE
+        lock acquisition for the whole batch, set lookups per name.
+        Returns ONLY the suspect entries — ``name -> None`` when the
+        node is gone from the cache (deleted, or never seen), ``name ->
+        Node`` when it exists but is cordoned or carries taints the
+        commit guard must test against the pod's tolerations. Names
+        absent from the result are fully bindable. The common no-churn
+        batch returns an empty dict, so the guard costs O(1) per commit
+        and nothing allocates on the happy path."""
+        flagged: Dict[str, Optional[Node]] = {}
+        with self._lock:
+            for name in names:
+                item = self._nodes.get(name)
+                node = item.info.node if item is not None else None
+                if node is None:
+                    flagged[name] = None
+                elif node.spec.unschedulable or any(
+                    t.key == TAINT_NODE_UNREACHABLE
+                    for t in node.spec.taints
+                ):
+                    flagged[name] = node
+        return flagged
 
     def note_external_mutation(self) -> None:
         """Record a state change the cache itself doesn't track (PV /
@@ -319,6 +356,8 @@ class SchedulerCache:
         with self._lock:
             self._mutation_seq += 1
             item = self._ensure_node(node.name)
+            if item.info.node is None:
+                self._node_set_seq += 1
             self._remove_node_image_states(item.info.node)
             item.info.set_node(node)
             self._add_node_image_states(node, item.info)
@@ -342,6 +381,8 @@ class SchedulerCache:
             if item is None:
                 return
             self._mutation_seq += 1
+            if item.info.node is not None:
+                self._node_set_seq += 1
             item.info.remove_node()
             self._remove_node_image_states(node)
             # keep the entry while pods remain (they'll be removed by events)

@@ -23,7 +23,16 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
-from kubernetes_tpu.api.types import Pod, PodCondition, shallow_copy
+from kubernetes_tpu.api.types import (
+    NO_SCHEDULE,
+    TAINT_NODE_UNREACHABLE,
+    TAINT_NODE_UNSCHEDULABLE,
+    Node,
+    Pod,
+    PodCondition,
+    Taint,
+    shallow_copy,
+)
 from kubernetes_tpu.apiserver.store import ClusterStore
 from kubernetes_tpu.config.feature_gates import FeatureGates
 from kubernetes_tpu.config.types import KubeSchedulerConfiguration
@@ -45,6 +54,29 @@ from kubernetes_tpu.utils.clock import RealClock
 PLUGIN_METRICS_SAMPLE_PERCENT = 10  # scheduler.go:56
 
 _logger = logging.getLogger("kubernetes_tpu.scheduler")
+
+
+def commit_target_stale(pod: Pod, node: Optional[Node]) -> Optional[str]:
+    """Commit-time stale-node verdict for one (pod, flagged node) pair:
+    the reason string when binding ``pod`` there would bind into the
+    void, None when the pod may proceed (e.g. it tolerates the taint).
+    ``node`` comes from ``SchedulerCache.commit_target_flags`` — None
+    means the node vanished from the cache between snapshot and commit.
+    Only called for flagged nodes, so the toleration scans here are off
+    the no-churn hot path entirely."""
+    if node is None:
+        return "deleted"
+    tolerations = pod.spec.tolerations
+    if node.spec.unschedulable:
+        cordon = Taint(TAINT_NODE_UNSCHEDULABLE, "", NO_SCHEDULE)
+        if not any(t.tolerates(cordon) for t in tolerations):
+            return "cordoned"
+    for taint in node.spec.taints:
+        if taint.key != TAINT_NODE_UNREACHABLE:
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return "unreachable"
+    return None
 
 
 class _Deps:
@@ -476,6 +508,19 @@ class Scheduler:
         callers that must know (the batch session's device-state
         accounting) use sync_bind."""
         pod = qpi.pod
+        # stale-node guard (chaos_nodes): the algorithm ran against a
+        # snapshot that may predate a node death/cordon/unreachable
+        # taint — binding there would bind into the void (the store
+        # accepts binds to nonexistent nodes). One cache probe per
+        # commit; requeue through the normal error function.
+        flagged = self.cache.commit_target_flags((result.suggested_host,))
+        if flagged:
+            reason = commit_target_stale(pod, flagged[result.suggested_host])
+            if reason is not None:
+                self._reject_stale_commit(
+                    fwk, qpi, result.suggested_host, reason, "serial",
+                    pod_scheduling_cycle)
+                return False
         # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
         assumed_pod = shallow_copy(pod)
         assumed_pod.spec = shallow_copy(pod.spec)
@@ -544,9 +589,32 @@ class Scheduler:
         async binding cycle exactly as in the serial path.
 
         ``commits``: list of (qpi, result, cycle, start). Returns
-        (committed, failed) where failed counts pods that were assumed
-        but then rejected host-side (the caller's device-mirror
-        accounting needs to know)."""
+        (committed, failed) where failed counts pods that were rejected
+        host-side after the device counted them (the caller's
+        device-mirror accounting needs to know)."""
+        # --- stale-node guard (chaos_nodes): ONE cache probe for the
+        # whole batch; assignments targeting nodes that died, were
+        # cordoned, or went unreachable since the solve are refused
+        # before assume and requeued — never bound into the void.
+        flagged = self.cache.commit_target_flags(
+            {r.suggested_host for _, r, _, _ in commits}
+        ) if commits else {}
+        stale_failed = 0
+        if flagged:
+            live_commits: List[tuple] = []
+            for item in commits:
+                qpi, result, cycle, _start = item
+                node = flagged.get(result.suggested_host, False)
+                reason = commit_target_stale(qpi.pod, node) \
+                    if node is not False else None
+                if reason is None:
+                    live_commits.append(item)
+                else:
+                    self._reject_stale_commit(
+                        fwk, qpi, result.suggested_host, reason, "bulk",
+                        cycle)
+                    stale_failed += 1
+            commits = live_commits
         # --- assume (bulk): share the queue's parse via PodInfo.derived
         prepared: List[tuple] = []
         assumed_pods: List[Pod] = []
@@ -567,7 +635,7 @@ class Scheduler:
             else:
                 self._record_failure(fwk, item[0], ValueError(err),
                                      "SchedulerError", "", item[2])
-        failed = len(prepared) - len(live)
+        failed = stale_failed + len(prepared) - len(live)
 
         # --- Reserve + Permit (per-pod hook contract)
         has_reserve = bool(fwk.reserve_plugins)
@@ -834,6 +902,25 @@ class Scheduler:
         if gang is not None:
             gang.unreserve_group(assumed_pod)
         self._forget_and_fail(fwk, state, qpi, assumed_pod, result, err, cycle)
+
+    def _reject_stale_commit(self, fwk: Framework, qpi: QueuedPodInfo,
+                             node_name: str, reason: str, path: str,
+                             cycle: int) -> None:
+        """Refuse to commit an assignment whose target node went stale
+        between snapshot and commit: count it, then route the pod back
+        through the normal error function (SchedulerError → backoff
+        requeue; the next attempt solves against the post-churn
+        state)."""
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        fabric_metrics().stale_binds_rejected_total.inc(path)
+        _logger.debug("refusing stale bind of %s/%s to %s node %s (%s)",
+                      qpi.pod.namespace, qpi.pod.name, reason, node_name,
+                      path)
+        err = RuntimeError(
+            f"commit target node {node_name!r} is {reason} "
+            f"(assignment solved against a stale snapshot)")
+        self._record_failure(fwk, qpi, err, "SchedulerError", "", cycle)
 
     def _record_failure(self, fwk: Framework, qpi: QueuedPodInfo,
                         err: Exception, reason: str, nominated_node: str,

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 _logger = logging.getLogger(__name__)
 
@@ -38,7 +38,7 @@ from kubernetes_tpu.ops.session import SolverSession
 from kubernetes_tpu.ops.solver import SolverParams
 from kubernetes_tpu.scheduler.core import ScheduleResult
 from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
-from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.scheduler import Scheduler, commit_target_stale
 from kubernetes_tpu.scheduler.types import PodInfo, QueuedPodInfo
 
 
@@ -525,6 +525,17 @@ class TPUBatchScheduler:
         declined: List[tuple] = []  # (batch index, qpi, cycle)
         commits: List[tuple] = []   # (qpi, result, cycle, start)
         vol_binder = _CommitVolumeBinder(sched.client)
+        # stale-node guard (chaos_nodes): ONE cache probe for every
+        # distinct target in this batch. The solve ran against an
+        # encoding that may predate node churn; assignments whose node
+        # has since died / been cordoned / gone unreachable route to
+        # the serial path for a fresh verdict, and the session is told
+        # the node planes drifted so the next solve re-encodes instead
+        # of spinning mass declines against ghost columns.
+        stale_flags = sched.cache.commit_target_flags(
+            {cluster.node_names[int(a)] for a in assignments if a >= 0}
+        )
+        stale_routed = 0
         for bi, ((qpi, cycle), assignment) in enumerate(
             zip(batchable, assignments)
         ):
@@ -532,6 +543,12 @@ class TPUBatchScheduler:
                 declined.append((bi, qpi, cycle))
                 continue
             node_name = cluster.node_names[assignment]
+            flag = stale_flags.get(node_name, False)
+            if flag is not False and \
+                    commit_target_stale(qpi.pod, flag) is not None:
+                stale_routed += 1
+                serial.append(qpi)
+                continue
             if self.validate and not self._host_validates(fwk, qpi, node_name):
                 # the device state counts this pod but the host refused it
                 self.session.invalidate()
@@ -551,6 +568,14 @@ class TPUBatchScheduler:
                 feasible_nodes=1,
             )
             commits.append((qpi, result, cycle, start))
+        if stale_routed:
+            from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+            fabric_metrics().stale_binds_rejected_total.inc(
+                "batch", amount=stale_routed)
+            # the device counted these pods onto nodes that are gone:
+            # static planes drifted, force a full re-encode
+            self.session.note_drift()
         if commits:
             committed, failed = sched.commit_assignments_bulk(fwk, commits)
             if failed:

@@ -1,0 +1,75 @@
+"""Package-wide unresolved-annotation smoke check (ISSUE 3 satellite).
+
+``from __future__ import annotations`` makes every annotation lazy, so
+a name used in an annotation but never imported (the
+``self._pools: Dict[str, list]`` bug in sidecar.py) parses fine and
+never fails at import — PEP 526 attribute annotations aren't even
+stored, so ``typing.get_type_hints`` can't see them either. This test
+walks each module's AST, collects EVERY annotation expression
+(variable/attribute annotations, parameters, returns), and evaluates
+it in the module's namespace: an annotation naming something the
+module never imports fails here instead of in a consumer that forces
+resolution (dataclass tooling, debuggers, docs generators).
+"""
+
+import ast
+import importlib
+import pkgutil
+
+import kubernetes_tpu
+
+
+def _iter_modules():
+    prefix = kubernetes_tpu.__name__ + "."
+    for info in pkgutil.walk_packages(kubernetes_tpu.__path__, prefix):
+        if info.name.endswith(".__main__"):
+            continue   # importing a CLI entry point runs it
+        yield info.name
+
+
+def _annotation_nodes(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            yield node.annotation
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            yield node.annotation
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.returns is not None:
+            yield node.returns
+
+
+def _eval_annotation(node, namespace):
+    expr = node
+    # quoted forward refs: evaluate the string's CONTENT
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        expr = ast.parse(node.value, mode="eval").body
+        expr = ast.copy_location(expr, node)
+        ast.fix_missing_locations(expr)
+    code = compile(ast.Expression(body=expr), "<annotation>", "eval")
+    eval(code, namespace)  # noqa: S307 — our own source, CI-only
+
+
+def test_every_annotation_in_the_package_resolves():
+    failures = []
+    for name in _iter_modules():
+        try:
+            mod = importlib.import_module(name)
+        except Exception:  # noqa: BLE001 — optional deps (native .so)
+            continue
+        source_file = getattr(mod, "__file__", None)
+        if not source_file or not source_file.endswith(".py"):
+            continue
+        with open(source_file, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=source_file)
+        namespace = dict(vars(mod))
+        for node in _annotation_nodes(tree):
+            try:
+                _eval_annotation(node, namespace)
+            except NameError as e:
+                failures.append(f"{name}:{node.lineno}: {e}")
+            except Exception:  # noqa: BLE001 — only unresolved NAMES
+                pass           # (e.g. subscripting a mock) are the bug
+    assert not failures, (
+        "unresolved annotations (missing imports under "
+        "`from __future__ import annotations`):\n" + "\n".join(failures)
+    )

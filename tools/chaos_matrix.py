@@ -1,17 +1,25 @@
 #!/usr/bin/env python
-"""Run the seeded chaos-over-REST fault matrix and print a pass/fail
-table (the CI face of ``kubernetes_tpu.harness.chaos_rest``).
+"""Run the seeded chaos matrices and print a pass/fail table (the CI
+face of ``kubernetes_tpu.harness.chaos_rest`` and ``chaos_nodes``).
 
-Each cell is one ``run_chaos_rest`` invocation: a seeded fault profile
-armed through /debug/faults, an apiserver SIGKILL + WAL-restore restart
-mid-workload, and the chaos invariants (all bound exactly once, no
-oversubscription, WAL == live, no resourceVersion regression) checked
-after quiescence.
+Two suites:
+
+- ``rest`` — wire-level: a seeded fault profile armed through
+  /debug/faults, an apiserver SIGKILL + WAL-restore restart
+  mid-workload, invariants (all bound exactly once, no
+  oversubscription, WAL == live, no resourceVersion regression)
+  checked after quiescence.
+- ``nodes`` — node churn: a seeded injector kills/flaps/cordons/taints
+  nodes while the workload streams in over REST, with the
+  nodelifecycle controller evicting and the rescue pipeline
+  recreating; invariants (no binds to dead nodes, no lost pods,
+  cache == store after quiesce) plus rescue-latency p99 per cell.
 
 Usage::
 
-    python tools/chaos_matrix.py                      # default matrix
-    python tools/chaos_matrix.py --seeds 11,23 --profiles mixed,resets
+    python tools/chaos_matrix.py                      # both suites
+    python tools/chaos_matrix.py --suite nodes --churn mixed,killer
+    python tools/chaos_matrix.py --suite rest --seeds 11,23 -v
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -28,14 +36,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _run_suite(args, progress, rows, suite: str, run_fn,
+               profile_kw: str, profiles) -> None:
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    for profile in profiles:
+        for seed in seeds:
+            t0 = time.monotonic()
+            try:
+                r = run_fn(seed, nodes=args.nodes, pods=args.pods,
+                           wait_timeout=args.wait_timeout,
+                           progress=progress, **{profile_kw: profile})
+            except Exception as e:  # noqa: BLE001 — a crashed run is a FAIL row
+                r = {"seed": seed, "profile": profile, "ok": False,
+                     "failure": f"{type(e).__name__}: {e}", "stats": {}}
+            r["suite"] = suite
+            r["elapsed"] = time.monotonic() - t0
+            rows.append(r)
+            status = "PASS" if r["ok"] else "FAIL"
+            print(f"  [{status}] {suite}/{profile}/seed={seed} "
+                  f"({r['elapsed']:.1f}s)", flush=True)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
-        description="seeded chaos-over-REST matrix")
+        description="seeded chaos matrices (wire faults + node churn)")
+    parser.add_argument("--suite", default="both",
+                        choices=("rest", "nodes", "both"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
-                        help="comma-separated fault profiles "
+                        help="rest-suite fault profiles "
                              "(mixed,resets,pushback,watchstorm)")
+    parser.add_argument("--churn", default="mixed",
+                        help="nodes-suite churn profiles "
+                             "(mixed,killer,flappy,gentle)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -43,56 +77,52 @@ def main() -> int:
                         help="stream per-run progress")
     args = parser.parse_args()
 
-    # keep the scheduler on the CPU mesh: the matrix measures the wire,
-    # not the solver
+    # keep the scheduler on the CPU mesh: the matrix measures the
+    # fabric and the churn, not the solver
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from kubernetes_tpu.harness.chaos_rest import (
-        FAULT_PROFILES,
-        run_chaos_rest,
-    )
+    from kubernetes_tpu.harness.chaos_rest import FAULT_PROFILES
+    from kubernetes_tpu.harness.chaos_nodes import CHURN_PROFILES
 
-    seeds = [int(s) for s in args.seeds.split(",") if s]
-    profiles = [p for p in args.profiles.split(",") if p]
-    for p in profiles:
-        if p not in FAULT_PROFILES:
-            parser.error(f"unknown profile {p!r} "
+    for p in args.profiles.split(","):
+        if p and p not in FAULT_PROFILES:
+            parser.error(f"unknown fault profile {p!r} "
                          f"(have: {', '.join(sorted(FAULT_PROFILES))})")
+    for p in args.churn.split(","):
+        if p and p not in CHURN_PROFILES:
+            parser.error(f"unknown churn profile {p!r} "
+                         f"(have: {', '.join(sorted(CHURN_PROFILES))})")
+
+    from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
+    from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
 
     progress = print if args.verbose else None
     rows = []
-    failed = 0
-    for profile in profiles:
-        for seed in seeds:
-            t0 = time.monotonic()
-            try:
-                r = run_chaos_rest(
-                    seed, nodes=args.nodes, pods=args.pods,
-                    fault_profile=profile,
-                    wait_timeout=args.wait_timeout, progress=progress)
-            except Exception as e:  # noqa: BLE001 — a crashed run is a FAIL row
-                r = {"seed": seed, "profile": profile, "ok": False,
-                     "failure": f"{type(e).__name__}: {e}", "stats": {}}
-            r["elapsed"] = time.monotonic() - t0
-            rows.append(r)
-            if not r["ok"]:
-                failed += 1
-            status = "PASS" if r["ok"] else "FAIL"
-            print(f"  [{status}] {profile}/seed={seed} "
-                  f"({r['elapsed']:.1f}s)", flush=True)
+    if args.suite in ("rest", "both"):
+        _run_suite(args, progress, rows, "rest", run_chaos_rest,
+                   "fault_profile",
+                   [p for p in args.profiles.split(",") if p])
+    if args.suite in ("nodes", "both"):
+        _run_suite(args, progress, rows, "nodes", run_chaos_nodes,
+                   "churn_profile",
+                   [p for p in args.churn.split(",") if p])
 
-    head = (f"{'profile':<12} {'seed':>5} {'result':<6} {'faults':>7} "
-            f"{'retries':>8} {'degraded_s':>10} {'time':>7}  failure")
+    failed = sum(1 for r in rows if not r["ok"])
+    head = (f"{'suite':<6} {'profile':<10} {'seed':>5} {'result':<6} "
+            f"{'faults':>7} {'retries':>8} {'evict':>6} {'rescue_p99':>10} "
+            f"{'time':>7}  failure")
     print()
     print(head)
     print("-" * len(head))
     for r in rows:
         s = r.get("stats") or {}
-        print(f"{r['profile']:<12} {r['seed']:>5} "
+        rescue_p99 = s.get("rescue_p99_s")
+        print(f"{r['suite']:<6} {r['profile']:<10} {r['seed']:>5} "
               f"{'PASS' if r['ok'] else 'FAIL':<6} "
               f"{s.get('faults_injected', '-'):>7} "
               f"{s.get('client_retries', '-'):>8} "
-              f"{s.get('degraded_seconds', '-'):>10} "
+              f"{s.get('evictions', '-'):>6} "
+              f"{(f'{rescue_p99:.3f}s' if rescue_p99 is not None else '-'):>10} "
               f"{r['elapsed']:>6.1f}s  {r.get('failure', '')}")
     print(f"\n{len(rows) - failed}/{len(rows)} cells passed")
     return 1 if failed else 0
