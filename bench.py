@@ -170,7 +170,25 @@ def _diagnose(sched, bs) -> None:
             churn = (f" churn[evictions={evictions:.0f} "
                      f"stale_rejected={stale:.0f} "
                      f"rescue_p99={p99 * 1000:.0f}ms]")
-        log(f"    diag: {' '.join(segs)}{sess}{churn}{buckets}")
+        # autoscaler segment, only when the elastic layer acted this
+        # process (the autoscale row / an elastic chaos run): scale
+        # events + time-to-capacity explain an elastic row's tail the
+        # way the churn numbers explain a degraded one
+        autoscale = ""
+        from kubernetes_tpu.metrics.autoscaler_metrics import (
+            autoscaler_metrics,
+        )
+
+        am = autoscaler_metrics()
+        ups = sum(v for _, _, v in am.scaleups_total.collect())
+        downs = sum(v for _, _, v in am.scaledowns_total.collect())
+        if ups or downs:
+            ttc = am.time_to_capacity_seconds.quantile(0.99)
+            autoscale = (
+                f" autoscaler[nodes_up={ups:.0f} nodes_down={downs:.0f} "
+                f"pending={am.pending_unschedulable.get():.0f} "
+                f"ttc_p99={ttc:.1f}s]")
+        log(f"    diag: {' '.join(segs)}{sess}{churn}{autoscale}{buckets}")
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -354,7 +372,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
-                    + ["rest", "traceab"])
+                    + ["rest", "traceab", "autoscale"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -383,6 +401,23 @@ def main() -> None:
         print(json.dumps(run_trace_ab(
             nodes, measure_pods, repeat=1 if args.quick else 3)),
             flush=True)
+        return
+
+    if args.config == "autoscale":
+        # the elastic row: start at 20% of needed capacity, burst to
+        # 30k pods, let the autoscaler buy the rest — pods/s and
+        # time-to-all-bound INCLUDE capacity acquisition
+        from kubernetes_tpu.harness.elastic import run_autoscale_bench
+
+        if args.quick:
+            row = run_autoscale_bench(burst=1000, node_cpu=16,
+                                      boot_latency=0.2, max_batch=1024,
+                                      wait_timeout=300, progress=log)
+        else:
+            row = run_autoscale_bench(burst=30000, node_cpu=32,
+                                      boot_latency=1.0, max_batch=4096,
+                                      wait_timeout=1800, progress=log)
+        print(json.dumps(row), flush=True)
         return
 
     if args.config == "rest":

@@ -1,0 +1,58 @@
+"""Cluster autoscaler: node groups, solver-simulated scale-up,
+drain-based scale-down.
+
+The elastic layer over the batched scheduling core: ``nodegroups``
+holds the templates + the simulated cloud provisioner, ``simulator``
+recasts upstream's per-pod scheduler simulation as virtual node
+COLUMNS in the encoded pod×node planes (one batched solve per group
+instead of one per pod), and ``controller`` is the leader-electable
+RunOnce loop wiring trigger → expander → provision → drain.
+
+Lazy exports (PEP 562): ``simulator`` transitively imports the jax
+solver, and ``controller`` pulls the whole controllers package; the
+eager surface is just ``nodegroups`` (api types only), so light
+importers — ``harness/burst.py`` reading one annotation constant, the
+REST harness's jax-free creator/apiserver children — pay for neither a
+device backend nor the controller-manager import graph.
+"""
+
+from kubernetes_tpu.autoscaler.nodegroups import (
+    NODE_GROUP_LABEL,
+    SAFE_TO_EVICT_ANNOTATION,
+    NodeGroup,
+    NodeGroupRegistry,
+    SimulatedProvisioner,
+)
+
+__all__ = [
+    "ClusterAutoscaler",
+    "EXPANDERS",
+    "NODE_GROUP_LABEL",
+    "NodeGroup",
+    "NodeGroupRegistry",
+    "SAFE_TO_EVICT_ANNOTATION",
+    "ScaleUpOption",
+    "ScaleUpPlan",
+    "SimulatedProvisioner",
+    "plan_scale_up",
+    "pods_fit_elsewhere",
+    "run_whatif",
+    "scale_up_option",
+]
+
+_SIMULATOR_EXPORTS = (
+    "EXPANDERS", "ScaleUpOption", "ScaleUpPlan", "plan_scale_up",
+    "pods_fit_elsewhere", "run_whatif", "scale_up_option",
+)
+
+
+def __getattr__(name):
+    if name == "ClusterAutoscaler":
+        from kubernetes_tpu.autoscaler.controller import ClusterAutoscaler
+
+        return ClusterAutoscaler
+    if name in _SIMULATOR_EXPORTS:
+        from kubernetes_tpu.autoscaler import simulator
+
+        return getattr(simulator, name)
+    raise AttributeError(name)

@@ -1,0 +1,432 @@
+"""The cluster-autoscaler control loop.
+
+Behavioral equivalent of the reference cluster-autoscaler's
+``core/static_autoscaler.go`` RunOnce: each tick (1) collects the
+unschedulable trigger set (scheduling-queue leftovers when a queue is
+attached, plus pods carrying a FailedScheduling/Unschedulable
+condition), (2) if anything is pending and the scale-up cooldown has
+passed, runs ONE batched what-if solve per candidate node group
+(``simulator.plan_scale_up`` — virtual template-node columns appended
+to the encoded planes, NOT a per-pod loop), lets the expander
+(least-waste | priority) choose a group, and provisions the read-off
+node count within the group's max size; (3) when nothing is pending,
+scans the cluster for scale-down candidates — group nodes below the
+utilization threshold whose pods all fit elsewhere (the same virtual-
+solve machinery with the candidate's column REMOVED) — and, after
+``scale_down_unneeded_time`` of continuous unneededness, drives the
+drain pipeline: cordon → PDB-respecting eviction (consulting the
+disruption controller's published ``status.disruptions_allowed``) →
+node deletion once empty.
+
+The loop rides the shared controller scaffolding (tick → workqueue →
+worker) and is leader-electable via ``run_with_leader_election`` (the
+reference deploys one replica with lease-based leader election).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Node, Pod
+from kubernetes_tpu.autoscaler.nodegroups import (
+    NodeGroupRegistry,
+    SAFE_TO_EVICT_ANNOTATION,
+    SimulatedProvisioner,
+)
+from kubernetes_tpu.controllers.base import Controller, controller_of
+from kubernetes_tpu.metrics.autoscaler_metrics import autoscaler_metrics
+from kubernetes_tpu.scheduler.types import (
+    compute_pod_resource_request,
+    get_pod_key,
+)
+
+
+class ClusterAutoscaler(Controller):
+    name = "clusterautoscaler"
+    workers = 1
+    RESYNC_SECONDS = 0.25           # reference --scan-interval (10s), scaled
+
+    # -- knobs (class-level so harnesses override like nodelifecycle's)
+    expander = "least-waste"        # or "priority"
+    scale_up_cooldown = 2.0         # min seconds between scale-up decisions
+    max_virtual_per_group = 64      # K cap per what-if solve
+    max_whatif_pods = 2048          # pending-set sample cap per solve
+    scale_down_enabled = True
+    scale_down_utilization_threshold = 0.5   # max(cpu,mem) requested frac
+    scale_down_unneeded_time = 3.0  # reference --scale-down-unneeded-time
+    max_concurrent_drains = 1
+    pending_age_backstop = 3.0      # store-scan fallback trigger age (s)
+
+    def __init__(self, store, factory,
+                 registry: Optional[NodeGroupRegistry] = None,
+                 provisioner: Optional[SimulatedProvisioner] = None):
+        self.registry = registry if registry is not None \
+            else NodeGroupRegistry()
+        self.provisioner = provisioner if provisioner is not None \
+            else SimulatedProvisioner(store, self.registry)
+        # optional SchedulingQueue: when the scheduler is colocated, its
+        # unschedulableQ IS the trigger surface (exact, no heuristics)
+        self.queue_introspect = None
+        self.metrics = autoscaler_metrics()
+        self.whatif_solves = 0      # batched solves issued (test hook)
+        self.scale_up_events = 0
+        self.scale_down_events = 0
+        self.elector = None
+        self._last_scale_up = 0.0
+        self._pending_first_seen: Optional[float] = None
+        self._unneeded_since: Dict[str, float] = {}
+        self._draining: Dict[str, str] = {}   # node name -> group name
+        # persistent eviction ledger per PDB: [resource_version, used].
+        # status.disruptions_allowed lags our deletions by a disruption-
+        # controller resync; without remembering what this loop already
+        # spent against the OBSERVED status generation, consecutive
+        # passes would re-read the stale budget and over-evict. A status
+        # recompute bumps the PDB's resourceVersion, resetting the entry.
+        self._pdb_spent: Dict[str, list] = {}
+        super().__init__(store, factory)
+
+    # -- controller scaffolding ----------------------------------------
+    def register(self) -> None:
+        # tick-driven (the reference CA polls on --scan-interval); no
+        # event handlers — the what-if reads store truth each pass
+        pass
+
+    def resync(self) -> None:
+        self.enqueue_key("reconcile")
+
+    def sync(self, key: str) -> None:
+        self.reconcile_once()
+
+    def run(self) -> None:
+        self.provisioner.start()
+        super().run()
+
+    def stop(self) -> None:
+        super().stop()
+        self.provisioner.stop()
+        if self.elector is not None:
+            self.elector.stop()
+
+    def run_with_leader_election(
+        self, identity: str = "cluster-autoscaler-0",
+        lease_name: str = "cluster-autoscaler",
+        lease_duration: float = 15.0, renew_deadline: float = 10.0,
+        retry_period: float = 2.0, clock=None,
+    ):
+        """Only the lease holder runs the loop (one elastic brain per
+        cluster — two concurrent autoscalers would double-provision).
+        Losing the lease stops this instance for good, mirroring the
+        scheduler's fatal-on-deposed posture."""
+        from kubernetes_tpu.client.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+
+        cfg = LeaderElectionConfig(
+            lock_name=lease_name, identity=identity,
+            lease_duration=lease_duration, renew_deadline=renew_deadline,
+            retry_period=retry_period,
+            on_started_leading=self.run,
+            on_stopped_leading=self._on_lost_lease,
+        )
+        self.elector = LeaderElector(self.store, cfg, clock=clock)
+        self.elector.run_in_thread()
+        return self.elector
+
+    def _on_lost_lease(self) -> None:
+        if not self._stopped:
+            self.stop()
+
+    # -- the reconcile pass --------------------------------------------
+    def reconcile_once(self) -> None:
+        if not len(self.registry):
+            # default-registered in every ControllerManager: with no
+            # groups there is nothing to scale, so don't pay the
+            # per-tick store scan (or publish a bogus pending gauge)
+            return
+        now = time.monotonic()
+        # ONE pod-list snapshot per tick: the elastic bench runs this
+        # loop at 10 Hz beside a 30k-pod scheduler, and each extra
+        # store scan is GIL time stolen from the bind path
+        pods = self.store.list_pods()
+        self._continue_drains(pods)
+        pending = self.pending_unschedulable(pods)
+        self.metrics.pending_unschedulable.set(float(len(pending)))
+        if pending:
+            if self._pending_first_seen is None:
+                self._pending_first_seen = now
+            if now - self._last_scale_up >= self.scale_up_cooldown:
+                self._scale_up(pods, pending, now)
+        else:
+            if self._pending_first_seen is not None:
+                self.metrics.time_to_capacity_seconds.observe(
+                    now - self._pending_first_seen)
+                self._pending_first_seen = None
+            if self.scale_down_enabled:
+                self._scale_down(pods, now)
+
+    # -- trigger surface -----------------------------------------------
+    def pending_unschedulable(self,
+                              pods: Optional[List[Pod]] = None) -> List[Pod]:
+        """Queue leftovers + FailedScheduling outcomes: the pods whose
+        existence justifies buying nodes. Bound, terminal and
+        terminating pods never count; without queue introspection an
+        age backstop catches pods the scheduler never got to."""
+        out: Dict[str, Pod] = {}
+        q = self.queue_introspect
+        if q is not None:
+            # same liveness filters as the store scan: a pod deleted or
+            # bound in the store lingers in the queue until the informer
+            # event lands, and must not trigger (or keep alive) a solve
+            for pod in q.unschedulable_pods():
+                if pod.spec.node_name or \
+                        pod.metadata.deletion_timestamp is not None or \
+                        pod.status.phase in (SUCCEEDED, FAILED):
+                    continue
+                out[get_pod_key(pod)] = pod
+        now_wall = time.time()
+        for pod in (pods if pods is not None else self.store.list_pods()):
+            if pod.spec.node_name or \
+                    pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.status.phase in (SUCCEEDED, FAILED):
+                continue
+            key = get_pod_key(pod)
+            if key in out:
+                continue
+            if any(c.type == "PodScheduled" and c.status == "False"
+                   and c.reason == "Unschedulable"
+                   for c in pod.status.conditions):
+                out[key] = pod
+            elif q is None and pod.metadata.creation_timestamp and \
+                    now_wall - pod.metadata.creation_timestamp \
+                    >= self.pending_age_backstop:
+                out[key] = pod
+        return list(out.values())
+
+    # -- scale-up -------------------------------------------------------
+    @staticmethod
+    def _live_bound_pods(pods: List[Pod]) -> List[Pod]:
+        return [
+            p for p in pods
+            if p.spec.node_name and p.status.phase not in (SUCCEEDED, FAILED)
+            and p.metadata.deletion_timestamp is None
+        ]
+
+    def _scale_up(self, pods: List[Pod], pending: List[Pod],
+                  now: float) -> None:
+        # lazy: the simulator pulls in the jax solver, which jax-free
+        # processes constructing (but never scaling) this controller
+        # must not pay for
+        from kubernetes_tpu.autoscaler.simulator import plan_scale_up
+
+        groups = []
+        for group in self.registry:
+            headroom = group.max_size - self.provisioner.group_size(
+                group.name)
+            if headroom > 0:
+                groups.append((group, headroom))
+        if not groups:
+            return
+        # upcoming BEFORE the node list: a node registering between the
+        # two reads then shows up twice (harmless — upcoming columns
+        # only absorb pods) instead of in neither (a re-buy)
+        upcoming = self.provisioner.booting_templates()
+        plan = plan_scale_up(
+            self.store.list_nodes(), self._live_bound_pods(pods), pending,
+            groups, expander=self.expander,
+            upcoming=upcoming,
+            max_virtual=self.max_virtual_per_group,
+            max_pods=self.max_whatif_pods,
+        )
+        self.whatif_solves += plan.solves
+        # the cooldown gates plan ATTEMPTS, not just purchases: a
+        # pending pod no group can help would otherwise re-run a full
+        # encode + solve per group every tick, forever
+        self._last_scale_up = now
+        best = plan.chosen
+        if best is None or best.nodes_needed <= 0:
+            return
+        group = self.registry.get(best.group)
+        self.provisioner.provision(group, best.nodes_needed)
+        self.scale_up_events += 1
+        self.metrics.scaleups_total.inc(
+            best.group, self.expander, amount=best.nodes_needed)
+
+    # -- scale-down -----------------------------------------------------
+    @staticmethod
+    def _drainable(pod: Pod) -> bool:
+        """Upstream refuses to delete nodes holding pods nothing will
+        recreate, unless the pod opts in via the safe-to-evict
+        annotation."""
+        if controller_of(pod) is not None:
+            return True
+        return pod.metadata.annotations.get(
+            SAFE_TO_EVICT_ANNOTATION) == "true"
+
+    @staticmethod
+    def _utilization(node: Node, pods: List[Pod]) -> float:
+        alloc = node.status.allocatable
+        cpu_alloc = int(alloc["cpu"].milli_value()) if "cpu" in alloc else 0
+        mem_alloc = int(alloc["memory"].value()) if "memory" in alloc else 0
+        cpu_used = mem_used = 0
+        for p in pods:
+            r = compute_pod_resource_request(p)
+            cpu_used += r.milli_cpu
+            mem_used += r.memory
+        fracs = []
+        if cpu_alloc:
+            fracs.append(cpu_used / cpu_alloc)
+        if mem_alloc:
+            fracs.append(mem_used / mem_alloc)
+        return max(fracs) if fracs else 0.0
+
+    def _scale_down(self, pods: List[Pod], now: float) -> None:
+        from kubernetes_tpu.autoscaler.simulator import pods_fit_elsewhere
+
+        nodes = self.store.list_nodes()
+        bound = self._live_bound_pods(pods)
+        pods_by_node: Dict[str, List[Pod]] = {}
+        for p in bound:
+            pods_by_node.setdefault(p.spec.node_name, []).append(p)
+        sizes = {g.name: self.provisioner.group_size(g.name)
+                 for g in self.registry}
+        draining_per_group: Dict[str, int] = {}
+        for g in self._draining.values():
+            draining_per_group[g] = draining_per_group.get(g, 0) + 1
+        live_names = set()
+        for node in sorted(nodes, key=lambda n: n.name):
+            name = node.name
+            live_names.add(name)
+            if name in self._draining:
+                continue
+            gname = NodeGroupRegistry.group_of(node)
+            group = self.registry.get(gname) if gname else None
+            if group is None:
+                self._unneeded_since.pop(name, None)
+                continue
+            budget = sizes[gname] - group.min_size \
+                - draining_per_group.get(gname, 0)
+            its_pods = pods_by_node.get(name, [])
+            unneeded = (
+                budget > 0
+                and not node.spec.unschedulable
+                and self._utilization(node, its_pods)
+                < self.scale_down_utilization_threshold
+                and all(self._drainable(p) for p in its_pods)
+            )
+            if not unneeded:
+                self._unneeded_since.pop(name, None)
+                continue
+            since = self._unneeded_since.setdefault(name, now)
+            if now - since < self.scale_down_unneeded_time:
+                continue
+            # _draining already includes this pass's starts
+            if len(self._draining) >= self.max_concurrent_drains:
+                continue
+            if its_pods:
+                # the expensive gate LAST, and only once the unneeded
+                # timer matured (the cheap gates keep the timer honest
+                # each tick; re-solving fit-elsewhere every tick of the
+                # window would buy nothing — state can still change up
+                # to the cordon, which is the moment this verdict gates)
+                self.whatif_solves += 1
+                if not pods_fit_elsewhere(nodes, bound, name, its_pods):
+                    self._unneeded_since.pop(name, None)
+                    continue
+            self._cordon(name)
+            self._draining[name] = gname
+            draining_per_group[gname] = draining_per_group.get(gname, 0) + 1
+            self._unneeded_since.pop(name, None)
+        for name in list(self._unneeded_since):
+            if name not in live_names:
+                del self._unneeded_since[name]
+
+    def _cordon(self, name: str, on: bool = True) -> None:
+        node = self.store.get_node(name)
+        if node is None:
+            return
+        node = copy.copy(node)
+        node.metadata = copy.copy(node.metadata)
+        node.spec = copy.copy(node.spec)
+        node.spec.unschedulable = on
+        self.store.update_node(node)
+
+    def _uncordon(self, name: str) -> None:
+        self._cordon(name, on=False)
+
+    def _continue_drains(self, pods: List[Pod]) -> None:
+        """Advance every in-flight drain: evict what the PDBs allow;
+        delete the node once empty. A blocked eviction just waits for
+        the next pass (the disruption controller will raise
+        disruptions_allowed as replacements land elsewhere)."""
+        if not self._draining:
+            return
+        by_node: Dict[str, List[Pod]] = {}
+        for p in pods:
+            if p.spec.node_name and p.metadata.deletion_timestamp is None \
+                    and p.status.phase not in (SUCCEEDED, FAILED):
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        for name in sorted(self._draining):
+            gname = self._draining[name]
+            if self.store.get_node(name) is None:
+                # vanished underneath us (churn): nothing left to delete
+                self._draining.pop(name)
+                continue
+            its_pods = by_node.get(name, [])
+            if not its_pods:
+                self.provisioner.deprovision(name)
+                self._draining.pop(name)
+                self.scale_down_events += 1
+                self.metrics.scaledowns_total.inc(gname)
+                continue
+            if not all(self._drainable(p) for p in its_pods):
+                # a non-drainable pod bound in the scan→cordon window
+                # (the commit guard only sees the cordon after informer
+                # delivery): the node is needed after all — abandon the
+                # drain rather than stall cordoned forever or delete a
+                # pod nothing will recreate
+                self._uncordon(name)
+                self._draining.pop(name)
+                continue
+            for pod in its_pods:
+                if not self._pdb_allows(pod):
+                    continue
+                self.store.delete_pod(pod.namespace, pod.metadata.name)
+                self.metrics.evicted_for_scaledown_total.inc()
+
+    def _pdb_allows(self, pod: Pod) -> bool:
+        """Eviction-API semantics against the disruption controller's
+        published state: every PDB matching the pod must have budget
+        left; a granted eviction consumes one unit from each.
+        ``status.disruptions_allowed`` lags our deletions until the
+        disruption controller resyncs, so spends are remembered in
+        ``_pdb_spent`` keyed on the PDB's resourceVersion — a status
+        recompute bumps the version and resets the ledger, and until
+        then the stale budget can't be spent twice."""
+        matching = [
+            pdb for pdb in self.store.list_pdbs()
+            if pdb.namespace == pod.namespace
+            and pdb.selector.matches(pod.metadata.labels)
+        ]
+
+        def spent(pdb) -> int:
+            ent = self._pdb_spent.get(f"{pdb.namespace}/{pdb.name}")
+            if ent is not None and ent[0] == pdb.metadata.resource_version:
+                return ent[1]
+            return 0
+
+        for pdb in matching:
+            if pdb.status.disruptions_allowed - spent(pdb) <= 0:
+                return False
+        for pdb in matching:
+            key = f"{pdb.namespace}/{pdb.name}"
+            rv = pdb.metadata.resource_version
+            ent = self._pdb_spent.get(key)
+            if ent is not None and ent[0] == rv:
+                ent[1] += 1
+            else:
+                self._pdb_spent[key] = [rv, 1]
+        return True

@@ -1,0 +1,268 @@
+"""Node groups + the simulated cloud provisioner.
+
+The reference cluster-autoscaler abstracts providers behind
+``cloudprovider.NodeGroup`` (TargetSize/IncreaseSize/DeleteNodes over a
+template ``TemplateNodeInfo``); this module is that surface for the
+harness's world: a ``NodeGroup`` is a node *template* (capacity, labels,
+taints) plus min/max bounds, and the ``SimulatedProvisioner`` plays the
+cloud — it creates and deletes REAL ``Node`` objects through the store
+after a configurable boot latency, so nodelifecycle, the scheduler
+cache/queue, and the churn guards all observe ordinary node add/remove
+events (nothing downstream knows the node came from an autoscaler).
+
+Group membership is carried on the node itself via the
+``cluster-autoscaler.kubernetes.io/node-group`` label (the reference
+uses provider-specific instance-group tags); statically-created nodes
+can opt into a group by carrying the same label.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import Node, Taint
+
+NODE_GROUP_LABEL = "cluster-autoscaler.kubernetes.io/node-group"
+# upstream's opt-in for evicting pods without a controller during drain
+SAFE_TO_EVICT_ANNOTATION = "cluster-autoscaler.kubernetes.io/safe-to-evict"
+
+
+@dataclass
+class NodeGroup:
+    """One node template with scaling bounds (cloudprovider.NodeGroup)."""
+
+    name: str
+    cpu: str = "32"
+    memory: str = "64Gi"
+    max_pods: int = 110
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    min_size: int = 0
+    max_size: int = 10
+    priority: int = 0          # consulted by the "priority" expander
+    boot_latency: float = 0.0  # seconds between provision and Node add
+
+    def node_template(self, index) -> Node:
+        """A concrete Node of this group (TemplateNodeInfo). ``index``
+        also serves the what-if simulator, which stamps virtual names
+        that never reach the store."""
+        name = f"{self.name}-{index}"
+        node = Node()
+        node.metadata.name = name
+        node.metadata.labels.update({
+            "kubernetes.io/hostname": name,
+            NODE_GROUP_LABEL: self.name,
+        })
+        node.metadata.labels.update(self.labels)
+        for key, value in (("cpu", self.cpu), ("memory", self.memory),
+                           ("pods", str(self.max_pods))):
+            q = parse_quantity(value)
+            node.status.capacity[key] = q
+            node.status.allocatable[key] = q
+        node.spec.taints = [Taint(t.key, t.value, t.effect)
+                            for t in self.taints]
+        return node
+
+
+class NodeGroupRegistry:
+    """Name → NodeGroup registry + node→group resolution."""
+
+    def __init__(self, groups: Optional[List[NodeGroup]] = None):
+        self._groups: Dict[str, NodeGroup] = {}
+        for g in groups or ():
+            self.add(g)
+
+    def add(self, group: NodeGroup) -> NodeGroup:
+        self._groups[group.name] = group
+        return group
+
+    def get(self, name: str) -> Optional[NodeGroup]:
+        return self._groups.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._groups)
+
+    def __iter__(self) -> Iterator[NodeGroup]:
+        return iter([self._groups[n] for n in sorted(self._groups)])
+
+    def __len__(self) -> int:
+        return len(self._groups)
+
+    @staticmethod
+    def group_of(node: Node) -> Optional[str]:
+        return node.metadata.labels.get(NODE_GROUP_LABEL)
+
+
+class SimulatedProvisioner:
+    """The cloud side of the autoscaler: asynchronously materializes
+    group nodes as real store objects after the group's boot latency
+    (instance spin-up), and deletes them on scale-down. One worker
+    thread drives a ready-time heap; ``boot_latency == 0`` creates
+    synchronously so unit tests stay deterministic."""
+
+    def __init__(self, store, registry: NodeGroupRegistry):
+        self._store = store
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # (ready_at_monotonic, seq, group_name, Node)
+        self._boot_heap: List[Tuple[float, int, str, Node]] = []
+        # nodes popped from the heap (or provisioned synchronously) but
+        # whose store add hasn't landed yet: still "booting" to every
+        # reader, or the scale-up re-buy guard goes blind in the window
+        # between pop and registration
+        self._registering: List[Tuple[str, Node]] = []
+        self._seq = itertools.count()
+        self._next_index: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.provisioned_total = 0
+        self.deleted_total = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-provisioner")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- sizing --------------------------------------------------------
+    def _ensure_index_seed(self, group: NodeGroup) -> None:
+        """Seed the group's name counter past any statically-created
+        members so names never collide (a reused name would replay the
+        churn harness's flap re-registration path by accident). The
+        store scan runs with NO provisioner lock held — the store
+        dispatches watch handlers under its own lock, so nesting
+        provisioner→store here would arm an ABBA deadlock against any
+        handler that queries the provisioner."""
+        with self._lock:
+            if group.name in self._next_index:
+                return
+        prefix = f"{group.name}-"
+        nxt = 0
+        for node in self._store.list_nodes():
+            if NodeGroupRegistry.group_of(node) != group.name:
+                continue
+            suffix = node.name[len(prefix):] \
+                if node.name.startswith(prefix) else ""
+            if suffix.isdigit():
+                nxt = max(nxt, int(suffix) + 1)
+        with self._lock:
+            self._next_index.setdefault(group.name, nxt)
+
+    def _allocate_index(self, group: NodeGroup) -> int:
+        """Caller holds the lock and has called _ensure_index_seed."""
+        nxt = self._next_index.get(group.name, 0)
+        self._next_index[group.name] = nxt + 1
+        return nxt
+
+    def live_count(self, group_name: str) -> int:
+        return sum(
+            1 for n in self._store.list_nodes()
+            if NodeGroupRegistry.group_of(n) == group_name
+        )
+
+    def booting_count(self, group_name: str) -> int:
+        with self._lock:
+            return sum(1 for _, _, g, _ in self._boot_heap
+                       if g == group_name) \
+                + sum(1 for g, _ in self._registering if g == group_name)
+
+    def group_size(self, group_name: str) -> int:
+        """Booting + live — the target-size analog the max-size cap and
+        the what-if headroom must both respect (counting live only
+        would double-provision while instances boot). Booting is read
+        FIRST: a node completing registration between the two reads is
+        then double-counted (harmless overcount) instead of counted in
+        neither (headroom inflated past max size)."""
+        return self.booting_count(group_name) + self.live_count(group_name)
+
+    def booting_templates(self, group_name: Optional[str] = None
+                          ) -> List[Node]:
+        """Nodes provisioned but not yet registered — the reference's
+        "upcoming nodes", which the scale-up simulation must count as
+        capacity or every loop iteration re-buys the same nodes."""
+        with self._lock:
+            return [node for _, _, g, node in self._boot_heap
+                    if group_name is None or g == group_name] + [
+                node for g, node in self._registering
+                if group_name is None or g == group_name]
+
+    # -- provisioning --------------------------------------------------
+    def provision(self, group: NodeGroup, count: int) -> List[str]:
+        """Start ``count`` instances of ``group``; returns their node
+        names. Registration (the store add) happens after
+        ``group.boot_latency``."""
+        import time
+
+        names: List[str] = []
+        immediate: List[Node] = []
+        self._ensure_index_seed(group)
+        ready_at = time.monotonic() + group.boot_latency
+        with self._cond:
+            for _ in range(max(0, count)):
+                node = group.node_template(self._allocate_index(group))
+                names.append(node.name)
+                if group.boot_latency <= 0:
+                    immediate.append(node)
+                    self._registering.append((group.name, node))
+                else:
+                    heapq.heappush(
+                        self._boot_heap,
+                        (ready_at, next(self._seq), group.name, node))
+            self._cond.notify_all()
+        # register OUTSIDE the lock, like the worker loop: the store add
+        # fans watch deliveries out synchronously, and a watch handler
+        # querying the provisioner must never find the lock held
+        for node in immediate:
+            self._register(node)
+            with self._lock:
+                self._registering.remove((group.name, node))
+        return names
+
+    def deprovision(self, node_name: str) -> None:
+        self._store.delete_node(node_name)
+        self.deleted_total += 1
+
+    def _register(self, node: Node) -> None:
+        try:
+            self._store.add_node(node)
+        except Exception:  # noqa: BLE001 — e.g. name collision on replay
+            return
+        self.provisioned_total += 1
+
+    def _loop(self) -> None:
+        import time
+
+        while not self._stop.is_set():
+            with self._cond:
+                if not self._boot_heap:
+                    self._cond.wait(0.5)
+                    continue
+                now = time.monotonic()
+                ready_at = self._boot_heap[0][0]
+                if ready_at > now:
+                    self._cond.wait(min(ready_at - now, 0.5))
+                    continue
+                _, _, gname, node = heapq.heappop(self._boot_heap)
+                self._registering.append((gname, node))
+            # register OUTSIDE the lock: the store add fans out watch
+            # deliveries (scheduler cache, informers) synchronously
+            self._register(node)
+            with self._lock:
+                self._registering.remove((gname, node))

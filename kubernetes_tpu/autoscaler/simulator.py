@@ -1,0 +1,460 @@
+"""Scale-up/scale-down what-if simulation over virtual node columns.
+
+The reference cluster-autoscaler answers "would a new node help, and
+how many are needed?" by running the scheduler's predicate/priority
+code one pending pod at a time against a hypothetical NodeInfo
+(``simulator/scheduler_based_predicate_checker.go`` FitsAnyNode in a
+loop over pods). That per-pod loop is exactly the shape this project
+exists to batch: here the hypothetical capacity becomes K extra
+template-node COLUMNS appended to the encoded node planes
+(``ops/encode.py`` ``extra_nodes``), score-penalized so the scan solver
+(``ops/solver.py`` ``solve_whatif``) only spills pods onto them when no
+real node fits — ONE batched solve estimates placements for the whole
+pending set, and reading off which virtual columns received
+assignments yields the per-group node count (a vectorized bin-packing
+estimator).
+
+``serial=True`` routes the same question through a per-pod numpy loop
+(``_serial_whatif``) — the reference-shaped serial simulation that the
+differential tests hold the batched path against.
+
+Three penalty tiers order capacity preference:
+real nodes (no penalty) > upcoming/booting nodes (half penalty) >
+hypothetical new nodes (full ``VIRTUAL_NODE_PENALTY``) — pods use
+capacity that exists, then capacity already paid for, and only then
+demand new nodes.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod, shallow_copy
+from kubernetes_tpu.autoscaler.nodegroups import NodeGroup
+from kubernetes_tpu.ops.encode import BatchEncoder, EncodedBatch, EncodedCluster
+from kubernetes_tpu.ops.solver import (
+    BIG,
+    NEG_INF,
+    SolverParams,
+    VIRTUAL_NODE_PENALTY,
+    solve_whatif,
+)
+from kubernetes_tpu.scheduler.snapshot import new_snapshot
+
+UPCOMING_NODE_PENALTY = float(VIRTUAL_NODE_PENALTY) / 2.0
+# Graded per-column step WITHIN a tier: column k gets tier + k*STEP, so
+# the scan fills virtual column 0 until infeasible before touching
+# column 1 — first-fit bin-packing. Without it the least-allocated
+# score prefers the emptiest virtual node and every pod buys its own.
+# The step must dominate the real score range (balanced+least+spread
+# sum to a few hundred) while staying far below the tier separation
+# (5e5) times the column budget.
+VIRTUAL_COLUMN_STEP = 1000.0
+WHATIF_PREFIX = "whatif"
+
+
+@dataclass
+class WhatIfResult:
+    assignments: np.ndarray      # [num_real_pods] node column or -1
+    counts: np.ndarray           # [N] pods assigned per column
+    cluster: EncodedCluster
+    batch: EncodedBatch
+    virtual_cols: List[int]      # columns of the hypothetical new nodes
+    upcoming_cols: List[int]     # columns of still-booting nodes
+
+
+@dataclass
+class ScaleUpOption:
+    """One group's what-if outcome (cloudprovider expansion.Option)."""
+
+    group: str
+    nodes_needed: int    # virtual columns that received >= 1 pod
+    pods_placed: int     # pending pods that received ANY assignment
+    pods_on_new: int     # of those, pods that needed a NEW node
+    waste: float         # mean unused capacity fraction of the new nodes
+
+
+@dataclass
+class ScaleUpPlan:
+    chosen: Optional[ScaleUpOption]
+    options: List[ScaleUpOption]
+    solves: int          # what-if solves issued (== candidate groups)
+
+
+# ---------------------------------------------------------------------------
+# the core what-if
+
+
+def run_whatif(
+    nodes: Sequence[Node],
+    bound_pods: Sequence[Pod],
+    batch_pods: Sequence[Pod],
+    *,
+    new_nodes: Sequence[Node] = (),
+    upcoming_nodes: Sequence[Node] = (),
+    disabled_names: Sequence[str] = (),
+    serial: bool = False,
+    params: SolverParams = SolverParams(),
+    pad_pods: int = 64,
+) -> WhatIfResult:
+    """Encode (real cluster + extra columns) and solve. ``new_nodes``
+    get the full virtual penalty, ``upcoming_nodes`` the half tier,
+    ``disabled_names`` are removed from the solve (scale-down)."""
+    snapshot = new_snapshot(bound_pods, list(nodes))
+    extras = list(upcoming_nodes) + list(new_nodes)
+    enc = BatchEncoder(snapshot, extra_nodes=extras)
+    cluster, batch = enc.encode(list(batch_pods), pad_pods=pad_pods)
+    base = enc.num_snapshot_nodes
+    upcoming_cols = list(range(base, base + len(upcoming_nodes)))
+    virtual_cols = list(range(base + len(upcoming_nodes),
+                              base + len(extras)))
+    # clamp the graded upcoming tier strictly below the virtual tier:
+    # past ~500 booting columns the j*STEP ramp would otherwise cross
+    # VIRTUAL_NODE_PENALTY and the scan would buy new nodes over
+    # capacity that is already spinning up
+    upcoming_cap = float(VIRTUAL_NODE_PENALTY) - VIRTUAL_COLUMN_STEP
+    penalties: Dict[int, float] = {
+        c: min(UPCOMING_NODE_PENALTY + j * VIRTUAL_COLUMN_STEP,
+               upcoming_cap)
+        for j, c in enumerate(upcoming_cols)
+    }
+    penalties.update({
+        c: float(VIRTUAL_NODE_PENALTY) + j * VIRTUAL_COLUMN_STEP
+        for j, c in enumerate(virtual_cols)
+    })
+    col_of = {name: i for i, name in enumerate(cluster.node_names)}
+    disabled = [col_of[n] for n in disabled_names if n in col_of]
+    solver = _serial_whatif if serial else solve_whatif
+    assignments, counts = solver(
+        cluster, batch, params,
+        deprioritized_cols=penalties, disabled_cols=disabled,
+    )
+    return WhatIfResult(
+        assignments=assignments, counts=counts, cluster=cluster,
+        batch=batch, virtual_cols=virtual_cols,
+        upcoming_cols=upcoming_cols,
+    )
+
+
+def _pending_order(pods: Sequence[Pod]) -> List[Pod]:
+    """Queue-equivalent order (PrioritySort): priority desc, then age."""
+    return sorted(
+        pods,
+        key=lambda p: (-p.priority(),
+                       p.metadata.creation_timestamp or 0.0,
+                       p.metadata.name),
+    )
+
+
+def scale_up_option(
+    nodes: Sequence[Node],
+    bound_pods: Sequence[Pod],
+    pending: Sequence[Pod],
+    group: NodeGroup,
+    headroom: int,
+    *,
+    upcoming_nodes: Sequence[Node] = (),
+    serial: bool = False,
+    max_virtual: int = 64,
+    params: SolverParams = SolverParams(),
+    pad_pods: int = 64,
+) -> Optional[ScaleUpOption]:
+    """One group's what-if: append K = min(headroom, |pending|,
+    max_virtual) virtual columns of this group's template and read off
+    how many received assignments."""
+    k = max(0, min(int(headroom), len(pending), int(max_virtual)))
+    if k == 0:
+        return None
+    virt = [group.node_template(f"{WHATIF_PREFIX}-{i}") for i in range(k)]
+    res = run_whatif(
+        nodes, bound_pods, pending, new_nodes=virt,
+        upcoming_nodes=upcoming_nodes, serial=serial, params=params,
+        pad_pods=pad_pods,
+    )
+    vset = set(res.virtual_cols)
+    placed = int((res.assignments >= 0).sum())
+    pods_on_new = int(sum(int(res.counts[c]) for c in res.virtual_cols))
+    nodes_needed = int(sum(1 for c in res.virtual_cols
+                           if res.counts[c] > 0))
+    return ScaleUpOption(
+        group=group.name, nodes_needed=nodes_needed,
+        pods_placed=placed, pods_on_new=pods_on_new,
+        waste=_waste(res, vset),
+    )
+
+
+def _waste(res: WhatIfResult, vset: set) -> float:
+    """Mean unused cpu/mem fraction across the virtual columns that
+    were used (the least-waste expander's criterion)."""
+    used: Dict[int, Tuple[int, int]] = {}
+    for bi, col in enumerate(res.assignments):
+        col = int(col)
+        if col in vset:
+            uc, um = used.get(col, (0, 0))
+            used[col] = (uc + int(res.batch.requests[bi, 0]),
+                         um + int(res.batch.requests[bi, 1]))
+    fracs = []
+    for col, (uc, um) in used.items():
+        ac = max(int(res.cluster.allocatable[col, 0]), 1)
+        am = max(int(res.cluster.allocatable[col, 1]), 1)
+        fracs.append(((ac - uc) / ac + (am - um) / am) / 2.0)
+    return sum(fracs) / len(fracs) if fracs else 0.0
+
+
+# ---------------------------------------------------------------------------
+# expanders (reference cluster-autoscaler/expander)
+
+
+def _expand_least_waste(options: List[ScaleUpOption], groups) -> ScaleUpOption:
+    """Most pods helped first, then least wasted capacity (the
+    reference waste expander), then fewest nodes, then name."""
+    return min(options, key=lambda o: (-o.pods_placed, o.waste,
+                                       o.nodes_needed, o.group))
+
+
+def _expand_priority(options: List[ScaleUpOption], groups) -> ScaleUpOption:
+    """Highest configured group priority wins (the reference priority
+    expander); pods helped / fewest nodes / name break ties."""
+    def prio(o: ScaleUpOption) -> int:
+        g = groups.get(o.group)
+        return g.priority if g is not None else 0
+
+    return min(options, key=lambda o: (-prio(o), -o.pods_placed,
+                                       o.nodes_needed, o.group))
+
+
+EXPANDERS = {
+    "least-waste": _expand_least_waste,
+    "priority": _expand_priority,
+}
+
+
+def plan_scale_up(
+    nodes: Sequence[Node],
+    bound_pods: Sequence[Pod],
+    pending: Sequence[Pod],
+    groups: Sequence[Tuple[NodeGroup, int]],
+    expander: str = "least-waste",
+    *,
+    upcoming: Sequence[Node] = (),
+    serial: bool = False,
+    max_virtual: int = 64,
+    max_pods: int = 2048,
+    params: SolverParams = SolverParams(),
+    pad_pods: int = 64,
+) -> ScaleUpPlan:
+    """The full scale-up decision: one what-if per candidate group
+    (NOT one per pod), then the expander picks among the options.
+    ``groups`` pairs each candidate with its remaining headroom."""
+    pending = _pending_order(pending)[: max_pods]
+    options: List[ScaleUpOption] = []
+    solves = 0
+    for group, headroom in groups:
+        opt = scale_up_option(
+            nodes, bound_pods, pending, group, headroom,
+            upcoming_nodes=upcoming, serial=serial,
+            max_virtual=max_virtual, params=params, pad_pods=pad_pods,
+        )
+        if opt is None:
+            continue
+        solves += 1
+        if opt.pods_on_new > 0 and opt.nodes_needed > 0:
+            options.append(opt)
+    chosen = None
+    if options:
+        by_name = {g.name: g for g, _ in groups}
+        chosen = EXPANDERS[expander](options, by_name)
+    return ScaleUpPlan(chosen=chosen, options=options, solves=solves)
+
+
+# ---------------------------------------------------------------------------
+# scale-down: the same machinery with a column removed
+
+
+def _unbound_copy(pod: Pod) -> Pod:
+    p = shallow_copy(pod)
+    p.spec = copy.copy(pod.spec)
+    p.spec.node_name = ""
+    return p
+
+
+def pods_fit_elsewhere(
+    nodes: Sequence[Node],
+    bound_pods: Sequence[Pod],
+    node_name: str,
+    its_pods: Sequence[Pod],
+    *,
+    serial: bool = False,
+    params: SolverParams = SolverParams(),
+    pad_pods: int = 64,
+) -> bool:
+    """Scale-down feasibility: with ``node_name``'s column disabled,
+    does every one of its pods receive an assignment somewhere else?
+    Conservative by construction — the candidate's existing pods stay
+    in the encoded usage planes (on the disabled column, where they no
+    longer matter) and in the topology counts (where they can only make
+    re-placement harder, never easier)."""
+    if not its_pods:
+        return True
+    unbound = [_unbound_copy(p) for p in its_pods]
+    res = run_whatif(
+        nodes, bound_pods, unbound, disabled_names=[node_name],
+        serial=serial, params=params, pad_pods=pad_pods,
+    )
+    return bool(np.all(res.assignments[: len(unbound)] >= 0))
+
+
+# ---------------------------------------------------------------------------
+# the serial oracle (per-pod loop, numpy — reference-shaped simulation)
+
+
+def _serial_whatif(
+    cluster: EncodedCluster, batch: EncodedBatch,
+    params: SolverParams = SolverParams(),
+    deprioritized_cols=(),
+    disabled_cols=(),
+):
+    """Per-pod re-simulation over the same encoded planes: one Python
+    loop iteration per pod, full-width numpy per node — the shape of
+    upstream's serial simulation, used as the differential oracle for
+    ``solve_whatif``. Same contract: (assignments, per-node counts).
+    All float arithmetic is float32 to match the device solver."""
+    f32 = np.float32
+    n = cluster.allocatable.shape[0]
+    v = batch.num_values
+    allocatable = cluster.allocatable.astype(np.int32)
+    max_pods = cluster.max_pods.astype(np.int32)
+    requested = cluster.requested.astype(np.int32).copy()
+    nonzero_requested = cluster.nonzero_requested.astype(np.int32).copy()
+    pod_count = cluster.pod_count.astype(np.int32).copy()
+    sc_counts = batch.sc_counts.astype(np.int32).copy()
+    term_counts = batch.term_counts.astype(np.int32).copy()
+    term_owners = batch.term_owners.astype(np.int32).copy()
+    sc_codes = np.minimum(
+        cluster.topo_codes[:, batch.sc_key_idx].T, v).astype(np.int32)
+    term_codes = np.minimum(
+        cluster.topo_codes[:, batch.term_key_idx].T, v).astype(np.int32)
+
+    node_valid = np.zeros(n, dtype=bool)
+    node_valid[: cluster.num_real_nodes] = True
+    if len(disabled_cols):
+        node_valid[np.asarray(list(disabled_cols), dtype=np.int64)] = False
+    static_scores = np.array(batch.static_scores, dtype=f32, copy=True)
+    if len(deprioritized_cols):
+        if hasattr(deprioritized_cols, "items"):
+            for col, penalty in deprioritized_cols.items():
+                static_scores[:, int(col)] -= f32(penalty)
+        else:
+            cols = np.asarray(list(deprioritized_cols), dtype=np.int64)
+            static_scores[:, cols] -= VIRTUAL_NODE_PENALTY
+
+    b = batch.num_real_pods
+    assignments = np.full(b, -1, dtype=np.int32)
+    arange_sc = np.arange(sc_counts.shape[0])
+    arange_t = np.arange(term_counts.shape[0])
+    for bi in range(b):
+        if batch.inexpressible[bi]:
+            continue
+        req = batch.requests[bi].astype(np.int32)
+        nz = batch.nonzero_requests[bi].astype(np.int32)
+        profile = int(batch.profile_idx[bi])
+        pod_sc = batch.pod_sc[bi]
+        pod_sc_match = batch.pod_sc_match[bi]
+        match_by = batch.match_by[bi]
+        own_aff = batch.own_aff[bi]
+        own_anti = batch.own_anti[bi]
+        pref_weight = batch.pref_weight[bi].astype(f32)
+
+        fit = np.all(requested + req[None, :] <= allocatable, axis=1)
+        fit &= pod_count < max_pods
+        static_ok = batch.static_masks[profile]
+
+        counts_at = np.take_along_axis(sc_counts, sc_codes, axis=1)
+        domain = batch.sc_domain[profile]
+        min_c = np.min(np.where(domain[:, :v], sc_counts[:, :v], BIG),
+                       axis=1)
+        min_c = np.where(np.any(domain[:, :v], axis=1), min_c, 0)
+        skew = counts_at + pod_sc_match[:, None].astype(np.int32) \
+            - min_c[:, None]
+        missing = sc_codes >= v
+        active_hard = pod_sc & batch.sc_hard
+        spread_violation = np.any(
+            active_hard[:, None]
+            & ((skew > batch.sc_max_skew[:, None]) | missing),
+            axis=0,
+        )
+
+        tcounts_at = np.take_along_axis(term_counts, term_codes, axis=1)
+        towners_at = np.take_along_axis(term_owners, term_codes, axis=1)
+        t_missing = term_codes >= v
+        existing_anti_block = np.any(
+            match_by[:, None] & (towners_at > 0), axis=0)
+        own_anti_block = np.any(
+            own_anti[:, None] & (tcounts_at > 0), axis=0)
+        aff_here = (tcounts_at > 0) & ~t_missing
+        aff_sat = np.all(~own_aff[:, None] | aff_here, axis=0)
+        totals = np.sum(term_counts[:, :v], axis=1)
+        no_any = bool(np.all(~own_aff | (totals == 0)))
+        self_all = bool(np.all(~own_aff | match_by))
+        if np.any(own_aff):
+            aff_ok = aff_sat | (no_any and self_all)
+        else:
+            aff_ok = np.ones(n, dtype=bool)
+
+        feasible = (
+            node_valid & static_ok & fit & ~spread_violation
+            & ~existing_anti_block & ~own_anti_block & aff_ok
+        )
+
+        alloc_cpu = np.maximum(allocatable[:, 0], 1).astype(f32)
+        alloc_mem = np.maximum(allocatable[:, 1], 1).astype(f32)
+        cpu_frac = (nonzero_requested[:, 0] + nz[0]).astype(f32) / alloc_cpu
+        mem_frac = (nonzero_requested[:, 1] + nz[1]).astype(f32) / alloc_mem
+        over = (cpu_frac >= 1.0) | (mem_frac >= 1.0)
+        balanced = np.where(
+            over, f32(0.0),
+            (f32(1.0) - np.abs(cpu_frac - mem_frac)) * f32(100.0))
+        least = (
+            np.clip(f32(1.0) - cpu_frac, 0.0, 1.0)
+            + np.clip(f32(1.0) - mem_frac, 0.0, 1.0)
+        ) * f32(50.0)
+
+        active_soft = pod_sc & ~batch.sc_hard
+        soft_counts = np.sum(
+            np.where(active_soft[:, None], counts_at, 0), axis=0
+        ).astype(f32)
+        if np.any(active_soft):
+            spread_score = f32(100.0) / (f32(1.0) + soft_counts)
+        else:
+            spread_score = np.zeros(n, dtype=f32)
+
+        pref_score = np.sum(
+            pref_weight[:, None] * tcounts_at.astype(f32), axis=0)
+
+        score = (
+            f32(params.balanced_weight) * balanced
+            + f32(params.least_weight) * least
+            + f32(params.spread_weight) * spread_score
+            + f32(params.affinity_weight) * pref_score
+            + f32(params.static_weight) * static_scores[profile]
+        )
+        score = np.where(feasible, score, f32(NEG_INF))
+        if not np.any(feasible):
+            continue
+        chosen = int(np.argmax(score))
+        assignments[bi] = chosen
+        requested[chosen] += req
+        nonzero_requested[chosen] += nz
+        pod_count[chosen] += 1
+        np.add.at(sc_counts, (arange_sc, sc_codes[:, chosen]),
+                  pod_sc_match.astype(np.int32))
+        np.add.at(term_counts, (arange_t, term_codes[:, chosen]),
+                  match_by.astype(np.int32))
+        np.add.at(term_owners, (arange_t, term_codes[:, chosen]),
+                  own_anti.astype(np.int32))
+    counts = np.bincount(assignments[assignments >= 0], minlength=n)
+    return assignments, counts

@@ -78,6 +78,11 @@ from kubernetes_tpu.controllers.volumeprotection import (
 
 def new_controller_initializers() -> Dict[str, Callable]:
     """name -> constructor (controllermanager.go:387)."""
+    # imported here, not at module top: the autoscaler's controller
+    # imports controllers.base, so a top-level import would be circular
+    # whichever package loads first
+    from kubernetes_tpu.autoscaler.controller import ClusterAutoscaler
+
     return {
         "replicaset": ReplicaSetController,
         "replicationcontroller": ReplicationController,
@@ -114,6 +119,11 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "volumeexpand": VolumeExpandController,
         "ephemeral-volume": EphemeralVolumeController,
         "clusterrole-aggregation": ClusterRoleAggregationController,
+        # no kube-controller-manager analog — upstream ships the
+        # cluster-autoscaler as its own binary — but it rides the same
+        # loop scaffolding; with an empty NodeGroupRegistry (the
+        # default) every pass is a no-op, so enabling it here is safe
+        "clusterautoscaler": ClusterAutoscaler,
     }
 
 

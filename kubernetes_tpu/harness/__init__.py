@@ -6,12 +6,21 @@ Lazy exports (PEP 562): ``perf`` transitively imports the TPU solver
 spawned beside the scheduler process would fight it for the chip.
 """
 
+from kubernetes_tpu.harness.burst import (
+    BurstResult,
+    make_burst_pods,
+    run_pending_burst,
+    wait_all_bound,
+)
 from kubernetes_tpu.harness.workloads import WORKLOADS, make_workload
 
 __all__ = [
     "WORKLOADS", "make_workload",
     "BenchmarkResult", "run_workload", "ThroughputCollector",
     "run_workload_rest",
+    "BurstResult", "make_burst_pods", "run_pending_burst",
+    "wait_all_bound",
+    "run_autoscale_bench", "run_scale_cell",
 ]
 
 
@@ -24,4 +33,9 @@ def __getattr__(name):
         from kubernetes_tpu.harness.rest_perf import run_workload_rest
 
         return run_workload_rest
+    if name in ("run_autoscale_bench", "run_scale_cell"):
+        # lazy: elastic transitively imports the jax solver
+        from kubernetes_tpu.harness import elastic
+
+        return getattr(elastic, name)
     raise AttributeError(name)

@@ -1,0 +1,138 @@
+"""Reusable pending-burst workload generator (factored out of the
+per-suite copies that grew around ``harness/workloads.py``): burst N
+pods — typically exceeding current capacity — into a store or REST
+client, then report time-to-all-bound. One implementation shared by
+the autoscaler bench (``harness/elastic.py``), the chaos suites
+(``harness/chaos_nodes.py`` waves), and the tests.
+
+Pod shapes come from ``workloads.basic_pod`` (the same template every
+benchmark workload builds on) so a burst pod is indistinguishable from
+a bench pod; the burst layer only adds naming/uid discipline, the
+optional safe-to-evict annotation (so the autoscaler may drain burst
+pods during scale-down), and the bound-set wait.
+
+jax-free by design: the REST harness's creator children import this.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kubernetes_tpu.api.types import FAILED, SUCCEEDED, Pod
+# nodegroups is jax-free (api types only), so the shared constant keeps
+# this module's jax-free contract
+from kubernetes_tpu.autoscaler.nodegroups import SAFE_TO_EVICT_ANNOTATION
+from kubernetes_tpu.harness.workloads import basic_pod
+
+
+def make_burst_pods(
+    count: int,
+    cpu_milli: int = 500,
+    memory: str = "500Mi",
+    name_prefix: str = "burst-",
+    uid_prefix: str = "bu-",
+    offset: int = 0,
+    labels: Optional[Dict[str, str]] = None,
+    safe_to_evict: bool = False,
+    owner_ref: Optional[dict] = None,
+) -> List[Pod]:
+    """N plain resource pods named ``{name_prefix}{i}`` for i in
+    [offset, offset+count) — the pending-burst shape every elastic
+    suite shares."""
+    out: List[Pod] = []
+    for i in range(offset, offset + count):
+        d = basic_pod(i, cpu=f"{cpu_milli}m", memory=memory, labels=labels)
+        d["metadata"]["name"] = f"{name_prefix}{i}"
+        pod = Pod.from_dict(d)
+        pod.metadata.uid = f"{uid_prefix}{i}"
+        if safe_to_evict:
+            pod.metadata.annotations[SAFE_TO_EVICT_ANNOTATION] = "true"
+        if owner_ref is not None:
+            pod.metadata.owner_references.append(dict(owner_ref))
+        out.append(pod)
+    return out
+
+
+@dataclass
+class BurstResult:
+    injected: int
+    bound: int
+    time_to_all_bound: Optional[float]   # None = timed out
+    names: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.time_to_all_bound is not None
+
+    @property
+    def pods_per_second(self) -> float:
+        if not self.time_to_all_bound:
+            return 0.0
+        return self.injected / self.time_to_all_bound
+
+
+def _create(store, pods: Sequence[Pod]) -> None:
+    """Bulk-admit when the target supports it (the in-process store's
+    one-lock path); fall back to per-object creates (REST clients)."""
+    create_bulk = getattr(store, "create_pods", None)
+    if create_bulk is not None:
+        create_bulk(list(pods))
+        return
+    for pod in pods:
+        store.create_object("Pod", pod)
+
+
+def count_bound(store, names: Sequence[str]) -> int:
+    """Bound-or-terminal count BY NAME: a rescued replacement (same
+    name, fresh uid) counts — the chaos suites' lost-pod invariant is
+    name-based for exactly this reason."""
+    wanted = set(names)
+    n = 0
+    for pod in store.list_pods():
+        if pod.metadata.name not in wanted:
+            continue
+        if pod.spec.node_name or pod.status.phase in (SUCCEEDED, FAILED):
+            n += 1
+    return n
+
+
+def wait_all_bound(
+    store, names: Sequence[str], timeout: float,
+    poll: float = 0.05,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Optional[float]:
+    """Seconds until every named pod is bound (or terminal); None on
+    timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    last_report = 0
+    while time.monotonic() < deadline:
+        bound = count_bound(store, names)
+        if bound >= len(names):
+            return time.monotonic() - t0
+        if progress and bound - last_report >= max(50, len(names) // 20):
+            last_report = bound
+            progress(f"burst: {bound}/{len(names)} bound")
+        time.sleep(poll)
+    return None
+
+
+def run_pending_burst(
+    store, count: int, timeout: float = 120.0,
+    progress: Optional[Callable[[str], None]] = None,
+    **make_kwargs,
+) -> BurstResult:
+    """Inject a burst and wait: create ``count`` pods (kwargs forwarded
+    to ``make_burst_pods``), then measure time-to-all-bound."""
+    pods = make_burst_pods(count, **make_kwargs)
+    names = [p.metadata.name for p in pods]
+    _create(store, pods)
+    elapsed = wait_all_bound(store, names, timeout, progress=progress)
+    return BurstResult(
+        injected=count,
+        bound=count_bound(store, names),
+        time_to_all_bound=elapsed,
+        names=names,
+    )

@@ -52,7 +52,7 @@ from kubernetes_tpu.api.types import (
     SUCCEEDED,
     Taint,
 )
-from kubernetes_tpu.apiserver.store import DELETED, MODIFIED
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED
 
 # injected (non-lifecycle) taint the injector applies and removes
 CHAOS_TAINT = "chaos.kubernetes.io/injected"
@@ -127,6 +127,13 @@ class HeartbeatPump:
     def unmute(self, name: str) -> None:
         with self._lock:
             self._muted.discard(name)
+
+    def add_node(self, name: str) -> None:
+        """Adopt a node that registered after the pump started (the
+        autoscaler's provisioned capacity needs heartbeats like any
+        other hollow kubelet, or nodelifecycle taints it at grace)."""
+        with self._lock:
+            self._nodes.add(name)
 
     def beat_now(self) -> None:
         with self._lock:
@@ -552,12 +559,19 @@ def run_chaos_nodes(
     eviction_grace: float = 0.5,
     heartbeat_interval: float = 0.2,
     wait_timeout: float = 120.0,
+    autoscale: bool = False,
     progress: Optional[Callable[[str], None]] = None,
 ) -> Dict:
     """One seeded node-churn run; returns ``{"ok", "invariants",
     "stats"}``. The workload streams in over REST while the injector
     churns nodes; quiescence heals the cluster and the invariants are
-    checked against store truth."""
+    checked against store truth.
+
+    ``autoscale=True`` runs the cluster autoscaler colocated with the
+    control plane: when churn-killed capacity leaves workload pods
+    unschedulable, the what-if solve buys replacement nodes from an
+    ``ng-chaos`` group (scale-down stays off — removing nodes mid-churn
+    is the injector's job). The PR 3 invariants must hold unchanged."""
     from kubernetes_tpu.apiserver.rest import APIServer
     from kubernetes_tpu.apiserver.store import ClusterStore
     from kubernetes_tpu.client.informers import SharedInformerFactory
@@ -570,7 +584,7 @@ def run_chaos_nodes(
     from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
     from kubernetes_tpu.scheduler.scheduler import Scheduler
     from kubernetes_tpu.sidecar import attach_batch_scheduler
-    from kubernetes_tpu.testing import MakeNode, MakePod
+    from kubernetes_tpu.testing import MakeNode
 
     def note(msg: str) -> None:
         if progress:
@@ -601,6 +615,8 @@ def run_chaos_nodes(
     server = APIServer(store=store).start()
     sched = None
     pump = injector = rescuer = nlc = gc = void_watch = None
+    ca = None
+    ca_node_watch = None
     factory = None
     invariants: Dict[str, bool] = {}
     failure = ""
@@ -616,6 +632,22 @@ def run_chaos_nodes(
         nlc.monitor_interval = min(0.05, grace_period / 4)
         gc = PodGCController(store, factory)
         gc.RESYNC_SECONDS = 0.25
+        if autoscale:
+            from kubernetes_tpu.autoscaler import (
+                ClusterAutoscaler,
+                NodeGroup,
+                NodeGroupRegistry,
+            )
+
+            registry = NodeGroupRegistry()
+            registry.add(NodeGroup(
+                "ng-chaos", cpu=str(node_cpu), memory="64Gi",
+                min_size=0, max_size=nodes, boot_latency=0.1,
+            ))
+            ca = ClusterAutoscaler(store, factory, registry=registry)
+            ca.RESYNC_SECONDS = 0.1
+            ca.scale_up_cooldown = 0.75
+            ca.scale_down_enabled = False
         factory.start()
         factory.wait_for_cache_sync()
         nlc.run()
@@ -623,11 +655,22 @@ def run_chaos_nodes(
 
         pump = HeartbeatPump(nlc, node_names, heartbeat_interval)
         pump.start()
+        if ca is not None:
+            # provisioned nodes must heartbeat like any hollow kubelet
+            def _adopt_autoscaled(event) -> None:
+                if event.kind == "Node" and event.type == ADDED \
+                        and event.obj.name.startswith("ng-chaos-"):
+                    pump.add_node(event.obj.name)
+
+            ca_node_watch = store.watch(_adopt_autoscaled)
 
         gates = FeatureGates({"TPUBatchScheduler": use_batch})
         sched = Scheduler.create(sched_client, feature_gates=gates)
         bs = attach_batch_scheduler(sched, max_batch=max_batch) \
             if use_batch else None
+        if ca is not None:
+            ca.queue_introspect = sched.queue
+            ca.run()
         sched.run()
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline and \
@@ -643,16 +686,17 @@ def run_chaos_nodes(
         injector.start()
         note(f"{nodes} nodes up, churn running")
 
-        # the workload, over REST, interleaved with the churn
+        # the workload, over REST, interleaved with the churn — waves
+        # of the shared pending-burst generator (harness/burst.py)
+        from kubernetes_tpu.harness.burst import make_burst_pods
+
         per_wave = pods // waves
         created = 0
         for w in range(waves):
             count = per_wave if w < waves - 1 else pods - created
-            items = [
-                MakePod().name(f"cp-{w}-{i}").uid(f"cu{w}-{i}")
-                .req({"cpu": f"{pod_cpu_milli}m"}).obj()
-                for i in range(count)
-            ]
+            items = make_burst_pods(
+                count, cpu_milli=pod_cpu_milli,
+                name_prefix=f"cp-{w}-", uid_prefix=f"cu{w}-")
             made = creator.create_objects_bulk("Pod", items)
             if made != count:
                 raise RuntimeError(
@@ -757,7 +801,8 @@ def run_chaos_nodes(
         if diverged is not None and not failure:
             failure = f"cache diverged: {diverged}"
     finally:
-        for component in (injector, pump, rescuer, void_watch, nlc, gc):
+        for component in (injector, pump, rescuer, void_watch, nlc, gc,
+                          ca, ca_node_watch):
             if component is not None:
                 try:
                     component.stop()
@@ -798,5 +843,9 @@ def run_chaos_nodes(
             "session_rebuilds": sched.batch_scheduler.session.rebuilds
             if sched is not None and sched.batch_scheduler is not None
             else 0,
+            "autoscaler_scaleups": ca.scale_up_events
+            if ca is not None else 0,
+            "autoscaler_nodes_added": ca.provisioner.provisioned_total
+            if ca is not None else 0,
         },
     }

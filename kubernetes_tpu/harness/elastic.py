@@ -1,0 +1,185 @@
+"""Elastic bench harness: the static benchmark world made autoscaled.
+
+The scheduler-perf harness (``harness/perf.py``) measures a FIXED node
+set; this one starts the cluster at a fraction of the capacity the
+workload needs and lets the cluster autoscaler buy the rest while the
+burst is pending — measuring pods/s *through* the scale-up plus
+time-to-all-bound (capacity acquisition included), the number an
+elastic production cluster actually experiences.
+
+Wiring per run: in-process store, scheduler on the TPU batch path,
+``ClusterAutoscaler`` with queue introspection, and the
+``SimulatedProvisioner`` registering real Node objects after the
+configured boot latency. The burst comes from the shared generator
+(``harness/burst.py``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config.feature_gates import FeatureGates
+from kubernetes_tpu.harness.burst import run_pending_burst
+
+
+def run_autoscale_bench(
+    burst: int = 1000,
+    pod_cpu_milli: int = 500,
+    pod_memory: str = "500Mi",
+    node_cpu: int = 16,
+    node_memory: str = "64Gi",
+    initial_fraction: float = 0.2,
+    boot_latency: float = 0.0,
+    use_batch: bool = True,
+    max_batch: int = 1024,
+    expander: str = "least-waste",
+    scale_down: bool = False,
+    wait_timeout: float = 600.0,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict:
+    """One elastic run: cluster at ``initial_fraction`` of needed
+    capacity, burst to ``burst`` pods, autoscaler fills the gap.
+    Returns a BENCH-JSON-shaped row."""
+    from kubernetes_tpu.autoscaler import (
+        ClusterAutoscaler,
+        NodeGroup,
+        NodeGroupRegistry,
+    )
+    from kubernetes_tpu.client.informers import SharedInformerFactory
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.sidecar import attach_batch_scheduler
+
+    def note(msg: str) -> None:
+        if progress:
+            progress(f"elastic: {msg}")
+
+    # capacity per node is the tighter of cpu fit and the template's
+    # 110-pod cap (at high node_cpu the pod cap binds first); +2 slack
+    # keeps the max-size cap out of the way of estimator rounding
+    per_node = max(1, min(node_cpu * 1000 // pod_cpu_milli, 110))
+    needed = max(1, math.ceil(burst / per_node))
+    initial = max(1, math.ceil(initial_fraction * needed))
+    store = ClusterStore()
+    registry = NodeGroupRegistry()
+    group = registry.add(NodeGroup(
+        "ng-elastic", cpu=str(node_cpu), memory=node_memory,
+        min_size=initial, max_size=needed + 2,
+        boot_latency=boot_latency,
+    ))
+    for i in range(initial):
+        store.add_node(group.node_template(i))
+
+    factory = SharedInformerFactory(store)
+    ca = ClusterAutoscaler(store, factory, registry=registry)
+    ca.RESYNC_SECONDS = 0.1
+    ca.scale_up_cooldown = 0.5
+    ca.expander = expander
+    # cover the whole gap in few rounds (cooldown-paced) even at bench
+    # scale; the what-if still pays one solve per round, not per pod
+    ca.max_virtual_per_group = min(256, needed + 2)
+    ca.scale_down_enabled = scale_down
+
+    gates = FeatureGates({"TPUBatchScheduler": use_batch})
+    sched = Scheduler.create(store, feature_gates=gates)
+    bs = attach_batch_scheduler(sched, max_batch=max_batch) \
+        if use_batch else None
+    ca.queue_introspect = sched.queue
+
+    result = None
+    try:
+        sched.run()
+        factory.start()
+        factory.wait_for_cache_sync()
+        ca.run()
+        if bs is not None:
+            from kubernetes_tpu.harness.burst import make_burst_pods
+
+            warm = bs.warmup(sample_pods=make_burst_pods(
+                min(64, burst), cpu_milli=pod_cpu_milli,
+                memory=pod_memory, name_prefix="warm-", uid_prefix="w-"))
+            if warm > 0.05:
+                note(f"solver warmup {warm:.1f}s")
+        note(f"{initial}/{needed} nodes up, bursting {burst} pods "
+             f"(boot latency {boot_latency}s)")
+        result = run_pending_burst(
+            store, burst, timeout=wait_timeout,
+            cpu_milli=pod_cpu_milli, memory=pod_memory,
+            name_prefix="eb-", uid_prefix="ebu-", safe_to_evict=True,
+            progress=progress,
+        )
+        note(f"{result.bound}/{burst} bound, "
+             f"t={result.time_to_all_bound}")
+    finally:
+        ca.stop()
+        sched.stop()
+        factory.stop()
+
+    final_nodes = len(store.list_nodes())
+    row = {
+        "metric": (
+            f"pods_scheduled_per_sec[autoscale {initial}->{final_nodes}"
+            f"nodes/{burst}pods, boot {boot_latency}s, "
+            f"{'TPU batch' if use_batch else 'serial'} path]"
+        ),
+        "value": round(result.pods_per_second, 1) if result else 0.0,
+        "unit": "pods/s",
+        "time_to_all_bound_s": (
+            round(result.time_to_all_bound, 2)
+            if result and result.time_to_all_bound is not None else None
+        ),
+        "bound": result.bound if result else 0,
+        "nodes_start": initial,
+        "nodes_end": final_nodes,
+        "scaleup_decisions": ca.scale_up_events,
+        "nodes_provisioned": ca.provisioner.provisioned_total,
+        "whatif_solves": ca.whatif_solves,
+        "expander": expander,
+    }
+    if result and result.time_to_all_bound is None:
+        row["error"] = f"timeout: {result.bound}/{burst} bound"
+    return row
+
+
+def run_scale_cell(
+    burst: int, boot_latency: float, repeats: int = 2,
+    wait_timeout: float = 120.0,
+    progress: Optional[Callable[[str], None]] = None,
+    **kwargs,
+) -> Dict:
+    """One chaos-matrix ``scale`` suite cell: ``repeats`` independent
+    elastic runs at (burst size × boot latency); reports the worst
+    (p99-for-small-N = max) time-to-capacity across runs."""
+    samples: List[float] = []
+    rows = []
+    failure = ""
+    for r in range(repeats):
+        row = run_autoscale_bench(
+            burst=burst, boot_latency=boot_latency,
+            wait_timeout=wait_timeout, progress=progress, **kwargs)
+        rows.append(row)
+        if row.get("time_to_all_bound_s") is None:
+            failure = row.get("error", "timeout")
+        else:
+            samples.append(row["time_to_all_bound_s"])
+    ok = len(samples) == repeats
+    return {
+        "ok": ok,
+        "failure": failure,
+        "burst": burst,
+        "boot_latency": boot_latency,
+        "stats": {
+            "runs": repeats,
+            "time_to_capacity_p99_s": max(samples) if samples else None,
+            "time_to_capacity_p50_s": (
+                sorted(samples)[(len(samples) - 1) // 2]
+                if samples else None),
+            "pods_per_s_min": min(
+                (r["value"] for r in rows), default=0.0),
+            "scaleup_decisions": sum(
+                r["scaleup_decisions"] for r in rows),
+            "nodes_provisioned": sum(
+                r["nodes_provisioned"] for r in rows),
+        },
+    }

@@ -1,0 +1,90 @@
+"""Cluster-autoscaler metrics (the observability half of
+``kubernetes_tpu/autoscaler/``; reference analogs:
+``cluster_autoscaler_scaled_up_nodes_total``,
+``cluster_autoscaler_unschedulable_pods_count``, and the
+``function_duration_seconds{function=scaleUp}`` family in
+cluster-autoscaler/metrics).
+
+Four series matter operationally:
+
+- ``autoscaler_scaleups_total{group,expander}`` — nodes provisioned per
+  scale-up decision, by chosen node group and the expander strategy
+  that chose it;
+- ``autoscaler_scaledowns_total{group}`` — nodes drained and deleted;
+- ``autoscaler_pending_unschedulable`` — the live size of the trigger
+  surface (queue leftovers + FailedScheduling outcomes); a gauge stuck
+  above zero with no scale-ups means every group is at max or the
+  pending pods fit no template;
+- ``autoscaler_time_to_capacity_seconds`` — pending-set-first-seen →
+  pending-set-drained latency, the elastic bench's headline histogram.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_tpu.metrics.fabric_metrics import (
+    _counter,
+    _gauge,
+    _histogram,
+)
+from kubernetes_tpu.metrics.registry import MetricsRegistry
+
+
+class AutoscalerMetrics:
+    """Scale-up / scale-down / pending counters. Reuses already-
+    registered metrics so the control loop and any in-process readers
+    share series (the FabricMetrics pattern)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            from kubernetes_tpu.metrics import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self.scaleups_total = _counter(
+            registry, "autoscaler_scaleups_total",
+            "Nodes provisioned by cluster-autoscaler scale-up decisions, "
+            "by node group and expander strategy",
+            ("group", "expander"),
+        )
+        self.scaledowns_total = _counter(
+            registry, "autoscaler_scaledowns_total",
+            "Nodes drained and deleted by cluster-autoscaler scale-down, "
+            "by node group",
+            ("group",),
+        )
+        self.pending_unschedulable = _gauge(
+            registry, "autoscaler_pending_unschedulable",
+            "Pods currently in the autoscaler's unschedulable trigger "
+            "set (queue leftovers + FailedScheduling outcomes)",
+        )
+        self.time_to_capacity_seconds = _histogram(
+            registry, "autoscaler_time_to_capacity_seconds",
+            "Latency from a pending unschedulable set first appearing "
+            "to that set draining to zero (capacity arrived and every "
+            "triggering pod bound or went away)",
+            # capacity acquisition spans instance boot times, not
+            # request latencies: the default 50s ceiling would clamp
+            # the headline elastic row's p99 (30k-pod bursts legally
+            # take minutes)
+            buckets=(0.5, 1, 2, 5, 10, 20, 30, 60, 120, 300, 600,
+                     1200, 1800),
+        )
+        self.evicted_for_scaledown_total = _counter(
+            registry, "autoscaler_evicted_for_scaledown_total",
+            "Pods evicted (PDB-respecting) while draining a scale-down "
+            "candidate node",
+        )
+
+
+_default: Optional[AutoscalerMetrics] = None
+
+
+def autoscaler_metrics() -> AutoscalerMetrics:
+    """Process-wide AutoscalerMetrics bound to the default registry
+    (the legacyregistry pattern fabric_metrics follows)."""
+    global _default
+    if _default is None:
+        _default = AutoscalerMetrics()
+    return _default

@@ -53,7 +53,7 @@ from kubernetes_tpu.scheduler.framework.plugins.taint_toleration import (
     TaintToleration,
 )
 from kubernetes_tpu.scheduler.snapshot import Snapshot
-from kubernetes_tpu.scheduler.types import PodInfo, Resource
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Resource
 
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
@@ -261,9 +261,22 @@ class BatchEncoder:
     Generation-LRU of the device mirror, SURVEY.md section 7 hard part 1)."""
 
     def __init__(self, snapshot: Snapshot, pad_nodes: int = 128,
-                 client=None):
+                 client=None, extra_nodes: Optional[List] = None):
         self.snapshot = snapshot
         self.node_infos = [ni for ni in snapshot.list() if ni.node is not None]
+        # virtual node columns (the cluster autoscaler's what-if hook):
+        # hypothetical template nodes appended AFTER the snapshot's real
+        # nodes, encoded with the same host plugin code — static masks,
+        # taints, topology codes all behave as if the node existed. The
+        # caller identifies their columns as the last len(extra_nodes)
+        # entries of cluster.node_names (ops/solver.py solve_whatif then
+        # score-penalizes or disables them).
+        self.num_snapshot_nodes = len(self.node_infos)
+        if extra_nodes:
+            for node in extra_nodes:
+                ni = NodeInfo()
+                ni.set_node(node)
+                self.node_infos.append(ni)
         self.pad_nodes = pad_nodes
         self._client = client
         self._taint_plugin = TaintToleration()

@@ -345,3 +345,60 @@ def solve_scan(
     ints, floats = pack_podin(batch)
     _, assignments = _solve_packed(static, state, ints, floats, params)
     return np.asarray(assignments)
+
+
+# ---------------------------------------------------------------------------
+# what-if solves (the cluster autoscaler's virtual-column hook)
+
+# keeps a column feasible but strictly below every real-node score: the
+# scan only spills onto a penalized column when NO unpenalized node is
+# feasible — exactly the "would a new node help" question. Real scores
+# are O(hundreds) (balanced/least ≤ 200, spread ≤ 100, static small),
+# so one tier of 1e6 cleanly separates real < upcoming < virtual.
+VIRTUAL_NODE_PENALTY = np.float32(1.0e6)
+
+
+def solve_whatif(
+    cluster: EncodedCluster, batch: EncodedBatch,
+    params: SolverParams = SolverParams(),
+    deprioritized_cols=(),
+    disabled_cols=(),
+):
+    """Scan solve with per-column overrides, for autoscaler what-ifs:
+
+    - ``deprioritized_cols``: node columns (e.g. the K appended virtual
+      template nodes, or still-booting "upcoming" nodes) whose static
+      score is pushed down by ``VIRTUAL_NODE_PENALTY`` — a mapping
+      ``{col: penalty}`` applies per-column tiers (upcoming nodes get a
+      smaller penalty than hypothetical ones, so pods prefer capacity
+      that is already paid for);
+    - ``disabled_cols``: node columns removed from the solve entirely
+      (the scale-down "do its pods fit elsewhere" question).
+
+    Returns ``(assignments [num_real_pods], per-node assigned counts
+    [N])``. The batch-wide scan IS the estimator: one solve answers the
+    question for every pending pod at once, replacing the reference
+    cluster-autoscaler's one-pod-at-a-time scheduler simulation.
+    """
+    static = build_static(cluster, batch)
+    n = cluster.allocatable.shape[0]
+    if len(deprioritized_cols):
+        scores = np.array(batch.static_scores, dtype=np.float32, copy=True)
+        if hasattr(deprioritized_cols, "items"):
+            for col, penalty in deprioritized_cols.items():
+                scores[:, int(col)] -= np.float32(penalty)
+        else:
+            cols = np.asarray(list(deprioritized_cols), dtype=np.int64)
+            scores[:, cols] -= VIRTUAL_NODE_PENALTY
+        static = static._replace(static_scores=jnp.asarray(scores))
+    if len(disabled_cols):
+        node_valid = np.zeros(n, dtype=bool)
+        node_valid[: cluster.num_real_nodes] = True
+        node_valid[np.asarray(list(disabled_cols), dtype=np.int64)] = False
+        static = static._replace(node_valid=jnp.asarray(node_valid))
+    state = build_state(cluster, batch)
+    ints, floats = pack_podin(batch)
+    _, assignments = _solve_packed(static, state, ints, floats, params)
+    a = np.asarray(assignments)[: batch.num_real_pods]
+    counts = np.bincount(a[a >= 0], minlength=n)
+    return a, counts

@@ -496,6 +496,15 @@ class SchedulingQueue(PodNominator):
         with self._qlock:
             return len(self._unschedulable_q)
 
+    def unschedulable_pods(self) -> List[Pod]:
+        """The parked unschedulable set — the cluster autoscaler's
+        trigger surface (upstream CA watches pods with a FailedScheduling
+        condition; here the queue IS that set, exactly: every pod in it
+        failed a cycle with an Unschedulable outcome and waits on a
+        cluster event)."""
+        with self._qlock:
+            return [q.pod for q in self._unschedulable_q.values()]
+
     def pending_active_count(self) -> int:
         """Pods still due a scheduling attempt (active + backoff); pods
         parked in unschedulableQ have been tried and wait on events."""

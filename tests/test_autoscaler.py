@@ -1,0 +1,785 @@
+"""Cluster autoscaler: node groups, solver-simulated scale-up,
+drain-based scale-down (ISSUE 4).
+
+Layers under test, bottom-up: the group/provisioner surface, the
+virtual-column what-if solve (batched vs the per-pod serial oracle —
+the differential acceptance bar), the expander strategies, the
+reconcile loop (trigger → cooldown → max-size caps), the PDB-respecting
+drain pipeline, and the end-to-end elastic story (burst beyond
+capacity → scale up → all bind → idle → drain back toward min with
+zero lost pods). Satellites: ClusterAutoscalerProvider actually scoring
+with MostAllocated, the shared pending-burst generator, the HPA →
+autoscaler hand-off, and the churn-integration run (slow marker).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.api.types import (
+    PodCondition,
+    PodDisruptionBudget,
+    SUCCEEDED,
+    shallow_copy,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.autoscaler import (
+    ClusterAutoscaler,
+    NODE_GROUP_LABEL,
+    NodeGroup,
+    NodeGroupRegistry,
+    SAFE_TO_EVICT_ANNOTATION,
+    SimulatedProvisioner,
+    plan_scale_up,
+    pods_fit_elsewhere,
+)
+from kubernetes_tpu.client.informers import SharedInformerFactory
+from kubernetes_tpu.harness.burst import make_burst_pods, run_pending_burst
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _wait(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timeout waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _full_node(store, name, cpu="4", used="3800m", uid=None):
+    """A node plus a bound pod leaving no meaningful headroom."""
+    store.add_node(MakeNode().name(name)
+                   .capacity({"cpu": cpu, "memory": "8Gi"}).obj())
+    store.create_pod(
+        MakePod().name(f"filler-{name}").uid(uid or f"fu-{name}")
+        .req({"cpu": used}).node(name).obj())
+
+
+def _mark_unschedulable(store, pod):
+    store.patch_pod_condition(
+        pod.namespace, pod.metadata.name,
+        PodCondition("PodScheduled", "False", "Unschedulable", "test"))
+
+
+def _mk_ca(store, registry, **knobs):
+    ca = ClusterAutoscaler(store, SharedInformerFactory(store),
+                           registry=registry)
+    for k, v in knobs.items():
+        setattr(ca, k, v)
+    return ca
+
+
+# ---------------------------------------------------------------------------
+# node groups + provisioner
+
+
+class TestNodeGroups:
+    def test_template_carries_identity_capacity_and_taints(self):
+        from kubernetes_tpu.api.types import Taint
+
+        g = NodeGroup("ng-a", cpu="8", memory="16Gi",
+                      labels={ZONE: "z-a"},
+                      taints=[Taint("dedicated", "batch", "NoSchedule")],
+                      min_size=1, max_size=4)
+        node = g.node_template(3)
+        assert node.name == "ng-a-3"
+        assert node.metadata.labels[NODE_GROUP_LABEL] == "ng-a"
+        assert node.metadata.labels["kubernetes.io/hostname"] == "ng-a-3"
+        assert node.metadata.labels[ZONE] == "z-a"
+        assert int(node.status.allocatable["cpu"].milli_value()) == 8000
+        assert node.spec.taints[0].key == "dedicated"
+        reg = NodeGroupRegistry([g])
+        assert reg.get("ng-a") is g
+        assert reg.group_of(node) == "ng-a"
+        assert reg.group_of(MakeNode().name("plain").obj()) is None
+
+    def test_provisioner_creates_real_nodes_after_boot_latency(self):
+        store = ClusterStore()
+        reg = NodeGroupRegistry()
+        g = reg.add(NodeGroup("ng-b", cpu="2", boot_latency=0.15))
+        prov = SimulatedProvisioner(store, reg)
+        prov.start()
+        try:
+            names = prov.provision(g, 2)
+            assert prov.group_size("ng-b") == 2      # booting counts
+            assert prov.live_count("ng-b") == 0
+            assert len(prov.booting_templates("ng-b")) == 2
+            _wait(lambda: prov.live_count("ng-b") == 2,
+                  msg="nodes registered after boot latency")
+            got = {n.name for n in store.list_nodes()}
+            assert set(names) <= got
+            prov.deprovision(names[0])
+            assert prov.live_count("ng-b") == 1
+        finally:
+            prov.stop()
+
+    def test_provisioner_skips_existing_static_indices(self):
+        store = ClusterStore()
+        reg = NodeGroupRegistry()
+        g = reg.add(NodeGroup("ng-c", cpu="2"))
+        store.add_node(g.node_template(5))   # static member, index 5
+        prov = SimulatedProvisioner(store, reg)
+        names = prov.provision(g, 2)         # boot 0: synchronous
+        assert names == ["ng-c-6", "ng-c-7"]
+        assert prov.group_size("ng-c") == 3
+
+
+# ---------------------------------------------------------------------------
+# the what-if solve (virtual columns)
+
+
+class TestWhatIf:
+    def _pending(self, n, cpu="500m"):
+        return [MakePod().name(f"p{i}").uid(f"pu{i}")
+                .req({"cpu": cpu, "memory": "500Mi"}).obj()
+                for i in range(n)]
+
+    def test_prefers_existing_capacity_no_scale_up(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("n0")
+                       .capacity({"cpu": "8", "memory": "16Gi"}).obj())
+        g = NodeGroup("ng", cpu="8")
+        plan = plan_scale_up(store.list_nodes(), [], self._pending(4),
+                             [(g, 8)])
+        assert plan.solves == 1
+        assert plan.chosen is None          # pods fit the real node
+
+    def test_counts_needed_nodes_by_bin_packing(self):
+        store = ClusterStore()
+        _full_node(store, "n0")
+        g = NodeGroup("ng", cpu="2", memory="4Gi")
+        plan = plan_scale_up(
+            store.list_nodes(),
+            [p for p in store.list_pods()], self._pending(10), [(g, 20)])
+        # 10 x 500m onto 2-cpu nodes: 4+4+2 -> 3 nodes, not 10
+        assert plan.chosen is not None
+        assert plan.chosen.nodes_needed == 3
+        assert plan.chosen.pods_on_new == 10
+
+    def test_headroom_caps_virtual_columns(self):
+        store = ClusterStore()
+        _full_node(store, "n0")
+        g = NodeGroup("ng", cpu="2", max_size=1)
+        plan = plan_scale_up(store.list_nodes(),
+                             [p for p in store.list_pods()],
+                             self._pending(10), [(g, 1)])
+        assert plan.chosen.nodes_needed == 1   # only 1 column offered
+        assert plan.chosen.pods_on_new == 4    # 4 x 500m fit 2 cpu
+
+    def test_respects_template_constraints(self):
+        """A group whose template a pod's nodeSelector rejects gets no
+        placements — template taints/labels flow through the same host
+        plugin code as real nodes."""
+        store = ClusterStore()
+        _full_node(store, "n0")
+        pending = [MakePod().name(f"z{i}").uid(f"zu{i}")
+                   .req({"cpu": "500m"})
+                   .node_selector({ZONE: "z-a"}).obj() for i in range(4)]
+        g_a = NodeGroup("ng-za", cpu="4", labels={ZONE: "z-a"})
+        g_b = NodeGroup("ng-zb", cpu="4", labels={ZONE: "z-b"})
+        plan = plan_scale_up(store.list_nodes(),
+                             [p for p in store.list_pods()],
+                             pending, [(g_a, 4), (g_b, 4)])
+        assert plan.chosen.group == "ng-za"
+        assert [o.group for o in plan.options] == ["ng-za"]
+
+    def test_expanders_least_waste_vs_priority(self):
+        store = ClusterStore()
+        _full_node(store, "n0")
+        bound = [p for p in store.list_pods()]
+        pending = self._pending(10)
+        g_small = NodeGroup("ng-small", cpu="2", memory="4Gi", priority=0)
+        g_big = NodeGroup("ng-big", cpu="16", memory="32Gi", priority=9)
+        groups = [(g_small, 20), (g_big, 20)]
+        lw = plan_scale_up(store.list_nodes(), bound, pending, groups,
+                           expander="least-waste")
+        pr = plan_scale_up(store.list_nodes(), bound, pending, groups,
+                           expander="priority")
+        assert lw.chosen.group == "ng-small"   # tighter fit
+        assert pr.chosen.group == "ng-big"     # higher priority
+        assert pr.chosen.nodes_needed == 1
+
+    def test_upcoming_nodes_prevent_double_buy(self):
+        """Capacity already booting absorbs pending demand: the what-if
+        must not re-buy nodes the provisioner is still spinning up."""
+        store = ClusterStore()
+        _full_node(store, "n0")
+        g = NodeGroup("ng", cpu="8", memory="16Gi")
+        upcoming = [g.node_template("boot-0")]
+        plan = plan_scale_up(store.list_nodes(),
+                             [p for p in store.list_pods()],
+                             self._pending(8), [(g, 20)],
+                             upcoming=upcoming)
+        assert plan.chosen is None   # all 8 x 500m ride the upcoming node
+
+    def test_fit_elsewhere_disabled_column(self):
+        store = ClusterStore()
+        for name in ("m0", "m1"):
+            store.add_node(MakeNode().name(name)
+                           .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        pods_m0 = [MakePod().name(f"d{i}").uid(f"du{i}")
+                   .req({"cpu": "1"}).node("m0").obj() for i in range(2)]
+        for p in pods_m0:
+            store.create_pod(p)
+        assert pods_fit_elsewhere(store.list_nodes(), store.list_pods(),
+                                  "m0", pods_m0)
+        # fill m1: now m0's pods have nowhere to go
+        store.create_pod(MakePod().name("big").uid("bigu")
+                         .req({"cpu": "3800m"}).node("m1").obj())
+        assert not pods_fit_elsewhere(
+            store.list_nodes(), store.list_pods(), "m0", pods_m0)
+
+
+# ---------------------------------------------------------------------------
+# differential: batched virtual-column solve vs serial per-pod oracle
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("seed", [7, 21, 42, 1337])
+    def test_batched_agrees_with_serial_oracle(self, seed):
+        """Acceptance bar: on randomized clusters/bursts the batched
+        estimator and a per-pod serial simulation must choose the same
+        group and node count, under both expanders."""
+        import random
+
+        rng = random.Random(seed)
+        nodes = []
+        bound = []
+        for i in range(rng.randint(6, 10)):
+            cpu = rng.choice([2, 4, 8])
+            nodes.append(
+                MakeNode().name(f"rn{i}")
+                .label(ZONE, f"z{i % 2}")
+                .capacity({"cpu": str(cpu), "memory": "16Gi"}).obj())
+            # fill 60-100% of each node
+            fill = int(cpu * 1000 * rng.uniform(0.6, 1.0))
+            bound.append(
+                MakePod().name(f"rf{i}").uid(f"rfu{i}")
+                .req({"cpu": f"{fill}m"}).node(f"rn{i}").obj())
+        pending = []
+        for i in range(rng.randint(10, 22)):
+            w = MakePod().name(f"rp{i}").uid(f"rpu{i}").req(
+                {"cpu": f"{rng.choice([250, 500, 1000])}m",
+                 "memory": "256Mi"})
+            if rng.random() < 0.3:
+                w.node_selector({ZONE: f"z{rng.randint(0, 1)}"})
+            pending.append(w.obj())
+        groups = []
+        for j, cpu in enumerate(rng.sample([2, 4, 8, 16], k=2)):
+            groups.append((NodeGroup(
+                f"rg{j}", cpu=str(cpu), memory="16Gi",
+                labels={ZONE: f"z{j % 2}"},
+                priority=rng.randint(0, 5)), 16))
+        for expander in ("least-waste", "priority"):
+            batched = plan_scale_up(nodes, bound, pending, groups,
+                                    expander=expander)
+            serial = plan_scale_up(nodes, bound, pending, groups,
+                                   expander=expander, serial=True)
+            if batched.chosen is None:
+                assert serial.chosen is None, (expander, serial.chosen)
+            else:
+                assert serial.chosen is not None, (expander, batched.chosen)
+                assert batched.chosen.group == serial.chosen.group
+                assert batched.chosen.nodes_needed == \
+                    serial.chosen.nodes_needed
+                assert batched.chosen.pods_on_new == \
+                    serial.chosen.pods_on_new
+
+
+# ---------------------------------------------------------------------------
+# the control loop
+
+
+class TestControlLoop:
+    def test_scale_up_decision_is_batched_not_per_pod(self, monkeypatch):
+        """The decision path issues ONE solve per candidate group —
+        independent of pending-set size — through the virtual-column
+        solver, never a per-pod loop."""
+        from kubernetes_tpu.autoscaler import simulator as sim
+        from kubernetes_tpu.ops import solver as solver_mod
+
+        batched_calls = []
+        serial_calls = []
+        real = solver_mod.solve_whatif
+        monkeypatch.setattr(
+            sim, "solve_whatif",
+            lambda *a, **kw: batched_calls.append(1) or real(*a, **kw))
+        monkeypatch.setattr(
+            sim, "_serial_whatif",
+            lambda *a, **kw: serial_calls.append(1) or (_ for _ in ()).throw(
+                AssertionError("serial oracle used on the decision path")))
+
+        store = ClusterStore()
+        _full_node(store, "n0")
+        for i in range(40):
+            pod = MakePod().name(f"q{i}").uid(f"qu{i}") \
+                .req({"cpu": "500m"}).obj()
+            store.create_pod(pod)
+            _mark_unschedulable(store, pod)
+        reg = NodeGroupRegistry([NodeGroup("ga", cpu="4", max_size=30),
+                                 NodeGroup("gb", cpu="8", max_size=30)])
+        ca = _mk_ca(store, reg, scale_up_cooldown=0.0)
+        ca.reconcile_once()
+        assert len(batched_calls) == 2      # one per group, not per pod
+        assert not serial_calls
+        assert ca.whatif_solves == 2
+        assert ca.scale_up_events == 1
+
+    def test_reconcile_scales_up_within_bounds_and_cooldown(self):
+        store = ClusterStore()
+        _full_node(store, "n0")
+        pods = []
+        for i in range(12):
+            pod = MakePod().name(f"w{i}").uid(f"wu{i}") \
+                .req({"cpu": "500m"}).obj()
+            store.create_pod(pod)
+            _mark_unschedulable(store, pod)
+            pods.append(pod)
+        reg = NodeGroupRegistry([NodeGroup("gc", cpu="2", max_size=2)])
+        ca = _mk_ca(store, reg, scale_up_cooldown=30.0)
+        ca.reconcile_once()
+        # 12 x 500m want 3 nodes; max_size caps the group at 2
+        assert ca.provisioner.group_size("gc") == 2
+        assert ca.metrics.pending_unschedulable.get() == 12.0
+        # cooldown: a second pass buys nothing even though pods pend
+        ca.reconcile_once()
+        assert ca.provisioner.group_size("gc") == 2
+        # bind everything -> pending drains -> time-to-capacity observed
+        before = ca.metrics.time_to_capacity_seconds.count()
+        names = {n.name for n in store.list_nodes()}
+        target = sorted(names - {"n0"})[0]
+        for pod in pods:
+            store.bind(pod.namespace, pod.metadata.name, pod.uid, target)
+        ca.reconcile_once()
+        assert ca.metrics.pending_unschedulable.get() == 0.0
+        assert ca.metrics.time_to_capacity_seconds.count() == before + 1
+
+    def test_queue_introspection_is_the_trigger(self):
+        """With a scheduler queue attached, its unschedulableQ is the
+        trigger surface (no store heuristics)."""
+        from kubernetes_tpu.scheduler.queue import SchedulingQueue
+        from kubernetes_tpu.scheduler.types import QueuedPodInfo
+
+        q = SchedulingQueue()
+        pod = MakePod().name("uq").uid("uqu").req({"cpu": "1"}).obj()
+        q.add(pod)
+        qpi = q.pop()
+        q.add_unschedulable_if_not_present(qpi, q.scheduling_cycle)
+        assert [p.metadata.name for p in q.unschedulable_pods()] == ["uq"]
+        assert isinstance(qpi, QueuedPodInfo)
+
+        store = ClusterStore()
+        _full_node(store, "n0")
+        reg = NodeGroupRegistry([NodeGroup("gq", cpu="2", max_size=4)])
+        ca = _mk_ca(store, reg, scale_up_cooldown=0.0)
+        ca.queue_introspect = q
+        ca.reconcile_once()
+        assert ca.provisioner.group_size("gq") == 1
+
+    def test_scale_down_drains_with_pdb_and_deletes(self):
+        """Cordon -> PDB-respecting eviction -> deletion: a PDB with
+        zero budget blocks the drain; raising the budget releases it."""
+        store = ClusterStore()
+        reg = NodeGroupRegistry(
+            [NodeGroup("gd", cpu="4", memory="8Gi", min_size=1,
+                       max_size=5)])
+        g = reg.get("gd")
+        for i in range(3):
+            store.add_node(g.node_template(i))
+        # one small annotated pod on gd-0; gd-1 busy; gd-2 holds the
+        # PDB-protected app pod's sibling so healthy count is 2
+        low = MakePod().name("low").uid("lowu").label("app", "db") \
+            .req({"cpu": "250m"}).node("gd-0").obj()
+        low.metadata.annotations[SAFE_TO_EVICT_ANNOTATION] = "true"
+        store.create_pod(low)
+        sib = MakePod().name("sib").uid("sibu").label("app", "db") \
+            .req({"cpu": "250m"}).node("gd-2").obj()
+        sib.metadata.annotations[SAFE_TO_EVICT_ANNOTATION] = "true"
+        store.create_pod(sib)
+        store.create_pod(MakePod().name("busy").uid("busyu")
+                         .req({"cpu": "3500m"}).node("gd-1").obj())
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+            min_available=2)
+        pdb.metadata.name = "db-pdb"
+        pdb.status.disruptions_allowed = 0     # blocked
+        store.add_pdb(pdb)
+
+        ca = _mk_ca(store, reg, scale_down_unneeded_time=0.0,
+                    max_concurrent_drains=1,
+                    scale_down_utilization_threshold=0.5)
+        ca.reconcile_once()                     # picks ONE candidate
+        assert len(ca._draining) == 1
+        drained_name = next(iter(ca._draining))
+        assert store.get_node(drained_name).spec.unschedulable
+        if drained_name == "gd-0":
+            # PDB budget 0: the pod survives every pass
+            ca.reconcile_once()
+            assert store.get_pod("default", "low") is not None
+            # raise the budget (the disruption controller's job)
+            upd = shallow_copy(pdb)
+            upd.metadata = shallow_copy(pdb.metadata)
+            upd.status = type(pdb.status)(disruptions_allowed=1,
+                                          current_healthy=2,
+                                          desired_healthy=2,
+                                          expected_pods=2)
+            store.update_object("PodDisruptionBudget", upd)
+            ca.reconcile_once()                 # evicts
+            assert store.get_pod("default", "low") is None
+        _wait(lambda: (ca.reconcile_once(),
+                       store.get_node(drained_name) is None)[1],
+              timeout=5.0, msg="drained node deleted")
+        assert ca.scale_down_events >= 1
+        assert ca.metrics.scaledowns_total.get("gd") >= 1.0
+        # busy and the min-size floor survive
+        assert store.get_node("gd-1") is not None
+        assert len(store.list_nodes()) >= 1
+
+    def test_scale_down_refuses_unowned_unannotated_pods(self):
+        store = ClusterStore()
+        reg = NodeGroupRegistry([NodeGroup("ge", cpu="4", min_size=0,
+                                           max_size=5)])
+        g = reg.get("ge")
+        for i in range(2):
+            store.add_node(g.node_template(i))
+        store.create_pod(MakePod().name("bare").uid("bareu")
+                         .req({"cpu": "100m"}).node("ge-0").obj())
+        ca = _mk_ca(store, reg, scale_down_unneeded_time=0.0)
+        for _ in range(3):
+            ca.reconcile_once()
+        # ge-0 holds a bare pod nothing would recreate: never drained;
+        # ge-1 is empty and goes
+        assert store.get_node("ge-0") is not None
+        assert store.get_pod("default", "bare") is not None
+        _wait(lambda: (ca.reconcile_once(),
+                       store.get_node("ge-1") is None)[1],
+              timeout=5.0, msg="empty node deleted")
+
+    def test_leader_election_single_brain(self):
+        """Two autoscalers, one lease: only the leader provisions."""
+        store = ClusterStore()
+        _full_node(store, "n0")
+        for i in range(2):
+            pod = MakePod().name(f"le{i}").uid(f"leu{i}") \
+                .req({"cpu": "500m"}).obj()
+            store.create_pod(pod)
+            _mark_unschedulable(store, pod)
+        mk = lambda: _mk_ca(  # noqa: E731 — two identical instances
+            store, NodeGroupRegistry([NodeGroup("gl", cpu="2",
+                                                max_size=4)]),
+            scale_up_cooldown=0.0, RESYNC_SECONDS=0.05,
+            scale_down_enabled=False)
+        ca1, ca2 = mk(), mk()
+        try:
+            ca1.run_with_leader_election(
+                identity="ca-1", lease_duration=1.0,
+                renew_deadline=0.6, retry_period=0.1)
+            _wait(lambda: ca1.elector.is_leader, msg="ca-1 leads")
+            ca2.run_with_leader_election(
+                identity="ca-2", lease_duration=1.0,
+                renew_deadline=0.6, retry_period=0.1)
+            _wait(lambda: len(store.list_nodes()) == 2,
+                  msg="leader provisions one node")
+            time.sleep(0.6)   # a double-brain would buy more
+            assert not ca2.elector.is_leader
+            assert len(store.list_nodes()) == 2
+        finally:
+            ca1.stop()
+            ca2.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites
+
+
+class TestClusterAutoscalerProvider:
+    def _run_one(self, provider):
+        store = ClusterStore()
+        for name in ("pa", "pb"):
+            store.add_node(MakeNode().name(name)
+                           .capacity({"cpu": "10", "memory": "10Gi"}).obj())
+        # pa is 60% full; pb empty
+        store.create_pod(MakePod().name("base").uid("baseu")
+                         .req({"cpu": "6", "memory": "6Gi"})
+                         .node("pa").obj())
+        sched = Scheduler.create(store, provider=provider)
+        try:
+            sched.start()
+            store.create_pod(MakePod().name("probe").uid("probeu")
+                             .req({"cpu": "1", "memory": "1Gi"}).obj())
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                sched.queue.flush_backoff_completed()
+                if not sched.schedule_one(pop_timeout=0.05):
+                    pod = store.get_pod("default", "probe")
+                    if pod is not None and pod.spec.node_name:
+                        break
+            assert sched.wait_for_inflight_bindings()
+            return sched, store.get_pod("default", "probe").spec.node_name
+        finally:
+            sched.stop()
+
+    def test_profile_swaps_least_for_most_allocated(self):
+        store = ClusterStore()
+        sched = Scheduler.create(store, provider="ClusterAutoscalerProvider")
+        try:
+            fwk = next(iter(sched.profiles.values()))
+            score = fwk.list_plugins()["score"]
+            assert "NodeResourcesMostAllocated" in score
+            assert "NodeResourcesLeastAllocated" not in score
+        finally:
+            sched.stop()
+
+    def test_bin_packs_vs_default_spreading(self):
+        """The profile must CHANGE BEHAVIOR: MostAllocated packs onto
+        the fuller node, the default LeastAllocated spreads away from
+        it — same cluster, same pod."""
+        _, packed = self._run_one("ClusterAutoscalerProvider")
+        assert packed == "pa"
+        _, spread = self._run_one("DefaultProvider")
+        assert spread == "pb"
+
+
+class TestBurstGenerator:
+    def test_shapes_names_uids_annotations(self):
+        pods = make_burst_pods(3, cpu_milli=250, name_prefix="bb-",
+                               uid_prefix="bbu-", offset=5,
+                               labels={"app": "bb"}, safe_to_evict=True)
+        assert [p.metadata.name for p in pods] == ["bb-5", "bb-6", "bb-7"]
+        assert pods[0].metadata.uid == "bbu-5"
+        assert pods[0].metadata.labels["app"] == "bb"
+        assert pods[0].metadata.annotations[SAFE_TO_EVICT_ANNOTATION] \
+            == "true"
+        from kubernetes_tpu.scheduler.types import (
+            compute_pod_resource_request,
+        )
+
+        assert compute_pod_resource_request(pods[0]).milli_cpu == 250
+
+    def test_reports_time_to_all_bound(self):
+        store = ClusterStore()
+        store.add_node(MakeNode().name("bn0")
+                       .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        sched = Scheduler.create(store)
+        try:
+            sched.run()
+            res = run_pending_burst(store, 5, timeout=20.0,
+                                    cpu_milli=250, name_prefix="tb-",
+                                    uid_prefix="tbu-")
+            assert res.ok and res.bound == 5
+            assert res.time_to_all_bound > 0
+            assert res.pods_per_second > 0
+        finally:
+            sched.stop()
+
+    def test_timeout_reports_unbound(self):
+        store = ClusterStore()   # no nodes, no scheduler
+        res = run_pending_burst(store, 2, timeout=0.2,
+                                name_prefix="to-", uid_prefix="tou-")
+        assert not res.ok
+        assert res.bound == 0
+        assert res.time_to_all_bound is None
+
+
+class TestHPAHandoff:
+    def test_hpa_scales_past_capacity_autoscaler_adds_nodes(self):
+        """HPA scales a Deployment beyond node capacity -> replicas go
+        unschedulable -> the autoscaler buys a group node -> every
+        replica binds."""
+        from kubernetes_tpu.api.types import (
+            Deployment,
+            HorizontalPodAutoscaler,
+        )
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.controllers.horizontalpodautoscaler import (
+            USAGE_ANNOTATION,
+        )
+
+        store = ClusterStore()
+        store.add_node(MakeNode().name("static-0")
+                       .capacity({"cpu": "2", "memory": "8Gi"}).obj())
+        cm = ControllerManager(store, controllers=[
+            "deployment", "replicaset", "horizontalpodautoscaler"])
+        cm.get("horizontalpodautoscaler").RESYNC_SECONDS = 0.2
+        reg = NodeGroupRegistry([NodeGroup(
+            "ng-hpa", cpu="2", memory="8Gi", min_size=0, max_size=3)])
+        sched = Scheduler.create(store)
+        ca = _mk_ca(store, reg, RESYNC_SECONDS=0.05,
+                    scale_up_cooldown=0.3, scale_down_enabled=False)
+        ca.queue_introspect = sched.queue
+        try:
+            cm.start()
+            sched.run()
+            ca.run()
+            d = Deployment(
+                selector=LabelSelector(match_labels={"app": "web"}),
+                replicas=2,
+                template={
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {"containers": [
+                        {"name": "c",
+                         "resources": {"requests": {"cpu": "1000m"}}}
+                    ]},
+                })
+            d.metadata.name = "web"
+            store.add_deployment(d)
+            _wait(lambda: sum(
+                1 for p in store.list_pods() if p.spec.node_name) == 2,
+                msg="2 replicas bound on the static node")
+
+            def annotate(usage: str) -> None:
+                for p in store.list_pods():
+                    if p.metadata.labels.get("app") != "web":
+                        continue
+                    cur = store.get_pod(p.namespace, p.metadata.name)
+                    if cur is None or \
+                            cur.metadata.annotations.get(
+                                USAGE_ANNOTATION) == usage:
+                        continue
+                    up = shallow_copy(cur)
+                    up.metadata = shallow_copy(cur.metadata)
+                    up.metadata.annotations = dict(cur.metadata.annotations)
+                    up.metadata.annotations[USAGE_ANNOTATION] = usage
+                    store.update_pod(up)
+
+            hpa = HorizontalPodAutoscaler(
+                scale_target_ref={"kind": "Deployment", "name": "web"},
+                min_replicas=2, max_replicas=4,
+                target_cpu_utilization_percentage=50)
+            hpa.metadata.name = "web-hpa"
+            store.add_hpa(hpa)
+            annotate("1000")   # 100% vs 50% target -> scale toward 4
+            _wait(lambda: (annotate("1000"),
+                           store.get_deployment("default", "web")
+                           .replicas == 4)[1],
+                  timeout=20.0, msg="HPA scaled 2 -> 4")
+            # the hand-off: 2 new replicas exceed static capacity, the
+            # autoscaler must buy capacity and every replica must bind
+            _wait(lambda: sum(
+                1 for p in store.list_pods()
+                if p.metadata.labels.get("app") == "web"
+                and p.spec.node_name) == 4,
+                timeout=30.0, msg="all 4 replicas bound after scale-up")
+            assert ca.scale_up_events >= 1
+            assert ca.provisioner.live_count("ng-hpa") >= 1
+        finally:
+            ca.stop()
+            sched.stop()
+            cm.stop()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end elastic story (acceptance)
+
+
+class TestEndToEndElastic:
+    def test_burst_scale_up_all_bind_then_drain_back(self):
+        """Cluster at 2 nodes, burst 40 pods that cannot fit ->
+        autoscaler scales the group within min/max -> ALL pods bind ->
+        the workload shrinks -> idle nodes are drained (PDB honored,
+        evicted pods rescued and re-bound: zero lost) back toward min
+        size."""
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.harness.chaos_nodes import PodRescuer
+
+        store = ClusterStore()
+        reg = NodeGroupRegistry([NodeGroup(
+            "ng-e2e", cpu="4", memory="8Gi", min_size=2, max_size=12,
+            boot_latency=0.05)])
+        g = reg.get("ng-e2e")
+        for i in range(2):
+            store.add_node(g.node_template(i))
+        # disruption controller maintains the PDB state the drain reads
+        cm = ControllerManager(store, controllers=["disruption"])
+        sched = Scheduler.create(store)
+        ca = _mk_ca(store, reg, RESYNC_SECONDS=0.05,
+                    scale_up_cooldown=0.3,
+                    scale_down_unneeded_time=0.4,
+                    scale_down_utilization_threshold=0.35,
+                    max_concurrent_drains=1)
+        ca.queue_introspect = sched.queue
+        rescuer = PodRescuer(store, store, name_prefix="eb-")
+        pdb = PodDisruptionBudget(
+            label_selector=LabelSelector(match_labels={"app": "eb"}),
+            min_available=2)
+        pdb.metadata.name = "eb-pdb"
+        store.add_pdb(pdb)
+        try:
+            cm.start()
+            sched.run()
+            ca.run()
+            rescuer.start()
+            # ---- phase A: burst beyond capacity, scale up, all bind
+            res = run_pending_burst(
+                store, 40, timeout=60.0, cpu_milli=500,
+                name_prefix="eb-", uid_prefix="ebu-",
+                labels={"app": "eb"}, safe_to_evict=True)
+            assert res.ok, f"only {res.bound}/40 bound"
+            peak = ca.provisioner.live_count("ng-e2e")
+            assert 5 <= peak <= 12          # needed 5, capped at 12
+            assert ca.scale_up_events >= 1
+            assert ca.whatif_solves >= 1    # the batched decision path
+            assert ca.metrics.time_to_capacity_seconds.count() >= 1
+            # ---- phase B: workload completes down to 8 pods, spread
+            # across the scaled-up nodes so draining REQUIRES eviction
+            survivor_ids = [5 * i for i in range(8)]
+            survivors = [f"eb-{i}" for i in survivor_ids]
+            for i in range(40):
+                if i in survivor_ids:
+                    continue
+                cur = store.get_pod("default", f"eb-{i}")
+                up = shallow_copy(cur)
+                up.metadata = shallow_copy(cur.metadata)
+                up.status = type(cur.status)(phase=SUCCEEDED)
+                store.update_pod(up)            # terminal: rescuer skips
+                store.delete_pod("default", f"eb-{i}")
+            # idle nodes drain back toward min; evicted survivors are
+            # rescued (fresh uid, same name) and re-bind elsewhere
+            _wait(lambda: ca.provisioner.live_count("ng-e2e") <= 3,
+                  timeout=45.0, msg="scale-down toward min size")
+            _wait(lambda: all(
+                any(p.metadata.name == n and p.spec.node_name
+                    for p in store.list_pods()) for n in survivors),
+                timeout=30.0, msg="every surviving pod re-bound")
+            assert rescuer.recreate_failures == 0
+            live = ca.provisioner.live_count("ng-e2e")
+            assert live >= reg.get("ng-e2e").min_size
+            assert ca.scale_down_events >= 1
+            assert ca.metrics.scaledowns_total.get("ng-e2e") >= 1.0
+            # zero lost: every survivor bound exactly once, on a live node
+            live_nodes = {n.name for n in store.list_nodes()}
+            for name in survivors:
+                pod = store.get_pod("default", name)
+                assert pod is not None and pod.spec.node_name in live_nodes
+        finally:
+            rescuer.stop()
+            ca.stop()
+            sched.stop()
+            cm.stop()
+
+
+# ---------------------------------------------------------------------------
+# churn integration (slow): killer profile with the autoscaler on
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChurnIntegration:
+    def test_killer_churn_with_autoscaler_replaces_dead_capacity(self):
+        """chaos_nodes killer profile with the autoscaler enabled: the
+        PR 3 invariants (no binds to dead nodes, zero lost pods, cache
+        convergence) must hold, AND dead capacity is replaced — the
+        workload needs ~9 of 10 nodes, the killer profile buries up to
+        3, so binding everything requires autoscaled replacements."""
+        from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
+
+        result = run_chaos_nodes(
+            seed=29, nodes=10, pods=70, node_cpu=4, waves=4,
+            churn_profile="killer", autoscale=True,
+            wait_timeout=180.0)
+        assert result["ok"], result
+        assert result["stats"]["autoscaler_nodes_added"] >= 1, result

@@ -2,7 +2,7 @@
 """Run the seeded chaos matrices and print a pass/fail table (the CI
 face of ``kubernetes_tpu.harness.chaos_rest`` and ``chaos_nodes``).
 
-Two suites:
+Three suites:
 
 - ``rest`` — wire-level: a seeded fault profile armed through
   /debug/faults, an apiserver SIGKILL + WAL-restore restart
@@ -14,12 +14,17 @@ Two suites:
   nodelifecycle controller evicting and the rescue pipeline
   recreating; invariants (no binds to dead nodes, no lost pods,
   cache == store after quiesce) plus rescue-latency p99 per cell.
+- ``scale`` — elasticity: burst-size × boot-latency cells through the
+  cluster autoscaler (cluster starts at 20% of needed capacity, the
+  what-if solver buys the rest); each cell reports time-to-capacity
+  p99 across repeats and fails on any unbound pod.
 
 Usage::
 
-    python tools/chaos_matrix.py                      # both suites
+    python tools/chaos_matrix.py                      # rest + nodes
     python tools/chaos_matrix.py --suite nodes --churn mixed,killer
     python tools/chaos_matrix.py --suite rest --seeds 11,23 -v
+    python tools/chaos_matrix.py --suite scale --bursts 60,120 -v
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -61,7 +66,7 @@ def main() -> int:
     parser = argparse.ArgumentParser(
         description="seeded chaos matrices (wire faults + node churn)")
     parser.add_argument("--suite", default="both",
-                        choices=("rest", "nodes", "both"))
+                        choices=("rest", "nodes", "scale", "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -73,6 +78,12 @@ def main() -> int:
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
+    parser.add_argument("--bursts", default="60,120",
+                        help="scale-suite burst sizes (pods per cell)")
+    parser.add_argument("--boots", default="0.0,0.3",
+                        help="scale-suite provisioner boot latencies (s)")
+    parser.add_argument("--scale-repeats", type=int, default=2,
+                        help="elastic runs per scale cell (p99 basis)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="stream per-run progress")
     args = parser.parse_args()
@@ -102,28 +113,76 @@ def main() -> int:
         _run_suite(args, progress, rows, "rest", run_chaos_rest,
                    "fault_profile",
                    [p for p in args.profiles.split(",") if p])
-    if args.suite in ("nodes", "both"):
+    if args.suite in ("nodes", "both", "all"):
         _run_suite(args, progress, rows, "nodes", run_chaos_nodes,
                    "churn_profile",
                    [p for p in args.churn.split(",") if p])
+    if args.suite in ("scale", "all"):
+        from kubernetes_tpu.harness.elastic import run_scale_cell
+
+        bursts = [int(b) for b in args.bursts.split(",") if b]
+        boots = [float(b) for b in args.boots.split(",") if b != ""]
+        for burst in bursts:
+            for boot in boots:
+                cell = f"b{burst}/bl{boot:g}"
+                t0 = time.monotonic()
+                try:
+                    r = run_scale_cell(
+                        burst, boot, repeats=args.scale_repeats,
+                        node_cpu=4, wait_timeout=args.wait_timeout,
+                        progress=progress)
+                except Exception as e:  # noqa: BLE001 — crashed cell = FAIL
+                    r = {"ok": False,
+                         "failure": f"{type(e).__name__}: {e}",
+                         "stats": {}}
+                r["suite"] = "scale"
+                r["profile"] = cell
+                r["seed"] = "-"
+                r["elapsed"] = time.monotonic() - t0
+                rows.append(r)
+                status = "PASS" if r["ok"] else "FAIL"
+                print(f"  [{status}] scale/{cell} "
+                      f"({r['elapsed']:.1f}s)", flush=True)
 
     failed = sum(1 for r in rows if not r["ok"])
     head = (f"{'suite':<6} {'profile':<10} {'seed':>5} {'result':<6} "
             f"{'faults':>7} {'retries':>8} {'evict':>6} {'rescue_p99':>10} "
             f"{'time':>7}  failure")
-    print()
-    print(head)
-    print("-" * len(head))
-    for r in rows:
-        s = r.get("stats") or {}
-        rescue_p99 = s.get("rescue_p99_s")
-        print(f"{r['suite']:<6} {r['profile']:<10} {r['seed']:>5} "
-              f"{'PASS' if r['ok'] else 'FAIL':<6} "
-              f"{s.get('faults_injected', '-'):>7} "
-              f"{s.get('client_retries', '-'):>8} "
-              f"{s.get('evictions', '-'):>6} "
-              f"{(f'{rescue_p99:.3f}s' if rescue_p99 is not None else '-'):>10} "
-              f"{r['elapsed']:>6.1f}s  {r.get('failure', '')}")
+    chaos_rows = [r for r in rows if r["suite"] != "scale"]
+    if chaos_rows:
+        print()
+        print(head)
+        print("-" * len(head))
+        for r in chaos_rows:
+            s = r.get("stats") or {}
+            rescue_p99 = s.get("rescue_p99_s")
+            print(f"{r['suite']:<6} {r['profile']:<10} {r['seed']:>5} "
+                  f"{'PASS' if r['ok'] else 'FAIL':<6} "
+                  f"{s.get('faults_injected', '-'):>7} "
+                  f"{s.get('client_retries', '-'):>8} "
+                  f"{s.get('evictions', '-'):>6} "
+                  f"{(f'{rescue_p99:.3f}s' if rescue_p99 is not None else '-'):>10} "
+                  f"{r['elapsed']:>6.1f}s  {r.get('failure', '')}")
+    scale_rows = [r for r in rows if r["suite"] == "scale"]
+    if scale_rows:
+        head2 = (f"{'cell':<12} {'result':<6} {'ttc_p99':>8} "
+                 f"{'ttc_p50':>8} {'pods/s':>8} {'scaleups':>9} "
+                 f"{'nodes':>6} {'time':>7}  failure")
+        print()
+        print(head2)
+        print("-" * len(head2))
+        for r in scale_rows:
+            s = r.get("stats") or {}
+            p99 = s.get("time_to_capacity_p99_s")
+            p50 = s.get("time_to_capacity_p50_s")
+            print(f"{r['profile']:<12} "
+                  f"{'PASS' if r['ok'] else 'FAIL':<6} "
+                  f"{(f'{p99:.2f}s' if p99 is not None else '-'):>8} "
+                  f"{(f'{p50:.2f}s' if p50 is not None else '-'):>8} "
+                  f"{s.get('pods_per_s_min', 0.0):>8.1f} "
+                  f"{s.get('scaleup_decisions', 0):>9} "
+                  f"{s.get('nodes_provisioned', 0):>6} "
+                  f"{r['elapsed']:>6.1f}s  {r.get('failure', '')}")
     print(f"\n{len(rows) - failed}/{len(rows)} cells passed")
     return 1 if failed else 0
 
