@@ -6,11 +6,17 @@ serial path measured on the same cluster (the stock-scheduler stand-in;
 BASELINE.md: "absolute reference numbers must be measured, not cited").
 
 Default (the driver invocation) prints one JSON line PER workload —
-configs 1-5 then the headline LAST (the driver records the final line;
-the reference likewise emits per-workload DataItems,
-scheduler_perf/util.go:101-129). Every BASELINE.md matrix row is
+configs 1-5, then the REST-fabric row, then the headline LAST (the
+driver records the final lines of stdout; the reference likewise emits
+per-workload DataItems, scheduler_perf/util.go:101-129). The REST row
+prints immediately before the headline ON PURPOSE: the driver
+tail-captures stdout, and a row printed mid-run falls out of the
+artifact (VERDICT r5 weak #1). Every BASELINE.md matrix row is
 therefore traceable to the driver artifact (VERDICT r2 weak #2):
     {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N}
+The REST row also carries ``store_direct_pods_per_sec`` and
+``fabric_overhead_ratio`` (REST/store-direct, same process, same
+scale): the fabric's cost is a first-class bench number.
 
 Options (all optional):
     --config {1..5|headline|rest}  run ONE workload instead of the matrix
@@ -106,6 +112,19 @@ EXTRA_MATRIX = {
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def matrix_row_order(include_extra: bool = False) -> list:
+    """Emission order for the default matrix. The REST-fabric row comes
+    SECOND-TO-LAST — after the config rows, immediately before the
+    headline — so the driver's tail capture of stdout always contains
+    it next to the headline (the round-5 artifact lost the REST row
+    because it printed first and fell out of the tail). Guarded by
+    tests/test_fastfabric.py::TestBenchRowOrder."""
+    order = ["1", "2", "3", "4", "5"]
+    if include_extra:
+        order += sorted(EXTRA_MATRIX)
+    return order + ["rest", "headline"]
 
 
 def _diagnose(sched, bs) -> None:
@@ -250,7 +269,11 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
 def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
                  qps: float, repeat: int = 3) -> dict:
     """The REST-fabric row: headline workload, every byte over HTTP.
-    Median-of-repeat like the other rows (tunnel variance)."""
+    Median-of-repeat like the other rows (tunnel variance). Also runs
+    the SAME workload store-direct in the SAME process (one run — the
+    A/B's job is attribution, not its own precision) and reports the
+    fabric-overhead ratio REST/store-direct as a first-class number:
+    how much of the headline survives the deployable fabric."""
     from kubernetes_tpu.harness.rest_perf import run_workload_rest
 
     samples = []
@@ -274,6 +297,25 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
         samples.append(res)
     samples.sort(key=lambda b: b.pods_per_second)
     median = samples[len(samples) // 2]
+    # store-direct arm of the A/B (same process, same scale): the
+    # remaining gap REST/store-direct is fabric overhead by definition
+    direct_rate = 0.0
+    try:
+        ops = make_workload("SchedulingBasic", nodes=nodes, init_pods=0,
+                            measure_pods=measure_pods)
+        direct = run_workload("SchedulingBasic/direct-ab", ops,
+                              use_batch=True,
+                              max_batch=min(measure_pods, 4096),
+                              wait_timeout=1200, progress=log)
+        direct_rate = direct.pods_per_second
+        import gc
+
+        gc.collect()
+        log(f"[rest] store-direct A/B arm: {direct_rate:.1f} pods/s "
+            f"(fabric overhead ratio "
+            f"{median.pods_per_second / direct_rate:.3f})")
+    except Exception as e:  # noqa: BLE001 — the REST row must survive
+        log(f"[rest] store-direct A/B arm failed: {e}")
     row = {
         "metric": f"pods_scheduled_per_sec[SchedulingBasic {nodes}nodes/"
                   f"{measure_pods}pods, REST fabric "
@@ -287,6 +329,10 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
         ) if serial_rate > 0 else 0.0,
         "server_pods_bound": median.metrics.get("server_pods_bound"),
         "wal_entries": median.metrics.get("wal_entries"),
+        "store_direct_pods_per_sec": round(direct_rate, 1),
+        "fabric_overhead_ratio": round(
+            median.pods_per_second / direct_rate, 3
+        ) if direct_rate > 0 else 0.0,
     }
     if repeat > 1:
         row["runs"] = [round(b.pods_per_second, 1) for b in samples]
@@ -460,25 +506,35 @@ def main() -> None:
     matrix = {k: CONFIGS[k] for k in ("1", "2", "3", "4", "5")}
     if args.all:
         matrix.update(EXTRA_MATRIX)
-    # the REST-fabric row rides the default matrix (VERDICT r4 #1:
-    # the headline must also survive the repo's own API fabric)
-    try:
-        nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
-        rest_row = run_rest_one(nodes, measure_pods, serial_rate,
-                                args.rest_qps,
-                                repeat=1 if args.quick else 3)
-        rest_row["baseline"] = "SchedulingBasic 5k-node serial rate"
-        print(json.dumps(rest_row), flush=True)
-    except Exception as e:  # noqa: BLE001 — must not lose the matrix
-        log(f"[rest] FAILED: {e}")
-        print(json.dumps({
-            "metric": "pods_scheduled_per_sec[SchedulingBasic REST fabric]",
-            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "error": str(e),
-        }), flush=True)
-    # headline LAST: the driver records the final JSON line
     matrix["headline"] = CONFIGS["headline"]
-    for key, (name, nodes, init_pods, measure_pods) in matrix.items():
+    for key in matrix_row_order(args.all):
+        if key == "rest":
+            # the REST-fabric row rides the default matrix (VERDICT r4
+            # #1: the headline must also survive the repo's own API
+            # fabric) and prints IMMEDIATELY BEFORE the headline: the
+            # driver tail-captures the end of stdout, and a row printed
+            # mid-run falls out of the artifact (VERDICT r5 weak #1 —
+            # tests/test_fastfabric.py guards this ordering)
+            try:
+                nodes, measure_pods = (200, 1000) if args.quick \
+                    else (5000, 30000)
+                rest_row = run_rest_one(nodes, measure_pods, serial_rate,
+                                        args.rest_qps,
+                                        repeat=1 if args.quick else 3)
+                rest_row["baseline"] = \
+                    "SchedulingBasic 5k-node serial rate"
+                print(json.dumps(rest_row), flush=True)
+            except Exception as e:  # noqa: BLE001 — must not lose the
+                # remaining rows
+                log(f"[rest] FAILED: {e}")
+                print(json.dumps({
+                    "metric": "pods_scheduled_per_sec"
+                              "[SchedulingBasic REST fabric]",
+                    "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                    "error": str(e),
+                }), flush=True)
+            continue
+        name, nodes, init_pods, measure_pods = matrix[key]
         if args.quick:
             nodes, init_pods, measure_pods = (
                 200, min(init_pods, 200), 1000,

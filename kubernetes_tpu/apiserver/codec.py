@@ -31,7 +31,12 @@ BINARY_CONTENT_TYPE = "application/vnd.ktpu.binary"
 
 # watch streams prefix each frame with a 4-byte big-endian length (the
 # reference streams length-delimited protobuf frames the same way:
-# runtime/serializer/streaming)
+# runtime/serializer/streaming). A frame's payload is a pickled LIST
+# whose elements are per-event pickles (bytes) — encoded once
+# server-side and cached on the event (rest.py _cached_event_bytes), so
+# coalescing a chunk is a list-of-bytes pickle (memcpy per element),
+# never a re-encode. A frame cut mid-event reads as torn (read_frame →
+# None): the client relists, exactly like a torn JSON line.
 FRAME_LEN_BYTES = 4
 
 

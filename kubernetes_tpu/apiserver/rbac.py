@@ -65,10 +65,15 @@ class RBACAuthorizer:
     def __init__(self, store):
         self.store = store
         self._groups: Dict[str, Set[str]] = {}
+        # bumped on static-group edits so the REST layer's decision
+        # cache (rest.py authorize_cached) can observe policy changes
+        # that don't flow through store events
+        self.policy_gen = 0
 
     # -- group registry ------------------------------------------------
     def add_user_to_group(self, user: str, group: str) -> None:
         self._groups.setdefault(user, set()).add(group)
+        self.policy_gen += 1
 
     def groups_for(self, user: str) -> Set[str]:
         groups = set(self._groups.get(user, ()))

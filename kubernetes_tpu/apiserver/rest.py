@@ -11,16 +11,31 @@ delete,watch}.go`` + ``pkg/controlplane/instance.go:547 InstallLegacyAPI``):
   object routes ``.../{name}``, subresources ``.../pods/{name}/binding``
   (reference ``pkg/registry/core/pod/storage/storage.go:159``) and
   ``.../pods/{name}/status``
-- watches: ``GET ...?watch=true&resourceVersion=N`` streams newline-
-  delimited ``{"type": ..., "object": {...}}`` frames over a chunked
-  response, replaying from N via the revisioned watch cache — the same
+- watches: ``GET ...?watch=true&resourceVersion=N`` streams chunked
+  frames, replaying from N via the revisioned watch cache — the same
   List+Watch contract client-go reflectors consume. A compacted N returns
-  HTTP 410 Gone ("Expired"), telling the client to relist.
+  HTTP 410 Gone ("Expired"), telling the client to relist. Delivery is
+  PIPELINED: events are coalesced per chunk (binary clients get one
+  length-prefixed frame carrying a batch of per-event pickles, cached so
+  N watchers never pay N encodes; JSON clients get several newline-
+  delimited ``{"type": ..., "object": {...}}`` documents per chunk), with
+  a small flush window so informer catch-up on 30k pods costs
+  O(batches) syscalls, not O(pods).
+- bulk hot-path verbs: POST ``{Kind}List`` to a collection,
+  POST ``/api/v1/bindings`` (BindingList), and POST ``/api/v1/statuses``
+  (PodStatusList) apply N objects per request with positional failures —
+  per-object semantics, per-batch wire cost.
 - ``/healthz`` ``/livez`` ``/readyz`` probes and Prometheus ``/metrics``
 
-Transport is JSON over HTTP/1.1 chunked streams (the wire codec in
-``kubernetes_tpu.api.serialization``); the reference's protobuf negotiation
-is an encoding detail its clients don't observe.
+Transport negotiates per request between JSON over HTTP/1.1 chunked
+streams (the kubectl/debug wire, ``kubernetes_tpu.api.serialization``)
+and the binary codec (``kubernetes_tpu.apiserver.codec`` — the analog of
+the reference's ``application/vnd.kubernetes.protobuf``), which control-
+plane clients use for every hot-path payload. Per-request overhead is
+amortized server-side too: selector-free binary list responses are
+served from a per-kind pre-encoded cache, and authn/authz resolution
+sits behind token→identity / decision LRUs invalidated by the relevant
+object events.
 """
 
 from __future__ import annotations
@@ -103,6 +118,21 @@ def _encode_custom(obj, api_version: str) -> Dict:
     d = to_wire(obj)
     d["apiVersion"] = api_version
     return d
+
+
+def _cached_event_bytes(event: Event) -> bytes:
+    """Pickle one watch event as ``(type, obj, old)``, memoized on the
+    event so N binary watchers (and the replay path) pay ONE encode —
+    the reference's cachingObject, applied to the binary wire. Benign
+    race: two watch writers may both encode the first time; both produce
+    identical bytes and one assignment wins."""
+    from kubernetes_tpu.apiserver import codec
+
+    b = event.__dict__.get("_bin_frame")
+    if b is None:
+        b = codec.encode((event.type, event.obj, event.old_obj))
+        event.__dict__["_bin_frame"] = b
+    return b
 
 
 def resources_metrics_text(store: ClusterStore) -> str:
@@ -642,6 +672,14 @@ class _Handler(BaseHTTPRequestHandler):
             user = self.server.tokens.get(token)
             if user is not None:
                 return user
+            # token→identity LRU: a resolved SA/cert identity must not
+            # re-pay the index lookups and liveness checks per request;
+            # invalidated by Secret/ServiceAccount/CSR events (the only
+            # mutations that can change a resolution)
+            cache = self.server._token_cache
+            user = cache.get(token)
+            if user is not None:
+                return user
             # CSR-issued client certificates authenticate by
             # fingerprint (the x509 request authenticator's role,
             # reference apiserver/pkg/authentication/request/x509/
@@ -651,19 +689,23 @@ class _Handler(BaseHTTPRequestHandler):
                 user = self.server.resolve_cert_fingerprint(
                     token[len("cert:"):])
                 if user is not None:
+                    self.server._cache_token(token, user, cache)
                     return user
             # service-account tokens (minted by the tokens controller)
             # authenticate as system:serviceaccount:<ns>:<name> —
             # reference pkg/serviceaccount token authenticator
             user = self.server.resolve_sa_token(token)
             if user is not None:
+                self.server._cache_token(token, user, cache)
                 return user
+            # failures are never cached: an unknown-token flood must
+            # not evict resolved identities
             return f"token:{token[:8]}"
         return "system:anonymous"
 
     def _check_authz(self, verb: str, kind: str, namespace: str) -> str:
         user = self._user()
-        if not self.server.authorizer(user, verb, kind, namespace):
+        if not self.server.authorize_cached(user, verb, kind, namespace):
             raise Forbidden(f"user {user!r} cannot {verb} {kind}")
         return user
 
@@ -1045,7 +1087,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_negotiated(200, obj,
                                   json_fallback=lambda: self._encode(obj))
             return
-        # list + RV atomically: a watch from this RV misses nothing
+        # list + RV atomically: a watch from this RV misses nothing.
+        # Selector-free binary lists serve from the per-kind pre-encoded
+        # cache — the hot reflector path pays no per-request encode.
+        # Leases are excluded: renewals mutate lease state without a
+        # dispatch, so kind_seq cannot validate a cached body for them.
+        if label_sel is None and field_checks is None \
+                and kind != "Lease" and self._accepts_binary():
+            from kubernetes_tpu.apiserver import codec
+
+            self._send_bytes(200, self.server.cached_list_binary(kind, ns),
+                             codec.BINARY_CONTENT_TYPE)
+            return
         objs, rv = store.list_objects_with_rv(kind, ns)
         if label_sel is not None:
             objs = [o for o in objs
@@ -1117,6 +1170,125 @@ class _Handler(BaseHTTPRequestHandler):
             "kind": "Status",
             "status": "Success" if not failures else "Failure",
             "bound": len(bindings) - len(failures),
+            "failures": failures,
+        })
+
+    def _apply_pod_status(self, ns: str, name: str, status: dict,
+                          user: str) -> Optional[tuple]:
+        """Apply one pods/status payload — the EXACT single-PUT
+        semantics (validating admission against the proposed object,
+        then phase/podIP/hostIP, nominatedNodeName, conditions in that
+        order), shared by the per-object subresource handler and the
+        bulk ``/statuses`` verb so both produce identical store mutation
+        sequences. Returns None on success, (code, reason, message) on
+        failure."""
+        store = self.server.store
+        # status writes dispatch through validating admission too
+        # (NodeRestriction: a kubelet may only write status of pods
+        # bound to it). Validators must judge the PROPOSED object —
+        # req.obj carries the incoming status applied to a copy of
+        # the live pod, old_obj the untouched stored one.
+        live = store.get_pod(ns, name)
+        if live is not None:
+            from kubernetes_tpu.api.types import shallow_copy
+
+            proposed = shallow_copy(live)
+            proposed.status = shallow_copy(live.status)
+            if status.get("phase"):
+                proposed.status.phase = status["phase"]
+            if status.get("podIP"):
+                proposed.status.pod_ip = status["podIP"]
+            if status.get("hostIP"):
+                proposed.status.host_ip = status["hostIP"]
+            try:
+                self.server.admission.validate_only(AdmissionRequest(
+                    UPDATE, "Pod", ns, proposed,
+                    old_obj=live, user=user, subresource="status",
+                ))
+            except AdmissionError as e:
+                return (422, "Invalid", str(e))
+        if live is None:
+            return (404, "NotFound", f"pod {name!r} not found")
+        if status.get("phase") or status.get("podIP") \
+                or status.get("hostIP"):
+            store.set_pod_phase(
+                ns, name,
+                status.get("phase", ""),
+                status.get("podIP", ""),
+                status.get("hostIP", ""),
+            )
+        # scheduler-owned status fields (reference pod/status
+        # strategy allows conditions + nominatedNodeName through the
+        # status subresource — the scheduler's Unschedulable
+        # condition and preemption nomination both write here)
+        if "nominatedNodeName" in status:
+            node = status["nominatedNodeName"]
+            if node:
+                store.set_nominated_node_name(ns, name, node)
+            else:
+                store.clear_nominated_node_name(ns, name)
+        for cond in status.get("conditions") or ():
+            from kubernetes_tpu.api.types import PodCondition
+
+            store.patch_pod_condition(
+                ns, name,
+                cond if not isinstance(cond, dict)
+                else PodCondition(
+                    type=cond.get("type", ""),
+                    status=cond.get("status", ""),
+                    reason=cond.get("reason", ""),
+                    message=cond.get("message", ""),
+                ))
+        return None
+
+    def _bulk_pod_status(self, ns: Optional[str]) -> None:
+        """POST .../statuses with a PodStatusList: the bulk hot-path
+        verb for status writes — mass-decline condition patches and
+        kubelet phase sweeps ship N updates in one request instead of N
+        round trips. Each item is its own transaction with the exact
+        per-pod semantics of PUT pods/{name}/status
+        (``_apply_pod_status``); failures come back positionally."""
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._send_error(400, "BadRequest", f"invalid JSON: {e}")
+            return
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(items, list):
+            self._send_error(400, "BadRequest",
+                             "PodStatusList body with items required")
+            return
+        try:
+            namespaces = {it.get("namespace") or ns or "default"
+                          for it in items}
+        except AttributeError:
+            self._send_error(400, "BadRequest", "malformed status item")
+            return
+        try:
+            user = None
+            for item_ns in namespaces:
+                user = self._check_authz("update", "pods/status", item_ns)
+        except Forbidden as e:
+            self._send_error(403, "Forbidden", str(e))
+            return
+        if user is None:
+            user = self._user()
+        applied = 0
+        failures: List[dict] = []
+        for i, it in enumerate(items):
+            err = self._apply_pod_status(
+                it.get("namespace") or ns or "default",
+                it.get("name") or "",
+                it.get("status") or {}, user)
+            if err is None:
+                applied += 1
+            else:
+                failures.append({"index": i, "code": err[0],
+                                 "message": err[2]})
+        self._send_negotiated(200, {
+            "kind": "Status",
+            "status": "Success" if not failures else "Failure",
+            "applied": applied,
             "failures": failures,
         })
 
@@ -1209,6 +1381,9 @@ class _Handler(BaseHTTPRequestHandler):
             path = urlparse(self.path).path.rstrip("/")
             if path.endswith("/bindings"):
                 self._bulk_bindings(ns)
+                return
+            if path.endswith("/statuses"):
+                self._bulk_pod_status(ns)
                 return
             if path.endswith("/selfsubjectaccessreviews"):
                 # virtual kind (reference authorization.k8s.io/v1
@@ -1457,66 +1632,11 @@ class _Handler(BaseHTTPRequestHandler):
             except Forbidden as e:
                 self._send_error(403, "Forbidden", str(e))
                 return
-            # status writes dispatch through validating admission too
-            # (NodeRestriction: a kubelet may only write status of pods
-            # bound to it). Validators must judge the PROPOSED object —
-            # req.obj carries the incoming status applied to a copy of
-            # the live pod, old_obj the untouched stored one.
-            live = store.get_pod(ns or "default", name)
-            status = body.get("status") or {}
-            if live is not None:
-                from kubernetes_tpu.api.types import shallow_copy
-
-                proposed = shallow_copy(live)
-                proposed.status = shallow_copy(live.status)
-                if status.get("phase"):
-                    proposed.status.phase = status["phase"]
-                if status.get("podIP"):
-                    proposed.status.pod_ip = status["podIP"]
-                if status.get("hostIP"):
-                    proposed.status.host_ip = status["hostIP"]
-                try:
-                    self.server.admission.validate_only(AdmissionRequest(
-                        UPDATE, "Pod", ns or "default", proposed,
-                        old_obj=live, user=user, subresource="status",
-                    ))
-                except AdmissionError as e:
-                    self._send_error(422, "Invalid", str(e))
-                    return
-            if live is None:
-                self._send_error(404, "NotFound", f"pod {name!r} not found")
+            err = self._apply_pod_status(ns or "default", name,
+                                         body.get("status") or {}, user)
+            if err is not None:
+                self._send_error(*err)
                 return
-            if status.get("phase") or status.get("podIP") \
-                    or status.get("hostIP"):
-                store.set_pod_phase(
-                    ns or "default", name,
-                    status.get("phase", ""),
-                    status.get("podIP", ""),
-                    status.get("hostIP", ""),
-                )
-            # scheduler-owned status fields (reference pod/status
-            # strategy allows conditions + nominatedNodeName through the
-            # status subresource — the scheduler's Unschedulable
-            # condition and preemption nomination both write here)
-            if "nominatedNodeName" in status:
-                node = status["nominatedNodeName"]
-                if node:
-                    store.set_nominated_node_name(ns or "default", name,
-                                                  node)
-                else:
-                    store.clear_nominated_node_name(ns or "default", name)
-            for cond in status.get("conditions") or ():
-                from kubernetes_tpu.api.types import PodCondition
-
-                store.patch_pod_condition(
-                    ns or "default", name,
-                    cond if not isinstance(cond, dict)
-                    else PodCondition(
-                        type=cond.get("type", ""),
-                        status=cond.get("status", ""),
-                        reason=cond.get("reason", ""),
-                        message=cond.get("message", ""),
-                    ))
             self._send_json(200, {"kind": "Status", "status": "Success"})
             return
         try:
@@ -1738,12 +1858,15 @@ class _Handler(BaseHTTPRequestHandler):
                     event.obj, field_checks):
                 return
             if binary:
-                # raw (type, obj, old) — pickled in batches by the
-                # writer; old_obj rides along because scheduler event
-                # handlers key bind/update detection on it (the
-                # reference's informers synthesize old from their local
-                # cache instead — our binary peers skip that cache)
-                frame = (event.type, event.obj, event.old_obj)
+                # the Event itself — pickled (once, cached on the event
+                # across ALL binary watchers) by the writer thread, so
+                # the store's dispatch path never pays an encode under
+                # its lock and N watchers never pay N encodes; old_obj
+                # rides along because scheduler event handlers key
+                # bind/update detection on it (the reference's informers
+                # synthesize old from their local cache instead — our
+                # binary peers skip that cache)
+                frame = event
             else:
                 # memoized per event: N watchers must not pay N encodes
                 # (reference cachingObject in the watch cache)
@@ -1814,13 +1937,45 @@ class _Handler(BaseHTTPRequestHandler):
                             break
                 closing = False
                 if binary:
-                    # drain the backlog into ONE length-prefixed frame:
-                    # a pickled list of (type, obj) — the client hands
-                    # the whole batch to its handler in one call (the
-                    # store's own batched dispatch, kept batched on the
-                    # wire; reference streams length-delimited protobuf)
+                    # drain the backlog — plus a small flush window so a
+                    # steady producer fills the chunk instead of paying
+                    # one syscall per event — into ONE length-prefixed
+                    # frame: a pickled list of per-event pickles (each
+                    # cached on its Event, shared across watchers). The
+                    # client hands the whole batch to its handler in one
+                    # call (the store's own batched dispatch, kept
+                    # batched on the wire; reference streams length-
+                    # delimited protobuf).
                     batch = [frame]
-                    while len(batch) < 512:
+                    deadline = None
+                    window = self.server.watch_flush_window
+                    while len(batch) < 2048:
+                        try:
+                            nxt = frames.get_nowait()
+                        except queue.Empty:
+                            if window <= 0.0:
+                                break
+                            if deadline is None:
+                                deadline = time.monotonic() + window
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            try:
+                                nxt = frames.get(timeout=left)
+                            except queue.Empty:
+                                break
+                        if nxt is None:
+                            closing = True
+                            break
+                        batch.append(nxt)
+                    frame = codec.frame(
+                        [_cached_event_bytes(e) for e in batch])
+                else:
+                    # JSON coalescing: several newline-delimited frames
+                    # ride one chunk write (readline-based clients parse
+                    # them unchanged) — syscalls per batch, not per event
+                    parts = [frame]
+                    while len(parts) < 512:
                         try:
                             nxt = frames.get_nowait()
                         except queue.Empty:
@@ -1828,8 +1983,8 @@ class _Handler(BaseHTTPRequestHandler):
                         if nxt is None:
                             closing = True
                             break
-                        batch.append(nxt)
-                    frame = codec.frame(batch)
+                        parts.append(nxt)
+                    frame = b"".join(parts)
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(frame), frame))
                 self.wfile.flush()
                 if closing:
@@ -1863,8 +2018,27 @@ class APIServer(ThreadingHTTPServer):
         max_mutating_inflight: Optional[int] = 200,
         binary_clients: Optional[set] = None,
         fault_gate: Optional[FaultGate] = None,
+        watch_flush_window: float = 0.002,
     ):
         super().__init__((host, port), _Handler)
+        # pipelined watch delivery: after the first event of a chunk,
+        # wait up to this long for more so a steady producer (informer
+        # catch-up, bulk creates) ships hundreds of events per syscall.
+        # 0 disables the wait (drain-only coalescing).
+        self.watch_flush_window = float(watch_flush_window)
+        # pre-encoded list responses (binary, selector-free), validated
+        # by the store's per-kind mutation counter: a scheduler relist
+        # of 5k nodes while only pods churn costs one cache hit, not a
+        # 5k-object pickle
+        self._list_cache: Dict[tuple, tuple] = {}
+        self._list_cache_lock = threading.Lock()
+        # authn/authz LRUs (reference: token cache in front of the
+        # authenticator, SubjectAccessReview cache in front of the
+        # webhook authorizer): resolved bearer identities and authz
+        # decisions, invalidated by the object events that could change
+        # them (_maybe_invalidate below)
+        self._token_cache: Dict[str, str] = {}
+        self._authz_cache: Dict[tuple, bool] = {}
         # chaos middleware: always present (a rule-less gate costs one
         # attribute read per request) so /debug/faults can arm it at
         # runtime without a server restart
@@ -1944,13 +2118,30 @@ class APIServer(ThreadingHTTPServer):
         self._cert_index: Optional[Dict[str, str]] = None
         self._cert_gen = 0
 
+        _AUTHZ_KINDS = frozenset((
+            "Role", "ClusterRole", "RoleBinding", "ClusterRoleBinding",
+            "CustomResourceDefinition",
+        ))
+
         def _maybe_invalidate(event) -> None:
             if event.kind == "Secret":
                 self._sa_gen += 1
                 self._sa_tokens = None
+                self._token_cache = {}
             elif event.kind == "CertificateSigningRequest":
                 self._cert_gen += 1
                 self._cert_index = None
+                self._token_cache = {}
+            elif event.kind == "ServiceAccount":
+                # a deleted/recreated account must stop authenticating
+                # through the resolved-identity cache immediately (the
+                # uid check the uncached path performs per request)
+                self._token_cache = {}
+            if event.kind in _AUTHZ_KINDS:
+                # policy changed: cached allow/deny decisions are void
+                # (rebinding the dict is atomic under the GIL — readers
+                # see either the old or the fresh empty map)
+                self._authz_cache = {}
 
         self._sa_watch = self.store.watch(_maybe_invalidate)
         self.stopping = threading.Event()
@@ -2070,6 +2261,78 @@ class APIServer(ThreadingHTTPServer):
         if not fingerprint:
             return None
         return self._cert_index_map().get(fingerprint)
+
+    def _cache_token(self, token: str, user: str, cache: Dict) -> None:
+        """Insert into the SNAPSHOT of the cache the caller resolved
+        against (captured before resolution began): an invalidation
+        that raced the resolution rebinds ``_token_cache`` to a fresh
+        dict, so the stale identity lands in the discarded one instead
+        of resurrecting a just-revoked credential."""
+        if len(cache) >= 4096 and cache is self._token_cache:
+            self._token_cache = {}
+            return
+        cache[token] = user
+
+    def authorize_cached(self, user: str, verb: str, kind: str,
+                         namespace: str) -> bool:
+        """Authz with a decision cache in front: hot-path requests from
+        the same identity repeat the same (verb, kind, ns) triple
+        thousands of times per second, and the RBAC walk costs a store
+        lock + binding scan each time. Invalidated by RBAC/CRD object
+        events and by static-group edits (``policy_gen``)."""
+        authorizer = self.authorizer
+        if authorizer is allow_all:
+            return True
+        gen = getattr(authorizer, "policy_gen", 0)
+        key = (user, verb, kind, namespace, gen)
+        cache = self._authz_cache
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        ok = bool(authorizer(user, verb, kind, namespace))
+        # write into the SNAPSHOT captured before the walk: a policy
+        # invalidation that raced it rebinds the live dict, and the
+        # stale decision must land in the discarded one. On overflow,
+        # reset the live dict only if it still IS the snapshot.
+        if len(cache) >= 8192 and cache is self._authz_cache:
+            self._authz_cache = {}
+            return ok
+        cache[key] = ok
+        return ok
+
+    def cached_list_binary(self, kind: str,
+                           namespace: Optional[str]) -> bytes:
+        """Pre-encoded binary list response for (kind, namespace),
+        validated against the store's per-kind mutation counter: while
+        the KIND is unchanged the pickled body is byte-identical, so a
+        reflector relist of 5k nodes during pod churn costs a dict hit
+        instead of a 5k-object encode. The seq is read BEFORE listing —
+        a write racing the encode caches a newer body under an older
+        seq, which can only cause a spurious miss, never a stale hit.
+
+        The cached body also carries the rv it listed at: once OTHER
+        kinds' churn compacts the watch log past that rv, serving it
+        would strand the reflector in a relist→410 loop (its watch from
+        the stale rv can never attach) — such entries re-list at the
+        current rv instead."""
+        from kubernetes_tpu.apiserver import codec
+
+        seq = self.store.kind_seq(kind)
+        key = (kind, namespace)
+        with self._list_cache_lock:
+            hit = self._list_cache.get(key)
+        if hit is not None and hit[0] == seq:
+            oldest = self.watch_cache.oldest_rv()
+            if oldest is None or hit[2] >= oldest - 1:
+                return hit[1]
+        objs, rv = self.store.list_objects_with_rv(kind, namespace)
+        body = codec.encode(
+            {"kind": f"{kind}List", "resourceVersion": rv, "items": objs})
+        with self._list_cache_lock:
+            if len(self._list_cache) >= 64:
+                self._list_cache.clear()
+            self._list_cache[key] = (seq, body, rv)
+        return body
 
     def metrics_text(self) -> str:
         if self._metrics_text_fn is not None:

@@ -152,13 +152,28 @@ class ClusterStore:
         self._log_sources: Dict[str, Callable] = {}
         self._exec_sources: Dict[str, Callable] = {}
         self._portforward_sources: Dict[str, Callable] = {}
+        # per-kind mutation counters (bumped alongside every dispatch and
+        # by the dispatch-free status patches): lets the REST layer serve
+        # a pre-encoded list response while the KIND is unchanged — the
+        # global _rv advances on every write of any kind, so it cannot
+        # validate a per-kind cache
+        self._kind_seq: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
 
+    def kind_seq(self, kind: str) -> int:
+        """Mutation counter for one kind (REST list-cache validation)."""
+        with self._lock:
+            return self._kind_seq.get(kind, 0)
+
+    def _bump_kind(self, kind: str) -> None:
+        self._kind_seq[kind] = self._kind_seq.get(kind, 0) + 1
+
     def _dispatch(self, event: Event) -> None:
+        self._bump_kind(event.kind)
         for w in list(self._watches):
             w.fn(event)
 
@@ -169,6 +184,8 @@ class ClusterStore:
         watchers see the same events one by one."""
         if not events:
             return
+        for e in events:
+            self._bump_kind(e.kind)
         for w in list(self._watches):
             if w.batch_fn is not None:
                 w.batch_fn(events)
@@ -344,15 +361,28 @@ class ClusterStore:
             pod.status.conditions = [
                 c for c in pod.status.conditions if c.type != condition.type
             ] + [condition]
+            # no event, but the object DID change: the REST layer's
+            # pre-encoded list cache must not serve the old conditions
+            self._bump_kind("Pod")
 
     def set_nominated_node_name(self, namespace: str, name: str, node: str) -> None:
         with self._lock:
             pod = self._pods.get(f"{namespace}/{name}")
             if pod is not None:
                 pod.status.nominated_node_name = node
+                self._bump_kind("Pod")
 
     def clear_nominated_node_name(self, namespace: str, name: str) -> None:
         self.set_nominated_node_name(namespace, name, "")
+
+    def batched_status_writes(self):
+        """No-op scope for the in-process store (API parity with
+        ``RestClusterClient.batched_status_writes``): store calls are
+        already one lock acquisition each, there are no round trips to
+        collapse."""
+        import contextlib
+
+        return contextlib.nullcontext()
 
     # ------------------------------------------------------------------
     # generic add/update/delete for the remaining kinds

@@ -14,10 +14,17 @@ Wire discipline (reference ``test/integration/scheduler_perf/util.go:
 
 - every call charges a client-side token bucket PER OBJECT — a bulk
   request of N pods costs N tokens, so batching never launders rate;
-- keep-alive connections with TCP_NODELAY (one urllib-style connection
-  per request stalls ~40 ms each under Nagle + delayed ACK);
+- pooled keep-alive connections with TCP_NODELAY per (client, lane)
+  (one urllib-style connection per request stalls ~40 ms each under
+  Nagle + delayed ACK; after a transport failure the pool pre-warms a
+  replacement under the retry backoff so retries never reconnect cold);
+- hot-path writes ship as bulk verbs: creates as ``{Kind}List``, binds
+  as ``BindingList`` (POST /bindings), status writes as
+  ``PodStatusList`` (POST /statuses, see ``batched_status_writes``);
 - the binary codec (``apiserver/codec.py``, the protobuf analog) is
   negotiated for every payload; JSON remains the kubectl/debug wire.
+  Watch streams arrive as server-coalesced chunks (a batch of
+  per-event pickles per read), decoded and delivered batch-at-a-time.
 
 Reads the scheduler consults once per cycle (services, replica sets,
 PDBs, ...) are served from short-TTL caches — the informer-cache
@@ -92,6 +99,76 @@ class _WatchHandle:
         self._client._stop_watches()
 
 
+class _ConnPool:
+    """Warm keep-alive connections for one (client, lane). Connections
+    are checked out per request and returned on success; a transport
+    failure discards the broken connection AND pre-warms a replacement
+    during the retry backoff, so the retry itself never reconnects cold
+    (reference: client-go's http.Transport connection pool per host)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 max_idle: int = 8):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self.max_idle = max_idle
+        self._idle: List[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+
+    def _connect(self) -> http.client.HTTPConnection:
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self._timeout)
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def acquire(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return self._connect()
+
+    def release(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._idle) < self.max_idle:
+                self._idle.append(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def discard(conn: Optional[http.client.HTTPConnection]) -> None:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def prewarm(self, n: int = 1) -> None:
+        """Best-effort: open fresh connections into the idle set (called
+        under retry backoff so the sleep pays the handshake)."""
+        for _ in range(n):
+            try:
+                conn = self._connect()
+            except OSError:
+                return
+            with self._lock:
+                if len(self._idle) < self.max_idle:
+                    self._idle.append(conn)
+                    conn = None
+            if conn is not None:
+                _ConnPool.discard(conn)
+                return
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            _ConnPool.discard(conn)
+
+
 class RestClusterClient:
     def __init__(
         self,
@@ -118,7 +195,16 @@ class RestClusterClient:
         self.watch_kinds = watch_kinds
         self.cache_ttl = cache_ttl
         self.limiter = TokenBucket(qps, burst) if qps else None
-        self._local = threading.local()
+        # keep-alive pools per lane (mirroring the server's readonly/
+        # mutating in-flight lanes): checked out per request, pre-warmed
+        # on failure so retries ride an established connection
+        self._pools: Dict[str, _ConnPool] = {
+            "ro": _ConnPool(self._host, self._port),
+            "rw": _ConnPool(self._host, self._port),
+        }
+        # active batched-status-write buffers per thread (see
+        # batched_status_writes)
+        self._status_buffers = threading.local()
         self._ttl_cache: Dict[str, tuple] = {}
         self._stopping = threading.Event()
         self._watch_threads: List[threading.Thread] = []
@@ -152,24 +238,11 @@ class RestClusterClient:
         self.breaker.set_listener(listener)
 
     # -- transport -----------------------------------------------------
-    def _conn(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = http.client.HTTPConnection(self._host, self._port,
-                                              timeout=60)
-            conn.connect()
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._local.conn = conn
-        return conn
-
     def _drop_conn(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            self._local.conn = None
+        """Close every pooled keep-alive connection (tests and the
+        chaos harness sever live transports after a server kill)."""
+        for pool in self._pools.values():
+            pool.close_all()
 
     def _headers(self, body_binary: bool) -> Dict[str, str]:
         h: Dict[str, str] = {}
@@ -198,26 +271,34 @@ class RestClusterClient:
         if payload is not None:
             data = codec.encode(payload) if body_binary \
                 else json.dumps(payload).encode()
+        pool = self._pools["ro" if method in ("GET", "HEAD") else "rw"]
+        conn: Optional[http.client.HTTPConnection] = None
         attempt = 0
         while True:
             try:
-                conn = self._conn()
+                if conn is None:
+                    conn = pool.acquire()
                 conn.request(method, path, body=data,
                              headers=self._headers(body_binary))
                 resp = conn.getresponse()
                 raw = resp.read()
             except (http.client.HTTPException, OSError):
                 # dropped/reset keep-alive or truncated response (server
-                # restart, idle timeout, injected wire fault): reconnect
-                # with jittered backoff — requests here are idempotent
-                # or conflict-detected server-side. Budget exhaustion
-                # surfaces the ORIGINAL transport error.
-                self._drop_conn()
+                # restart, idle timeout, injected wire fault): retry on
+                # a FRESH pooled connection with jittered backoff —
+                # requests here are idempotent or conflict-detected
+                # server-side. Budget exhaustion surfaces the ORIGINAL
+                # transport error. The pool pre-warms a replacement
+                # under the backoff sleep so the retry never pays the
+                # handshake inside its own window.
+                _ConnPool.discard(conn)
+                conn = None
                 self.breaker.record_failure()
                 if attempt >= self.max_retries \
                         or not self._retry_budget.try_spend():
                     raise
                 self._note_retry(method, "transport")
+                pool.prewarm(1)
                 time.sleep(self._backoff.delay(attempt))
                 attempt += 1
                 continue
@@ -225,7 +306,8 @@ class RestClusterClient:
                     and self._retry_budget.try_spend():
                 # overload pushback: honor Retry-After, CAPPED — a
                 # misbehaving server advertising an hour must not stall
-                # this client unboundedly
+                # this client unboundedly. The connection answered and
+                # is healthy: keep holding it for the retry.
                 try:
                     advertised = float(
                         resp.headers.get("Retry-After") or 0.0)
@@ -239,6 +321,10 @@ class RestClusterClient:
                 continue
             # any HTTP response means the transport is healthy
             self.breaker.record_success()
+            if resp.will_close:
+                _ConnPool.discard(conn)
+            else:
+                pool.release(conn)
             ctype = resp.headers.get("Content-Type") or ""
             if ctype.startswith(codec.BINARY_CONTENT_TYPE):
                 return resp.status, codec.decode(raw)
@@ -433,12 +519,79 @@ class RestClusterClient:
 
     # -- pod status / lifecycle writes ---------------------------------
     def _put_status(self, namespace: str, name: str, status: dict) -> None:
+        buf = getattr(self._status_buffers, "buf", None)
+        if buf is not None:
+            # inside a batched_status_writes scope: coalesce — the
+            # items apply in order at scope exit as ONE bulk request
+            buf.append({"namespace": namespace, "name": name,
+                        "status": status})
+            return
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
             {"status": status}, body_binary=False)
         if code == 404:
             return   # pod deleted under us: store semantics are no-op
         self._raise_for(code, payload)
+
+    def write_pod_statuses(self, updates: List[dict]
+                           ) -> List[Optional[Exception]]:
+        """Bulk POST /api/v1/statuses (PodStatusList): N status writes,
+        one round trip, positional failures. Each item is
+        ``{"namespace", "name", "status": {...}}`` with the exact
+        per-item semantics of PUT pods/{name}/status; the token bucket
+        charges per ITEM, so bulk status writes stay rate-equivalent to
+        N singles. 404s are None (pod deleted under us), matching
+        ``_put_status``."""
+        if not updates:
+            return []
+        code, resp = self._request(
+            "POST", "/api/v1/statuses",
+            {"kind": "PodStatusList", "items": list(updates)},
+            charge=len(updates), body_binary=False)
+        if code >= 400:
+            err = RuntimeError(
+                resp.get("message", f"HTTP {code}")
+                if isinstance(resp, dict) else f"HTTP {code}")
+            return [err] * len(updates)
+        errors: List[Optional[Exception]] = [None] * len(updates)
+        for f in resp.get("failures", ()):
+            if f.get("code") == 404:
+                continue   # pod deleted under us: single-PUT no-op
+            errors[f["index"]] = PermissionError(f["message"]) \
+                if f.get("code") in (403, 422) \
+                else RuntimeError(f["message"])
+        return errors
+
+    def batched_status_writes(self):
+        """Scope that coalesces this THREAD's pod-status writes
+        (conditions, nominatedNodeName, phase) into one bulk
+        ``/statuses`` request flushed at exit — the mass-decline path
+        writes thousands of PodScheduled=False conditions per batch,
+        and per-object round trips there serialize the whole commit
+        loop. Writes become visible at scope exit; the callers that use
+        this are already best-effort about status visibility."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            if getattr(self._status_buffers, "buf", None) is not None:
+                # nested scope: the outer one owns the flush
+                yield
+                return
+            buf: List[dict] = []
+            self._status_buffers.buf = buf
+            try:
+                yield
+            finally:
+                self._status_buffers.buf = None
+                if buf:
+                    try:
+                        self.write_pod_statuses(buf)
+                    except Exception:  # noqa: BLE001 — best-effort,
+                        # like the per-object writes it replaces
+                        pass
+
+        return scope()
 
     def patch_pod_condition(self, namespace: str, name: str,
                             condition) -> None:
@@ -629,11 +782,27 @@ class RestClusterClient:
                 codec.BINARY_CONTENT_TYPE)
             while not self._stopping.is_set():
                 if binary:
-                    batch = codec.read_frame(resp)
+                    try:
+                        batch = codec.read_frame(resp)
+                    except Exception:  # noqa: BLE001 — torn outer frame
+                        # the stream was cut mid-frame (injected
+                        # truncation, server death): relist, exactly
+                        # like the JSON torn-line path below
+                        return
                     if batch is None:
                         return
-                    events = [Event(t, kind, obj, old)
-                              for (t, obj, old) in batch]
+                    # a coalesced chunk carries per-event pickles
+                    # (encoded once server-side, shared across
+                    # watchers); decode each into the same Event shape
+                    try:
+                        events = []
+                        for item in batch:
+                            if isinstance(item, (bytes, bytearray)):
+                                item = codec.decode(item)
+                            t, obj, old = item
+                            events.append(Event(t, kind, obj, old))
+                    except Exception:  # noqa: BLE001 — torn event
+                        return
                 else:
                     line = resp.readline()
                     if not line:

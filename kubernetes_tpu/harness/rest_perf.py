@@ -15,9 +15,12 @@ and every client goes through REST at QPS/Burst 5000
   discipline is the reference's per-client 5000 QPS regardless of
   batching.
 - **scheduler (this process, owns the TPU)**: fed by watch-driven
-  list+watch streams over chunked HTTP, binds through the Binding
-  subresource (bulk BindingList for the batch commit), status writes
-  through pods/{name}/status — all via the binary codec.
+  list+watch streams over chunked HTTP (server-coalesced binary
+  chunks, O(batches) syscalls), binds through bulk BindingList
+  requests shipped on the binding pool (cycles never serialize on the
+  bind round trip), bulk PodStatusList for status sweeps — all via the
+  binary codec. "Scheduled" events ride a SEPARATE client+bucket, the
+  reference's own events-client discipline.
 
 Process topology mirrors the reference deployment (apiserver, client,
 scheduler are separate processes); it also gives each Python runtime
@@ -54,6 +57,7 @@ def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
     from kubernetes_tpu.apiserver.wal import attach_wal
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
+    profiler = _maybe_profiler("apiserver")
     tune_for_throughput()
     store = ClusterStore()
     # async WAL writer: serialization rides a background thread instead
@@ -86,7 +90,71 @@ def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
     server.shutdown_server()
     if wal is not None:
         wal.close()
+    _stop_profiler(profiler)
     conn.send("stopped")
+
+
+class _SamplingProfiler:
+    """All-threads stack sampler for the spawned fabric children (the
+    parent's profiler cannot see them, and cProfile only observes the
+    thread that enabled it — useless for a thread-per-connection
+    server). Samples ``sys._current_frames()`` on an interval and dumps
+    a self-time histogram per function to
+    ``$KTPU_PROFILE_REST/<role>.txt`` on shutdown."""
+
+    def __init__(self, role: str, interval: float = 0.002):
+        import collections
+        import threading
+
+        self.role = role
+        self.interval = interval
+        self.counts: dict = collections.Counter()
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"profiler-{role}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        import sys
+        import time as _time
+
+        me = self._thread.ident
+        while not self._stop.is_set():
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                code = frame.f_code
+                key = (f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                       f"{code.co_firstlineno}:{code.co_name}")
+                self.counts[key] += 1
+                self.samples += 1
+            _time.sleep(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        out = os.environ.get("KTPU_PROFILE_REST", "")
+        try:
+            os.makedirs(out, exist_ok=True)
+            with open(os.path.join(out, f"{self.role}.txt"), "w") as f:
+                f.write(f"samples={self.samples}\n")
+                for key, n in sorted(self.counts.items(),
+                                     key=lambda kv: -kv[1])[:60]:
+                    f.write(f"{n:8d}  {key}\n")
+        except OSError:
+            pass
+
+
+def _maybe_profiler(role: str):
+    if not os.environ.get("KTPU_PROFILE_REST"):
+        return None
+    return _SamplingProfiler(role)
+
+
+def _stop_profiler(profiler) -> None:
+    if profiler is not None:
+        profiler.stop()
 
 
 def _wal_lines(wal_dir: Optional[str]) -> int:
@@ -118,6 +186,7 @@ def _creator_main(conn, url: str, name: str, nodes: int, init_pods: int,
     from kubernetes_tpu.api.types import Node, Pod
     from kubernetes_tpu.client.restcluster import RestClusterClient
 
+    profiler = _maybe_profiler(f"creator-{name}")
     clients = [RestClusterClient(url, token=CREATOR_TOKEN, qps=qps)
                for _ in range(max(1, n_clients))]
     ops = make_workload(name, nodes=nodes, init_pods=init_pods,
@@ -168,6 +237,7 @@ def _creator_main(conn, url: str, name: str, nodes: int, init_pods: int,
                 conn.send(("done", op_idx, sent))
             continue
         conn.send(("done", op_idx, 0))
+    _stop_profiler(profiler)
     conn.send("stopped")
 
 
@@ -224,9 +294,15 @@ def run_workload_rest(
     cre_proc.start()
 
     client = RestClusterClient(url, token=SCHEDULER_TOKEN, qps=qps)
+    # the recorder's "Scheduled" events ride their OWN client+bucket
+    # (the reference scheduler's separate events client): sharing the
+    # bind client's bucket would charge ~1 token per scheduled pod
+    # against the bind budget — rate the reference never pays
+    event_client = RestClusterClient(url, token=SCHEDULER_TOKEN, qps=qps)
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
     sched = Scheduler.create(client, feature_gates=gates,
-                             provider="GangSchedulingProvider")
+                             provider="GangSchedulingProvider",
+                             event_client=event_client)
     bs = attach_batch_scheduler(sched, max_batch=max_batch) \
         if use_batch else None
     sched.start()

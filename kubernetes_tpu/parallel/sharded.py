@@ -45,6 +45,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: older runtimes (< 0.5)
+    only ship ``jax.experimental.shard_map.shard_map``, whose
+    replication-check kwarg is ``check_rep`` rather than ``check_vma``.
+    Same semantics either way; this shim keeps the solver runnable on
+    the baked-in toolchain."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
 from kubernetes_tpu.ops.encode import EncodedBatch, EncodedCluster
 from kubernetes_tpu.ops.pallas_solver import (
     LANES,
@@ -344,7 +360,7 @@ def _build_solve(mesh: Mesh, params: SolverParams, r: int, sc: int, t: int,
     node_sharded = P(None, "nodes")
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P(),                 # sc_meta (replicated)

@@ -122,6 +122,7 @@ class Scheduler:
         feature_gates: FeatureGates,
         metrics: SchedulerMetrics,
         clock=None,
+        event_client=None,
     ):
         self.client = client
         self.cache = cache
@@ -151,7 +152,29 @@ class Scheduler:
         self.event_handlers = EventHandlers(self)
         from kubernetes_tpu.client.events import EventRecorder
 
-        self.recorder = EventRecorder(client, "default-scheduler")
+        # events flow through their OWN client when one is provided
+        # (reference: the scheduler's EventBroadcaster writes through a
+        # separate events client with its own rate limit — kube-
+        # scheduler's eventClient in cmd/kube-scheduler/app/options).
+        # Over REST this matters: 30k "Scheduled" events sharing the
+        # bind client's token bucket would silently halve the bind
+        # budget the reference never charges.
+        self.recorder = EventRecorder(event_client or client,
+                                      "default-scheduler")
+        # bulk binds go async (the serial path's binding-goroutine
+        # model, applied to whole batches) when the client is remote:
+        # a REST round trip on the commit path would serialize every
+        # batch cycle on wire latency. In-process stores bind inline —
+        # same call, microseconds, and tests see bound pods
+        # synchronously.
+        self.async_bulk_bind = hasattr(client, "breaker")
+        # cache mutations performed by the LAST commit_assignments_bulk
+        # call (assumes + sync forgets): the sidecar's device-mirror
+        # accounting needs the true count — gang members parked at
+        # Permit are assumed but not committed, and counting only
+        # commits made every gang batch invalidate the session (the
+        # r5 state-only-rebuild-per-batch churn).
+        self.last_bulk_commit_mutations = 0
 
     # ------------------------------------------------------------------
     @classmethod
@@ -164,6 +187,7 @@ class Scheduler:
         feature_gates: Optional[FeatureGates] = None,
         metrics: Optional[SchedulerMetrics] = None,
         clock=None,
+        event_client=None,
     ) -> "Scheduler":
         """The Configurator (factory.go:90-184 create/createFromProvider)."""
         config = config or KubeSchedulerConfiguration()
@@ -199,7 +223,7 @@ class Scheduler:
         )
         sched = cls(
             client, cache, queue, {}, algorithm,
-            feature_gates, metrics, clock=clock,
+            feature_gates, metrics, clock=clock, event_client=event_client,
         )
         deps = _Deps(sched)
         from kubernetes_tpu.utils.parallelize import Parallelizer
@@ -591,7 +615,21 @@ class Scheduler:
         ``commits``: list of (qpi, result, cycle, start). Returns
         (committed, failed) where failed counts pods that were rejected
         host-side after the device counted them (the caller's
-        device-mirror accounting needs to know)."""
+        device-mirror accounting needs to know).
+
+        Side channel: ``self.last_bulk_commit_mutations`` is set to the
+        number of cache mutations THIS call performed synchronously
+        (one assume per pod that passed the stale guard, plus one
+        forget per sync rejection) — the sidecar validates its device
+        mirror against this count, so pods parked at Permit (gangs)
+        count via their assume even though they bind asynchronously.
+
+        When ``self.async_bulk_bind`` is set (remote clients), the
+        final bulk Bind ships on the binding pool instead of blocking
+        this call — the batch loop must not serialize every cycle on a
+        wire round trip. Failures there unreserve/forget/requeue
+        exactly as the sync path would, just later; the extra forget
+        invalidates the device mirror through the normal arithmetic."""
         # --- stale-node guard (chaos_nodes): ONE cache probe for the
         # whole batch; assignments targeting nodes that died, were
         # cordoned, or went unreachable since the solve are refused
@@ -636,6 +674,9 @@ class Scheduler:
                 self._record_failure(fwk, item[0], ValueError(err),
                                      "SchedulerError", "", item[2])
         failed = stale_failed + len(prepared) - len(live)
+        # cache-mutation ledger: one assume per live pod so far; every
+        # sync rejection below adds its forget
+        mutations = len(live)
 
         # --- Reserve + Permit (per-pod hook contract)
         has_reserve = bool(fwk.reserve_plugins)
@@ -652,6 +693,7 @@ class Scheduler:
                     self._forget_and_fail(fwk, state, qpi, assumed, result,
                                           status.as_error(), cycle)
                     failed += 1
+                    mutations += 1
                     continue
             if has_permit:
                 status = fwk.run_permit_plugins(state, assumed,
@@ -662,6 +704,7 @@ class Scheduler:
                                                 result, status.as_error(),
                                                 cycle)
                     failed += 1
+                    mutations += 1
                     continue
                 if status is not None and status.code == fw.WAIT:
                     # gang/permit-parked pods bind asynchronously
@@ -687,6 +730,7 @@ class Scheduler:
                                                 result, status.as_error(),
                                                 cycle)
                     failed += 1
+                    mutations += 1
                     continue
             if has_pre_bind:
                 status = fwk.run_pre_bind_plugins(state, assumed,
@@ -696,6 +740,7 @@ class Scheduler:
                                                 result, status.as_error(),
                                                 cycle)
                     failed += 1
+                    mutations += 1
                     continue
             bindable.append((qpi, result, cycle, start, assumed, state))
 
@@ -713,6 +758,7 @@ class Scheduler:
                     self._unreserve_forget_fail(fwk, state, qpi, assumed,
                                                 result, err, cycle)
                     failed += 1
+                    mutations += 1
                 else:
                     self._observe_scheduled(fwk, qpi, start,
                                             result.suggested_host)
@@ -720,31 +766,88 @@ class Scheduler:
             else:
                 bulk.append(item)
         if bulk:
-            t_bind = time.monotonic()
-            statuses = fwk.run_bind_plugins_bulk(
-                [i[5] for i in bulk], [i[4] for i in bulk],
-                [i[1].suggested_host for i in bulk],
-            )
-            get_tracer().record("bind.bulk", t_bind, pods=len(bulk))
-            bound: List[Pod] = []
-            observed: List[tuple] = []
-            for item, status in zip(bulk, statuses):
-                qpi, result, cycle, start, assumed, state = item
-                if not fw.Status.is_ok(status):
-                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
-                                                result, status.as_error(),
-                                                cycle)
-                    failed += 1
-                    continue
-                bound.append(assumed)
-                if has_post_bind:
-                    fwk.run_post_bind_plugins(state, assumed,
-                                              result.suggested_host)
-                observed.append((qpi, start, result.suggested_host))
-                committed += 1
-            self._observe_scheduled_bulk(fwk, observed)
-            self.cache.finish_binding_many(bound)
+            if self.async_bulk_bind:
+                # ship the whole batch's Bind on the binding pool: the
+                # commit loop keeps solving while the bulk request is
+                # on the wire (the serial path's per-pod binding
+                # goroutine, amortized to one per batch). Pods already
+                # count as assumed in the mutation ledger; a wire-level
+                # failure forgets them asynchronously, which the
+                # device-mirror arithmetic reads as an invalidation.
+                with self._inflight_lock:
+                    self._inflight_bindings += 1
+                self.metrics.goroutines.inc("binding")
+                try:
+                    self._bind_pool.submit(self._complete_bulk_bind,
+                                           fwk, bulk, has_post_bind)
+                except RuntimeError:
+                    # pool already shut down (stop() raced a late
+                    # commit): same accounting as the serial submit race
+                    self.metrics.goroutines.dec("binding")
+                    with self._inflight_zero:
+                        self._inflight_bindings -= 1
+                        if self._inflight_bindings == 0:
+                            self._inflight_zero.notify_all()
+            else:
+                n = self._bulk_bind_now(fwk, bulk, has_post_bind)
+                committed += n
+                failed += len(bulk) - n
+                mutations += len(bulk) - n   # one forget per rejection
+        self.last_bulk_commit_mutations = mutations
         return committed, failed
+
+    def _bulk_bind_now(self, fwk: Framework, bulk: List[tuple],
+                       has_post_bind: bool) -> int:
+        """The bulk Bind + PostBind + finish-binding tail shared by the
+        sync and async paths. Returns the number bound; failures
+        unreserve/forget/requeue per pod (each forget bumps the cache
+        mutation counter, which the async path relies on to invalidate
+        the device mirror)."""
+        t_bind = time.monotonic()
+        statuses = fwk.run_bind_plugins_bulk(
+            [i[5] for i in bulk], [i[4] for i in bulk],
+            [i[1].suggested_host for i in bulk],
+        )
+        get_tracer().record("bind.bulk", t_bind, pods=len(bulk))
+        bound: List[Pod] = []
+        observed: List[tuple] = []
+        committed = 0
+        for item, status in zip(bulk, statuses):
+            qpi, result, cycle, start, assumed, state = item
+            if not fw.Status.is_ok(status):
+                self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                            result, status.as_error(),
+                                            cycle)
+                continue
+            bound.append(assumed)
+            if has_post_bind:
+                fwk.run_post_bind_plugins(state, assumed,
+                                          result.suggested_host)
+            observed.append((qpi, start, result.suggested_host))
+            committed += 1
+        self._observe_scheduled_bulk(fwk, observed)
+        self.cache.finish_binding_many(bound)
+        return committed
+
+    def _complete_bulk_bind(self, fwk: Framework, bulk: List[tuple],
+                            has_post_bind: bool) -> None:
+        try:
+            try:
+                self._bulk_bind_now(fwk, bulk, has_post_bind)
+            except Exception as err:  # noqa: BLE001 — transport died
+                # (retries exhausted, server gone): every pod in the
+                # batch unwinds exactly as a failed sync bind would —
+                # unreserve, forget, SchedulerError requeue; the next
+                # attempt sees the post-outage world
+                for qpi, result, cycle, _start, assumed, state in bulk:
+                    self._unreserve_forget_fail(fwk, state, qpi, assumed,
+                                                result, err, cycle)
+        finally:
+            self.metrics.goroutines.dec("binding")
+            with self._inflight_zero:
+                self._inflight_bindings -= 1
+                if self._inflight_bindings == 0:
+                    self._inflight_zero.notify_all()
 
     def _observe_scheduled(self, fwk: Framework, qpi: QueuedPodInfo,
                            start: float, node_name: str = "") -> None:
