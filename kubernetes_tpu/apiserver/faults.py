@@ -38,22 +38,42 @@ FAULTS = ("reset", "truncate", "latency", "error",
 _WATCH_FAULTS = ("watch_stall", "watch_drop")
 
 
-def resource_of(path: str) -> str:
-    """Plural resource segment of an API path ("pods", "nodes", ...);
-    "" for non-resource paths. Mirrors the route logic in rest.py
-    without needing the resolved kind."""
+def api_segments(path: str) -> List[str]:
+    """Resource-route segments of an API path with the ``/api/v1`` or
+    ``/apis/<group>/<version>`` prefix and any ``namespaces/<ns>`` pair
+    stripped (kept when the namespace itself IS the object, as in
+    ``/api/v1/namespaces/default``). The ONE route parser behind fault
+    matching and flowcontrol's width estimation — a future route-shape
+    change lands here, not in per-module copies."""
     parts = [p for p in path.split("?", 1)[0].split("/") if p]
     if not parts:
-        return ""
+        return []
     if parts[0] == "api":
         rest = parts[2:]        # /api/v1/...
     elif parts[0] == "apis":
         rest = parts[3:]        # /apis/<g>/<v>/...
     else:
-        return ""
+        return []
     if rest and rest[0] == "namespaces" and len(rest) >= 3:
         rest = rest[2:]
+    return rest
+
+
+def resource_of(path: str) -> str:
+    """Plural resource segment of an API path ("pods", "nodes", ...);
+    "" for non-resource paths. Mirrors the route logic in rest.py
+    without needing the resolved kind."""
+    rest = api_segments(path)
     return rest[0] if rest else ""
+
+
+def namespace_of(path: str) -> str:
+    """Namespace segment of an API path; "" when cluster-scoped."""
+    parts = [p for p in path.split("?", 1)[0].split("/") if p]
+    for i, part in enumerate(parts):
+        if part == "namespaces" and i + 1 < len(parts):
+            return parts[i + 1]
+    return ""
 
 
 class FaultRule:

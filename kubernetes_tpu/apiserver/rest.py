@@ -5,8 +5,12 @@ of kube-apiserver's endpoint layer (reference
 ``staging/src/k8s.io/apiserver/pkg/endpoints/handlers/{create,get,update,
 delete,watch}.go`` + ``pkg/controlplane/instance.go:547 InstallLegacyAPI``):
 
-- handler chain per request: authenticate → authorize → (mutating requests)
-  admission → registry operation against the cluster store
+- handler chain per request: flow-control admission (API Priority &
+  Fairness, ``apiserver/flowcontrol.py`` — FlowSchemas route identities
+  to priority levels with shuffle-sharded fair queues and seat/width
+  accounting; the legacy readonly/mutating max-in-flight lanes remain
+  behind ``flow_control=None``) → authenticate → authorize → (mutating
+  requests) admission → registry operation against the cluster store
 - resource routes ``/api/v1/<plural>``, ``/api/v1/namespaces/{ns}/<plural>``,
   object routes ``.../{name}``, subresources ``.../pods/{name}/binding``
   (reference ``pkg/registry/core/pod/storage/storage.go:159``) and
@@ -26,6 +30,9 @@ delete,watch}.go`` + ``pkg/controlplane/instance.go:547 InstallLegacyAPI``):
   (PodStatusList) apply N objects per request with positional failures —
   per-object semantics, per-batch wire cost.
 - ``/healthz`` ``/livez`` ``/readyz`` probes and Prometheus ``/metrics``
+  — all exempt from flow control (a liveness probe must never be queued
+  or 429'd), like the ``/debug/*`` admin routes, which include
+  ``/debug/apf`` (flow-control introspection)
 
 Transport negotiates per request between JSON over HTTP/1.1 chunked
 streams (the kubectl/debug wire, ``kubernetes_tpu.api.serialization``)
@@ -52,6 +59,14 @@ from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.api.serialization import SCHEME, from_wire, is_namespaced, to_wire
 from kubernetes_tpu.apiserver.faults import FaultGate, resource_of
+from kubernetes_tpu.apiserver.flowcontrol import (
+    FlowControlConfig,
+    FlowController,
+    LaneStats,
+    Rejected,
+    default_config,
+    namespace_of,
+)
 from kubernetes_tpu.apiserver.admission import (
     CREATE,
     DELETE,
@@ -399,18 +414,31 @@ class _Handler(BaseHTTPRequestHandler):
     ADMIN_ROUTES = {
         "/debug/faults": "_serve_faults_admin",
         "/debug/trace": "_serve_trace_admin",
+        "/debug/apf": "_serve_apf_admin",
     }
 
-    # -- max-in-flight gate (reference apiserver filters/maxinflight.go:
-    # separate readonly and mutating lanes; a full lane answers 429 with
-    # Retry-After so one hot client cannot starve the control plane).
-    # Long-running requests (watches) are exempt, as upstream's
-    # longRunningRequestCheck exempts them.
-    _UNGATED_PATHS = ("/healthz", "/livez", "/readyz")
+    # -- flow-control exemption envelope: paths that must NEVER be
+    # queued, rejected, or charged seats — by either admission path.
+    # Flow control must never fail a liveness probe (429 under load
+    # would get the server restarted exactly when it's busy), never
+    # blind the metrics scraper, and (via ADMIN_ROUTES) never lock out
+    # the debug surfaces mid-overload.
+    _EXEMPT_PATHS = ("/healthz", "/livez", "/readyz",
+                     "/metrics", "/metrics/resources")
 
-    def _gate(self) -> Optional[threading.Semaphore]:
+    def _admission_exempt(self, path: str) -> bool:
+        return path in self.ADMIN_ROUTES or path in self._EXEMPT_PATHS
+
+    # -- legacy max-in-flight gate (reference apiserver filters/
+    # maxinflight.go: separate readonly and mutating lanes; a full lane
+    # answers 429 with a COMPUTED Retry-After so one hot client cannot
+    # starve the control plane). Active only when the server was built
+    # with ``flow_control=None``; the APF path below replaces it
+    # otherwise. Long-running requests (watches) are exempt, as
+    # upstream's longRunningRequestCheck exempts them.
+    def _gate(self) -> Optional[Tuple[threading.Semaphore, LaneStats]]:
         path = self.path.split("?", 1)[0]
-        if path in self.ADMIN_ROUTES:
+        if self._admission_exempt(path):
             # admin surfaces never consume a lane slot: /debug/trace is
             # exactly for when the server is overloaded, and /debug/
             # faults must stay operable mid-chaos
@@ -418,23 +446,24 @@ class _Handler(BaseHTTPRequestHandler):
         if self.command in ("GET", "HEAD"):
             if "watch=" in self.path:
                 return None      # long-running: never counts against a lane
-            if self.path in self._UNGATED_PATHS:
-                # flow control must never fail a liveness probe — 429
-                # under load would get the server restarted exactly when
-                # it's busy (reference exempts health paths likewise)
+            if self.server.readonly_lane is None:
                 return None
-            return self.server.readonly_lane
-        return self.server.mutating_lane
+            return self.server.readonly_lane, self.server.lane_stats["ro"]
+        if self.server.mutating_lane is None:
+            return None
+        return self.server.mutating_lane, self.server.lane_stats["rw"]
 
     # -- fault injection (faults.py FaultGate; the chaos-over-REST
     # middleware). Runs BEFORE the in-flight lanes so an injected reset
-    # never consumes a lane slot; health probes, metrics scrapes, and
-    # the admin endpoints (ADMIN_ROUTES) are exempt — chaos must not get
-    # the server restarted, blind its observers, or lock itself out.
-    _FAULT_EXEMPT = ("/healthz", "/livez", "/readyz",
-                     "/metrics", "/metrics/resources")
+    # never consumes a lane slot; the exemption envelope is the SAME
+    # set admission honors (plus ADMIN_ROUTES, checked at the call
+    # sites) — a probe path added to one layer's exemption and not the
+    # other would silently let chaos 429 a liveness probe that
+    # admission promised never to fail.
+    _FAULT_EXEMPT = _EXEMPT_PATHS
 
     _sock_aborted = False   # instance flag set by _abort_socket
+    _apf_ticket = None      # live APF ticket while a request executes
 
     def _abort_socket(self) -> None:
         """RST the client (SO_LINGER 1,0 → no FIN, no more bytes) and
@@ -527,38 +556,132 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(wfile, _TruncatingWriter):
                 wfile.finish_request()
 
-    def _dispatch_gated(self, inner) -> None:
-        lane = self._gate()
-        if lane is None:
-            try:
-                inner()
-            except Forbidden as e:
-                self._send_error(403, "Forbidden", str(e))
-            return
-        if not lane.acquire(blocking=False):
-            body = json.dumps({
-                "kind": "Status", "status": "Failure",
-                "reason": "TooManyRequests",
-                "message": "too many requests in flight, try again later",
-                "code": 429,
-            }).encode()
-            self.send_response(429)
-            self.send_header("Retry-After", "1")
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+    def _content_length(self) -> int:
+        """Malformed Content-Length must not traceback the admission
+        path (it runs before auth for every request): treat it as 0 AND
+        drop keep-alive — the framing of any body the client did send
+        is unknowable, so its unread bytes must not corrupt the next
+        request on this connection. Every consumer (admission width,
+        the 429 drain, ``_read_body``) routes through here, so the
+        close decision is made exactly once."""
         try:
-            try:
-                inner()
-            except Forbidden as e:
-                # raised before any bytes were written (body reads
-                # precede every send): e.g. a binary body from an
-                # unauthenticated client
-                self._send_error(403, "Forbidden", str(e))
+            return int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self.close_connection = True
+            return 0
+
+    def _send_429(self, message: str, retry_after: float,
+                  level: str = "", schema: str = "") -> None:
+        """Overload pushback with an HONEST Retry-After (the level's or
+        lane's expected drain time) plus the rejecting priority level /
+        flow schema headers the client's retry accounting keys on
+        (reference X-Kubernetes-PF-* response headers)."""
+        # drain the body first so keep-alive framing stays intact for
+        # the client's retry (same discipline as the injected-fault 429)
+        length = self._content_length()
+        if length:
+            self.rfile.read(length)
+        body = json.dumps({
+            "kind": "Status", "status": "Failure",
+            "reason": "TooManyRequests",
+            "message": message,
+            "code": 429,
+        }).encode()
+        self.send_response(429)
+        self.send_header("Retry-After", f"{retry_after:g}")
+        if level:
+            self.send_header("X-Kubernetes-PF-PriorityLevel", level)
+        if schema:
+            self.send_header("X-Kubernetes-PF-FlowSchema", schema)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _run_inner(self, inner) -> None:
+        try:
+            inner()
+        except Forbidden as e:
+            # raised before any bytes were written (body reads precede
+            # every send): e.g. a binary body from an unauthenticated
+            # client
+            self._send_error(403, "Forbidden", str(e))
+
+    def _dispatch_gated(self, inner) -> None:
+        fc = self.server.flowcontrol
+        if fc is not None:
+            self._dispatch_apf(fc, inner)
+            return
+        gated = self._gate()
+        if gated is None:
+            self._run_inner(inner)
+            return
+        lane, stats = gated
+        if not lane.acquire(blocking=False):
+            self._send_429(
+                "too many requests in flight, try again later",
+                stats.retry_after())
+            return
+        stats.start()
+        t0 = time.monotonic()
+        try:
+            self._run_inner(inner)
         finally:
             lane.release()
+            stats.done(time.monotonic() - t0)
+
+    # -- API Priority & Fairness admission (flowcontrol.py; reference
+    # filters/priority-and-fairness.go): the default admission path.
+    # FlowSchemas route identity/verb/resource to a priority level;
+    # the level's shuffle-sharded queueset fairly queues or rejects.
+    def _dispatch_apf(self, fc: FlowController, inner) -> None:
+        path = self.path.split("?", 1)[0]
+        if self._admission_exempt(path):
+            self._run_inner(inner)
+            return
+        user = self._user()
+        groups_fn = getattr(self.server.authorizer, "groups_for", None)
+        groups = groups_fn(user) if groups_fn is not None else ()
+        is_watch = self.command in ("GET", "HEAD") \
+            and "watch=" in self.path
+        try:
+            items_hint = int(
+                self.headers.get("X-Kubernetes-Request-Items") or 0)
+        except ValueError:
+            items_hint = 0
+        # the flow distinguisher is SERVER-derived (identity/namespace,
+        # as upstream insists): X-Flow-Id may refine it only from the
+        # control-plane trust envelope (_binary_decode_allowed — system
+        # identities or the loopback escape hatch). An untrusted tenant
+        # minting a fresh distinguisher per request would become a new
+        # flow per request, hash across every queue in its level, and
+        # shred the shuffle-shard isolation this subsystem exists for.
+        flow_id = self.headers.get("X-Flow-Id") or ""
+        if flow_id and not self._binary_decode_allowed():
+            flow_id = ""
+        try:
+            ticket = fc.admit(
+                user=user, groups=groups or (), verb=self.command,
+                resource=resource_of(self.path),
+                namespace=namespace_of(self.path),
+                flow_id=flow_id,
+                items_hint=items_hint,
+                content_length=self._content_length(),
+                is_watch=is_watch, path=self.path)
+        except Rejected as rej:
+            self._send_429(
+                f"too many requests for priority level {rej.level!r} "
+                f"({rej.reason}), try again later",
+                rej.retry_after, level=rej.level, schema=rej.schema)
+            return
+        # watches release their watch-init seats right after the stream
+        # attaches (_serve_watch); everything else releases here
+        self._apf_ticket = ticket
+        try:
+            self._run_inner(inner)
+        finally:
+            self._apf_ticket = None
+            ticket.release()
 
     def _send_json(self, code: int, payload: Any) -> None:
         body = json.dumps(payload).encode()
@@ -610,7 +733,7 @@ class _Handler(BaseHTTPRequestHandler):
         return groups is not None and "system:masters" in groups(user)
 
     def _read_body(self) -> Any:
-        length = int(self.headers.get("Content-Length") or 0)
+        length = self._content_length()
         raw = self.rfile.read(length) if length else b"{}"
         ctype = self.headers.get("Content-Type") or ""
         from kubernetes_tpu.apiserver import codec
@@ -929,6 +1052,29 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_error(405, "MethodNotAllowed",
                          "/debug/trace supports GET and DELETE")
 
+    def _serve_apf_admin(self, verb: str) -> None:
+        """/debug/apf: API Priority & Fairness introspection. GET → the
+        FlowController snapshot (per-level seats/queues/rejections/
+        flows, schema match counts, shed state). Same control-plane
+        trust envelope as the other debug surfaces, and — via
+        ADMIN_ROUTES — exempt from admission itself: the overload
+        postmortem must be readable mid-overload."""
+        if not self._binary_decode_allowed():
+            self._send_error(403, "Forbidden",
+                             "apf admin requires a control-plane identity")
+            return
+        fc = self.server.flowcontrol
+        if fc is None:
+            self._send_error(404, "NotFound",
+                             "flow control is not enabled (legacy "
+                             "max-in-flight lanes are active)")
+            return
+        if verb != "GET":
+            self._send_error(405, "MethodNotAllowed",
+                             "/debug/apf supports GET")
+            return
+        self._send_json(200, fc.snapshot())
+
     def _serve_faults_admin(self, verb: str) -> None:
         """/debug/faults: runtime fault-injection control surface.
         GET → config + injection counters; POST/PUT → replace rule set
@@ -1100,6 +1246,11 @@ class _Handler(BaseHTTPRequestHandler):
                              codec.BINARY_CONTENT_TYPE)
             return
         objs, rv = store.list_objects_with_rv(kind, ns)
+        fc = self.server.flowcontrol
+        if fc is not None:
+            # feed width estimation: the NEXT list of this resource
+            # charges seats proportional to what this one served
+            fc.width.note_list_size(resource_of(self.path), len(objs))
         if label_sel is not None:
             objs = [o for o in objs
                     if label_sel.matches(o.metadata.labels)]
@@ -1900,6 +2051,14 @@ class _Handler(BaseHTTPRequestHandler):
         except TooOldResourceVersion as e:
             self._send_error(410, "Expired", str(e))
             return
+        finally:
+            # watch-init seats cover exactly the expensive part — the
+            # replay/attach burst a reconnect herd multiplies. The
+            # stream itself is long-running and must not hold seats
+            # (upstream's watch-initialization seat model).
+            ticket = self._apf_ticket
+            if ticket is not None:
+                ticket.release()
         from kubernetes_tpu.apiserver import codec
 
         self.send_response(200)
@@ -2019,6 +2178,7 @@ class APIServer(ThreadingHTTPServer):
         binary_clients: Optional[set] = None,
         fault_gate: Optional[FaultGate] = None,
         watch_flush_window: float = 0.002,
+        flow_control: Any = "default",
     ):
         super().__init__((host, port), _Handler)
         # pipelined watch delivery: after the first event of a chunk,
@@ -2056,11 +2216,29 @@ class APIServer(ThreadingHTTPServer):
         self._req_seq = itertools.count()   # 1-in-N request-span sampling
         # self-protection lanes (reference filters/maxinflight.go
         # defaults: --max-requests-inflight 400,
-        # --max-mutating-requests-inflight 200); None = unlimited
+        # --max-mutating-requests-inflight 200); None = unlimited.
+        # Active only when flow_control=None — APF replaces them as the
+        # admission decision otherwise, deriving its seat budgets from
+        # the same numbers.
         self.readonly_lane = threading.Semaphore(max_readonly_inflight) \
             if max_readonly_inflight else None
         self.mutating_lane = threading.Semaphore(max_mutating_inflight) \
             if max_mutating_inflight else None
+        self.lane_stats = {"ro": LaneStats(max_readonly_inflight),
+                           "rw": LaneStats(max_mutating_inflight)}
+        # API Priority & Fairness (flowcontrol.py, KEP-1040): the
+        # default admission path. "default" derives the standard
+        # schema/level tiering from the lane budgets; a
+        # FlowControlConfig customizes it; None restores the raw lanes.
+        if flow_control is None:
+            self.flowcontrol: Optional[FlowController] = None
+        elif isinstance(flow_control, FlowControlConfig):
+            self.flowcontrol = FlowController(flow_control)
+        elif isinstance(flow_control, FlowController):
+            self.flowcontrol = flow_control
+        else:
+            self.flowcontrol = FlowController(default_config(
+                max_readonly_inflight, max_mutating_inflight))
         # extra non-control-plane identities granted the binary codec
         self.binary_clients = set(binary_clients or ())
         self.store = store if store is not None else ClusterStore()
@@ -2326,6 +2504,9 @@ class APIServer(ThreadingHTTPServer):
             if oldest is None or hit[2] >= oldest - 1:
                 return hit[1]
         objs, rv = self.store.list_objects_with_rv(kind, namespace)
+        if self.flowcontrol is not None:
+            self.flowcontrol.width.note_list_size(
+                KIND_TO_PLURAL.get(kind, kind.lower() + "s"), len(objs))
         body = codec.encode(
             {"kind": f"{kind}List", "resourceVersion": rv, "items": objs})
         with self._list_cache_lock:

@@ -185,12 +185,20 @@ class RestClusterClient:
         retry_budget: Optional[RetryBudget] = None,
         breaker_threshold: int = 5,
         retry_seed: Optional[int] = None,
+        flow_id: str = "",
     ):
         self.base_url = base_url.rstrip("/")
         rest = self.base_url.split("://", 1)[1]
         host, _, port = rest.partition(":")
         self._host, self._port = host, int(port or 80)
         self.token = token
+        # flow distinguisher refinement for the server's API Priority &
+        # Fairness layer (X-Flow-Id): several logical tenants behind one
+        # identity (the bench harness's anonymous loopback clients) get
+        # their own fair-queued flows instead of sharing one. The server
+        # honors it only from control-plane/loopback identities —
+        # untrusted tenants cannot mint flows to dodge fair queuing.
+        self.flow_id = flow_id
         self.binary = binary
         self.watch_kinds = watch_kinds
         self.cache_ttl = cache_ttl
@@ -252,6 +260,8 @@ class RestClusterClient:
             else "application/json"
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
+        if self.flow_id:
+            h["X-Flow-Id"] = self.flow_id
         return h
 
     @staticmethod
@@ -272,14 +282,20 @@ class RestClusterClient:
             data = codec.encode(payload) if body_binary \
                 else json.dumps(payload).encode()
         pool = self._pools["ro" if method in ("GET", "HEAD") else "rw"]
+        headers = self._headers(body_binary)
+        if charge > 1:
+            # declare the per-object count so the server's APF width
+            # estimation charges proportional seats — the wire half of
+            # "the token bucket charges per OBJECT": batching must not
+            # launder concurrency server-side either
+            headers["X-Kubernetes-Request-Items"] = str(int(charge))
         conn: Optional[http.client.HTTPConnection] = None
         attempt = 0
         while True:
             try:
                 if conn is None:
                     conn = pool.acquire()
-                conn.request(method, path, body=data,
-                             headers=self._headers(body_binary))
+                conn.request(method, path, body=data, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()
             except (http.client.HTTPException, OSError):
@@ -306,21 +322,47 @@ class RestClusterClient:
                     and self._retry_budget.try_spend():
                 # overload pushback: honor Retry-After, CAPPED — a
                 # misbehaving server advertising an hour must not stall
-                # this client unboundedly. The connection answered and
-                # is healthy: keep holding it for the retry.
+                # this client unboundedly. A 429 is the flow-control
+                # layers (APF or the legacy lanes) talking: overload is
+                # NOT outage, so tell the breaker the fabric is healthy
+                # — a throttled tenant must never trip degraded mode off
+                # the back of interleaved transport blips that pushback
+                # would otherwise let accumulate to the threshold. A 503
+                # is NOT that: nothing server-side emits it — it comes
+                # from fault injection or a genuinely failing server —
+                # so it stays breaker-neutral (retried, but never
+                # laundered into health during a 503 storm).
+                if resp.status == 429:
+                    self.breaker.record_success()
                 try:
                     advertised = float(
                         resp.headers.get("Retry-After") or 0.0)
                 except ValueError:
                     advertised = 0.0
-                self._note_retry(method, f"http_{resp.status}")
+                # attribute the pushback to the rejecting priority
+                # level (the server's X-Kubernetes-PF-* headers) so the
+                # retry series separates "APF throttled me" from
+                # generic 429/503 bursts
+                pf_level = resp.headers.get(
+                    "X-Kubernetes-PF-PriorityLevel") or ""
+                self._note_retry(
+                    method,
+                    f"apf_{pf_level}" if pf_level
+                    else f"http_{resp.status}")
                 time.sleep(min(max(advertised,
                                    self._backoff.delay(attempt)),
                                self.retry_after_cap))
                 attempt += 1
                 continue
-            # any HTTP response means the transport is healthy
-            self.breaker.record_success()
+            # any HTTP response proves the transport — but a terminal
+            # 503 is outage-shaped (fault injection or a genuinely
+            # failing server; the flow-control layers only ever answer
+            # 429), so it stays breaker-neutral here exactly as in the
+            # retry branch above: a sustained 503 storm must still let
+            # interleaved transport failures accumulate and open the
+            # breaker instead of resetting the count on every response.
+            if resp.status != 503:
+                self.breaker.record_success()
             if resp.will_close:
                 _ConnPool.discard(conn)
             else:
@@ -765,6 +807,8 @@ class RestClusterClient:
             headers["Accept"] = codec.BINARY_CONTENT_TYPE
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self.flow_id:
+            headers["X-Flow-Id"] = self.flow_id
         try:
             conn.request(
                 "GET", f"/api/v1/{plural}?watch=1&resourceVersion={rv}",

@@ -1,0 +1,278 @@
+"""Noisy-tenant QoS bench: multi-tenant overload against the REST
+fabric, with API Priority & Fairness as the thing under test.
+
+The row answers the question the headline number dodges: what happens
+to the scheduler's 30k-pod burst when it does NOT have the apiserver to
+itself? ``run_noisy_tenant_qos`` runs the SchedulingBasic REST workload
+twice at the same scale —
+
+- **solo**: the plain ``run_workload_rest`` arm (the REST row's own
+  configuration) as the victim's baseline;
+- **contended**: the same victim, plus ``tenants`` aggressor processes
+  armed at measurement start, each an authenticated workload-level
+  tenant mounting the three overload shapes from the chaos suite
+  (sustained list storms, watch reconnect herds, bulk-verb abuse) from
+  several threads, honoring nothing but its own 429s.
+
+APF routes the victim's control-plane traffic (scheduler binds/status,
+masters-exempt creators) past the aggressors' workload level, and fair
+queuing inside the workload level keeps the aggressors from starving
+each other. The row reports both arms' pods/s and p99, the ratio, and
+the server's /debug/apf totals; the acceptance bar is
+``p99_contended <= 2 x p99_solo`` with zero pods lost.
+
+Aggressors are separate PROCESSES (spawn, jax-free) speaking raw
+``http.client`` — no client-side rate limiting, no decode cost, just
+request pressure on the server.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import multiprocessing as mp
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+AGGRESSOR_SHAPES = ("liststorm", "watchherd", "bulkabuse")
+
+
+def tenant_tokens(tenants: int) -> Dict[str, str]:
+    return {f"qos-tenant-{i}-token": f"qos-tenant-{i}"
+            for i in range(tenants)}
+
+
+# ---------------------------------------------------------------------------
+# aggressor child (spawned; must stay jax-free — see harness/__init__)
+
+
+def _aggressor_thread(host: str, port: int, token: str, shape: str,
+                      seed: int, stop, stats: dict, lock) -> None:
+    rng = random.Random(seed)
+    headers = {"Authorization": f"Bearer {token}"}
+    bin_headers = dict(headers)
+    bin_headers["Accept"] = "application/vnd.ktpu.binary"
+    conn: Optional[http.client.HTTPConnection] = None
+    seq = 0
+    while not stop.is_set():
+        try:
+            if conn is None:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+            if shape == "liststorm":
+                # sustained expensive lists — the shape width
+                # estimation prices by recently served list sizes
+                conn.request("GET", rng.choice(
+                    ("/api/v1/pods", "/api/v1/nodes")),
+                    headers=bin_headers)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            elif shape == "watchherd":
+                # reconnect herd: attach (the headers arrive as soon as
+                # the stream attaches — that attach is what charges
+                # watch-init seats), linger a moment, drop, repeat
+                conn.request(
+                    "GET", "/api/v1/pods?watch=1&resourceVersion=0",
+                    headers=headers)
+                resp = conn.getresponse()
+                status = resp.status
+                time.sleep(rng.uniform(0.0, 0.02))
+                conn.close()
+                conn = None
+            else:   # bulkabuse: wide bulk verbs, width must scale
+                seq += 1
+                items = [{"metadata": {
+                    "name": f"ld-{seed}-{seq}-{i}",
+                    "namespace": "default"}}
+                    for i in range(200)]
+                body = json.dumps({"kind": "ConfigMapList",
+                                   "items": items}).encode()
+                h = dict(headers)
+                h["Content-Type"] = "application/json"
+                h["X-Kubernetes-Request-Items"] = "200"
+                conn.request("POST", "/api/v1/configmaps", body=body,
+                             headers=h)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            with lock:
+                stats["requests"] += 1
+                if status == 429:
+                    stats["throttled"] += 1
+            if status == 429:
+                time.sleep(0.02)    # hostile but not a pure spin
+        except Exception:  # noqa: BLE001 — server pushed back hard
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+            time.sleep(0.02)
+
+
+def _aggressor_main(url: str, token: str, seed: int, stop,
+                    threads: int = 6, ready=None) -> None:
+    rest = url.split("://", 1)[1]
+    host, _, port = rest.partition(":")
+    stats = {"requests": 0, "throttled": 0}
+    lock = threading.Lock()
+    workers = [
+        threading.Thread(
+            target=_aggressor_thread,
+            args=(host, int(port or 80), token,
+                  AGGRESSOR_SHAPES[i % len(AGGRESSOR_SHAPES)],
+                  seed * 1000 + i, stop, stats, lock),
+            daemon=True)
+        for i in range(threads)
+    ]
+    for w in workers:
+        w.start()
+    if ready is not None:
+        # interpreter spawn costs ~1s+; the parent gates measurement on
+        # this signal so the contended arm never measures an
+        # uncontended server
+        ready.set()
+    stop.wait()
+    for w in workers:
+        w.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# the bench row
+
+
+def _apf_summary(snap: Optional[dict]) -> dict:
+    if not snap:
+        return {}
+    out = {"rejections": 0, "levels": {}}
+    for name, lv in (snap.get("levels") or {}).items():
+        rejected = sum((lv.get("rejected") or {}).values())
+        out["rejections"] += rejected
+        out["levels"][name] = {
+            "dispatched": lv.get("dispatched_total", 0),
+            "seats_dispatched": lv.get("seats_dispatched_total", 0),
+            "rejected": rejected,
+            "peak_executing_seats": lv.get("peak_executing_seats", 0),
+            "capacity": lv.get("capacity", 0),
+        }
+    return out
+
+
+def run_noisy_tenant_qos(
+    nodes: int,
+    measure_pods: int,
+    tenants: int = 3,
+    qps: Optional[float] = 5000.0,
+    max_batch: int = 4096,
+    aggressor_threads: int = 6,
+    seed: int = 7,
+    wait_timeout: float = 1200.0,
+    progress: Optional[Callable[[str], None]] = None,
+    result_hook=None,
+    solo_baseline: Optional[dict] = None,
+) -> dict:
+    """One QoS bench row (see module doc). Returns the BENCH JSON dict;
+    ``qos_ok`` is the acceptance verdict (victim p99 within 2x solo,
+    all pods bound in both arms). ``solo_baseline`` (keys
+    ``pods_per_sec``, ``p99_latency_ms``) skips the solo arm — the
+    default bench matrix passes the adjacent REST row's numbers, which
+    measure the identical solo configuration, instead of paying a third
+    full-scale run."""
+    from kubernetes_tpu.harness.rest_perf import run_workload_rest
+
+    def note(msg: str) -> None:
+        if progress:
+            progress(f"[qos] {msg}")
+
+    if solo_baseline is not None:
+        solo_rate = float(solo_baseline["pods_per_sec"])
+        p99_solo = float(solo_baseline["p99_latency_ms"])
+        solo_bound = True
+        note(f"solo baseline (from the REST row): {solo_rate:.1f} "
+             f"pods/s p99 {p99_solo:.0f}ms")
+    else:
+        note(f"solo arm: SchedulingBasic {nodes} nodes / "
+             f"{measure_pods} pods over REST")
+        solo = run_workload_rest(
+            "SchedulingBasic", nodes=nodes, measure_pods=measure_pods,
+            max_batch=min(measure_pods, max_batch), qps=qps,
+            wait_timeout=wait_timeout, progress=progress,
+            result_hook=result_hook)
+        solo_rate = solo.pods_per_second
+        p99_solo = solo.metrics.get("Perc99", 0.0)
+        solo_bound = solo.metrics.get("server_pods_bound", 0) \
+            >= measure_pods
+
+    tokens = tenant_tokens(tenants)
+    ctx = mp.get_context("spawn")
+    procs: List = []
+    stop_evt = ctx.Event()
+
+    def start_aggressors(url: str) -> Callable[[], None]:
+        note(f"arming {tenants} aggressor tenants x "
+             f"{aggressor_threads} threads (list storms, watch herds, "
+             f"bulk abuse)")
+        ready_evts = []
+        for i, token in enumerate(tokens):
+            ready = ctx.Event()
+            p = ctx.Process(
+                target=_aggressor_main,
+                args=(url, token, seed + i, stop_evt, aggressor_threads,
+                      ready),
+                daemon=True)
+            p.start()
+            procs.append(p)
+            ready_evts.append(ready)
+        # block until every aggressor fleet is firing: the measured
+        # window must be contended from its first pod
+        for ready in ready_evts:
+            if not ready.wait(60.0):
+                note("WARNING: an aggressor process never came up")
+        note("aggressors firing")
+
+        def stop() -> None:
+            stop_evt.set()
+            for p in procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+
+        return stop
+
+    note("contended arm: same victim burst under aggressor load")
+    contended = run_workload_rest(
+        "SchedulingBasic", nodes=nodes, measure_pods=measure_pods,
+        max_batch=min(measure_pods, max_batch), qps=qps,
+        wait_timeout=wait_timeout, progress=progress,
+        result_hook=result_hook,
+        extra_tokens=tokens, on_measure_start=start_aggressors)
+
+    p99_contended = contended.metrics.get("Perc99", 0.0)
+    ratio = (p99_contended / p99_solo) if p99_solo > 0 else 0.0
+    all_bound = (
+        solo_bound
+        and contended.metrics.get("server_pods_bound", 0) >= measure_pods)
+    apf = _apf_summary(contended.metrics.get("apf"))
+    note(f"victim: solo {solo_rate:.1f} pods/s "
+         f"p99 {p99_solo:.0f}ms -> contended "
+         f"{contended.pods_per_second:.1f} pods/s "
+         f"p99 {p99_contended:.0f}ms (ratio {ratio:.2f}); "
+         f"apf rejections {apf.get('rejections', 0)}")
+    return {
+        "metric": f"noisy_tenant_qos[SchedulingBasic {nodes}nodes/"
+                  f"{measure_pods}pods, {tenants} aggressor tenants x "
+                  f"{aggressor_threads} threads list/watch/bulk]",
+        "value": round(contended.pods_per_second, 1),
+        "unit": "pods/s",
+        "p99_latency_ms": round(p99_contended),
+        "solo_pods_per_sec": round(solo_rate, 1),
+        "solo_p99_latency_ms": round(p99_solo),
+        "p99_ratio_vs_solo": round(ratio, 2),
+        "qos_ok": bool(all_bound and (p99_solo <= 0
+                                      or p99_contended <= 2.0 * p99_solo)),
+        "server_pods_bound": contended.metrics.get("server_pods_bound"),
+        "apf": apf,
+    }

@@ -50,7 +50,8 @@ CREATOR_TOKEN = "rest-perf-creator-token"
 # child mains (spawned; must stay jax-free — see harness/__init__)
 
 
-def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
+def _apiserver_main(conn, wal_dir: Optional[str],
+                    extra_tokens: Optional[dict] = None) -> None:
     from kubernetes_tpu.apiserver.rbac import provision_bootstrap_policy
     from kubernetes_tpu.apiserver.rest import APIServer
     from kubernetes_tpu.apiserver.store import ClusterStore
@@ -67,11 +68,35 @@ def _apiserver_main(conn, wal_dir: Optional[str]) -> None:
                      async_serialize=True) if wal_dir else None
     authz = provision_bootstrap_policy(store)
     authz.add_user_to_group("perf-creator", "system:masters")
+    tokens = {SCHEDULER_TOKEN: "system:kube-scheduler",
+              CREATOR_TOKEN: "perf-creator"}
+    # extra identities (the noisy-tenant QoS harness's aggressor
+    # tenants): authenticated but NOT control-plane/masters, so APF
+    # routes them to the workload level, one fair-queued flow each.
+    # They get a viewer-ish role — enough to mount list storms, watch
+    # herds, and bulk ConfigMap abuse, nothing privileged.
+    tokens.update(extra_tokens or {})
+    if extra_tokens:
+        from kubernetes_tpu.api.types import (
+            ClusterRole, ClusterRoleBinding, ObjectMeta, PolicyRule,
+            RBACSubject, RoleRef,
+        )
+
+        store.add_cluster_role(ClusterRole(
+            metadata=ObjectMeta(name="qos-tenant"),
+            rules=[PolicyRule(verbs=["get", "list", "watch"],
+                              resources=["pods", "nodes", "services"]),
+                   PolicyRule(verbs=["get", "list", "watch", "create"],
+                              resources=["configmaps"])]))
+        store.add_cluster_role_binding(ClusterRoleBinding(
+            metadata=ObjectMeta(name="qos-tenants"),
+            subjects=[RBACSubject(kind="User", name=u)
+                      for u in extra_tokens.values()],
+            role_ref=RoleRef(kind="ClusterRole", name="qos-tenant")))
     server = APIServer(
         store=store,
         authorizer=authz,
-        tokens={SCHEDULER_TOKEN: "system:kube-scheduler",
-                CREATOR_TOKEN: "perf-creator"},
+        tokens=tokens,
     ).start()
     conn.send(server.url)
     while True:
@@ -258,6 +283,8 @@ def run_workload_rest(
     wal: bool = True,
     progress: Optional[Callable[[str], None]] = None,
     result_hook: Optional[Callable[[object, object], None]] = None,
+    extra_tokens: Optional[dict] = None,
+    on_measure_start: Optional[Callable[[str], Callable[[], None]]] = None,
 ):
     """Run one workload with every byte crossing the REST fabric.
     Returns a ``BenchmarkResult`` whose ``metrics`` carry the apiserver
@@ -281,7 +308,8 @@ def run_workload_rest(
 
     api_conn, api_child = ctx.Pipe()
     api_proc = ctx.Process(target=_apiserver_main,
-                           args=(api_child, wal_dir), daemon=True)
+                           args=(api_child, wal_dir, extra_tokens),
+                           daemon=True)
     api_proc.start()
     url = api_conn.recv()
 
@@ -369,6 +397,7 @@ def run_workload_rest(
     measure_start = 0.0
     expected_bound = 0
     created_pods = 0
+    stop_companions: Optional[Callable[[], None]] = None
     ops = make_workload(name, nodes=nodes, init_pods=init_pods,
                         measure_pods=measure_pods)
     try:
@@ -401,6 +430,12 @@ def run_workload_rest(
                     if progress and warm > 0.05:
                         progress(f"{name}/rest: solver warmup {warm:.1f}s")
                 if collect:
+                    if on_measure_start is not None \
+                            and stop_companions is None:
+                        # companion load (the QoS harness's aggressor
+                        # tenants) starts exactly when measurement does
+                        # and runs through the whole measured window
+                        stop_companions = on_measure_start(url)
                     collector = ThroughputCollector(count_fn=bound_count)
                     measure_start = time.monotonic()
                     collector.start()
@@ -419,9 +454,27 @@ def run_workload_rest(
         sched.wait_for_inflight_bindings(timeout=30.0)
         duration = time.monotonic() - measure_start if measure_start \
             else 0.0
+        if stop_companions is not None:
+            stop_companions()
+            stop_companions = None
+        # mirror the server's APF totals into this process before the
+        # result hook runs, so bench.py's diag line can print the apf
+        # segment (the server lives in a child process)
+        apf_snapshot = None
+        try:
+            code, snap = client._request("GET", "/debug/apf")
+            if code == 200 and isinstance(snap, dict):
+                apf_snapshot = snap
+                from kubernetes_tpu.metrics.apf_metrics import apf_metrics
+
+                apf_metrics().absorb_snapshot(snap)
+        except Exception:  # noqa: BLE001 — introspection is best-effort
+            pass
         if result_hook is not None:
             result_hook(sched, bs)
     except BaseException:
+        if stop_companions is not None:
+            stop_companions()
         teardown_children()
         raise
     finally:
@@ -448,6 +501,7 @@ def run_workload_rest(
         "server_pods_total": server_counts["pods_total"],
         "wal_entries": server_counts["wal_entries"],
         "scheduler_bound": bound_count(),
+        "apf": apf_snapshot,
     }
     if server_counts["pods_bound"] < expected_bound:
         raise RuntimeError(

@@ -1,0 +1,137 @@
+"""API Priority & Fairness metrics (reference analogs:
+``apiserver_flowcontrol_rejected_requests_total``,
+``apiserver_flowcontrol_dispatched_requests_total``,
+``apiserver_flowcontrol_current_executing_seats``,
+``apiserver_flowcontrol_current_inqueue_requests``,
+``apiserver_flowcontrol_request_queue_length_after_enqueue`` /
+wait-duration histograms).
+
+Operationally, three questions these answer:
+
+- *who is being pushed back*: ``apf_rejected_requests_total
+  {priority_level, reason}`` (queue-full | timeout | shed) — a climbing
+  workload-level rate with a flat system-level rate is the subsystem
+  working as designed; a climbing SYSTEM rate is an under-provisioned
+  control plane;
+- *is batching laundering concurrency*: ``apf_seats_dispatched_total /
+  apf_dispatched_requests_total`` per level is the average request
+  width — bulk-verb abuse shows up as width, not as extra requests;
+- *how close to saturation*: ``apf_current_executing_seats`` vs
+  ``apf_request_concurrency_limit`` per level, and the queue-wait
+  histogram's tail.
+
+``absorb_snapshot`` mirrors a REMOTE server's ``/debug/apf`` totals
+into this process's counters, so the bench harness (apiserver in a
+child process) can still emit the ``apf`` diag segment from the
+scheduler process.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kubernetes_tpu.metrics.fabric_metrics import (
+    _counter,
+    _gauge,
+    _histogram,
+)
+from kubernetes_tpu.metrics.registry import MetricsRegistry
+
+_QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.0, 5.0, 10.0)
+
+
+class ApfMetrics:
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            from kubernetes_tpu.metrics import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self.rejected_requests_total = _counter(
+            registry, "apf_rejected_requests_total",
+            "Requests rejected by API Priority & Fairness, by priority "
+            "level and reason (queue-full, timeout, shed)",
+            ("priority_level", "reason"),
+        )
+        self.dispatched_requests_total = _counter(
+            registry, "apf_dispatched_requests_total",
+            "Requests admitted to execute by APF, by priority level",
+            ("priority_level",),
+        )
+        self.seats_dispatched_total = _counter(
+            registry, "apf_seats_dispatched_total",
+            "Seats (request width) admitted to execute by APF, by "
+            "priority level — seats/requests is the average width, the "
+            "bulk-verb concurrency-laundering detector",
+            ("priority_level",),
+        )
+        self.current_executing_seats = _gauge(
+            registry, "apf_current_executing_seats",
+            "Seats currently occupied by executing requests, by "
+            "priority level",
+            ("priority_level",),
+        )
+        self.current_inqueue_requests = _gauge(
+            registry, "apf_current_inqueue_requests",
+            "Requests currently waiting in APF queues, by priority level",
+            ("priority_level",),
+        )
+        self.peak_executing_seats = _gauge(
+            registry, "apf_peak_executing_seats",
+            "High-water mark of executing seats per priority level "
+            "since the last diag read — bench rows consume (reset) it "
+            "so each row reports its own peak, not the gauge's current "
+            "post-run value (~0 once the row's requests drain)",
+            ("priority_level",),
+        )
+        self.request_concurrency_limit = _gauge(
+            registry, "apf_request_concurrency_limit",
+            "Assured seat budget per priority level (shares of the "
+            "legacy lane budgets)",
+            ("priority_level",),
+        )
+        self.request_queue_wait_seconds = _histogram(
+            registry, "apf_request_queue_wait_seconds",
+            "Time requests spent queued before dispatch or rejection, "
+            "by priority level",
+            ("priority_level",),
+            buckets=_QUEUE_WAIT_BUCKETS,
+        )
+
+    # the last absorbed /debug/apf snapshot, kept whole: the queue-wait
+    # histogram and peak-seat numbers live server-side and cannot be
+    # reconstructed from mirrored counters — bench.py's diag segment
+    # reads them from here for remote-server rows
+    last_snapshot: Optional[Dict] = None
+
+    def absorb_snapshot(self, snap: Dict) -> None:
+        """Fold a remote server's /debug/apf snapshot totals into this
+        process's counters (cumulative per server lifetime; the bench
+        harness calls this once per row, after the run)."""
+        self.last_snapshot = snap
+        for name, lv in (snap.get("levels") or {}).items():
+            for reason, n in (lv.get("rejected") or {}).items():
+                if n:
+                    self.rejected_requests_total.inc(name, reason,
+                                                     amount=n)
+            if lv.get("dispatched_total"):
+                self.dispatched_requests_total.inc(
+                    name, amount=lv["dispatched_total"])
+            if lv.get("seats_dispatched_total"):
+                self.seats_dispatched_total.inc(
+                    name, amount=lv["seats_dispatched_total"])
+            if lv.get("capacity"):
+                self.request_concurrency_limit.set(lv["capacity"], name)
+
+
+_default: Optional[ApfMetrics] = None
+
+
+def apf_metrics() -> ApfMetrics:
+    """Process-wide ApfMetrics bound to the default registry (the
+    legacyregistry pattern fabric_metrics follows)."""
+    global _default
+    if _default is None:
+        _default = ApfMetrics()
+    return _default

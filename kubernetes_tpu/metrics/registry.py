@@ -119,6 +119,12 @@ class Histogram(_Metric):
             series[1] += total
             series[2] += len(values)
 
+    def clear(self) -> None:
+        """Drop every series — for callers that report per-interval
+        numbers (the bench diag consumes its histograms between rows)."""
+        with self._lock:
+            self._series.clear()
+
     def count(self, *label_values: str) -> int:
         with self._lock:
             series = self._series.get(tuple(label_values))

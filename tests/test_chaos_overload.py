@@ -1,0 +1,68 @@
+"""Overload chaos suite (harness/chaos_overload.py): seeded
+multi-tenant abuse cells against the APF-guarded fabric. The fast
+smoke cell runs in tier-1; the full shape x seed matrix rides the
+``chaos``/``slow`` markers like the other chaos rings."""
+
+import pytest
+
+from kubernetes_tpu.harness.chaos_overload import (
+    OVERLOAD_PROFILES,
+    overload_fault_spec,
+    run_chaos_overload,
+)
+
+
+def _fmt(r):
+    return (f"invariants={r['invariants']} failure={r['failure']!r} "
+            f"stats={r['stats']}")
+
+
+class TestOverloadCellSmoke:
+    @pytest.mark.chaos
+    def test_bulkabuse_cell_holds_invariants(self):
+        """One small seeded cell in tier-1: bulk-verb abuse under small
+        seat budgets — zero lost pods, exempt envelope intact, no
+        starved flow, bulk width proportional."""
+        r = run_chaos_overload(seed=11, nodes=6, pods=24, tenants=2,
+                               waves=2, overload_profile="bulkabuse",
+                               wait_timeout=60.0)
+        assert r["ok"], _fmt(r)
+        assert r["invariants"]["bulk_width_proportional"]
+        assert r["stats"]["aggressor_requests"] > 0
+
+    def test_fault_spec_is_seeded_and_valid(self):
+        from kubernetes_tpu.apiserver.faults import FaultRule
+
+        spec = overload_fault_spec(23)
+        assert spec["seed"] == 23
+        for rule in spec["rules"]:
+            FaultRule.from_dict(rule)   # must parse
+
+
+class TestOverloadMatrix:
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("profile", sorted(OVERLOAD_PROFILES))
+    def test_profile_cells_pass(self, profile):
+        """Every overload shape, two seeds each, at matrix scale: the
+        acceptance invariants (no starved flow, exempt always served,
+        rate equivalence, zero lost pods) hold per cell."""
+        for seed in (11, 23):
+            r = run_chaos_overload(seed=seed, nodes=12, pods=96,
+                                   tenants=4,
+                                   overload_profile=profile,
+                                   wait_timeout=120.0)
+            assert r["ok"], f"{profile}/seed={seed}: {_fmt(r)}"
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_saturation_actually_saturates(self):
+        """The saturation cell must drive the workload level to its
+        seat capacity — an idle cell proves nothing."""
+        r = run_chaos_overload(seed=37, nodes=12, pods=96, tenants=4,
+                               overload_profile="saturation",
+                               wait_timeout=120.0)
+        assert r["ok"], _fmt(r)
+        assert r["invariants"]["apf_engaged"]
+        assert r["stats"]["workload_peak_seats"] \
+            >= r["stats"]["workload_capacity"]

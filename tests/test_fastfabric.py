@@ -433,12 +433,24 @@ class TestBenchRowOrder:
             return {"metric":
                     "pods_scheduled_per_sec[SchedulingBasic REST fabric]",
                     "value": 4500.0, "unit": "pods/s",
-                    "vs_baseline": 70.0,
+                    "vs_baseline": 70.0, "p99_latency_ms": 900,
                     "store_direct_pods_per_sec": 7500.0,
                     "fabric_overhead_ratio": 0.6}
 
+        def fake_run_qos_one(nodes, measure_pods, serial_rate, qps,
+                             tenants=3, solo_baseline=None):
+            # the default matrix hands the REST row's numbers over as
+            # the solo baseline (same configuration, no third run)
+            assert solo_baseline is not None
+            assert solo_baseline["pods_per_sec"] == 4500.0
+            return {"metric": "noisy_tenant_qos[SchedulingBasic]",
+                    "value": 3000.0, "unit": "pods/s",
+                    "vs_baseline": 48.0, "p99_ratio_vs_solo": 1.3,
+                    "qos_ok": True}
+
         monkeypatch.setattr(bench, "run_one", fake_run_one)
         monkeypatch.setattr(bench, "run_rest_one", fake_run_rest_one)
+        monkeypatch.setattr(bench, "run_qos_one", fake_run_qos_one)
         monkeypatch.setattr(bench.sys, "argv",
                             ["bench.py", "--skip-serial"])
         bench.main()
@@ -449,9 +461,14 @@ class TestBenchRowOrder:
                         if "REST fabric" in r["metric"])
         idx_headline = len(rows) - 1
         # the driver tail-captures stdout: the REST row must be the
-        # second-to-last JSON line, right before the headline
+        # second-to-last JSON line, right before the headline — and the
+        # noisy-tenant QoS row rides right before the REST row
         assert idx_rest == idx_headline - 1
         assert "REST fabric" not in rows[idx_headline]["metric"]
+        idx_qos = next(i for i, r in enumerate(rows)
+                       if "noisy_tenant_qos" in r["metric"])
+        assert idx_qos == idx_rest - 1
+        assert rows[idx_qos]["qos_ok"] is True
         # smoke: the REST row parses with its required fields
         rest = rows[idx_rest]
         assert rest["value"] > 0 and rest["unit"] == "pods/s"
@@ -464,8 +481,9 @@ class TestBenchRowOrder:
         order = bench.matrix_row_order()
         assert order[-1] == "headline"
         assert order[-2] == "rest"
+        assert order[-3] == "qos"
         order_all = bench.matrix_row_order(include_extra=True)
-        assert order_all[-2:] == ["rest", "headline"]
+        assert order_all[-3:] == ["qos", "rest", "headline"]
         assert set(bench.EXTRA_MATRIX) < set(order_all)
 
 

@@ -1,0 +1,600 @@
+"""API Priority & Fairness (apiserver/flowcontrol.py + the rest.py
+admission path): shuffle-sharded fair queuing, seat/width accounting,
+the exemption envelope, honest Retry-After on both admission paths, the
+client's APF-aware 429 handling, and the differential guard that the
+fairness machinery is free on the uncontended hot path. Reference
+anchors: ``apiserver/pkg/util/flowcontrol`` (queueset, shufflesharding),
+``filters/priority-and-fairness.go``."""
+
+import http.client
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.flowcontrol import (
+    FlowControlConfig,
+    FlowController,
+    FlowSchema,
+    PriorityLevelSpec,
+    Rejected,
+    WidthEstimator,
+    default_config,
+    shuffle_shard_hand,
+)
+from kubernetes_tpu.apiserver.rest import APIServer
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.restcluster import RestClusterClient
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _serve(**kwargs):
+    store = ClusterStore()
+    server = APIServer(store=store, **kwargs).start()
+    return store, server
+
+
+def _http(url: str, method: str = "GET", headers=None, body=None):
+    rest = url.split("://", 1)[1]
+    hostport, _, path = rest.partition("/")
+    host, _, port = hostport.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=15)
+    try:
+        conn.request(method, "/" + path, body=body,
+                     headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, dict(resp.headers), raw
+    finally:
+        conn.close()
+
+
+def _tiny_config(queue_wait_s: float = 0.2,
+                 shed_factor: float = 0.8) -> FlowControlConfig:
+    """Two seats per level, one queue of two slots for best-effort —
+    small enough that a pair of slow requests saturates it."""
+    return FlowControlConfig(
+        levels=[
+            PriorityLevelSpec("system", shares=50, queues=2,
+                              queue_length=8, hand_size=2),
+            PriorityLevelSpec("best-effort", shares=50, queues=1,
+                              queue_length=2, hand_size=1,
+                              sheddable=True),
+        ],
+        schemas=[
+            FlowSchema("system", 10, "system",
+                       lambda u, g, v, r, ns:
+                       u.startswith("system:kube-")),
+            FlowSchema("catch-all", 100, "best-effort"),
+        ],
+        total_seats=4, queue_wait_s=queue_wait_s,
+        shed_factor=shed_factor)
+
+
+# ---------------------------------------------------------------------------
+# shuffle sharding + fair dispatch (queueset unit layer)
+
+
+class TestQueueSet:
+    def test_shuffle_shard_hand_is_distinct_and_deterministic(self):
+        hand = shuffle_shard_hand(123456789, 16, 4)
+        assert len(hand) == len(set(hand)) == 4
+        assert all(0 <= i < 16 for i in hand)
+        assert hand == shuffle_shard_hand(123456789, 16, 4)
+
+    def test_distinct_flows_spread_across_queues(self):
+        from kubernetes_tpu.apiserver.flowcontrol import _flow_hash
+
+        firsts = {tuple(shuffle_shard_hand(_flow_hash("L", f"flow-{i}"),
+                                           16, 4))
+                  for i in range(64)}
+        # 64 flows into C(16,4) hands: collisions allowed, but a
+        # degenerate dealer (everyone in one hand) must not pass
+        assert len(firsts) > 16
+
+    def test_noisy_flow_does_not_starve_light_flow(self):
+        """Capacity 2, 12 queued noisy requests, then 1 light request:
+        fair dispatch must serve the light flow long before the noisy
+        backlog drains — it sits in its own shuffle-sharded queue with
+        the least virtual work."""
+        fc = FlowController(FlowControlConfig(
+            levels=[PriorityLevelSpec("workload", shares=1, queues=8,
+                                      queue_length=64, hand_size=2)],
+            schemas=[FlowSchema("all", 1, "workload")],
+            total_seats=2, queue_wait_s=30.0))
+        level = fc.levels["workload"]
+        assert level.capacity == 2
+        blockers = [fc.admit("noisy", (), "GET", "pods", "", path="x")
+                    for _ in range(2)]
+        order = []
+        order_lock = threading.Lock()
+
+        def worker(flow: str) -> None:
+            t = fc.admit(flow, (), "GET", "pods", "", path="x")
+            with order_lock:
+                order.append(flow)
+            t.release()
+
+        noisy = [threading.Thread(target=worker, args=("noisy",),
+                                  daemon=True) for _ in range(12)]
+        for t in noisy:
+            t.start()
+        deadline = time.monotonic() + 5
+        while level.queued_requests < 12 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        light = threading.Thread(target=worker, args=("light",),
+                                 daemon=True)
+        light.start()
+        deadline = time.monotonic() + 5
+        while level.queued_requests < 13 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        for b in blockers:
+            b.release()
+        light.join(timeout=10)
+        for t in noisy:
+            t.join(timeout=10)
+        assert "light" in order
+        # the light flow was served within the first few dispatches,
+        # not behind the whole noisy backlog
+        assert order.index("light") < 4
+
+    def test_queue_full_rejects_with_computed_retry_after(self):
+        fc = FlowController(_tiny_config(queue_wait_s=5.0))
+        blockers = [fc.admit("anon", (), "GET", "pods", "", path="x")
+                    for _ in range(2)]     # seats gone
+        queued = []
+        for _ in range(2):                 # queue_length=2 fills
+            t = threading.Thread(
+                target=lambda: fc.admit("anon", (), "GET", "pods", "",
+                                        path="x"),
+                daemon=True)
+            t.start()
+            queued.append(t)
+        level = fc.levels["best-effort"]
+        deadline = time.monotonic() + 5
+        while level.queued_requests < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(Rejected) as exc:
+            fc.admit("anon", (), "GET", "pods", "", path="x")
+        assert exc.value.reason == "queue-full"
+        assert 0.05 <= exc.value.retry_after <= 13.0
+        assert exc.value.level == "best-effort"
+        for b in blockers:
+            b.release()
+
+    def test_deadline_exceeded_rejects_with_timeout(self):
+        fc = FlowController(_tiny_config(queue_wait_s=0.05))
+        blockers = [fc.admit("anon", (), "GET", "pods", "", path="x")
+                    for _ in range(2)]
+        t0 = time.monotonic()
+        with pytest.raises(Rejected) as exc:
+            fc.admit("anon", (), "GET", "pods", "", path="x")
+        assert exc.value.reason == "timeout"
+        assert time.monotonic() - t0 < 2.0
+        for b in blockers:
+            b.release()
+        # the abandoned entry must not strand accounting: seats free,
+        # queue empty, a fresh request dispatches immediately
+        t = fc.admit("anon", (), "GET", "pods", "", path="x")
+        t.release()
+        snap = fc.levels["best-effort"].snapshot()
+        assert snap["queued_requests"] == 0
+        assert snap["executing_seats"] == 0
+
+    def test_shed_mode_protects_unsheddable_levels(self):
+        """With aggregate queued demand past shed_factor, sheddable
+        levels reject instead of queueing while the system level keeps
+        admitting."""
+        fc = FlowController(_tiny_config(queue_wait_s=5.0,
+                                         shed_factor=0.0))
+        blockers = [fc.admit("anon", (), "GET", "pods", "", path="x")
+                    for _ in range(2)]
+        # one queued request pushes queued seats past factor 0.0
+        q = threading.Thread(
+            target=lambda: fc.admit("anon", (), "GET", "pods", "",
+                                    path="x"), daemon=True)
+        q.start()
+        level = fc.levels["best-effort"]
+        deadline = time.monotonic() + 5
+        while level.queued_requests < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(Rejected) as exc:
+            fc.admit("anon", (), "GET", "pods", "", path="x")
+        assert exc.value.reason == "shed"
+        # system is NOT sheddable: it queues/admits as normal
+        ticket = fc.admit("system:kube-scheduler", (), "POST",
+                          "bindings", "", path="x")
+        ticket.release()
+        for b in blockers:
+            b.release()
+
+    def test_admission_overhead_uncontended(self):
+        """The fairness machinery must be ~free on the uncontended hot
+        path: one admit+release well under 100us on average."""
+        fc = FlowController(default_config(400, 200))
+        t0 = time.monotonic()
+        for _ in range(10_000):
+            fc.admit("system:kube-scheduler", (), "POST", "bindings",
+                     "default", path="/api/v1/bindings").release()
+        assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# classification + width
+
+
+class TestClassificationAndWidth:
+    def test_default_schemas_route_by_identity(self):
+        fc = FlowController(default_config(400, 200))
+        cases = {
+            ("admin", ("system:masters",)): "exempt",
+            ("system:kube-scheduler", ()): "system",
+            ("system:node:n1", ()): "system",
+            ("alice", ()): "workload",
+            ("system:anonymous", ()): "best-effort",
+            ("token:deadbeef", ()): "best-effort",
+        }
+        for (user, groups), want in cases.items():
+            schema, level = fc.classify(user, groups, "GET", "pods", "")
+            got = schema.priority_level
+            assert got == want, f"{user} -> {got}, want {want}"
+
+    def test_flow_id_refines_the_distinguisher(self):
+        s = FlowSchema("x", 1, "workload")
+        assert s.flow_key("alice", "", "t1") != s.flow_key("alice", "",
+                                                           "t2")
+        assert s.flow_key("alice", "", "") == "alice"
+
+    def test_width_scales_with_declared_items(self):
+        w = WidthEstimator(items_per_seat=100, max_seats=10)
+        assert w.estimate("POST", "pods", False, False, 1, 0) == 1
+        assert w.estimate("POST", "pods", False, False, 500, 0) == 5
+        # a 4096-item bulk bind caps at max_seats, never unbounded
+        assert w.estimate("POST", "bindings", False, False, 4096, 0) == 10
+
+    def test_list_width_follows_served_sizes(self):
+        w = WidthEstimator(list_objects_per_seat=500, max_seats=10)
+        assert w.estimate("GET", "pods", True, False, 0, 0) == 1
+        w.note_list_size("pods", 3000)
+        assert w.estimate("GET", "pods", True, False, 0, 0) >= 4
+        # other resources unaffected
+        assert w.estimate("GET", "nodes", True, False, 0, 0) == 1
+
+    def test_undeclared_bulk_cannot_launder_width(self):
+        # a hostile tenant omitting X-Kubernetes-Request-Items on a
+        # collection POST is priced by the per-item byte floor: a
+        # ~200-tiny-item body (~20 KiB) costs what declaring honestly
+        # would, while a normal single-object create stays at 1 seat
+        w = WidthEstimator(items_per_seat=100, bulk_item_bytes=128,
+                           max_seats=10)
+        assert w.estimate("POST", "configmaps", False, False, 0,
+                          20 * 1024, is_collection_mutation=True) >= 2
+        assert w.estimate("POST", "pods", False, False, 0, 2048,
+                          is_collection_mutation=True) == 1
+        # named-object routes keep the coarse large-body fallback only
+        assert w.estimate("PUT", "pods", False, False, 0, 20 * 1024,
+                          is_collection_mutation=False) == 1
+
+    def test_watch_release_does_not_sample_exec_ewma(self):
+        # watch-init tickets release ~instantly at stream attach; those
+        # near-zero durations must not collapse avg_exec_s (and with it
+        # every 429's computed Retry-After) under a reconnect herd
+        fc = FlowController(default_config(10, 10))
+        lvl = fc.levels["workload"]
+        lvl.avg_exec_s = 0.5
+        t = fc.admit(user="alice", groups=("system:authenticated",),
+                     verb="GET", resource="pods", namespace="",
+                     is_watch=True, path="/api/v1/pods?watch=1")
+        assert t.exec_sample is False
+        t.release()
+        assert lvl.avg_exec_s == 0.5          # untouched
+        t2 = fc.admit(user="alice", groups=("system:authenticated",),
+                      verb="GET", resource="pods", namespace="",
+                      path="/api/v1/pods")
+        t2.release()
+        assert lvl.avg_exec_s != 0.5          # normal requests sample
+
+    def test_watch_init_width(self):
+        w = WidthEstimator(watch_init_seats=2)
+        assert w.estimate("GET", "pods", False, True, 0, 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# the server admission path
+
+
+class TestServerAPF:
+    def _saturate(self, store, server, n=2, hold_s=2.0):
+        """Jam the best-effort level with slow anonymous list GETs."""
+        hold = threading.Event()
+        orig = store.list_objects_with_rv
+
+        def slow_list(kind, ns=None):
+            hold.wait(hold_s)
+            return orig(kind, ns)
+
+        store.list_objects_with_rv = slow_list
+        jammers = []
+        host, port = server.url.replace("http://", "").split(":")
+        for _ in range(n):
+            c = http.client.HTTPConnection(host, int(port), timeout=15)
+            c.request("GET", "/api/v1/pods")
+            jammers.append(c)
+        deadline = time.monotonic() + 5
+        level = server.flowcontrol.levels["best-effort"]
+        while level.executing_seats < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return hold, jammers, orig
+
+    def test_apf_429_computed_retry_after_and_pf_headers(self):
+        store, server = _serve(flow_control=_tiny_config(
+            queue_wait_s=0.15))
+        orig = store.list_objects_with_rv
+        try:
+            hold, jammers, orig = self._saturate(store, server)
+            # seats gone AND the single queue (length 2) fills: the
+            # next requests must come back 429 with the computed hint
+            extra = []
+            host, port = server.url.replace("http://", "").split(":")
+            for _ in range(3):
+                c = http.client.HTTPConnection(host, int(port),
+                                               timeout=15)
+                c.request("GET", "/api/v1/pods")
+                extra.append(c)
+            statuses = []
+            got_429 = None
+            for c in extra:
+                resp = c.getresponse()
+                statuses.append(resp.status)
+                if resp.status == 429 and got_429 is None:
+                    got_429 = (dict(resp.headers),
+                               json.loads(resp.read()))
+                else:
+                    resp.read()
+            assert 429 in statuses
+            headers, body = got_429
+            assert body["reason"] == "TooManyRequests"
+            assert headers.get("X-Kubernetes-PF-PriorityLevel") \
+                == "best-effort"
+            assert headers.get("X-Kubernetes-PF-FlowSchema")
+            retry_after = headers.get("Retry-After", "")
+            assert re.fullmatch(r"\d+(\.\d+)?", retry_after)
+            assert 0.05 <= float(retry_after) <= 13.0
+            hold.set()
+            for c in jammers + extra:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        finally:
+            store.list_objects_with_rv = orig
+            server.shutdown_server()
+
+    def test_exemption_envelope_at_full_saturation(self):
+        """/healthz /livez /readyz /metrics /metrics/resources and the
+        debug routes must NEVER be queued, rejected, or charged seats —
+        even with every seat occupied and the queues full (the 'flow
+        control must never fail a liveness probe' promise, tested)."""
+        store, server = _serve(flow_control=_tiny_config(
+            queue_wait_s=2.0))
+        orig = store.list_objects_with_rv
+        try:
+            hold, jammers, orig = self._saturate(store, server)
+            before = {
+                name: lv.snapshot()["dispatched_total"]
+                for name, lv in server.flowcontrol.levels.items()
+                if lv is not None
+            }
+            for path in ("/healthz", "/livez", "/readyz", "/metrics",
+                         "/metrics/resources", "/debug/faults",
+                         "/debug/apf"):
+                t0 = time.monotonic()
+                code, headers, raw = _http(server.url + path)
+                elapsed = time.monotonic() - t0
+                assert code == 200, (path, code, raw[:200])
+                assert elapsed < 1.0, (path, elapsed)
+            # /debug/trace: 200 when tracing is live, 404 when the
+            # tracer is disabled — NEVER 429, never queued
+            code, _h, _raw = _http(server.url + "/debug/trace")
+            assert code in (200, 404)
+            after = {
+                name: lv.snapshot()["dispatched_total"]
+                for name, lv in server.flowcontrol.levels.items()
+                if lv is not None
+            }
+            # no exempt probe consumed a seat or a dispatch
+            assert after == before
+            hold.set()
+            for c in jammers:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        finally:
+            store.list_objects_with_rv = orig
+            server.shutdown_server()
+
+    def test_client_records_pf_level_and_breaker_stays_closed(self):
+        """Satellite: the client attributes APF 429s to the rejecting
+        priority level in client_retries_total{reason=apf_<level>} and
+        the CircuitBreaker does NOT count them as fabric failures —
+        overload is not outage."""
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        store, server = _serve(flow_control=_tiny_config(
+            queue_wait_s=0.1))
+        orig = store.list_objects_with_rv
+        fm = fabric_metrics()
+        before = fm.client_retries_total.get("GET", "apf_best-effort")
+        try:
+            hold, jammers, orig = self._saturate(store, server)
+            client = RestClusterClient(
+                server.url, max_retries=2, retry_after_cap=0.05,
+                breaker_threshold=1, binary=False)
+            for _ in range(3):
+                code, _ = client._request("GET", "/api/v1/pods")
+            hold.set()
+            assert fm.client_retries_total.get(
+                "GET", "apf_best-effort") > before
+            # a breaker with threshold 1 would be open after ONE
+            # counted failure: APF pushback must not have counted
+            assert not client.breaker.is_open
+            for c in jammers:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        finally:
+            store.list_objects_with_rv = orig
+            server.shutdown_server()
+
+    def test_bulk_verbs_consume_proportional_seats(self):
+        """Per-object rate equivalence, server half: a 500-pod bulk
+        create declared via X-Kubernetes-Request-Items reads as ~5
+        seats, not 1 — batching cannot launder concurrency."""
+        store, server = _serve()
+        try:
+            client = RestClusterClient(server.url)
+            level = server.flowcontrol.levels["best-effort"]
+            before = level.snapshot()
+            pods = [MakePod().name(f"b{i}").uid(f"u{i}").obj()
+                    for i in range(500)]
+            code, resp = client._request(
+                "POST", "/api/v1/namespaces/default/pods",
+                {"kind": "PodList", "items": pods}, charge=500)
+            assert code == 201 and resp["created"] == 500
+            after = level.snapshot()
+            seats = after["seats_dispatched_total"] \
+                - before["seats_dispatched_total"]
+            requests = after["dispatched_total"] \
+                - before["dispatched_total"]
+            assert requests == 1
+            assert seats == 5
+        finally:
+            server.shutdown_server()
+
+    def test_watch_init_seats_release_after_attach(self):
+        """Watches charge watch-init seats for the attach/replay burst
+        only; a long-lived stream must not hold seats."""
+        store, server = _serve()
+        try:
+            import urllib.request
+
+            done = threading.Event()
+
+            def watcher():
+                req = urllib.request.Request(
+                    server.url + "/api/v1/pods?watch=1")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    done.set()
+                    try:
+                        resp.read(1)
+                    except Exception:  # noqa: BLE001 — server shutdown
+                        pass
+
+            t = threading.Thread(target=watcher, daemon=True)
+            t.start()
+            assert done.wait(5.0)
+            time.sleep(0.2)
+            snap = server.flowcontrol.levels["best-effort"].snapshot()
+            assert snap["executing_seats"] == 0
+            assert snap["seats_dispatched_total"] >= 2   # init width
+        finally:
+            server.shutdown_server()
+
+    def test_legacy_lane_retry_after_is_computed(self):
+        """Satellite: the legacy max-in-flight path no longer answers a
+        hard-coded `Retry-After: 1` — it reports the lane's expected
+        drain time."""
+        store, server = _serve(max_readonly_inflight=1,
+                               max_mutating_inflight=10,
+                               flow_control=None)
+        orig = store.list_objects_with_rv
+        try:
+            hold = threading.Event()
+
+            def slow_list(kind, ns=None):
+                hold.wait(2.0)
+                return orig(kind, ns)
+
+            store.list_objects_with_rv = slow_list
+            host, port = server.url.replace("http://", "").split(":")
+            jammer = http.client.HTTPConnection(host, int(port))
+            jammer.request("GET", "/api/v1/pods")
+            time.sleep(0.2)
+            code, headers, raw = _http(server.url + "/api/v1/pods")
+            assert code == 429
+            retry_after = headers.get("Retry-After", "")
+            assert re.fullmatch(r"\d+(\.\d+)?", retry_after)
+            assert 0.05 <= float(retry_after) <= 13.0
+            hold.set()
+            jammer.getresponse().read()
+            jammer.close()
+        finally:
+            store.list_objects_with_rv = orig
+            server.shutdown_server()
+
+    def test_debug_apf_snapshot_shape(self):
+        store, server = _serve()
+        try:
+            client = RestClusterClient(server.url)
+            client.list_pods()
+            code, snap = client._request("GET", "/debug/apf")
+            assert code == 200
+            assert snap["total_capacity"] > 0
+            assert set(snap["levels"]) == {"system", "workload",
+                                           "best-effort"}
+            lv = snap["levels"]["best-effort"]
+            assert lv["dispatched_total"] >= 1
+            assert "queue_depths" in lv and "flows" in lv
+            assert [s["name"] for s in snap["schemas"]][0] == "exempt"
+        finally:
+            server.shutdown_server()
+
+
+# ---------------------------------------------------------------------------
+# differential guard: APF must be free when uncontended
+
+
+class TestDifferentialGuard:
+    def _drive(self, server, n: int) -> float:
+        """n serial GET+POST pairs over one keep-alive connection;
+        returns elapsed seconds."""
+        host, port = server.url.replace("http://", "").split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=15)
+        body = json.dumps({
+            "kind": "ConfigMap",
+            "metadata": {"name": "g", "namespace": "default"}}).encode()
+        t0 = time.monotonic()
+        for i in range(n):
+            conn.request("GET", "/api/v1/pods")
+            conn.getresponse().read()
+            conn.request("POST", "/api/v1/namespaces/default/configmaps",
+                         body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+        elapsed = time.monotonic() - t0
+        conn.close()
+        return elapsed
+
+    def test_single_tenant_throughput_within_noise_of_legacy(self):
+        """With one tenant and no contention, the APF admission path
+        must cost the same as the legacy lanes (generous 1.6x bound:
+        this guards against a blocking/lock bug on the hot path, not
+        against microseconds)."""
+        _store_a, apf_server = _serve()
+        _store_l, legacy_server = _serve(flow_control=None)
+        try:
+            # warmup both (connection setup, code paths)
+            self._drive(apf_server, 20)
+            self._drive(legacy_server, 20)
+            apf_t = min(self._drive(apf_server, 150) for _ in range(2))
+            legacy_t = min(self._drive(legacy_server, 150)
+                           for _ in range(2))
+            assert apf_t < legacy_t * 1.6 + 0.2, (
+                f"APF path {apf_t:.3f}s vs legacy {legacy_t:.3f}s")
+        finally:
+            apf_server.shutdown_server()
+            legacy_server.shutdown_server()

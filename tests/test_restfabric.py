@@ -167,9 +167,12 @@ class TestBulkVerbs:
 class TestMaxInFlight:
     def test_flooded_readonly_lane_answers_429_and_binds_progress(self):
         """VERDICT next #7 done-condition: flood GETs while a scheduler
-        binds; binds (the mutating lane) still progress."""
+        binds; binds (the mutating lane) still progress. Runs the
+        LEGACY lane path (flow_control=None) — the APF default replaces
+        these semantics and has its own suite in test_flowcontrol.py."""
         store, server = _serve(max_readonly_inflight=2,
-                               max_mutating_inflight=50)
+                               max_mutating_inflight=50,
+                               flow_control=None)
         try:
             store.add_node(MakeNode().name("n1").obj())
             store.create_pod(MakePod().name("p1").uid("u1").obj())
@@ -213,7 +216,8 @@ class TestMaxInFlight:
 
     def test_watches_are_exempt_from_the_readonly_lane(self):
         store, server = _serve(max_readonly_inflight=1,
-                               max_mutating_inflight=10)
+                               max_mutating_inflight=10,
+                               flow_control=None)
         try:
             got = []
             done = threading.Event()

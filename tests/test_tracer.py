@@ -210,9 +210,13 @@ class TestAdminEndpoints:
         from kubernetes_tpu.apiserver.rest import APIServer
         from kubernetes_tpu.apiserver.store import ClusterStore
 
+        # legacy lane path: exhausting the semaphores directly is the
+        # cheapest way to prove lane exemption (APF-path exemption has
+        # its own saturation test in test_flowcontrol.py)
         server = APIServer(store=ClusterStore(),
                            max_readonly_inflight=1,
-                           max_mutating_inflight=1).start()
+                           max_mutating_inflight=1,
+                           flow_control=None).start()
         try:
             url = server.url
             # exhaust both lanes: ordinary traffic now answers 429 ...

@@ -18,6 +18,12 @@ Three suites:
   cluster autoscaler (cluster starts at 20% of needed capacity, the
   what-if solver buys the rest); each cell reports time-to-capacity
   p99 across repeats and fails on any unbound pod.
+- ``overload`` — multi-tenant abuse against API Priority & Fairness:
+  aggressor tenants mount list storms / watch reconnect herds /
+  bulk-verb abuse / full seat saturation (seeded read-latency via the
+  FaultGate makes queues form) while a victim tenant's pods must all
+  bind; invariants: zero lost pods, exempt routes always served, no
+  starved flow, per-object rate equivalence for bulk verbs.
 
 Usage::
 
@@ -25,6 +31,9 @@ Usage::
     python tools/chaos_matrix.py --suite nodes --churn mixed,killer
     python tools/chaos_matrix.py --suite rest --seeds 11,23 -v
     python tools/chaos_matrix.py --suite scale --bursts 60,120 -v
+    python tools/chaos_matrix.py --suite overload -v
+    python tools/chaos_matrix.py --suite overload \
+        --overload liststorm,saturation --seeds 11,23
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -66,7 +75,8 @@ def main() -> int:
     parser = argparse.ArgumentParser(
         description="seeded chaos matrices (wire faults + node churn)")
     parser.add_argument("--suite", default="both",
-                        choices=("rest", "nodes", "scale", "both", "all"))
+                        choices=("rest", "nodes", "scale", "overload",
+                                 "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -75,6 +85,9 @@ def main() -> int:
     parser.add_argument("--churn", default="mixed",
                         help="nodes-suite churn profiles "
                              "(mixed,killer,flappy,gentle)")
+    parser.add_argument("--overload", default="mixed",
+                        help="overload-suite abuse shapes (liststorm,"
+                             "watchherd,bulkabuse,saturation,mixed)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -94,6 +107,7 @@ def main() -> int:
 
     from kubernetes_tpu.harness.chaos_rest import FAULT_PROFILES
     from kubernetes_tpu.harness.chaos_nodes import CHURN_PROFILES
+    from kubernetes_tpu.harness.chaos_overload import OVERLOAD_PROFILES
 
     for p in args.profiles.split(","):
         if p and p not in FAULT_PROFILES:
@@ -103,6 +117,10 @@ def main() -> int:
         if p and p not in CHURN_PROFILES:
             parser.error(f"unknown churn profile {p!r} "
                          f"(have: {', '.join(sorted(CHURN_PROFILES))})")
+    for p in args.overload.split(","):
+        if p and p not in OVERLOAD_PROFILES:
+            parser.error(f"unknown overload profile {p!r} "
+                         f"(have: {', '.join(sorted(OVERLOAD_PROFILES))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -117,6 +135,14 @@ def main() -> int:
         _run_suite(args, progress, rows, "nodes", run_chaos_nodes,
                    "churn_profile",
                    [p for p in args.churn.split(",") if p])
+    if args.suite in ("overload", "all"):
+        from kubernetes_tpu.harness.chaos_overload import (
+            run_chaos_overload,
+        )
+
+        _run_suite(args, progress, rows, "overload", run_chaos_overload,
+                   "overload_profile",
+                   [p for p in args.overload.split(",") if p])
     if args.suite in ("scale", "all"):
         from kubernetes_tpu.harness.elastic import run_scale_cell
 
