@@ -138,47 +138,42 @@ def _diagnose(sched, bs) -> None:
     row's p99 blows its budget, the root cause — a slow batch absorbing
     a rebuild/recompile, tunnel stall, chunk collapse — must be readable
     from the run's own log, not re-derived by a fresh profiling run).
-    Phase breakdowns come from the flight-recorder tracer (the ONE
-    instrumentation layer feeding logs, /metrics, Perfetto dumps and
-    this line), not hand-rolled counters."""
+    Phase breakdowns come from the flight-recorder tracer and the
+    device profiler (the instrumentation layers feeding logs, /metrics,
+    Perfetto dumps, the per-cycle telemetry stream and this line), and
+    every segment is RENDERED by harness/diagfmt.py — one writer, one
+    parser (tools/perf_report.py), no ad-hoc regexes."""
     try:
+        from kubernetes_tpu.harness import diagfmt
         from kubernetes_tpu.observability import get_tracer
 
         tracer = get_tracer()
         segs = []
         if tracer.enabled:
-            stats = tracer.phase_stats()
-            for phase in sorted(stats):
-                s = stats[phase]
-                segs.append(f"{phase}={s['total_s']:.2f}s/{s['count']}"
-                            f"~p99 {s['p99_s'] * 1000:.0f}ms")
+            segs.extend(diagfmt.format_phases(tracer.phase_stats()))
         else:
             # tracer off (e.g. the A/B's off arm): the solver-segment
             # histogram still holds the breakdown — a blown p99 must be
             # explainable from this run's log either way
             segs.append("tracer=off")
-            for key, (_c, total, count) in sorted(
-                    sched.metrics.batch_solve_duration._series.items()):
-                segs.append(f"{key[0]}={total:.2f}s/{count}")
-        e2e = sched.metrics.e2e_scheduling_duration
-        series = e2e._series.get(("scheduled",))
-        buckets = ""
-        if series is not None:
-            counts = series[0]
-            edges = list(e2e.buckets) + ["inf"]
-            nonzero = [
-                f"<={edges[i]}:{c}" for i, c in enumerate(counts) if c
-            ]
-            buckets = " e2e_buckets[" + " ".join(nonzero) + "]"
+            segs.extend(diagfmt.format_hist_segments(
+                sched.metrics.batch_solve_duration))
+        # e2e p99 + legacy bucket text, both rendered from the SAME
+        # metrics-registry histogram /metrics exposes (interpolated
+        # quantile; the diag line and the scrape cannot disagree)
+        buckets = diagfmt.format_e2e(sched.metrics.e2e_scheduling_duration)
         sess = ""
+        devprof_seg = ""
         if bs is not None:
-            s = bs.session
-            sess = (f" session[hits={s.incremental_hits} "
-                    f"rebuilds={s.rebuilds} "
-                    f"state_only={s.state_only_rebuilds}] "
-                    f"chunk={bs._chunk} "
-                    f"max_cycle={bs.max_cycle_s:.2f}s "
-                    f"pad_warms={bs.pad_warms}")
+            sess = " " + diagfmt.format_session(
+                bs.session, bs._chunk, bs.max_cycle_s, bs.pad_warms)
+            from kubernetes_tpu.observability.devprof import get_devprof
+
+            dp = get_devprof()
+            if dp.enabled:
+                summary = dp.summary()
+                if summary["cycles"] or summary["warm_compiles"]:
+                    devprof_seg = " " + diagfmt.format_devprof(summary)
         # node-churn segment, only when churn actually happened this
         # process (chaos_nodes harness / a churn-enabled run): the
         # eviction/stale-reject/rescue numbers explain a degraded row
@@ -272,8 +267,9 @@ def _diagnose(sched, bs) -> None:
         for _, lbl, _v in apfm.peak_executing_seats.collect():
             apfm.peak_executing_seats.set(0.0, *lbl)
         apfm.request_queue_wait_seconds.clear()
-        log(f"    diag: {' '.join(segs)}{sess}{churn}{autoscale}{apf}"
-            f"{buckets}")
+        log(diagfmt.format_diag(
+            segs + [sess.strip(), devprof_seg.strip(), churn.strip(),
+                    autoscale.strip(), apf.strip()] + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -322,6 +318,12 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
     }
     if repeat > 1:
         row["runs"] = [round(b.pods_per_second, 1) for b in samples]
+    if median.telemetry:
+        # the devprof per-cycle summary rides every row into the
+        # driver-captured artifact: compile count, device-wait share,
+        # pad waste, and the slowest cycle's phase attribution are
+        # readable from the committed JSON without a re-run
+        row["telemetry"] = median.telemetry
     if key == "headline":
         # provenance for the trace-overhead tracking (--config traceab):
         # which sampling config this headline number was measured under
@@ -402,6 +404,8 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
     }
     if repeat > 1:
         row["runs"] = [round(b.pods_per_second, 1) for b in samples]
+    if median.telemetry:
+        row["telemetry"] = median.telemetry
     return row
 
 
@@ -428,65 +432,116 @@ def run_qos_one(nodes: int, measure_pods: int, serial_rate: float,
     return row
 
 
-def run_trace_ab(nodes: int, measure_pods: int, repeat: int = 1) -> dict:
-    """Tracer-on vs tracer-off headline A/B: the observability layer's
-    steady-state overhead, tracked as a BENCH_* row across PRs (the
-    <3% budget is an acceptance bar, so it must be measured, not
-    assumed). Tracer-on uses the DEFAULT sampling config. Modes are
-    INTERLEAVED per round behind one unmeasured warmup run — a blocked
-    on-then-off order would hand all the process warm-state (JIT cache,
-    allocator) to the second mode and misattribute it as tracer cost."""
+def _layer_ab(tag: str, layer: str, set_enabled,
+              nodes: int, measure_pods: int, repeat: int) -> dict:
+    """Shared on/off A/B harness for an instrumentation layer's
+    steady-state overhead (tracer, devprof — both tracked rows judge
+    the same methodology, so it lives in ONE place). One unmeasured
+    warmup run absorbs compile/allocator warm-state, then the arms
+    INTERLEAVE with alternating pair order per round — a blocked
+    on-then-off order would hand all the process warm-state (JIT
+    cache, allocator) to the second mode and misattribute it as layer
+    cost. Returns per-arm medians, the overhead %, and the max
+    within-arm run-to-run spread (the noise band the overhead is
+    judged against)."""
     import gc
-
-    from kubernetes_tpu.observability import get_tracer
 
     def one_run(mode: str):
         ops = make_workload("SchedulingBasic", nodes=nodes,
                             init_pods=0, measure_pods=measure_pods)
-        res = run_workload(f"SchedulingBasic/trace-{mode}", ops,
+        res = run_workload(f"SchedulingBasic/{tag}-{mode}", ops,
                            use_batch=True,
                            max_batch=min(measure_pods, 4096),
                            wait_timeout=1200, progress=log)
         gc.collect()
         return res.pods_per_second
 
+    samples = {"on": [], "off": []}
+    one_run("warm")   # unmeasured: absorbs compile/allocator warmup
+    for r in range(repeat):
+        for mode in (("off", "on") if r % 2 == 0 else ("on", "off")):
+            set_enabled(mode == "on")
+            samples[mode].append(one_run(mode))
+    rates = {}
+    noise_pct = 0.0
+    for mode, vals in samples.items():
+        vals.sort()
+        rates[mode] = vals[len(vals) // 2]
+        if rates[mode] > 0:
+            noise_pct = max(
+                noise_pct, 100.0 * (vals[-1] - vals[0]) / rates[mode])
+        log(f"[{tag}-ab] {layer} {mode}: {rates[mode]:.1f} pods/s "
+            f"(runs {[round(v, 1) for v in vals]})")
+    overhead_pct = 0.0
+    if rates["off"] > 0:
+        overhead_pct = 100.0 * (1.0 - rates["on"] / rates["off"])
+    return {"rates": rates, "overhead_pct": overhead_pct,
+            "noise_pct": noise_pct}
+
+
+def run_trace_ab(nodes: int, measure_pods: int, repeat: int = 1) -> dict:
+    """Tracer-on vs tracer-off headline A/B: the observability layer's
+    steady-state overhead, tracked as a BENCH_* row across PRs (the
+    <3% budget is an acceptance bar, so it must be measured, not
+    assumed). Tracer-on uses the DEFAULT sampling config."""
+    from kubernetes_tpu.observability import get_tracer
     from kubernetes_tpu.observability.tracer import DEFAULT_SAMPLE_RATE
 
     tracer = get_tracer()
     prev_enabled, prev_rate = tracer.enabled, tracer.sample_rate
-    samples = {"on": [], "off": []}
     try:
         # the tracked row must measure the DEFAULT sampling config, not
         # whatever KTPU_TRACE_SAMPLE happens to be live — otherwise the
         # cross-PR overhead trend compares incomparable configurations
         tracer.configure(sample_rate=DEFAULT_SAMPLE_RATE)
-        one_run("warm")   # unmeasured: absorbs compile/allocator warmup
-        for r in range(repeat):
-            # alternate the pair order per round: residual warm-state
-            # drift across the run would otherwise always favor the
-            # second arm and bias the tracked overhead number
-            for mode in (("off", "on") if r % 2 == 0 else ("on", "off")):
-                tracer.configure(enabled=(mode == "on"))
-                samples[mode].append(one_run(mode))
+        ab = _layer_ab("trace", "tracer",
+                       lambda on: tracer.configure(enabled=on),
+                       nodes, measure_pods, repeat)
     finally:
         tracer.configure(enabled=prev_enabled, sample_rate=prev_rate)
-    rates = {}
-    for mode, vals in samples.items():
-        vals.sort()
-        rates[mode] = vals[len(vals) // 2]
-        log(f"[trace-ab] tracer {mode}: {rates[mode]:.1f} pods/s "
-            f"(runs {[round(v, 1) for v in vals]})")
-    overhead_pct = 0.0
-    if rates["off"] > 0:
-        overhead_pct = 100.0 * (1.0 - rates["on"] / rates["off"])
     return {
         "metric": f"trace_overhead_pct[SchedulingBasic {nodes}nodes/"
                   f"{measure_pods}pods, default sampling "
                   f"1/{round(1 / DEFAULT_SAMPLE_RATE)}]",
-        "value": round(overhead_pct, 2),
+        "value": round(ab["overhead_pct"], 2),
         "unit": "%",
-        "tracer_on_pods_per_sec": round(rates["on"], 1),
-        "tracer_off_pods_per_sec": round(rates["off"], 1),
+        "tracer_on_pods_per_sec": round(ab["rates"]["on"], 1),
+        "tracer_off_pods_per_sec": round(ab["rates"]["off"], 1),
+    }
+
+
+def run_profile_ab(nodes: int, measure_pods: int, repeat: int = 1) -> dict:
+    """Devprof-on vs devprof-off headline A/B (``--config profab``):
+    the hot-path telemetry layer's steady-state overhead, measured the
+    same way the tracer A/B measures its layer (the ≈0 bar is an
+    acceptance criterion, so it is measured, not assumed). The row
+    reports the overhead next to the run-to-run noise band so "within
+    noise" is a number, not a claim."""
+    from kubernetes_tpu.observability.devprof import get_devprof
+
+    dp = get_devprof()
+    prev_enabled = dp.enabled
+    try:
+        ab = _layer_ab("prof", "devprof",
+                       lambda on: dp.configure(enabled=on),
+                       nodes, measure_pods, repeat)
+    finally:
+        dp.configure(enabled=prev_enabled)
+    return {
+        "metric": f"devprof_overhead_pct[SchedulingBasic {nodes}nodes/"
+                  f"{measure_pods}pods, telemetry on/off A/B]",
+        "value": round(ab["overhead_pct"], 2),
+        "unit": "%",
+        "devprof_on_pods_per_sec": round(ab["rates"]["on"], 1),
+        "devprof_off_pods_per_sec": round(ab["rates"]["off"], 1),
+        # run-to-run spread within the arms: the bar the overhead is
+        # judged against (overhead within the band = within noise);
+        # null with a single run per arm — one sample has no spread to
+        # judge against, and a 0% band would flag pure noise
+        "noise_band_pct": round(ab["noise_pct"], 2),
+        "within_noise": (abs(ab["overhead_pct"])
+                         <= max(ab["noise_pct"], 1.0))
+        if repeat > 1 else None,
     }
 
 
@@ -507,7 +562,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
-                    + ["rest", "qos", "traceab", "autoscale"])
+                    + ["rest", "qos", "traceab", "profab", "autoscale"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -534,6 +589,13 @@ def main() -> None:
     if args.config == "traceab":
         nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
         print(json.dumps(run_trace_ab(
+            nodes, measure_pods, repeat=1 if args.quick else 3)),
+            flush=True)
+        return
+
+    if args.config == "profab":
+        nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
+        print(json.dumps(run_profile_ab(
             nodes, measure_pods, repeat=1 if args.quick else 3)),
             flush=True)
         return

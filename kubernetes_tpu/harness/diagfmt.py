@@ -1,0 +1,196 @@
+"""Shared formatter + parser for the bench ``diag:`` line.
+
+The ``diag:`` line is the per-row solver postmortem every bench run
+leaves in its stderr tail (and so in the driver-committed ``BENCH_r*``
+artifacts): phase totals, session counters, device-profiler summary,
+and the e2e latency histogram. Before this module, bench.py built the
+line from hand-rolled f-strings and every consumer (perf trend tools,
+tests, humans grepping artifacts) re-derived its own ad-hoc regexes —
+which silently diverged the moment a segment changed shape. Now:
+
+- every segment is rendered HERE (``format_*``), so the line has one
+  writer;
+- ``parse_diag`` round-trips the current format AND the legacy one in
+  the committed r01–r05 artifacts (``tools/perf_report.py`` reads both
+  to attribute a regression to a phase);
+- the e2e bucket text is rendered from the metrics-registry histogram's
+  public accessors (``bucket_counts`` + interpolated ``quantile``,
+  ``metrics/registry.py``) — the SAME series ``/metrics`` exposes, so
+  the diag line and the scrape can never disagree about e2e latency.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# formatters (one writer for every diag segment)
+
+
+def format_phases(stats: Dict[str, dict]) -> List[str]:
+    """Tracer phase stats → ``solve.commit=4.32s/8~p99 540ms`` segments
+    (``stats`` is ``Tracer.phase_stats()``)."""
+    return [
+        f"{phase}={s['total_s']:.2f}s/{s['count']}"
+        f"~p99 {s['p99_s'] * 1000:.0f}ms"
+        for phase, s in sorted(stats.items())
+    ]
+
+
+def format_hist_segments(hist) -> List[str]:
+    """Fallback phase segments from the solver-segment histogram when
+    the tracer is off (the A/B's off arm): ``device=1.34s/14``."""
+    return [
+        f"{labels[0]}={total_sum:.2f}s/{count}"
+        for _name, labels, total_sum, count in sorted(hist.collect())
+    ]
+
+
+def format_session(session, chunk: int, max_cycle_s: float,
+                   pad_warms: int) -> str:
+    """The solver-session counters segment (mirror validity + tuner)."""
+    return (f"session[hits={session.incremental_hits} "
+            f"rebuilds={session.rebuilds} "
+            f"state_only={session.state_only_rebuilds}] "
+            f"chunk={chunk} "
+            f"max_cycle={max_cycle_s:.2f}s "
+            f"pad_warms={pad_warms}")
+
+
+def format_devprof(summary: dict) -> str:
+    """Device-profiler segment from ``DevProfiler.summary()``: compile
+    ledger, dispatch-vs-block split, pad waste, transfer volume, and
+    the slowest cycle's dominant phase."""
+    parts = [
+        f"cycles={summary['cycles']}",
+        f"compiles={summary['compiles']}",
+        f"unexpected={summary['unexpected_compiles']}",
+        f"warm={summary['warm_compiles']}",
+        f"wait_share={summary['device_wait_share']:.2f}",
+        f"pad_waste={summary['pad_waste_pct']:.1f}%",
+        f"h2d_mb={summary['h2d_bytes'] / 1e6:.1f}",
+        f"d2h_mb={summary['d2h_bytes'] / 1e6:.1f}",
+    ]
+    mc = summary.get("max_cycle")
+    if mc:
+        parts.append(f"max_cycle_phase={max_cycle_phase(mc)}")
+    parts.append(f"detector={summary['compile_detector']}")
+    return "devprof[" + " ".join(parts) + "]"
+
+
+def max_cycle_phase(max_cycle: dict) -> str:
+    """Which phase made the slowest cycle slow — the first question
+    every blown p99 asks. A cycle that compiled answers ``compile``
+    regardless of the split (the compile IS the story)."""
+    if max_cycle.get("compiles"):
+        return "compile"
+    phases = {k[:-2]: max_cycle.get(k, 0.0)
+              for k in ("encode_s", "dispatch_s", "block_s")}
+    return max(phases, key=phases.get) if any(phases.values()) else "none"
+
+
+def format_e2e(hist, label: str = "scheduled") -> List[str]:
+    """E2e latency segments rendered from the metrics-registry
+    histogram itself: interpolated p99 (``quantile``) plus the legacy
+    bucket text (``bucket_counts``) — one series, two renderings."""
+    counts = hist.bucket_counts(label)
+    if not counts or not any(counts):
+        return []
+    p99 = hist.quantile(0.99, label)
+    edges = list(hist.buckets) + ["inf"]
+    nonzero = [f"<={edges[i]}:{c}" for i, c in enumerate(counts) if c]
+    return [f"e2e[p99={p99 * 1000:.0f}ms]",
+            "e2e_buckets[" + " ".join(nonzero) + "]"]
+
+
+def format_diag(segments: List[str]) -> str:
+    """The full line (bench.py prints this to stderr, indented so the
+    driver tail keeps it visually attached to its row)."""
+    return "    diag: " + " ".join(s for s in segments if s)
+
+
+# ---------------------------------------------------------------------------
+# parser (handles the current format AND the committed legacy artifacts)
+
+_BRACKET_RE = re.compile(r"(\w+)\[([^\]]*)\]")
+_PHASE_RE = re.compile(
+    r"([\w.]+)=([0-9.]+)s/(\d+)(?:~p99\s+([0-9.]+)ms)?")
+_SCALAR_RE = re.compile(r"([\w.]+)=([^\s\[\]]+)")
+_BUCKET_RE = re.compile(r"<=([0-9.a-z]+):(\d+)")
+
+
+def _coerce(value: str):
+    """Numeric coercion with unit stripping (ms/s/%/plain)."""
+    for suffix, scale in (("ms", 1.0), ("s", 1.0), ("%", 1.0), ("", 1.0)):
+        if suffix and not value.endswith(suffix):
+            continue
+        body = value[: len(value) - len(suffix)] if suffix else value
+        try:
+            num = float(body) * scale
+            return int(num) if num.is_integer() and "." not in body \
+                else num
+        except ValueError:
+            continue
+    return value
+
+
+def _parse_kv(body: str) -> dict:
+    return {k: _coerce(v) for k, v in _SCALAR_RE.findall(body)}
+
+
+def parse_diag(line: str) -> Optional[dict]:
+    """Parse one ``diag:`` line into a structured dict, or None when
+    the line is not a diag line. Keys (all optional): ``phases``
+    (name → total_s/count/p99_ms), ``session``, ``chunk``,
+    ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
+    ``autoscaler``, ``apf``, ``e2e_p99_ms``, ``e2e_buckets``
+    (upper-edge str → count). Handles both the current diagfmt output
+    and the legacy hand-rolled format in committed BENCH_r* tails."""
+    marker = "diag:"
+    idx = line.find(marker)
+    if idx < 0:
+        return None
+    body = line[idx + len(marker):].strip()
+    out: dict = {}
+    # bracket segments first (their contents must not leak into the
+    # flat phase/scalar scan below)
+    for name, inner in _BRACKET_RE.findall(body):
+        if name == "e2e_buckets":
+            out["e2e_buckets"] = {
+                edge: int(c) for edge, c in _BUCKET_RE.findall(inner)
+            }
+        elif name == "e2e":
+            kv = _parse_kv(inner)
+            if "p99" in kv:
+                out["e2e_p99_ms"] = float(kv["p99"])
+        else:
+            out[name] = _parse_kv(inner)
+    flat = _BRACKET_RE.sub(" ", body)
+    phases: dict = {}
+    for name, total, count, p99 in _PHASE_RE.findall(flat):
+        phases[name] = {"total_s": float(total), "count": int(count)}
+        if p99:
+            phases[name]["p99_ms"] = float(p99)
+    if phases:
+        out["phases"] = phases
+    flat = _PHASE_RE.sub(" ", flat)
+    for key, value in _SCALAR_RE.findall(flat):
+        if key in ("chunk", "pad_warms"):
+            out[key] = int(float(value))
+        elif key == "max_cycle":
+            out["max_cycle_s"] = float(value.rstrip("s"))
+        elif key == "tracer":
+            out["tracer"] = value
+    return out or None
+
+
+def parse_diag_lines(text: str) -> List[dict]:
+    """Every diag line in a blob (e.g. a driver-captured stdout tail),
+    in order."""
+    out = []
+    for line in text.splitlines():
+        parsed = parse_diag(line)
+        if parsed is not None:
+            out.append(parsed)
+    return out

@@ -88,6 +88,10 @@ class BenchmarkResult:
     pods_per_second: float
     throughput: Dict[str, float]
     metrics: Dict[str, float] = field(default_factory=dict)
+    # devprof per-row summary (compile count, dispatch-vs-block split,
+    # pad waste, max-cycle attribution) — bench.py attaches this to the
+    # row JSON as the ``telemetry`` sub-object
+    telemetry: Dict[str, object] = field(default_factory=dict)
 
     def data_items(self) -> dict:
         """DataItems JSON shape (util.go:101-129)."""
@@ -131,12 +135,15 @@ def run_workload(
     ``result_hook(sched, bs)`` runs after the workload completes, before
     teardown — the scaling bench reads solver-segment histograms there."""
     from kubernetes_tpu.observability import get_tracer
+    from kubernetes_tpu.observability.devprof import get_devprof
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
     tune_for_throughput()
-    # fresh flight-recorder window per row: the result_hook's diag line
-    # reads phase stats from the ring, which must describe THIS workload
+    # fresh flight-recorder + devprof window per row: the result_hook's
+    # diag line and the row's ``telemetry`` sub-object read from rings
+    # that must describe THIS workload
     get_tracer().clear()
+    get_devprof().reset(workload=name)
     store = ClusterStore()
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
     # gang scheduling is first-class in this harness (BASELINE config #5):
@@ -284,6 +291,7 @@ def run_workload(
         "Perc90": e2e.quantile(0.90, "scheduled") * 1000,
         "Perc99": e2e.quantile(0.99, "scheduled") * 1000,
     }
+    dp = get_devprof()
     return BenchmarkResult(
         name=name,
         total_pods=created_pods,
@@ -292,6 +300,7 @@ def run_workload(
         pods_per_second=(measured_pods / duration) if duration > 0 else 0.0,
         throughput=collector.summary() if collector else {},
         metrics=metrics,
+        telemetry=dp.summary() if dp.enabled else {},
     )
 
 

@@ -302,7 +302,13 @@ def run_workload_rest(
     from kubernetes_tpu.utils.gctune import tune_for_throughput
 
     tune_for_throughput()
-    get_tracer().clear()   # per-row flight-recorder window (diag source)
+    # per-row flight-recorder + devprof windows (diag line + the row's
+    # ``telemetry`` sub-object; the scheduler — and so the solver —
+    # runs in THIS process, only the apiserver/creators are children)
+    get_tracer().clear()
+    from kubernetes_tpu.observability.devprof import get_devprof
+
+    get_devprof().reset(workload=f"{name}/rest")
     ctx = mp.get_context("spawn")
     wal_dir = tempfile.mkdtemp(prefix="ktpu-wal-") if wal else None
 
@@ -507,6 +513,7 @@ def run_workload_rest(
         raise RuntimeError(
             f"store truth disagrees: server bound "
             f"{server_counts['pods_bound']} < expected {expected_bound}")
+    dp = get_devprof()
     return BenchmarkResult(
         name=f"{name}/rest",
         total_pods=created_pods,
@@ -515,4 +522,5 @@ def run_workload_rest(
         pods_per_second=(measured / duration) if duration > 0 else 0.0,
         throughput=collector.summary() if collector else {},
         metrics=metrics,
+        telemetry=dp.summary() if dp.enabled else {},
     )

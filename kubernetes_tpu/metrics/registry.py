@@ -125,6 +125,16 @@ class Histogram(_Metric):
         with self._lock:
             self._series.clear()
 
+    def bucket_counts(self, *label_values: str) -> List[int]:
+        """Per-bucket observation counts for one series (len(buckets)+1,
+        last entry = +Inf overflow). The public face of the bucket table:
+        the bench ``diag:`` line's e2e_buckets text is rendered from
+        THIS accessor (harness/diagfmt.py) against the same series
+        /metrics exposes, so the two can never disagree."""
+        with self._lock:
+            series = self._series.get(tuple(label_values))
+            return list(series[0]) if series else []
+
     def count(self, *label_values: str) -> int:
         with self._lock:
             series = self._series.get(tuple(label_values))

@@ -1,0 +1,98 @@
+"""Solver/device profiling metrics (the /metrics face of
+``observability/devprof.py``): per-bucket XLA compile counts and wall
+time, the dispatch-vs-block split around the solver call, pad occupancy,
+and host↔device transfer volume.
+
+The reference exposes nothing like this (its scheduler has no device),
+but the posture mirrors ``scheduler_perf``'s per-op metrics collection:
+every quantity a perf claim rests on must be scrapeable from the live
+process, not re-derived by a fresh profiling run. Cycle ids recorded by
+devprof correlate these series with the flight-recorder tracer's
+``solve.*`` spans, so a slow cycle found in ``/debug/trace`` links to
+its compile/wait breakdown here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_tpu.metrics.registry import MetricsRegistry
+from kubernetes_tpu.metrics.fabric_metrics import (
+    _counter,
+    _gauge,
+    _histogram,
+)
+
+# device waits and dispatches are sub-second in steady state; the
+# default bucket ladder starts at 1ms and tops out at 50s, fine here
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+                    20.0, 40.0, 80.0)
+
+
+class SolverMetrics:
+    """Registered into the process default registry (legacyregistry
+    pattern); reuses already-registered series so devprof and any tests
+    constructing their own instance share state instead of clobbering."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            from kubernetes_tpu.metrics import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self.compiles_total = _counter(
+            registry, "solver_compiles_total",
+            "XLA compilations observed by the devprof compile listener, "
+            "by padded-shape bucket (cache hits do not count — this is "
+            "actual recompiles)",
+            ("bucket",),
+        )
+        self.compile_seconds = _histogram(
+            registry, "solver_compile_seconds",
+            "Wall seconds spent in XLA backend compilation per solve "
+            "cycle that compiled",
+            buckets=_COMPILE_BUCKETS,
+        )
+        self.device_wait_seconds = _histogram(
+            registry, "solver_device_wait_seconds",
+            "block_until_ready wait per solve cycle: host wall time "
+            "blocked on the device after dispatch (the streaming "
+            "scheduler's double-buffer budget)",
+        )
+        self.dispatch_seconds = _histogram(
+            registry, "solver_dispatch_seconds",
+            "Async XLA dispatch time per solve cycle (solver call "
+            "returning a lazy handle, before any block)",
+        )
+        self.pad_occupancy_ratio = _gauge(
+            registry, "solver_pad_occupancy_ratio",
+            "Real rows / padded rows of the last solve in each "
+            "padded-shape bucket (1.0 = no device time wasted on pad)",
+            ("bucket",),
+        )
+        self.transfer_bytes_total = _counter(
+            registry, "solver_transfer_bytes_total",
+            "Host-device transfer volume computed from the encoded "
+            "plane shapes/dtypes, by direction (h2d = pod stream + "
+            "static/state uploads, d2h = materialized assignments)",
+            ("direction",),
+        )
+        self.unexpected_compiles_total = _counter(
+            registry, "solver_unexpected_compiles_total",
+            "Compilations that landed inside a MEASURED solve cycle "
+            "(not warmup/pre-warm) — the forbidden case: thousands of "
+            "pods absorbed the compile into their e2e latency; each "
+            "increment also drops a flight-recorder dump",
+        )
+
+
+_default: Optional[SolverMetrics] = None
+
+
+def solver_metrics() -> SolverMetrics:
+    """Process-wide SolverMetrics bound to the default registry (the
+    fabric_metrics pattern)."""
+    global _default
+    if _default is None:
+        _default = SolverMetrics()
+    return _default

@@ -32,6 +32,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from kubernetes_tpu.observability import get_tracer
+from kubernetes_tpu.observability.devprof import get_devprof
 from kubernetes_tpu.ops.encode import BatchEncoder, EncodedCluster
 from kubernetes_tpu.ops.solver import (
     SolverParams,
@@ -42,6 +43,20 @@ from kubernetes_tpu.ops.solver import (
 )
 
 _logger = logging.getLogger(__name__)
+
+
+def _tree_nbytes(tree) -> int:
+    """Byte size of every array leaf in a backend's prepared static or
+    state pytree — the devprof host→device transfer accounting is
+    computed from the shapes/dtypes we actually ship, so it works
+    identically for numpy staging and committed device buffers."""
+    import jax
+
+    try:
+        return sum(int(getattr(leaf, "nbytes", 0))
+                   for leaf in jax.tree_util.tree_leaves(tree))
+    except Exception:  # noqa: BLE001 — accounting must never break solves
+        return 0
 
 
 class XlaBackend:
@@ -208,7 +223,7 @@ class SolverSession:
         self._profiling = False
 
     # ------------------------------------------------------------------
-    def warm_pad(self, pods: List, pad: int) -> bool:
+    def warm_pad(self, pods: List, pad: int) -> Optional[int]:
         """Compile the ``pad``-sized executable WITHOUT touching the
         state mirror: runs one solve against the resident static/state
         arrays and discards every output (jax arrays are immutable, so
@@ -216,25 +231,48 @@ class SolverSession:
         handle stays valid). The sidecar calls this between cycles when
         the latency tuner shrinks to a bucket that has never compiled —
         the compile must burn an un-measured moment, not a real batch's
-        e2e latency. Returns False when there is no resident mirror to
-        warm against (the next real solve is a rebuild, which compiles
-        its own pad anyway)."""
+        e2e latency. Returns the number of compile events devprof
+        MEASURED during the warm (0 = the executable was already cached
+        and no warm was actually needed — the sidecar's accounting is
+        measured, not assumed), or None when there is no resident mirror
+        to warm against (the next real solve is a rebuild, which
+        compiles its own pad anyway)."""
         if self._state is None or self._encoder is None or \
                 self._cluster is None:
-            return False
+            return None
+        dp = get_devprof()
+        rec = dp.begin_cycle(cycle=-1, pad=pad, real=len(pods),
+                             warming=True) if dp.enabled else None
         try:
             pb = self._encoder.encode_pods_only(pods, pad)
             if pb is None or pb.requests.shape[1] != \
                     self._cluster.allocatable.shape[1]:
-                return False
+                dp.abort(rec)
+                rec = None
+                return None
             ints, floats = pack_podin(pb)
+            dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
+            t0 = time.monotonic()
             handle, _discarded_state = self._active.solve_lazy(
                 self.params, self._static, self._state, ints, floats
             )
-            self._active.materialize(handle)   # block until compiled+run
-            return True
+            t_disp = time.monotonic()
+            out = self._active.materialize(handle)  # block: compile+run
+            dp.phase("dispatch", t_disp - t0)
+            dp.phase("block", time.monotonic() - t_disp)
+            dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
+            # measured compile count when the listener is live; the
+            # timing heuristic can only classify at cycle completion,
+            # so without a listener the legacy one-warm-per-call
+            # assumption stands in
+            return rec["compiles"] \
+                if rec is not None and dp.listener_active else 1
         except Exception:   # noqa: BLE001 — warming is advisory
-            return False
+            dp.abort(rec)
+            rec = None
+            return None
+        finally:
+            dp.end_cycle(rec)
 
     def invalidate(self) -> None:
         """Mark the device mirror diverged. Sticky until the next rebuild:
@@ -309,35 +347,94 @@ class SolverSession:
         seq_before = self.sched.cache.mutation_seq
         if self._state is not None and seq_before == self._last_seq \
                 and self._node_epoch == self.sched.cache.node_set_seq:
-            t0 = time.monotonic()
-            pb = self._encoder.encode_pods_only(pods, pad)
-            if pb is not None and pb.requests.shape[1] == \
-                    self._cluster.allocatable.shape[1]:
-                self.last_profile_idx = pb.profile_idx
-                self.last_inexpressible = pb.inexpressible
-                t_pack = time.monotonic()
-                ints, floats = pack_podin(pb)
-                t_done = time.monotonic()
-                self._observe("encode", t_pack - t0, end_mono=t_pack)
-                self._observe("pack", t_done - t_pack, end_mono=t_done)
+            dp = get_devprof()
+            rec = dp.begin_cycle(
+                cycle=self.trace_cycle, pad=pad, real=len(pods),
+                warming=warming) if dp.enabled else None
+            try:
                 t0 = time.monotonic()
-                handle, self._state = self._active.solve_lazy(
-                    self.params, self._static, self._state, ints, floats
-                )
-                if lazy:
-                    self.last_materializer = self._active.materialize
-                else:
-                    handle = self._active.materialize(handle)
-                    self.last_materializer = None
-                self._observe("device", time.monotonic() - t0)
-                if not self._warming:
-                    self.incremental_hits += 1
-                return handle, self._cluster, seq_before
+                pb = self._encoder.encode_pods_only(pods, pad)
+                if pb is not None and pb.requests.shape[1] == \
+                        self._cluster.allocatable.shape[1]:
+                    self.last_profile_idx = pb.profile_idx
+                    self.last_inexpressible = pb.inexpressible
+                    t_pack = time.monotonic()
+                    ints, floats = pack_podin(pb)
+                    t_done = time.monotonic()
+                    self._observe("encode", t_pack - t0, end_mono=t_pack)
+                    self._observe("pack", t_done - t_pack,
+                                  end_mono=t_done)
+                    dp.phase("encode", t_pack - t0)
+                    dp.phase("pack", t_done - t_pack)
+                    dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
+                    t0 = time.monotonic()
+                    handle, self._state = self._active.solve_lazy(
+                        self.params, self._static, self._state,
+                        ints, floats
+                    )
+                    dp.phase("dispatch", time.monotonic() - t0)
+                    if lazy:
+                        self.last_materializer = \
+                            self._timed_materializer(rec)
+                    else:
+                        t_b = time.monotonic()
+                        handle = self._active.materialize(handle)
+                        dp.phase("block", time.monotonic() - t_b)
+                        dp.add_bytes(
+                            "d2h", int(getattr(handle, "nbytes", 0)))
+                        self.last_materializer = None
+                    self._observe("device", time.monotonic() - t0)
+                    dp.end_cycle(rec, pending_block=lazy)
+                    if not self._warming:
+                        self.incremental_hits += 1
+                    return handle, self._cluster, seq_before
+                # incremental encode fell through (epoch shape drift):
+                # the record describes no solve — drop it rather than
+                # pollute the cycle stream with an empty row
+                dp.abort(rec)
+            except BaseException:
+                # encode/solve raised (the sidecar falls back to the
+                # serial path): the record describes no completed solve,
+                # and leaving it thread-local-active would misattribute
+                # later compile events to a dead cycle
+                dp.abort(rec)
+                raise
         if incremental_only:
             return None
         # the rebuild path always solves eagerly (rebuilds are rare and
         # the caller just committed any in-flight batch anyway)
         return self._rebuild_and_solve(pods, seq_before, pad)
+
+    def _timed_materializer(self, rec):
+        """Wrap the backend's materialize so a lazy solve's
+        ``block_until_ready`` wait — which lands cycles later, inside
+        the commit pipeline — is measured and attributed to the cycle
+        that dispatched it (devprof ``note_block`` completes the record;
+        a ``solve.block`` tracer span carries the same cycle id so
+        ``/debug/trace`` shows the wait next to the dispatch). With
+        devprof disabled the raw materializer is returned: the off mode
+        costs nothing."""
+        mat = self._active.materialize
+        if rec is None:
+            return mat
+        dp = get_devprof()
+
+        def _timed(handle):
+            t0 = time.monotonic()
+            out = mat(handle)
+            end = time.monotonic()
+            try:
+                dp.note_block(rec, end - t0,
+                              int(getattr(out, "nbytes", 0)))
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.record("solve.block", t0, end,
+                                  cycle=rec["cycle"])
+            except Exception:  # noqa: BLE001 — must never break commits
+                pass
+            return out
+
+        return _timed
 
     # inputs whose equality makes the packed STATIC planes bit-identical
     _STATIC_FP_CLUSTER = ("allocatable", "max_pods", "topo_codes")
@@ -375,6 +472,22 @@ class SolverSession:
         if not self._warming:
             self.rebuilds += 1
         self._poisoned = False
+        dp = get_devprof()
+        rec = dp.begin_cycle(
+            cycle=self.trace_cycle, pad=pad or self.max_batch,
+            real=len(pods), warming=self._warming,
+            rebuild="full") if dp.enabled else None
+        try:
+            return self._rebuild_and_solve_inner(
+                pods, seq_before, pad, dp, rec)
+        except BaseException:
+            # the solve chain exhausted (or a keyboard interrupt): the
+            # record describes no completed solve
+            dp.abort(rec)
+            raise
+
+    def _rebuild_and_solve_inner(self, pods: List, seq_before: int,
+                                 pad: Optional[int], dp, rec):
         t0 = time.monotonic()
         # captured BEFORE the snapshot refresh: a node-set change that
         # races the rebuild bumps mutation_seq too, so the next solve
@@ -397,6 +510,9 @@ class SolverSession:
         t_done = time.monotonic()
         self._observe("encode", t_pack - t0, end_mono=t_pack)
         self._observe("pack", t_done - t_pack, end_mono=t_done)
+        dp.phase("encode", t_pack - t0)
+        dp.phase("pack", t_done - t_pack)
+        dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
 
         # a demoted backend earns retries of the preferred one FIRST —
         # the state-only fast path below must not starve the cooldown
@@ -420,19 +536,36 @@ class SolverSession:
             and self._fingerprints_equal(fp, self._static_fp)
         ):
             try:
+                if rec is not None:
+                    rec["rebuild"] = "state_only"
                 t0 = time.monotonic()
                 state = self._active.prepare_state_only(cluster, batch)
-                out, self._state = self._active.solve(
+                t_disp = time.monotonic()
+                handle, self._state = self._active.solve_lazy(
                     self.params, self._static, state, ints, floats
                 )
+                t_block = time.monotonic()
+                out = self._active.materialize(handle)
+                t_end = time.monotonic()
+                dp.phase("dispatch", t_block - t_disp)
+                dp.phase("block", t_end - t_block)
+                # bytes accounted only after the solve SUCCEEDS (same
+                # rule as the chain loop below): a failed state-only
+                # attempt falls through to the full path, which charges
+                # its own static+state upload for this cycle
+                dp.add_bytes("h2d", _tree_nbytes(state))
+                dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
                 self.last_materializer = None
-                self._observe("device", time.monotonic() - t0)
+                self._observe("device", t_end - t0)
+                dp.end_cycle(rec)
                 self._last_seq = seq_before
                 if not self._warming:
                     self.state_only_rebuilds += 1
                 return out, cluster, seq_before
             except Exception:  # noqa: BLE001 — fall back to full rebuild
                 _logger.exception("state-only rebuild failed; full path")
+                if rec is not None:
+                    rec["rebuild"] = "full"
         self._static_fp = fp
         from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
 
@@ -467,9 +600,20 @@ class SolverSession:
             try:
                 t0 = time.monotonic()
                 self._static, state = backend.prepare(cluster, batch)
-                out, self._state = backend.solve(
+                t_disp = time.monotonic()
+                handle, self._state = backend.solve_lazy(
                     self.params, self._static, state, ints, floats
                 )
+                t_block = time.monotonic()
+                out = backend.materialize(handle)
+                # phases recorded only for the backend that SUCCEEDED —
+                # a failed chain link's dispatch attempt must not read
+                # as device time of the solve that actually ran
+                dp.phase("dispatch", t_block - t_disp)
+                dp.phase("block", time.monotonic() - t_block)
+                dp.add_bytes("h2d", _tree_nbytes(self._static)
+                             + _tree_nbytes(state))
+                dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
                 self._active = backend
                 self.last_materializer = None  # already materialized
                 break
@@ -487,6 +631,7 @@ class SolverSession:
                     self.backend = chain[i + 1]
                     self._demote_cooldown = DEMOTION_RETRY_REBUILDS
         self._observe("device", time.monotonic() - t0)
+        dp.end_cycle(rec)
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
         return out, cluster, seq_before

@@ -187,10 +187,16 @@ class TPUBatchScheduler:
         # and the cascade lands thousands of pods in 20-50s e2e buckets
         # (VERDICT r4 weak #1, the driver run-1 collapse). Shrinks to
         # unwarmed buckets are pre-warmed with synthetic solves between
-        # cycles instead.
+        # cycles instead — and the convention is no longer trusted on
+        # faith: devprof's compile listener counts any compile that
+        # still lands inside a measured cycle
+        # (solver_unexpected_compiles_total + flight-recorder dump).
         self._warmed_pads: set = set()
         self._need_warm_pad: Optional[int] = None
         self._warm_samples: List = []
+        # XLA compile events MEASURED inside pre-warm solves (devprof
+        # listener; legacy builds fall back to one-per-warm) — not the
+        # old "assume every warm call compiled" bookkeeping
         self.pad_warms = 0
         self.max_cycle_s = 0.0
         # cache mutations the CURRENT cycle's commits performed
@@ -270,9 +276,13 @@ class TPUBatchScheduler:
             pad = self._need_warm_pad
             self._need_warm_pad = None
             if pad not in self._warmed_pads and self._warm_samples:
-                if self.session.warm_pad(self._warm_samples, pad):
+                warmed = self.session.warm_pad(self._warm_samples, pad)
+                if warmed is not None:
+                    # the bucket is live either way; pad_warms counts
+                    # the compiles devprof MEASURED (0 = executable was
+                    # already cached and the warm cost ~nothing)
                     self._warmed_pads.add(pad)
-                    self.pad_warms += 1
+                    self.pad_warms += warmed
 
         # a pending batch solved against a mirror that has since
         # diverged (external events, failed commits) is suspect: its

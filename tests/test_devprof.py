@@ -1,0 +1,327 @@
+"""Device/solver profiling layer (observability/devprof.py): cycle
+lifecycle, compile detection (jax.monitoring listener + timing
+heuristic), metrics mirroring, the KTPU_TELEMETRY JSONL stream, and the
+bench-row ``telemetry`` sub-object guard."""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.observability.devprof import (
+    DevProfiler,
+    get_devprof,
+    set_devprof,
+)
+
+
+@pytest.fixture
+def prof():
+    """A fresh profiler installed as the process default (the compile
+    listener routes through ``get_devprof``), restored afterwards."""
+    prev = get_devprof()
+    p = DevProfiler(enabled=True, use_listener=False)
+    set_devprof(p)
+    yield p
+    set_devprof(prev)
+
+
+@pytest.fixture
+def fresh_jax_cache(tmp_path):
+    """Point the persistent XLA compile cache at an empty dir: a
+    compile-event test must actually compile, not deserialize a binary
+    cached by an earlier run (cache hits emit no compile event — that
+    is devprof's 'actual recompiles' semantics, but here we need one)."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+class TestCycleLifecycle:
+    def test_phases_and_bytes_accumulate(self, prof):
+        rec = prof.begin_cycle(cycle=7, pad=256, real=100)
+        prof.phase("encode", 0.01)
+        prof.phase("encode", 0.02)
+        prof.phase("dispatch", 0.005)
+        prof.phase("block", 0.1)
+        prof.add_bytes("h2d", 1000)
+        prof.add_bytes("d2h", 64)
+        prof.end_cycle(rec)
+        (cycle,) = prof.cycles()
+        assert cycle["cycle"] == 7
+        assert cycle["encode_s"] == pytest.approx(0.03)
+        assert cycle["block_s"] == pytest.approx(0.1)
+        assert cycle["h2d_bytes"] == 1000 and cycle["d2h_bytes"] == 64
+
+    def test_pending_block_completes_via_note_block(self, prof):
+        """Lazy solves materialize cycles later in the commit pipeline:
+        the record stays open until the timed materializer reports the
+        measured block_until_ready wait."""
+        rec = prof.begin_cycle(cycle=1, pad=128, real=128)
+        prof.phase("dispatch", 0.002)
+        prof.end_cycle(rec, pending_block=True)
+        assert prof.cycles() == []          # not complete yet
+        prof.note_block(rec, 0.25, d2h_bytes=512)
+        (cycle,) = prof.cycles()
+        assert cycle["block_s"] == pytest.approx(0.25)
+        assert cycle["d2h_bytes"] == 512
+
+    def test_abort_drops_record(self, prof):
+        rec = prof.begin_cycle(cycle=1, pad=64, real=10)
+        prof.abort(rec)
+        assert prof.cycles(include_warming=True) == []
+        # a later phase call must not resurrect the aborted record
+        prof.phase("encode", 1.0)
+        assert prof.cycles(include_warming=True) == []
+
+    def test_disabled_is_noop(self):
+        p = DevProfiler(enabled=False, use_listener=False)
+        assert p.begin_cycle(cycle=1) is None
+        p.phase("encode", 1.0)          # must not raise
+        p.end_cycle(None)
+        assert p.cycles() == []
+
+    def test_warming_cycles_excluded_from_summary(self, prof):
+        rec = prof.begin_cycle(cycle=-1, pad=128, real=8, warming=True)
+        prof.phase("block", 5.0)
+        prof.end_cycle(rec)
+        rec = prof.begin_cycle(cycle=1, pad=128, real=64)
+        prof.phase("block", 0.1)
+        prof.phase("dispatch", 0.1)
+        prof.end_cycle(rec)
+        s = prof.summary()
+        assert s["cycles"] == 1
+        assert s["block_s"] == pytest.approx(0.1)
+        assert len(prof.cycles(include_warming=True)) == 2
+
+
+class TestSummary:
+    def _cycle(self, prof, cycle, pad, real, block, dispatch=0.01,
+               encode=0.01, rebuild="none"):
+        rec = prof.begin_cycle(cycle=cycle, pad=pad, real=real,
+                               rebuild=rebuild)
+        prof.phase("encode", encode)
+        prof.phase("dispatch", dispatch)
+        prof.phase("block", block)
+        prof.add_bytes("h2d", 100)
+        prof.end_cycle(rec)
+
+    def test_wait_share_pad_waste_and_max_cycle(self, prof):
+        self._cycle(prof, 1, pad=256, real=128, block=0.08)
+        self._cycle(prof, 2, pad=256, real=256, block=1.0,
+                    rebuild="full")
+        s = prof.summary()
+        assert s["cycles"] == 2
+        # block dominates: 1.08 of 1.12 total phase seconds
+        assert s["device_wait_share"] == pytest.approx(
+            1.08 / 1.12, abs=0.01)
+        # 384 real rows over 512 padded
+        assert s["pad_waste_pct"] == pytest.approx(25.0)
+        assert s["max_cycle"]["cycle"] == 2
+        assert s["max_cycle"]["rebuild"] == "full"
+        assert s["h2d_bytes"] == 200
+
+    def test_max_cycle_phase_attribution(self, prof):
+        from kubernetes_tpu.harness.diagfmt import max_cycle_phase
+
+        self._cycle(prof, 1, pad=64, real=64, block=0.5)
+        s = prof.summary()
+        assert max_cycle_phase(s["max_cycle"]) == "block"
+        assert max_cycle_phase({"compiles": 2}) == "compile"
+
+    def test_reset_clears_window(self, prof):
+        self._cycle(prof, 1, pad=64, real=64, block=0.1)
+        prof.unexpected_compiles = 3
+        prof.reset(workload="next-row")
+        assert prof.summary()["cycles"] == 0
+        assert prof.unexpected_compiles == 0
+        assert prof.workload == "next-row"
+
+
+class TestCompileDetection:
+    def test_listener_counts_real_compile_in_cycle(self, prof,
+                                                   fresh_jax_cache):
+        """A real XLA compilation inside an open cycle lands on that
+        cycle's record via the process-wide jax.monitoring listener."""
+        import jax
+        import jax.numpy as jnp
+
+        p = DevProfiler(enabled=True)   # listener ON
+        set_devprof(p)
+        if not p.listener_active:
+            pytest.skip("jax.monitoring listener unavailable")
+        rec = p.begin_cycle(cycle=1, pad=16, real=16)
+        jax.jit(lambda x: x * 3.5 + 17.25)(jnp.arange(16.0))
+        p.end_cycle(rec)
+        (cycle,) = p.cycles()
+        assert cycle["compiles"] >= 1
+        assert cycle["compile_s"] > 0.0
+
+    def test_background_compiles_counted_separately(self, prof,
+                                                    fresh_jax_cache):
+        import jax
+        import jax.numpy as jnp
+
+        p = DevProfiler(enabled=True)
+        set_devprof(p)
+        if not p.listener_active:
+            pytest.skip("jax.monitoring listener unavailable")
+        before = p.background_compiles
+        jax.jit(lambda x: x * 2.5 - 3.125)(jnp.arange(8.0))
+        assert p.background_compiles > before
+        assert p.cycles() == []
+
+    def test_unexpected_compile_increments_metric(self, prof):
+        """The forbidden case: a compile inside a MEASURED cycle bumps
+        solver_unexpected_compiles_total (and drops a flight dump)."""
+        from kubernetes_tpu.metrics.solver_metrics import solver_metrics
+
+        sm = solver_metrics()
+        before = sm.unexpected_compiles_total.get()
+        prof.listener_active = True     # trust on_compile attribution
+        rec = prof.begin_cycle(cycle=9, pad=512, real=400)
+        prof.on_compile(1.5)
+        prof.end_cycle(rec)
+        assert prof.unexpected_compiles == 1
+        assert sm.unexpected_compiles_total.get() == before + 1
+
+    def test_compile_after_abort_is_background(self, prof):
+        """An aborted cycle (encode fell through, solver raised) must
+        not soak up later compile events: they count as background, not
+        as compiles of a dead record."""
+        prof.listener_active = True
+        rec = prof.begin_cycle(cycle=1, pad=64, real=10)
+        prof.abort(rec)
+        prof.on_compile(1.0)
+        assert prof.background_compiles == 1
+        assert prof.unexpected_compiles == 0
+        assert rec["compiles"] == 0
+
+    def test_warm_compile_goes_to_warm_ledger(self, prof):
+        prof.listener_active = True
+        rec = prof.begin_cycle(cycle=-1, pad=512, real=8, warming=True)
+        prof.on_compile(2.0)
+        prof.end_cycle(rec)
+        assert prof.warm_compiles == 1
+        assert prof.unexpected_compiles == 0
+
+    def test_heuristic_flags_outlier_cycle(self, prof):
+        """No listener API: a warmed bucket's 4x + 250ms excursion is
+        attributed a suspected compile; ordinary jitter is not."""
+        assert not prof.listener_active
+        for i in range(3):
+            rec = prof.begin_cycle(cycle=i, pad=256, real=256)
+            prof.phase("block", 0.1)
+            prof.end_cycle(rec)
+        rec = prof.begin_cycle(cycle=3, pad=256, real=256)
+        prof.phase("block", 0.15)       # jitter: inside the band
+        prof.end_cycle(rec)
+        assert prof.unexpected_compiles == 0
+        rec = prof.begin_cycle(cycle=4, pad=256, real=256)
+        prof.phase("block", 2.0)        # 20x + >250ms: compile-shaped
+        prof.end_cycle(rec)
+        assert prof.unexpected_compiles == 1
+        assert prof.cycles()[-1]["compile_suspected"] is True
+
+
+class TestMetricsMirror:
+    def test_completed_cycle_updates_solver_metrics(self, prof):
+        from kubernetes_tpu.metrics.solver_metrics import solver_metrics
+
+        sm = solver_metrics()
+        wait_before = sm.device_wait_seconds.count()
+        h2d_before = sm.transfer_bytes_total.get("h2d")
+        rec = prof.begin_cycle(cycle=1, pad=128, real=96)
+        prof.phase("block", 0.05)
+        prof.add_bytes("h2d", 4096)
+        prof.end_cycle(rec)
+        assert sm.device_wait_seconds.count() == wait_before + 1
+        assert sm.transfer_bytes_total.get("h2d") == h2d_before + 4096
+        assert sm.pad_occupancy_ratio.get("128") == pytest.approx(0.75)
+
+
+class TestTelemetryStream:
+    def test_jsonl_one_record_per_cycle(self, tmp_path):
+        p = DevProfiler(enabled=True, use_listener=False,
+                        telemetry_dir=str(tmp_path))
+        for i in range(3):
+            rec = p.begin_cycle(cycle=i, pad=64, real=32)
+            p.phase("block", 0.01 * (i + 1))
+            p.end_cycle(rec)
+        p.close()
+        files = list(tmp_path.glob("solvercycles-*.jsonl"))
+        assert len(files) == 1
+        records = [json.loads(ln) for ln in
+                   files[0].read_text().splitlines()]
+        assert len(records) == 3
+        assert [r["cycle"] for r in records] == [0, 1, 2]
+        assert records[2]["block_s"] == pytest.approx(0.03)
+
+    def test_env_var_activates_stream(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KTPU_TELEMETRY", str(tmp_path / "t"))
+        p = DevProfiler(enabled=True, use_listener=False)
+        rec = p.begin_cycle(cycle=1, pad=8, real=8)
+        p.end_cycle(rec)
+        p.close()
+        assert list((tmp_path / "t").glob("solvercycles-*.jsonl"))
+
+
+class TestSessionIntegration:
+    def test_solve_produces_cycle_records(self, prof):
+        """The real solve path (session + sidecar over a small store)
+        emits one measured record per solve cycle with the phase split,
+        transfer bytes and pad occupancy populated — and the summary
+        aggregates into the shape every bench row commits."""
+        import time
+
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.config.feature_gates import FeatureGates
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        from kubernetes_tpu.sidecar import attach_batch_scheduler
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        store = ClusterStore()
+        for i in range(4):
+            store.add_node(
+                MakeNode().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "32Gi"}).obj())
+        sched = Scheduler.create(
+            store,
+            feature_gates=FeatureGates({"TPUBatchScheduler": True}))
+        bs = attach_batch_scheduler(sched, max_batch=32)
+        sched.start()
+        try:
+            for i in range(16):
+                store.create_pod(
+                    MakePod().name(f"p{i}").req({"cpu": "1"}).obj())
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                sched.queue.flush_backoff_completed()
+                if bs.run_batch(pop_timeout=0.0):
+                    continue
+                if sched.queue.num_active() == 0 \
+                        and sched.queue.num_backoff() == 0:
+                    break
+                time.sleep(0.05)
+            assert sched.wait_for_inflight_bindings()
+        finally:
+            sched.stop()
+        bound = [p for p in store.list_pods() if p.spec.node_name]
+        assert len(bound) == 16
+        recs = prof.cycles(include_warming=True)
+        assert recs, "solve path recorded no devprof cycles"
+        solved = [r for r in recs if not r["warming"]]
+        assert solved
+        for r in solved:
+            # every measured cycle shipped pod planes up and carries
+            # the dispatch-vs-block split around the solver call
+            assert r["h2d_bytes"] > 0
+            assert r["dispatch_s"] >= 0.0 and r["block_s"] >= 0.0
+            assert r["real"] > 0 and r["pad"] >= r["real"]
+        s = prof.summary()
+        assert s["cycles"] == len(solved)
+        assert s["h2d_bytes"] > 0
+        assert "max_cycle" in s

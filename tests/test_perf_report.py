@@ -1,0 +1,386 @@
+"""Shared diag formatter/parser (harness/diagfmt.py) and the
+perf-regression report (tools/perf_report.py).
+
+Covers the contracts the telemetry pipeline rests on:
+
+- the ``diag:`` line round-trips through the ONE writer
+  (``diagfmt.format_*``) and the ONE parser (``diagfmt.parse_diag``);
+- the parser still reads the legacy hand-rolled format frozen into the
+  committed ``BENCH_r01..r05`` tails;
+- the e2e segment is rendered from the metrics-registry histogram's own
+  accessors, so ``diag:`` and ``/metrics`` cannot disagree;
+- a synthetic bench history with a deliberate out-of-band regression
+  AND a within-noise wobble flags exactly the regression, with phase
+  attribution from the row's telemetry;
+- every committed ``BENCH_r*.json`` in the repo parses under the driver
+  schema (tier-1 smoke: a malformed round fails CI, not a human);
+- the headline row's ``telemetry`` sub-object survives into the
+  driver-captured stdout tail (the same trap the REST row hit pre-PR 5:
+  a row that prints too early falls off the tail).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.harness import diagfmt
+from tools.perf_report import (
+    _rows_from_tail,
+    build_series,
+    detect_regressions,
+    load_round,
+    load_rounds,
+    noise_band,
+    render,
+    summarize_telemetry,
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HEADLINE = ("pods_scheduled_per_sec[SchedulingBasic 5000nodes/"
+             "30000pods, TPU batch path]")
+
+# a verbatim line from the committed BENCH_r05.json tail — the legacy
+# hand-rolled format the parser must keep reading forever
+_LEGACY_DIAG = (
+    "    diag: commit=4.32s/8 device=1.34s/14 encode=2.37s/14 "
+    "session[hits=7 rebuilds=7 state_only=7] chunk=4096 "
+    "max_cycle=1.03s pad_warms=0 "
+    "e2e_buckets[<=0.2:84 <=0.5:12441 <=1.0:17127 <=2.0:348]")
+
+
+# ---------------------------------------------------------------------------
+# diagfmt: one writer, one parser
+
+
+class TestDiagFmtRoundTrip:
+    def test_current_format_round_trips(self):
+        segs = diagfmt.format_phases({
+            "solve.commit": {"total_s": 4.32, "count": 8,
+                             "p50_s": 0.4, "p99_s": 0.54},
+            "solve.device": {"total_s": 1.34, "count": 14,
+                             "p50_s": 0.05, "p99_s": 0.2},
+        })
+        sess = diagfmt.format_session(
+            type("S", (), {"incremental_hits": 7, "rebuilds": 1,
+                           "state_only_rebuilds": 1})(),
+            chunk=4096, max_cycle_s=0.88, pad_warms=2)
+        dev = diagfmt.format_devprof({
+            "cycles": 8, "compiles": 1, "unexpected_compiles": 0,
+            "warm_compiles": 1, "device_wait_share": 0.61,
+            "pad_waste_pct": 12.5, "h2d_bytes": 52_400_000,
+            "d2h_bytes": 960_000, "compile_detector": "listener",
+            "max_cycle": {"cycle": 3, "block_s": 0.4, "dispatch_s": 0.01,
+                          "encode_s": 0.05, "compiles": 0},
+        })
+        line = diagfmt.format_diag(segs + [sess, dev])
+        parsed = diagfmt.parse_diag(line)
+        assert parsed["phases"]["solve.commit"] == {
+            "total_s": 4.32, "count": 8, "p99_ms": 540.0}
+        assert parsed["session"]["hits"] == 7
+        assert parsed["chunk"] == 4096
+        assert parsed["max_cycle_s"] == pytest.approx(0.88)
+        assert parsed["pad_warms"] == 2
+        assert parsed["devprof"]["cycles"] == 8
+        assert parsed["devprof"]["wait_share"] == pytest.approx(0.61)
+        assert parsed["devprof"]["max_cycle_phase"] == "block"
+        assert parsed["devprof"]["detector"] == "listener"
+
+    def test_legacy_committed_format_parses(self):
+        parsed = diagfmt.parse_diag(_LEGACY_DIAG)
+        assert parsed["phases"]["commit"] == {"total_s": 4.32, "count": 8}
+        assert parsed["phases"]["device"]["total_s"] == pytest.approx(1.34)
+        assert parsed["session"] == {
+            "hits": 7, "rebuilds": 7, "state_only": 7}
+        assert parsed["chunk"] == 4096
+        assert parsed["max_cycle_s"] == pytest.approx(1.03)
+        assert parsed["pad_warms"] == 0
+        assert parsed["e2e_buckets"] == {
+            "0.2": 84, "0.5": 12441, "1.0": 17127, "2.0": 348}
+
+    def test_non_diag_lines_return_none(self):
+        assert diagfmt.parse_diag("[headline] batch run 1/3: ...") is None
+        assert diagfmt.parse_diag('{"metric": "x"}') is None
+
+    def test_e2e_segment_rendered_from_registry_histogram(self):
+        """The e2e text and /metrics share one series: counts in the
+        rendered bucket segment must equal the histogram's own
+        bucket_counts, and the p99 must be the histogram's interpolated
+        quantile — byte-for-byte the same numbers a scrape would see."""
+        from kubernetes_tpu.metrics.registry import Histogram
+
+        hist = Histogram("e2e_scheduling_duration_seconds", "t",
+                         ("result",))
+        for v in (0.1, 0.3, 0.3, 0.7, 0.9, 1.5):
+            hist.observe(v, "scheduled")
+        segs = diagfmt.format_e2e(hist)
+        parsed = diagfmt.parse_diag(diagfmt.format_diag(segs))
+        counts = hist.bucket_counts("scheduled")
+        edges = list(hist.buckets) + ["inf"]
+        expect = {str(edges[i]): c for i, c in enumerate(counts) if c}
+        assert parsed["e2e_buckets"] == expect
+        assert parsed["e2e_p99_ms"] == pytest.approx(
+            hist.quantile(0.99, "scheduled") * 1000, abs=0.5)
+
+    def test_e2e_empty_histogram_renders_nothing(self):
+        from kubernetes_tpu.metrics.registry import Histogram
+
+        hist = Histogram("e2e", "t", ("result",))
+        assert diagfmt.format_e2e(hist) == []
+
+
+# ---------------------------------------------------------------------------
+# synthetic trajectory: the flagging semantics
+
+
+def _artifact(dirpath, n: int, value: float, runs=None, telemetry=None,
+              diag: str = _LEGACY_DIAG) -> None:
+    row = {"metric": _HEADLINE, "value": value, "unit": "pods/s",
+           "p99_latency_ms": 994}
+    if runs:
+        row["runs"] = runs
+    if telemetry:
+        row["telemetry"] = telemetry
+    tail = "\n".join([
+        "SchedulingBasic/batch: 30000 pods created",
+        diag,
+        f"[headline] batch run 1/1: {value} pods/s",
+        json.dumps(row),
+    ])
+    doc = {"n": n, "cmd": "timeout 3600 python bench.py", "rc": 0,
+           "tail": tail}
+    with open(os.path.join(dirpath, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(doc, f)
+
+
+class TestSyntheticTrajectory:
+    def test_flags_regression_not_wobble(self, tmp_path):
+        """r3 wobbles -7% (inside the ±30% tunnel band: NOT flagged —
+        the r3→r4 false alarm this tool exists to prevent) while r4
+        drops -54% (flagged, attributed to its telemetry)."""
+        _artifact(tmp_path, 1, 7000.0, runs=[6800.0, 7000.0, 7200.0])
+        _artifact(tmp_path, 2, 7150.0, runs=[7000.0, 7150.0, 7300.0])
+        _artifact(tmp_path, 3, 6500.0, runs=[6400.0, 6500.0, 6700.0])
+        _artifact(tmp_path, 4, 3200.0, runs=[3100.0, 3200.0, 3400.0],
+                  telemetry={
+                      "cycles": 8, "compiles": 2, "unexpected_compiles": 2,
+                      "device_wait_share": 0.82, "pad_waste_pct": 4.0,
+                      "max_cycle": {"cycle": 5, "rebuild": "full",
+                                    "compiles": 2, "block_s": 2.0},
+                  })
+        series = build_series(load_rounds(str(tmp_path)))
+        assert len(series[_HEADLINE]) == 4
+        flags = detect_regressions(series)
+        assert len(flags) == 1
+        (flag,) = flags
+        assert flag["round"] == 4
+        assert flag["delta_pct"] < -30.0
+        # attribution names the compile-inside-measured-cycle and the
+        # wait share — the "what regressed" ships with the flag
+        assert "compile" in flag["attribution"]
+        assert "device-wait share 82%" in flag["attribution"]
+        # and the human rendering marks exactly that row
+        text = render(series, flags)
+        assert text.count("REGRESSION") == 1
+
+    def test_legacy_rounds_attribute_from_diag_phases(self, tmp_path):
+        """Pre-telemetry rounds attribute a flagged drop by comparing
+        parsed diag phase totals against the previous round's."""
+        fast = ("    diag: commit=1.30s/8 device=0.21s/8 encode=0.28s/8 "
+                "session[hits=7 rebuilds=1 state_only=1] chunk=4096 "
+                "max_cycle=0.88s pad_warms=0 e2e_buckets[<=1.0:30000]")
+        _artifact(tmp_path, 1, 7000.0, diag=fast)
+        _artifact(tmp_path, 2, 3000.0, diag=_LEGACY_DIAG)  # commit grew
+        flags = detect_regressions(build_series(load_rounds(str(tmp_path))))
+        (flag,) = flags
+        assert "commit" in flag["attribution"]
+
+    def test_regression_cannot_widen_its_own_band(self, tmp_path):
+        """The judging band comes from the PRIOR rounds only: a round
+        that regresses AND blows up its own run-to-run spread (the
+        classic recompile-in-some-runs signature) is still flagged."""
+        _artifact(tmp_path, 1, 4000.0, runs=[3900.0, 4000.0, 4100.0])
+        _artifact(tmp_path, 2, 4050.0, runs=[3950.0, 4050.0, 4150.0])
+        _artifact(tmp_path, 3, 2800.0, runs=[2000.0, 2800.0, 4100.0])
+        flags = detect_regressions(
+            build_series(load_rounds(str(tmp_path))))
+        (flag,) = flags
+        assert flag["round"] == 3
+        assert flag["band_pct"] == pytest.approx(30.0)  # prior floor
+
+    def test_stray_bench_named_files_are_ignored(self, tmp_path):
+        _artifact(tmp_path, 1, 7000.0)
+        # matches the glob, not the round-name contract — must be
+        # skipped, not crash the loader (and so the tier-1 smoke)
+        (tmp_path / "BENCH_rest.json").write_text("not even json")
+        rounds = load_rounds(str(tmp_path))
+        assert [r["round"] for r in rounds] == [1]
+
+    def test_noise_band_from_repeat_runs(self):
+        points = [{"value": 1000.0, "runs": [600.0, 1000.0, 1400.0]}]
+        assert noise_band(points) == pytest.approx(0.8)   # spread wins
+        assert noise_band([{"value": 1000.0, "runs": None}]) == \
+            pytest.approx(0.30)                           # floor
+
+    def test_schema_drift_raises(self, tmp_path):
+        p = tmp_path / "BENCH_r01.json"
+        p.write_text(json.dumps({"n": 1, "cmd": "x", "rc": 0}))  # no tail
+        with pytest.raises(ValueError, match="tail"):
+            load_round(str(p))
+
+
+# ---------------------------------------------------------------------------
+# committed artifacts: the tier-1 smoke over the real trajectory
+
+
+class TestCommittedArtifacts:
+    def test_every_committed_round_parses(self):
+        rounds = load_rounds(_REPO_ROOT)
+        assert len(rounds) >= 5, "committed BENCH_r*.json went missing"
+        for rnd in rounds:
+            assert rnd["rows"], f"round {rnd['round']} yielded no rows"
+
+    def test_headline_family_spans_all_rounds(self):
+        rounds = load_rounds(_REPO_ROOT)
+        series = build_series(rounds)
+        points = series.get(_HEADLINE, [])
+        assert len(points) == len(rounds), \
+            "headline row missing from a committed round"
+        assert all(p["value"] > 0 for p in points)
+
+    def test_report_renders_and_cli_exits_zero(self, capsys):
+        from tools.perf_report import main
+
+        assert main(["--dir", _REPO_ROOT]) == 0
+        out = capsys.readouterr().out
+        assert "SchedulingBasic" in out
+        assert "noise band" in out
+
+    def test_json_mode_is_machine_readable(self, capsys):
+        from tools.perf_report import main
+
+        assert main(["--dir", _REPO_ROOT, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["rounds"] == sorted(doc["rounds"])
+        assert _HEADLINE in doc["series"]
+
+
+# ---------------------------------------------------------------------------
+# telemetry stream cross-check
+
+
+class TestTelemetryStream:
+    def test_jsonl_summary_matches_profiler_summary(self, tmp_path):
+        """A bench row's committed telemetry sub-object can be
+        cross-checked against the raw KTPU_TELEMETRY stream: the same
+        cycles aggregate to the same compile count, wait share, pad
+        waste and transfer volume through both paths."""
+        from kubernetes_tpu.observability.devprof import DevProfiler
+
+        p = DevProfiler(enabled=True, use_listener=False,
+                        telemetry_dir=str(tmp_path))
+        rec = p.begin_cycle(cycle=-1, pad=256, real=8, warming=True)
+        p.phase("block", 1.0)
+        p.end_cycle(rec)
+        for i in range(4):
+            rec = p.begin_cycle(cycle=i, pad=256, real=192)
+            p.phase("encode", 0.02)
+            p.phase("dispatch", 0.01)
+            p.phase("block", 0.10)
+            p.add_bytes("h2d", 1_000_000)
+            p.add_bytes("d2h", 2_048)
+            p.end_cycle(rec)
+        p.close()
+        live = p.summary()
+        stream = summarize_telemetry(str(tmp_path))
+        assert stream["files"] == 1
+        assert stream["cycles"] == live["cycles"] == 4
+        assert stream["warming_cycles"] == 1
+        assert stream["h2d_bytes"] == live["h2d_bytes"]
+        assert stream["device_wait_share"] == pytest.approx(
+            live["device_wait_share"], abs=0.01)
+        assert stream["pad_waste_pct"] == pytest.approx(
+            live["pad_waste_pct"], abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: telemetry survives the driver tail capture
+
+
+class TestBenchTailGuard:
+    def test_run_one_attaches_devprof_summary(self, monkeypatch):
+        """bench.run_one carries the median run's devprof summary into
+        the row JSON as ``telemetry`` — the attach point the acceptance
+        criterion rests on."""
+        import bench
+        from kubernetes_tpu.harness.perf import BenchmarkResult
+
+        tel = {"cycles": 8, "compiles": 1, "unexpected_compiles": 0,
+               "device_wait_share": 0.4, "pad_waste_pct": 7.5}
+
+        def fake_run_workload(name, ops, **kw):
+            return BenchmarkResult(
+                name=name, total_pods=1000, measured_pods=1000,
+                duration_seconds=1.0, pods_per_second=5000.0,
+                throughput={}, metrics={"Perc99": 900.0}, telemetry=tel)
+
+        monkeypatch.setattr(bench, "make_workload", lambda *a, **k: [])
+        monkeypatch.setattr(bench, "run_workload", fake_run_workload)
+        row = bench.run_one("headline", "SchedulingBasic", 200, 0, 1000,
+                            serial_rate=100.0, repeat=1)
+        assert row["telemetry"] == tel
+
+    def test_headline_telemetry_survives_tail_capture(self, capsys,
+                                                      monkeypatch):
+        """The driver captures the LAST bytes of stdout: the headline
+        row must print last (so its telemetry cannot fall off the tail
+        — the trap the REST row hit pre-PR 5) and the committed-artifact
+        parser must recover the sub-object from that tail."""
+        import bench
+
+        tel = {"cycles": 8, "compiles": 0, "unexpected_compiles": 0,
+               "device_wait_share": 0.35, "pad_waste_pct": 3.1,
+               "max_cycle": {"cycle": 6, "rebuild": "none",
+                             "compiles": 0, "block_s": 0.2}}
+
+        def fake_run_one(key, name, nodes, init_pods, measure_pods,
+                         serial_rate, repeat=1):
+            row = {"metric": f"pods_scheduled_per_sec[{name} {key}]",
+                   "value": 7000.0, "unit": "pods/s",
+                   "vs_baseline": 10.0}
+            if key == "headline":
+                row["telemetry"] = tel
+            return row
+
+        def fake_run_rest_one(nodes, measure_pods, serial_rate, qps,
+                              repeat=1):
+            return {"metric":
+                    "pods_scheduled_per_sec[SchedulingBasic REST fabric]",
+                    "value": 4500.0, "unit": "pods/s",
+                    "vs_baseline": 70.0,
+                    "store_direct_pods_per_sec": 7500.0,
+                    "fabric_overhead_ratio": 0.6}
+
+        def fake_run_qos_one(nodes, measure_pods, serial_rate, qps,
+                             tenants=3, solo_baseline=None):
+            return {"metric": "noisy_tenant_qos[SchedulingBasic]",
+                    "value": 3000.0, "unit": "pods/s",
+                    "vs_baseline": 48.0, "p99_ratio_vs_solo": 1.3,
+                    "qos_ok": True}
+
+        monkeypatch.setattr(bench, "run_one", fake_run_one)
+        monkeypatch.setattr(bench, "run_rest_one", fake_run_rest_one)
+        monkeypatch.setattr(bench, "run_qos_one", fake_run_qos_one)
+        monkeypatch.setattr(bench.sys, "argv",
+                            ["bench.py", "--skip-serial"])
+        bench.main()
+        # simulate the driver's tail capture: keep only the last 2KB
+        tail = capsys.readouterr().out[-2048:]
+        rows = _rows_from_tail(tail)
+        assert rows, "tail capture lost every row"
+        headline = rows[-1]
+        assert "headline" in headline["metric"]
+        assert headline["telemetry"] == tel
