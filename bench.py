@@ -267,9 +267,18 @@ def _diagnose(sched, bs) -> None:
         for _, lbl, _v in apfm.peak_executing_seats.collect():
             apfm.peak_executing_seats.set(0.0, *lbl)
         apfm.request_queue_wait_seconds.clear()
+        # SLO segment, only when an objective is violated THIS ROW
+        # (mirrors the apf convention): the engine's window was reset
+        # at row start by the harness, so the verdicts are the row's
+        slo_seg = ""
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        engine = get_slo_engine()
+        if engine.enabled:
+            slo_seg = diagfmt.format_slo(engine.evaluate())
         log(diagfmt.format_diag(
             segs + [sess.strip(), devprof_seg.strip(), churn.strip(),
-                    autoscale.strip(), apf.strip()] + buckets))
+                    autoscale.strip(), apf.strip(), slo_seg] + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -324,6 +333,12 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
         # pad waste, and the slowest cycle's phase attribution are
         # readable from the committed JSON without a re-run
         row["telemetry"] = median.telemetry
+    if median.freshness:
+        # the SLI layer's numbers (watch-delivery p99, max snapshot
+        # staleness, SLO verdicts) ride the artifact the same way —
+        # tools/slo_report.py renders the per-row verdict table from
+        # exactly this sub-object
+        row["freshness"] = median.freshness
     if key == "headline":
         # provenance for the trace-overhead tracking (--config traceab):
         # which sampling config this headline number was measured under
@@ -406,6 +421,12 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
         row["runs"] = [round(b.pods_per_second, 1) for b in samples]
     if median.telemetry:
         row["telemetry"] = median.telemetry
+    if median.freshness:
+        row["freshness"] = median.freshness
+        # which components' registries the federation merged for this
+        # row (≥2 = the cross-process path measured real children)
+        row["federation_instances"] = \
+            median.metrics.get("federation_instances", [])
     return row
 
 
@@ -545,6 +566,44 @@ def run_profile_ab(nodes: int, measure_pods: int, repeat: int = 1) -> dict:
     }
 
 
+def run_freshness_ab(nodes: int, measure_pods: int,
+                     repeat: int = 1) -> dict:
+    """Freshness+SLO layer on/off headline A/B (``--config freshab``):
+    event stamping, per-batch delivery/lag observation, per-cycle
+    staleness, and the SLO engine's sampling, measured against the
+    same interleaved-arms noise band the tracer and devprof layers are
+    judged by."""
+    from kubernetes_tpu.metrics.freshness_metrics import freshness_metrics
+    from kubernetes_tpu.observability.slo import get_slo_engine
+
+    fm = freshness_metrics()
+    engine = get_slo_engine()
+    prev_fm, prev_slo = fm.enabled, engine.enabled
+
+    def set_enabled(on: bool) -> None:
+        fm.configure(enabled=on)
+        engine.configure(enabled=on)
+
+    try:
+        ab = _layer_ab("fresh", "freshness", set_enabled,
+                       nodes, measure_pods, repeat)
+    finally:
+        fm.configure(enabled=prev_fm)
+        engine.configure(enabled=prev_slo)
+    return {
+        "metric": f"freshness_overhead_pct[SchedulingBasic {nodes}nodes/"
+                  f"{measure_pods}pods, SLI layer on/off A/B]",
+        "value": round(ab["overhead_pct"], 2),
+        "unit": "%",
+        "freshness_on_pods_per_sec": round(ab["rates"]["on"], 1),
+        "freshness_off_pods_per_sec": round(ab["rates"]["off"], 1),
+        "noise_band_pct": round(ab["noise_pct"], 2),
+        "within_noise": (abs(ab["overhead_pct"])
+                         <= max(ab["noise_pct"], 1.0))
+        if repeat > 1 else None,
+    }
+
+
 def measure_serial(name: str, nodes: int, measure_pods: int,
                    serial_pods: int) -> float:
     serial_pods = min(serial_pods, measure_pods)
@@ -562,7 +621,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
-                    + ["rest", "qos", "traceab", "profab", "autoscale"])
+                    + ["rest", "qos", "traceab", "profab", "freshab",
+                       "autoscale"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -596,6 +656,13 @@ def main() -> None:
     if args.config == "profab":
         nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
         print(json.dumps(run_profile_ab(
+            nodes, measure_pods, repeat=1 if args.quick else 3)),
+            flush=True)
+        return
+
+    if args.config == "freshab":
+        nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
+        print(json.dumps(run_freshness_ab(
             nodes, measure_pods, repeat=1 if args.quick else 3)),
             flush=True)
         return

@@ -32,7 +32,8 @@ delete,watch}.go`` + ``pkg/controlplane/instance.go:547 InstallLegacyAPI``):
 - ``/healthz`` ``/livez`` ``/readyz`` probes and Prometheus ``/metrics``
   — all exempt from flow control (a liveness probe must never be queued
   or 429'd), like the ``/debug/*`` admin routes, which include
-  ``/debug/apf`` (flow-control introspection)
+  ``/debug/apf`` (flow-control introspection) and ``/debug/slo``
+  (live SLO evaluation over the cluster SLIs)
 
 Transport negotiates per request between JSON over HTTP/1.1 chunked
 streams (the kubectl/debug wire, ``kubernetes_tpu.api.serialization``)
@@ -136,16 +137,20 @@ def _encode_custom(obj, api_version: str) -> Dict:
 
 
 def _cached_event_bytes(event: Event) -> bytes:
-    """Pickle one watch event as ``(type, obj, old)``, memoized on the
-    event so N binary watchers (and the replay path) pay ONE encode —
-    the reference's cachingObject, applied to the binary wire. Benign
-    race: two watch writers may both encode the first time; both produce
-    identical bytes and one assignment wins."""
+    """Pickle one watch event as ``(type, obj, old, commit_ts)``,
+    memoized on the event so N binary watchers (and the replay path)
+    pay ONE encode — the reference's cachingObject, applied to the
+    binary wire. The commit timestamp rides along so the client can
+    measure end-to-end watch delivery (freshness SLI); decoders accept
+    the legacy 3-tuple too. Benign race: two watch writers may both
+    encode the first time; both produce identical bytes and one
+    assignment wins."""
     from kubernetes_tpu.apiserver import codec
 
     b = event.__dict__.get("_bin_frame")
     if b is None:
-        b = codec.encode((event.type, event.obj, event.old_obj))
+        b = codec.encode(
+            (event.type, event.obj, event.old_obj, event.ts))
         event.__dict__["_bin_frame"] = b
     return b
 
@@ -415,6 +420,7 @@ class _Handler(BaseHTTPRequestHandler):
         "/debug/faults": "_serve_faults_admin",
         "/debug/trace": "_serve_trace_admin",
         "/debug/apf": "_serve_apf_admin",
+        "/debug/slo": "_serve_slo_admin",
     }
 
     # -- flow-control exemption envelope: paths that must NEVER be
@@ -1074,6 +1080,30 @@ class _Handler(BaseHTTPRequestHandler):
                              "/debug/apf supports GET")
             return
         self._send_json(200, fc.snapshot())
+
+    def _serve_slo_admin(self, verb: str) -> None:
+        """/debug/slo: live SLO evaluation (observability/slo.py). GET
+        → every declared SLO's windowed SLI, burn rates, and verdicts
+        for THIS process. Same control-plane trust envelope as the
+        other debug surfaces and — via ADMIN_ROUTES — exempt from
+        admission: the burn-rate postmortem must be readable exactly
+        when the fabric is violating its objectives."""
+        if not self._binary_decode_allowed():
+            self._send_error(403, "Forbidden",
+                             "slo admin requires a control-plane identity")
+            return
+        if verb != "GET":
+            self._send_error(405, "MethodNotAllowed",
+                             "/debug/slo supports GET")
+            return
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        engine = get_slo_engine()
+        if not engine.enabled:
+            self._send_error(404, "NotFound",
+                             "SLO evaluation is not enabled (KTPU_SLO=off)")
+            return
+        self._send_json(200, engine.evaluate())
 
     def _serve_faults_admin(self, verb: str) -> None:
         """/debug/faults: runtime fault-injection control surface.
@@ -2029,9 +2059,12 @@ class _Handler(BaseHTTPRequestHandler):
                     wire = _encode_custom(event.obj, api_version) \
                         if isinstance(event.obj, CustomObject) \
                         else SCHEME_V.encode(event.obj, api_version)
-                    frame = json.dumps(
-                        {"type": event.type, "object": wire}
-                    ).encode() + b"\n"
+                    doc = {"type": event.type, "object": wire}
+                    if event.ts:
+                        # commit stamp for the freshness SLI (the JSON
+                        # wire's analog of the binary 4-tuple)
+                        doc["commitTs"] = event.ts
+                    frame = json.dumps(doc).encode() + b"\n"
                     if api_version == "v1":
                         event.__dict__["_v1_frame"] = frame
             try:

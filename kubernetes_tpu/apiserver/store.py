@@ -71,6 +71,11 @@ class Event:
     kind: str
     obj: Any
     old_obj: Any = None
+    # store-commit wall-clock timestamp, stamped ONCE at dispatch time
+    # (the freshness SLI layer's anchor: watch delivery and snapshot
+    # staleness are both measured against it). 0.0 = synthetic event
+    # (informer initial-sync replay, relist diff) — not measured.
+    ts: float = 0.0
 
 
 class WatchHandle:
@@ -98,8 +103,17 @@ class _Lease:
 
 class ClusterStore:
     def __init__(self):
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+
         self._lock = threading.RLock()
         self._rv = 0
+        # commit-time event stamping rides the freshness toggle with
+        # the rest of the SLI layer: the ``freshab`` on/off A/B (and
+        # ``KTPU_FRESHNESS=off``) must shed the stamping cost too, not
+        # just the downstream observation
+        self._freshness = freshness_metrics()
         self._pods: Dict[str, Pod] = {}           # "ns/name" -> Pod
         self._nodes: Dict[str, Node] = {}
         self._services: Dict[str, Service] = {}
@@ -174,6 +188,8 @@ class ClusterStore:
 
     def _dispatch(self, event: Event) -> None:
         self._bump_kind(event.kind)
+        if not event.ts and self._freshness.enabled:
+            event.ts = time.time()
         for w in list(self._watches):
             w.fn(event)
 
@@ -184,8 +200,16 @@ class ClusterStore:
         watchers see the same events one by one."""
         if not events:
             return
-        for e in events:
-            self._bump_kind(e.kind)
+        # commit-time stamp, once per batch (the freshness SLI anchor)
+        if self._freshness.enabled:
+            now = time.time()
+            for e in events:
+                self._bump_kind(e.kind)
+                if not e.ts:
+                    e.ts = now
+        else:
+            for e in events:
+                self._bump_kind(e.kind)
         for w in list(self._watches):
             if w.batch_fn is not None:
                 w.batch_fn(events)

@@ -271,6 +271,27 @@ class RestClusterClient:
 
         fabric_metrics().client_retries_total.inc(verb, reason)
 
+    @staticmethod
+    def _observe_delivery(kind: str, events: List[Event]) -> None:
+        """Freshness SLI: commit → decode latency for a decoded watch
+        batch. One ``observe_many`` per batch (one histogram lock
+        round-trip, not one per event); stamp-less events (legacy
+        peers, replay synthetics) are skipped."""
+        try:
+            from kubernetes_tpu.metrics.freshness_metrics import (
+                freshness_metrics,
+            )
+
+            fm = freshness_metrics()
+            if not fm.enabled:
+                return
+            now = time.time()
+            lags = [max(0.0, now - e.ts) for e in events if e.ts]
+            if lags:
+                fm.watch_delivery_seconds.observe_many(lags, kind)
+        except Exception:  # noqa: BLE001 — SLIs must never break watches
+            pass
+
     def _request(self, method: str, path: str, payload: Any = None,
                  charge: float = 1.0, body_binary: Optional[bool] = None
                  ) -> Tuple[int, Any]:
@@ -837,14 +858,20 @@ class RestClusterClient:
                         return
                     # a coalesced chunk carries per-event pickles
                     # (encoded once server-side, shared across
-                    # watchers); decode each into the same Event shape
+                    # watchers); decode each into the same Event shape.
+                    # The 4th element is the store-commit timestamp
+                    # (freshness SLI); legacy 3-tuples decode with no
+                    # stamp.
                     try:
                         events = []
                         for item in batch:
                             if isinstance(item, (bytes, bytearray)):
                                 item = codec.decode(item)
-                            t, obj, old = item
-                            events.append(Event(t, kind, obj, old))
+                            if len(item) == 4:
+                                t, obj, old, ts = item
+                            else:
+                                (t, obj, old), ts = item, 0.0
+                            events.append(Event(t, kind, obj, old, ts))
                     except Exception:  # noqa: BLE001 — torn event
                         return
                 else:
@@ -860,7 +887,9 @@ class RestClusterClient:
                         # Scoped to PARSING only: a consumer error in
                         # deliver() must surface, not loop forever.
                         return
-                    events = [Event(msg["type"], kind, obj)]
+                    events = [Event(msg["type"], kind, obj,
+                                    ts=float(msg.get("commitTs") or 0.0))]
+                self._observe_delivery(kind, events)
                 deliver(events)
         finally:
             try:

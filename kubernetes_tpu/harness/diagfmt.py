@@ -90,6 +90,31 @@ def max_cycle_phase(max_cycle: dict) -> str:
     return max(phases, key=phases.get) if any(phases.values()) else "none"
 
 
+def format_slo(evaluation: dict) -> str:
+    """The ``slo[...]`` segment from an ``SLOEngine.evaluate()`` dict,
+    emitted ONLY when an objective is violated (mirrors the ``apf``
+    segment's quiet-row convention — a green row prints nothing).
+    Names every violated SLO and carries the worst offender's burn
+    rates so a red row is attributable from the line alone."""
+    slos = (evaluation or {}).get("slos") or {}
+    bad = {n: s for n, s in slos.items() if s.get("violated")}
+    if not bad:
+        return ""
+    worst_name = max(bad, key=lambda n: bad[n].get("burn_fast", 0.0))
+    worst = bad[worst_name]
+    parts = [
+        "violated=" + ",".join(sorted(bad)),
+        f"worst={worst_name}",
+        f"burn_fast={worst.get('burn_fast', 0.0):.1f}",
+        f"burn_slow={worst.get('burn_slow', 0.0):.1f}",
+        f"budget={worst.get('budget_remaining_pct', 0.0):.1f}%",
+    ]
+    alerting = sorted(n for n, s in bad.items() if s.get("alerting"))
+    if alerting:
+        parts.append("alerting=" + ",".join(alerting))
+    return "slo[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy
@@ -144,7 +169,7 @@ def parse_diag(line: str) -> Optional[dict]:
     the line is not a diag line. Keys (all optional): ``phases``
     (name → total_s/count/p99_ms), ``session``, ``chunk``,
     ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
-    ``autoscaler``, ``apf``, ``e2e_p99_ms``, ``e2e_buckets``
+    ``autoscaler``, ``apf``, ``slo``, ``e2e_p99_ms``, ``e2e_buckets``
     (upper-edge str → count). Handles both the current diagfmt output
     and the legacy hand-rolled format in committed BENCH_r* tails."""
     marker = "diag:"

@@ -92,6 +92,10 @@ class BenchmarkResult:
     # pad waste, max-cycle attribution) — bench.py attaches this to the
     # row JSON as the ``telemetry`` sub-object
     telemetry: Dict[str, object] = field(default_factory=dict)
+    # freshness SLI summary (watch-delivery p99, max snapshot staleness,
+    # SLO verdicts) — bench.py attaches this to the row JSON as the
+    # ``freshness`` sub-object
+    freshness: Dict[str, object] = field(default_factory=dict)
 
     def data_items(self) -> dict:
         """DataItems JSON shape (util.go:101-129)."""
@@ -115,6 +119,58 @@ class BenchmarkResult:
                 },
             ],
         }
+
+
+def reset_sli_window() -> None:
+    """Fresh freshness-SLI + SLO evaluation window per bench row
+    (mirrors the tracer clear and the devprof reset): each row's
+    ``freshness`` sub-object and SLO verdicts must describe THAT row,
+    not the process lifetime. Shared by the store-direct and REST
+    harnesses."""
+    try:
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        freshness_metrics().reset_window()
+        get_slo_engine().reset(extra_registries=[])
+    except Exception:  # noqa: BLE001 — SLIs must never fail a row
+        pass
+
+
+def attach_slo_baseline(sched) -> None:
+    """Point the SLO engine at this row's scheduler registry (the e2e
+    latency SLI lives there) and take the baseline sample — window
+    deltas for cumulative series (the folded APF counters) start from
+    here, so a quiet row can never inherit an earlier row's bad
+    events."""
+    try:
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        engine = get_slo_engine()
+        if engine.enabled:
+            engine.add_registry(sched.metrics.registry)
+            engine.tick()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def collect_freshness(devprof_summary=None) -> dict:
+    """The row's ``freshness`` sub-object: watch-delivery p99, max
+    snapshot staleness, and the final SLO verdicts for the window
+    opened by ``reset_sli_window``."""
+    try:
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_row_summary,
+        )
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        engine = get_slo_engine()
+        slos = engine.evaluate().get("slos") if engine.enabled else None
+        return freshness_row_summary(devprof_summary, slos)
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 def run_workload(
@@ -144,6 +200,7 @@ def run_workload(
     # that must describe THIS workload
     get_tracer().clear()
     get_devprof().reset(workload=name)
+    reset_sli_window()
     store = ClusterStore()
     gates = FeatureGates({"TPUBatchScheduler": use_batch})
     # gang scheduling is first-class in this harness (BASELINE config #5):
@@ -156,6 +213,7 @@ def run_workload(
         backend=backend_factory() if backend_factory else None,
         adaptive_chunk=adaptive_chunk,
     ) if use_batch else None
+    attach_slo_baseline(sched)
     sched.start()
 
     def pump_until_quiescent(deadline: float, wait_names=None) -> None:
@@ -292,6 +350,7 @@ def run_workload(
         "Perc99": e2e.quantile(0.99, "scheduled") * 1000,
     }
     dp = get_devprof()
+    telemetry = dp.summary() if dp.enabled else {}
     return BenchmarkResult(
         name=name,
         total_pods=created_pods,
@@ -300,7 +359,8 @@ def run_workload(
         pods_per_second=(measured_pods / duration) if duration > 0 else 0.0,
         throughput=collector.summary() if collector else {},
         metrics=metrics,
-        telemetry=dp.summary() if dp.enabled else {},
+        telemetry=telemetry,
+        freshness=collect_freshness(telemetry),
     )
 
 

@@ -306,9 +306,15 @@ def run_workload_rest(
     # ``telemetry`` sub-object; the scheduler — and so the solver —
     # runs in THIS process, only the apiserver/creators are children)
     get_tracer().clear()
+    from kubernetes_tpu.harness.perf import (
+        attach_slo_baseline,
+        collect_freshness,
+        reset_sli_window,
+    )
     from kubernetes_tpu.observability.devprof import get_devprof
 
     get_devprof().reset(workload=f"{name}/rest")
+    reset_sli_window()
     ctx = mp.get_context("spawn")
     wal_dir = tempfile.mkdtemp(prefix="ktpu-wal-") if wal else None
 
@@ -339,6 +345,15 @@ def run_workload_rest(
                              event_client=event_client)
     bs = attach_batch_scheduler(sched, max_batch=max_batch) \
         if use_batch else None
+    attach_slo_baseline(sched)
+    # live SLO evaluation while the fabric runs: the engine's tick
+    # thread samples the SLIs so a mid-run burn-rate breach fires its
+    # flight-recorder dump DURING the run, not at the postmortem
+    from kubernetes_tpu.observability.slo import get_slo_engine
+
+    slo_engine = get_slo_engine()
+    if slo_engine.enabled:
+        slo_engine.start(interval_s=1.0)
     sched.start()
 
     def bound_count() -> int:
@@ -403,6 +418,7 @@ def run_workload_rest(
     measure_start = 0.0
     expected_bound = 0
     created_pods = 0
+    federation_instances: List[str] = []
     stop_companions: Optional[Callable[[], None]] = None
     ops = make_workload(name, nodes=nodes, init_pods=init_pods,
                         measure_pods=measure_pods)
@@ -463,17 +479,44 @@ def run_workload_rest(
         if stop_companions is not None:
             stop_companions()
             stop_companions = None
-        # mirror the server's APF totals into this process before the
-        # result hook runs, so bench.py's diag line can print the apf
-        # segment (the server lives in a child process)
+        # cross-process metrics, the generic path: scrape the child
+        # apiserver's /metrics, parse the exposition, and merge EVERY
+        # family into the federation under an ``instance`` label —
+        # fold=True also folds the child's counters (the APF rejections
+        # among them) into this process's same-name counters by
+        # cumulative delta, so bench.py's diag segments keep reading
+        # their usual local series with no per-family absorb mapping.
+        # The /debug/apf JSON snapshot is fetched ONLY for the diag
+        # line's queue-wait/peak-seat numbers (server-side histogram
+        # state a counter fold cannot reconstruct).
         apf_snapshot = None
+        from kubernetes_tpu.metrics import default_registry
+        from kubernetes_tpu.metrics.apf_metrics import apf_metrics
+        from kubernetes_tpu.metrics.federation import metrics_federation
+
+        # the fold lands only on counters THIS process has declared —
+        # instantiate the APF families before scraping (the legacy
+        # absorb path did this implicitly)
+        apfm = apf_metrics()
+        fed = metrics_federation()
+        # each row spawns a FRESH apiserver under the same instance
+        # name: forget the previous child's series AND fold baselines
+        # so this child's totals fold in full (not as a bogus delta)
+        fed.forget_instance("apiserver")
+        fed.forget_instance("scheduler")
+        fed.scrape(url, instance="apiserver", token=SCHEDULER_TOKEN,
+                   fold=True)
+        # the parent is a component too: mirror its registry through
+        # the same render→parse path so the merged view is complete —
+        # independently of the child scrape, which is best-effort (a
+        # dying child must not erase the parent from the merged view)
+        fed.absorb_registry(default_registry(), instance="scheduler")
+        federation_instances = sorted(fed.instances())
         try:
             code, snap = client._request("GET", "/debug/apf")
             if code == 200 and isinstance(snap, dict):
                 apf_snapshot = snap
-                from kubernetes_tpu.metrics.apf_metrics import apf_metrics
-
-                apf_metrics().absorb_snapshot(snap)
+                apfm.last_snapshot = snap
         except Exception:  # noqa: BLE001 — introspection is best-effort
             pass
         if result_hook is not None:
@@ -486,6 +529,8 @@ def run_workload_rest(
     finally:
         if collector:
             collector.stop()
+        if slo_engine.enabled:
+            slo_engine.stop()
         sched.stop()
 
     # cross-check against the apiserver's own truth (and WAL durability)
@@ -508,12 +553,14 @@ def run_workload_rest(
         "wal_entries": server_counts["wal_entries"],
         "scheduler_bound": bound_count(),
         "apf": apf_snapshot,
+        "federation_instances": federation_instances,
     }
     if server_counts["pods_bound"] < expected_bound:
         raise RuntimeError(
             f"store truth disagrees: server bound "
             f"{server_counts['pods_bound']} < expected {expected_bound}")
     dp = get_devprof()
+    telemetry = dp.summary() if dp.enabled else {}
     return BenchmarkResult(
         name=f"{name}/rest",
         total_pods=created_pods,
@@ -522,5 +569,6 @@ def run_workload_rest(
         pods_per_second=(measured / duration) if duration > 0 else 0.0,
         throughput=collector.summary() if collector else {},
         metrics=metrics,
-        telemetry=dp.summary() if dp.enabled else {},
+        telemetry=telemetry,
+        freshness=collect_freshness(telemetry),
     )

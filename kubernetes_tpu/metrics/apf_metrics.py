@@ -105,24 +105,52 @@ class ApfMetrics:
     # reads them from here for remote-server rows
     last_snapshot: Optional[Dict] = None
 
-    def absorb_snapshot(self, snap: Dict) -> None:
-        """Fold a remote server's /debug/apf snapshot totals into this
-        process's counters (cumulative per server lifetime; the bench
-        harness calls this once per row, after the run)."""
+    def absorb_snapshot(self, snap: Dict,
+                        instance: Optional[str] = None) -> None:
+        """Thin compat wrapper: fold a remote server's /debug/apf
+        snapshot totals into this process's counters. Since the SLI
+        layer landed, the generic path is ``metrics/federation.py``
+        (scrape the child's /metrics, merge + fold EVERY counter family
+        — no per-family mapping); this wrapper reshapes the legacy JSON
+        snapshot into counter samples and routes them through the
+        federation's delta ledger. With the default ``instance=None``
+        it keeps the EXACT legacy contract — each call is a different
+        server lifetime, so the full totals fold in (the ledger is
+        forgotten first; two calls with the same totals double, as the
+        old per-family inc did). Pass a stable ``instance`` to share
+        the delta ledger with the scrape path instead, so repeated
+        absorbs of the same still-running server never double-count."""
+        from kubernetes_tpu.metrics.federation import metrics_federation
+
         self.last_snapshot = snap
+        one_shot = instance is None
+        if one_shot:
+            instance = "debug-apf"
+        rejected: Dict[tuple, float] = {}
+        dispatched: Dict[tuple, float] = {}
+        seats: Dict[tuple, float] = {}
         for name, lv in (snap.get("levels") or {}).items():
             for reason, n in (lv.get("rejected") or {}).items():
                 if n:
-                    self.rejected_requests_total.inc(name, reason,
-                                                     amount=n)
+                    rejected[(name, reason)] = float(n)
             if lv.get("dispatched_total"):
-                self.dispatched_requests_total.inc(
-                    name, amount=lv["dispatched_total"])
+                dispatched[(name,)] = float(lv["dispatched_total"])
             if lv.get("seats_dispatched_total"):
-                self.seats_dispatched_total.inc(
-                    name, amount=lv["seats_dispatched_total"])
+                seats[(name,)] = float(lv["seats_dispatched_total"])
             if lv.get("capacity"):
                 self.request_concurrency_limit.set(lv["capacity"], name)
+        fed = metrics_federation()
+        if one_shot:
+            fed.forget_instance(instance)
+        fed.fold_samples("apf_rejected_requests_total",
+                         ("priority_level", "reason"), rejected, instance,
+                         into=self.registry)
+        fed.fold_samples("apf_dispatched_requests_total",
+                         ("priority_level",), dispatched, instance,
+                         into=self.registry)
+        fed.fold_samples("apf_seats_dispatched_total",
+                         ("priority_level",), seats, instance,
+                         into=self.registry)
 
 
 _default: Optional[ApfMetrics] = None

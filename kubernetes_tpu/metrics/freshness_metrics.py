@@ -1,0 +1,154 @@
+"""Cluster SLI metrics: watch/informer freshness.
+
+The fabric that feeds the scheduler was blind about its own staleness:
+watch events ride a coalescing flush window plus queues with zero
+latency accounting, and nothing measured how old the snapshot a solve
+cycle runs against actually is. These series close that gap — they are
+the SLIs the SLO engine (``observability/slo.py``) evaluates live:
+
+- ``watch_delivery_seconds{kind}`` — store-commit → client decode,
+  end-to-end across the wire: includes the server's coalescing flush
+  window, the frame queue, chunked-transfer delivery, and the client's
+  batch decode. Events are stamped ONCE at store dispatch time
+  (``Event.ts``) and the stamp rides the cached per-event encoding, so
+  N watchers measure real per-watcher delivery without re-stamping.
+- ``informer_lag_seconds{kind}`` — store-commit → informer handler
+  dispatch for ``SharedInformerFactory`` consumers (the controllers'
+  ingestion path): delivery PLUS the informer's delta-FIFO backlog.
+- ``informer_queue_depth`` — the factory FIFO's drain-time backlog
+  (how many events one dispatch wakeup had to absorb).
+- ``snapshot_staleness_seconds`` — per solve cycle, the age of the
+  newest event reflected in the planes the solver encoded (recorded
+  into the devprof cycle record and the tracer, so staleness is
+  attributable per cycle and so per pod).
+
+Hot-path budget matches the tracer/devprof bar: stamping is one
+``time.time()`` per DISPATCH BATCH, observation is one
+``observe_many`` per decoded batch — measured by the interleaved
+on/off A/B (``bench.py --config freshab``). ``KTPU_FRESHNESS=off``
+(or ``configure(enabled=False)``) disables BOTH the store-commit
+stamping and the observation, so the A/B's off arm sheds the whole
+layer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from kubernetes_tpu.metrics.fabric_metrics import _gauge, _histogram
+from kubernetes_tpu.metrics.registry import MetricsRegistry
+
+# watch delivery / informer lag are short-fuse series: the buckets
+# resolve the 2ms flush window at the bottom and a stalled watch at the
+# top (a 10s+ delivery is an outage, not a latency)
+_DELIVERY_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0)
+_STALENESS_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                      2.0, 5.0, 10.0, 30.0)
+
+
+class FreshnessMetrics:
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            from kubernetes_tpu.metrics import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self.enabled = os.environ.get("KTPU_FRESHNESS", "") != "off"
+        self.watch_delivery_seconds = _histogram(
+            registry, "watch_delivery_seconds",
+            "Watch-event propagation latency, store commit to client "
+            "decode (includes the server's coalescing flush window and "
+            "the frame queue), by kind",
+            ("kind",), buckets=_DELIVERY_BUCKETS,
+        )
+        self.informer_lag_seconds = _histogram(
+            registry, "informer_lag_seconds",
+            "Store commit to informer handler dispatch, by kind "
+            "(delivery plus the shared informer factory's delta-FIFO "
+            "backlog)",
+            ("kind",), buckets=_DELIVERY_BUCKETS,
+        )
+        self.informer_queue_depth = _gauge(
+            registry, "informer_queue_depth",
+            "Events drained from the shared informer factory's delta "
+            "FIFO by the last dispatch wakeup (backlog per wakeup)",
+        )
+        self.snapshot_staleness_seconds = _histogram(
+            registry, "snapshot_staleness_seconds",
+            "Per solve cycle: age of the newest watch event reflected "
+            "in the encoded planes the solver ran against",
+            buckets=_STALENESS_BUCKETS,
+        )
+
+    def configure(self, enabled: Optional[bool] = None) -> None:
+        if enabled is not None:
+            self.enabled = enabled
+
+    def reset_window(self) -> None:
+        """Fresh per-row window (mirrors the tracer's per-row clear and
+        the apf queue-wait clear): each bench row's ``freshness``
+        sub-object must describe THAT row, not the process lifetime."""
+        self.watch_delivery_seconds.clear()
+        self.informer_lag_seconds.clear()
+        self.snapshot_staleness_seconds.clear()
+
+
+_default: Optional[FreshnessMetrics] = None
+
+
+def freshness_metrics() -> FreshnessMetrics:
+    """Process-wide FreshnessMetrics bound to the default registry
+    (the legacyregistry pattern the other metric modules follow)."""
+    global _default
+    if _default is None:
+        _default = FreshnessMetrics()
+    return _default
+
+
+def freshness_row_summary(devprof_summary: Optional[dict] = None,
+                          slo_statuses: Optional[dict] = None) -> dict:
+    """The ``freshness`` sub-object every bench row carries: watch
+    delivery p99, max snapshot staleness, and the SLO verdicts — the
+    SLI layer's numbers in the driver-committed artifact."""
+    from kubernetes_tpu.metrics.registry import quantile_from_counts
+
+    fm = freshness_metrics()
+    out: dict = {}
+    wd = fm.watch_delivery_seconds
+    per_kind = {}
+    events = 0
+    # overall p99 interpolates over the bucket counts SUMMED across
+    # kinds — the max of per-kind p99s would let one slow event in a
+    # 4-event kind misreport a row that delivered 30k fast Pod events
+    agg = [0] * (len(wd.buckets) + 1)
+    for labels, counts, _sum, count in wd.collect_full():
+        if not count:
+            continue
+        kind = labels[0] if labels else ""
+        per_kind[kind] = round(
+            quantile_from_counts(counts, wd.buckets, 0.99) * 1000, 2)
+        for i, c in enumerate(counts):
+            agg[i] += c
+        events += count
+    if events:
+        out["watch_delivery_p99_ms"] = round(
+            quantile_from_counts(agg, wd.buckets, 0.99) * 1000, 2)
+        out["watch_delivery_events"] = events
+        out["watch_delivery_p99_ms_by_kind"] = per_kind
+    ss = fm.snapshot_staleness_seconds
+    if ss.count():
+        out["snapshot_staleness_p99_ms"] = round(
+            ss.quantile(0.99) * 1000, 2)
+    if devprof_summary and devprof_summary.get("max_staleness_s") \
+            is not None:
+        out["max_snapshot_staleness_ms"] = round(
+            devprof_summary["max_staleness_s"] * 1000, 2)
+    if slo_statuses:
+        out["slo"] = {
+            name: ("violated" if s.get("violated") else "ok")
+            for name, s in sorted(slo_statuses.items())
+            if s.get("events_fast") or s.get("violated")
+        }
+    return out

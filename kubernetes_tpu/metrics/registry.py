@@ -152,26 +152,11 @@ class Histogram(_Metric):
         edges to the higher edge — a 26s stall read as exactly "50s"
         with no shape information (VERDICT r4 weak #5)."""
         with self._lock:
-            key = tuple(label_values)
-            series = self._series.get(key)
-            counts = series[0] if series else None
-            total = series[2] if series else 0
-        if not counts or total == 0:
+            series = self._series.get(tuple(label_values))
+            counts = list(series[0]) if series else None
+        if not counts:
             return 0.0
-        target = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            prev_cum = cum
-            cum += c
-            if cum >= target:
-                if i >= len(self.buckets):
-                    return self.buckets[-1]   # +Inf bucket: clamp
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i]
-                if c == 0:
-                    return hi
-                return lo + (hi - lo) * (target - prev_cum) / c
-        return self.buckets[-1]
+        return quantile_from_counts(counts, self.buckets, q)
 
     def collect(self):
         with self._lock:
@@ -179,6 +164,42 @@ class Histogram(_Metric):
                 (self.name, k, series[1], series[2])
                 for k, series in self._series.items()
             ]
+
+    def collect_full(self):
+        """Per-series (labels, bucket_counts, sum, count) — the bucket
+        table the text exposition (and so the federation parser) reads.
+        ``collect`` keeps its historical sum/count-only shape for the
+        diag consumers."""
+        with self._lock:
+            return [
+                (k, list(series[0]), series[1], series[2])
+                for k, series in self._series.items()
+            ]
+
+
+def quantile_from_counts(counts: Sequence[int], edges: Sequence[float],
+                         q: float) -> float:
+    """Bucket-interpolated quantile over a raw count vector (the
+    ``Histogram.quantile`` math, reusable for aggregated or windowed
+    delta vectors — the SLO engine and the freshness row summary both
+    quantile counts that no single live series holds)."""
+    total = sum(counts)
+    if not counts or total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum >= target:
+            if i >= len(edges):
+                return edges[-1] if edges else 0.0
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[i]
+            if c == 0:
+                return hi
+            return lo + (hi - lo) * (target - prev_cum) / c
+    return edges[-1] if edges else 0.0
 
 
 class MetricsRegistry:
@@ -195,27 +216,58 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
-    def expose(self) -> str:
-        """Prometheus text exposition."""
-        lines = []
+    def all_metrics(self) -> List[_Metric]:
         with self._lock:
-            metrics = list(self._metrics.values())
-        for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
+            return list(self._metrics.values())
+
+    def expose(self) -> str:
+        """Prometheus text exposition. Histograms render the FULL
+        standard shape — cumulative ``_bucket{le="..."}`` lines
+        (``+Inf`` included) plus ``_sum``/``_count`` — so a remote
+        scraper (metrics/federation.py) can reconstruct the series
+        exactly; parse(expose(x)) ≡ x is CI-enforced by the metrics
+        lint."""
+        lines = []
+        for m in self.all_metrics():
+            lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.TYPE}")
             if isinstance(m, Histogram):
-                for name, labels, total_sum, total in m.collect():
+                edges = [_fmt_float(b) for b in m.buckets] + ["+Inf"]
+                for labels, counts, total_sum, total in m.collect_full():
+                    cum = 0
+                    for edge, c in zip(edges, counts):
+                        cum += c
+                        label_str = _fmt_labels(
+                            m.label_names + ("le",), labels + (edge,))
+                        lines.append(f"{m.name}_bucket{label_str} {cum}")
                     label_str = _fmt_labels(m.label_names, labels)
-                    lines.append(f"{name}_sum{label_str} {total_sum}")
-                    lines.append(f"{name}_count{label_str} {total}")
+                    lines.append(f"{m.name}_sum{label_str} {total_sum}")
+                    lines.append(f"{m.name}_count{label_str} {total}")
             else:
                 for name, labels, value in m.collect():
-                    lines.append(f"{name}{_fmt_labels(m.label_names, labels)} {value}")
+                    lines.append(
+                        f"{name}{_fmt_labels(m.label_names, labels)} {value}")
         return "\n".join(lines) + "\n"
+
+
+def _fmt_float(v: float) -> str:
+    """Bucket-edge rendering: integral edges drop the trailing .0 the
+    way Prometheus clients do (le="1" not le="1.0")."""
+    return str(int(v)) if float(v) == int(v) else repr(float(v))
+
+
+def _esc_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _fmt_labels(names, values) -> str:
     if not values:
         return ""
-    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    pairs = ",".join(
+        f'{n}="{_esc_label(v)}"' for n, v in zip(names, values))
     return "{" + pairs + "}"

@@ -14,4 +14,20 @@ from kubernetes_tpu.observability.tracer import (
     set_tracer,
 )
 
-__all__ = ["Span", "Tracer", "get_tracer", "set_tracer"]
+__all__ = ["Span", "Tracer", "get_tracer", "set_tracer",
+           "get_slo_engine", "set_slo_engine"]
+
+
+def get_slo_engine():
+    """Lazy re-export (slo.py imports metrics modules; keeping the
+    import deferred keeps ``observability`` cheap for the hot paths
+    that only need the tracer)."""
+    from kubernetes_tpu.observability.slo import get_slo_engine as _g
+
+    return _g()
+
+
+def set_slo_engine(engine):
+    from kubernetes_tpu.observability.slo import set_slo_engine as _s
+
+    return _s(engine)

@@ -173,6 +173,14 @@ class DevProfiler:
         if rec is not None and not rec.done:
             rec[name + "_s"] += seconds
 
+    def note_staleness(self, rec: Optional[_Cycle],
+                       seconds: float) -> None:
+        """Record the snapshot-staleness SLI on an open cycle record
+        (age of the newest watch event reflected in the planes this
+        cycle solves against) — set once per cycle by the session."""
+        if rec is not None and not rec.done:
+            rec["staleness_s"] = round(float(seconds), 6)
+
     def add_bytes(self, direction: str, n: int) -> None:
         """Account a host↔device transfer (direction: h2d | d2h),
         computed by the caller from the encoded array shapes/dtypes —
@@ -386,6 +394,7 @@ class DevProfiler:
         real = padded = 0
         slowest = None
         slowest_total = -1.0
+        max_staleness = None
         for r in recs:
             for k in tot:
                 tot[k] += r[k]
@@ -393,6 +402,10 @@ class DevProfiler:
             out["compile_s"] += r["compile_s"]
             out["h2d_bytes"] += r["h2d_bytes"]
             out["d2h_bytes"] += r["d2h_bytes"]
+            stale = r.get("staleness_s")
+            if stale is not None and (max_staleness is None
+                                      or stale > max_staleness):
+                max_staleness = stale
             real += r["real"]
             padded += r["pad"] if r["pad"] else r["real"]
             cycle_total = (r["encode_s"] + r["pack_s"] + r["dispatch_s"]
@@ -409,6 +422,11 @@ class DevProfiler:
                 tot["block_s"] / phase_total, 4)
         if padded > 0:
             out["pad_waste_pct"] = round(100.0 * (1.0 - real / padded), 2)
+        if max_staleness is not None:
+            # freshness SLI: the oldest snapshot any measured cycle
+            # solved against (bench rows surface it as
+            # freshness.max_snapshot_staleness_ms)
+            out["max_staleness_s"] = round(max_staleness, 4)
         if slowest is not None:
             out["max_cycle"] = {
                 "cycle": slowest["cycle"],

@@ -197,6 +197,13 @@ class SolverSession:
         # materializer for the LAST lazy solve's handle (None when the
         # result was returned eagerly, e.g. the rebuild path)
         self.last_materializer = None
+        # newest-applied-event anchor the LAST staleness sample was
+        # taken against: a retry cycle solving an UNCHANGED snapshot
+        # accrues no new staleness debt and must not be sampled (a
+        # quiet cluster — autoscale row waiting out node boot latency —
+        # would otherwise read as an ever-staler snapshot and
+        # false-flip the staleness SLO)
+        self._staleness_anchor = 0.0
         # telemetry: how often the incremental path was taken
         self.incremental_hits = 0
         self.rebuilds = 0
@@ -351,6 +358,8 @@ class SolverSession:
             rec = dp.begin_cycle(
                 cycle=self.trace_cycle, pad=pad, real=len(pods),
                 warming=warming) if dp.enabled else None
+            if not warming:
+                self._note_staleness(rec, dp)
             try:
                 t0 = time.monotonic()
                 pb = self._encoder.encode_pods_only(pods, pad)
@@ -404,6 +413,41 @@ class SolverSession:
         # the rebuild path always solves eagerly (rebuilds are rare and
         # the caller just committed any in-flight batch anyway)
         return self._rebuild_and_solve(pods, seq_before, pad)
+
+    def _note_staleness(self, rec, dp) -> None:
+        """Snapshot-staleness SLI, once per solve cycle: age of the
+        newest watch event reflected in the cache this encoding solves
+        against (``SchedulerCache.last_event_ts``, stamped at store
+        commit). Sampled only for cycles whose snapshot ADVANCED since
+        the previous sample — a backoff-retry cycle over an unchanged
+        snapshot (no events exist to reflect) is solving CURRENT truth,
+        and counting its ever-growing event age would false-flip the
+        staleness SLO during any event lull. Lands in the devprof cycle
+        record (→ the bench row's ``freshness`` sub-object), the
+        ``snapshot_staleness_seconds`` histogram (→ the SLO engine),
+        and a cycle-correlated tracer instant so staleness is
+        attributable per pod through the flight recorder."""
+        try:
+            ts = getattr(self.sched.cache, "last_event_ts", 0.0)
+            if not ts or ts == self._staleness_anchor:
+                return
+            self._staleness_anchor = ts
+            stale = max(0.0, time.time() - ts)
+            dp.note_staleness(rec, stale)
+            from kubernetes_tpu.metrics.freshness_metrics import (
+                freshness_metrics,
+            )
+
+            fm = freshness_metrics()
+            if fm.enabled:
+                fm.snapshot_staleness_seconds.observe(stale)
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.event("solve.staleness",
+                                 cycle=self.trace_cycle,
+                                 staleness_ms=round(stale * 1000, 2))
+        except Exception:  # noqa: BLE001 — SLIs must never break solves
+            pass
 
     def _timed_materializer(self, rec):
         """Wrap the backend's materialize so a lazy solve's
@@ -477,6 +521,8 @@ class SolverSession:
             cycle=self.trace_cycle, pad=pad or self.max_batch,
             real=len(pods), warming=self._warming,
             rebuild="full") if dp.enabled else None
+        if not self._warming:
+            self._note_staleness(rec, dp)
         try:
             return self._rebuild_and_solve_inner(
                 pods, seq_before, pad, dp, rec)

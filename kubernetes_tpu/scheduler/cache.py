@@ -109,6 +109,12 @@ class SchedulerCache:
         # path (chaos_nodes: mass deletion must force a re-encode, not
         # a spin of declines against ghost columns).
         self._node_set_seq = 0
+        # commit timestamp (Event.ts) of the NEWEST watch event the
+        # event handlers applied to this cache — the snapshot-staleness
+        # SLI's anchor: at solve time, staleness = now - last_event_ts.
+        # A bare float write/read (GIL-atomic) — no lock on the
+        # event-ingestion hot path.
+        self.last_event_ts = 0.0
         self._nodes: Dict[str, _NodeInfoListItem] = {}
         self._head: Optional[_NodeInfoListItem] = None
         self._node_tree = NodeTree()
@@ -201,6 +207,14 @@ class SchedulerCache:
         ``SolverSession.mirror_current``'s arithmetic fail."""
         with self._lock:
             self._mutation_seq += 1
+
+    def note_event_ts(self, ts: float) -> None:
+        """Advance the newest-applied-event commit timestamp (called by
+        the event handlers once per delivered batch; monotonic by
+        construction, but a relist can replay out of order — keep the
+        max)."""
+        if ts > self.last_event_ts:
+            self.last_event_ts = ts
 
     # ------------------------------------------------------------------
     # pods

@@ -43,6 +43,11 @@ class EventHandlers:
         matter at throughput scale are bind transitions (commit) and
         unassigned adds (admission)."""
         sched = self.sched
+        # snapshot-staleness anchor: one max() over the batch, recorded
+        # only AFTER the whole batch is applied (below) — "newest event
+        # reflected" must never run ahead of what the cache holds
+        newest = max((e.ts for e in events if getattr(e, "ts", 0.0)),
+                     default=0.0)
         bind_run = []    # Pods newly assigned (MODIFIED, old unassigned)
         add_run = []     # unassigned schedulable ADDED pods
         delete_run = []  # assigned DELETED pods (mass preemption)
@@ -119,10 +124,18 @@ class EventHandlers:
                     run_for(delete_run).append(pod)
                     continue
             flush()
-            self.handle(event)
+            self._handle_one(event)
         flush()
+        if newest:
+            sched.cache.note_event_ts(newest)
 
     def handle(self, event: Event) -> None:
+        self._handle_one(event)
+        ts = getattr(event, "ts", 0.0)
+        if ts:
+            self.sched.cache.note_event_ts(ts)
+
+    def _handle_one(self, event: Event) -> None:
         kind = event.kind
         if kind == "Pod":
             self._handle_pod(event)

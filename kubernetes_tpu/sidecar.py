@@ -974,4 +974,14 @@ def attach_batch_scheduler(
                            validate=validate, backend=backend,
                            adaptive_chunk=adaptive_chunk)
     sched.batch_scheduler = bs
+    try:
+        # the schedule-latency SLO reads the e2e histogram from THIS
+        # scheduler's registry — point the SLO engine at it so every
+        # batch-path consumer (bench, chaos, qos harnesses) gets live
+        # evaluation without per-harness wiring
+        from kubernetes_tpu.observability.slo import get_slo_engine
+
+        get_slo_engine().add_registry(sched.metrics.registry)
+    except Exception:  # noqa: BLE001 — SLO wiring must never block attach
+        pass
     return bs

@@ -201,8 +201,11 @@ class TestCoalescedWatchFraming:
         b1 = _cached_event_bytes(event)
         b2 = _cached_event_bytes(event)
         assert b1 is b2   # second watcher reuses the first encode
-        t, obj, old = codec.decode(b1)
+        t, obj, old, ts = codec.decode(b1)
         assert t == "ADDED" and obj.metadata.name == "c1" and old is None
+        # the commit stamp rides the cached encoding (freshness SLI);
+        # an un-dispatched event carries the 0.0 sentinel
+        assert ts == 0.0
 
     def test_frame_split_mid_event_reads_as_torn(self):
         events = [codec.encode(("ADDED", _pod(f"t{i}"), None))

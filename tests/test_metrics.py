@@ -22,6 +22,72 @@ class TestHistogramBulk:
         assert h.count() == 0
 
 
+class TestHistogramEdgeCases:
+    """``bucket_counts``/``quantile`` corners — the diag e2e segment
+    and the SLO evaluator's windowed-delta math both sit on these
+    accessors, so the degenerate shapes must be pinned down."""
+
+    BUCKETS = (0.1, 1.0, 5.0)
+
+    def _h(self) -> Histogram:
+        return Histogram("h_edge", "", buckets=self.BUCKETS)
+
+    def test_empty_histogram(self):
+        h = self._h()
+        assert h.bucket_counts() == []
+        assert h.count() == 0 and h.sum() == 0.0
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == 0.0
+        # an unobserved labelled series is just as empty
+        hl = Histogram("h_edge_l", "", ("k",), buckets=self.BUCKETS)
+        assert hl.bucket_counts("never") == []
+        assert hl.quantile(0.99, "never") == 0.0
+
+    def test_single_sample(self):
+        h = self._h()
+        h.observe(0.5)
+        assert h.bucket_counts() == [0, 1, 0, 0]
+        assert h.count() == 1 and h.sum() == 0.5
+        # every quantile interpolates inside the one occupied bucket
+        # (0.1, 1.0]: q of a single sample spans the bucket linearly
+        assert h.quantile(0.5) == 0.1 + 0.9 * 0.5
+        assert h.quantile(1.0) == 1.0
+
+    def test_everything_in_overflow_bucket(self):
+        h = self._h()
+        h.observe_many([9.0, 50.0, 1e6])
+        assert h.bucket_counts() == [0, 0, 0, 3]
+        # +Inf has no upper edge to interpolate toward: clamp to the
+        # largest finite edge (prometheus histogram_quantile semantics)
+        for q in (0.5, 0.99):
+            assert h.quantile(q) == self.BUCKETS[-1]
+
+    def test_exact_bucket_boundary_counts_le(self):
+        h = self._h()
+        h.observe(1.0)   # exactly on an edge: le="1.0" bucket, not 5.0
+        assert h.bucket_counts() == [0, 1, 0, 0]
+
+    def test_interpolation_at_exact_boundaries(self):
+        h = self._h()
+        h.observe_many([1.0] * 4)
+        # q=1.0 lands exactly on the occupied bucket's upper edge
+        assert h.quantile(1.0) == 1.0
+        # q=0.5 interpolates halfway through (0.1, 1.0]
+        assert h.quantile(0.5) == 0.1 + 0.9 * 0.5
+        # with the first bucket occupied, interpolation anchors at 0.0
+        h2 = self._h()
+        h2.observe_many([0.05] * 2)
+        assert h2.quantile(1.0) == 0.1
+        assert h2.quantile(0.5) == 0.05
+
+    def test_quantile_skips_empty_leading_buckets(self):
+        h = self._h()
+        h.observe_many([3.0] * 10)      # only the (1.0, 5.0] bucket
+        assert h.bucket_counts() == [0, 0, 10, 0]
+        assert h.quantile(0.0001) >= 1.0
+        assert h.quantile(0.99) <= 5.0
+
+
 class TestFabricMetrics:
     def test_retry_fault_degraded_counters_register_and_expose(self):
         from kubernetes_tpu.metrics.fabric_metrics import FabricMetrics

@@ -353,6 +353,15 @@ class TestRestPerfHarness:
         # WAL carried every mutation (nodes + creates + binds + ...)
         assert result.metrics["wal_entries"] >= 20 + 150 * 2
         assert result.pods_per_second > 0
+        # freshness SLIs measured through REAL child processes: the
+        # row's sub-object carries the watch-delivery p99 (commit →
+        # decode over the wire) and the SLO verdicts
+        assert result.freshness.get("watch_delivery_p99_ms", 0) > 0
+        assert result.freshness["watch_delivery_events"] > 0
+        assert "slo" in result.freshness
+        # metrics federation merged ≥ 2 spawned components' registries
+        # (instance label cardinality is the acceptance bar)
+        assert len(result.metrics["federation_instances"]) >= 2
 
     @pytest.mark.slow
     def test_harness_generalizes_beyond_basic(self):

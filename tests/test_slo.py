@@ -1,0 +1,825 @@
+"""Cluster SLI layer (PR 8): watch/informer freshness instrumentation,
+multi-process metrics federation, and live SLO evaluation with
+burn-rate alerting.
+
+Covers, in rough dependency order:
+
+- Prometheus text exposition round-trip — ``parse(expose(x)) ≡ x`` over
+  the ENTIRE live registry (the metrics lint: exposition drift can
+  never silently break the federation scraper);
+- ``MetricsFederation``: instance-labelled merge, last-scrape-wins,
+  counter folding by cumulative delta with reset detection, and the
+  ``absorb_snapshot`` compat wrapper sharing ONE delta ledger with the
+  scrape path;
+- freshness SLIs: store-commit stamping (``Event.ts``), end-to-end
+  watch delivery over a real APIServer, informer lag, and the
+  scheduler cache's newest-applied-event anchor;
+- the ``SLOEngine``: rolling-window good/bad accounting, multi-window
+  burn-rate alerting, metric mirroring, flight-recorder dump on breach;
+- ``/debug/slo`` (admin envelope) and ``tools/slo_report.py``;
+- the FaultGate acceptance: an injected watch stall flips the
+  freshness SLOs to violated (alert + dump fire) while a clean run
+  stays green.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.rest import APIServer
+from kubernetes_tpu.apiserver.store import ClusterStore, Event
+from kubernetes_tpu.client.restcluster import RestClusterClient
+from kubernetes_tpu.metrics.federation import (
+    ExpositionError,
+    MetricsFederation,
+    families_from_registry,
+    lint_family,
+    metrics_federation,
+    parse_exposition,
+)
+from kubernetes_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from kubernetes_tpu.observability.slo import SLODef, SLOEngine
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _serve(**kwargs):
+    store = ClusterStore()
+    server = APIServer(store=store, **kwargs).start()
+    return store, server
+
+
+def _sample_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    c = reg.register(Counter("t_requests_total", "requests",
+                             ("verb", "code")))
+    c.inc("GET", "200", amount=3)
+    c.inc("POST", "409", amount=1.5)
+    g = reg.register(Gauge("t_depth", "queue depth"))
+    g.set(7.0)
+    h = reg.register(Histogram("t_latency_seconds", "latency",
+                               ("kind",), buckets=(0.1, 1.0, 5.0)))
+    h.observe_many([0.05, 0.5, 2.0, 9.0], "Pod")
+    h.observe(0.2, "Node")
+    hu = reg.register(Histogram("t_plain_seconds", "unlabelled",
+                                buckets=(0.5, 2.0)))
+    hu.observe(0.7)
+    # escaping: label values carrying quotes, backslashes, newlines
+    # must survive the wire (the federation scraper reads real label
+    # values like pod names — a torn escape corrupts the merge)
+    e = reg.register(Counter("t_escaped_total", "with \"quotes\"\nand "
+                             "backslash \\", ("name",)))
+    e.inc('we"ird\\na\nme', amount=2)
+    return reg
+
+
+def _families_equal(truth, parsed) -> None:
+    for name, fam in truth.items():
+        got = parsed[name]
+        assert got.type == fam.type, name
+        if fam.samples or fam.histograms:
+            assert tuple(got.label_names) == tuple(fam.label_names), name
+        assert got.samples == fam.samples, name
+        assert set(got.histograms) == set(fam.histograms), name
+        for key, series in fam.histograms.items():
+            g = got.histograms[key]
+            assert g.bucket_edges == series.bucket_edges, (name, key)
+            assert g.bucket_counts == series.bucket_counts, (name, key)
+            assert g.sum == pytest.approx(series.sum), (name, key)
+            assert g.count == series.count, (name, key)
+
+
+# ---------------------------------------------------------------------------
+# exposition round-trip + metrics lint
+
+
+class TestExpositionRoundTrip:
+    def test_parse_expose_is_identity(self):
+        reg = _sample_registry()
+        _families_equal(families_from_registry(reg),
+                        parse_exposition(reg.expose()))
+
+    def test_histogram_renders_cumulative_buckets_with_inf(self):
+        reg = _sample_registry()
+        text = reg.expose()
+        # cumulative on the wire: Pod series 0.05,0.5,2.0,9.0 over
+        # edges (0.1, 1.0, 5.0, +Inf) -> cum 1,2,3,4
+        assert 't_latency_seconds_bucket{kind="Pod",le="0.1"} 1' in text
+        assert 't_latency_seconds_bucket{kind="Pod",le="1"} 2' in text
+        assert 't_latency_seconds_bucket{kind="Pod",le="5"} 3' in text
+        assert 't_latency_seconds_bucket{kind="Pod",le="+Inf"} 4' in text
+        assert 't_latency_seconds_count{kind="Pod"} 4' in text
+
+    def test_malformed_sample_line_raises(self):
+        with pytest.raises(ExpositionError):
+            parse_exposition("what even is this line\n")
+        with pytest.raises(ExpositionError):
+            parse_exposition('t_x{unclosed="yes} 1\n')
+
+    def test_lint_flags_invalid_families(self):
+        bad_name = families_from_registry(_sample_registry())
+        fam = list(bad_name.values())[0]
+        fam.name = "0bad-name"
+        assert lint_family(fam)
+        h = bad_name["t_latency_seconds"]
+        h.label_names = ("le",)
+        assert any("le" in p for p in lint_family(h))
+        c = bad_name["t_requests_total"]
+        c.label_names = ("__reserved",)
+        assert any("reserved" in p for p in lint_family(c))
+
+    def test_metrics_lint_entire_live_registry(self):
+        """The CI metrics lint (satellite): instantiate EVERY metric
+        module against the process registry, render the whole thing,
+        and require parse(render(x)) ≡ x plus Prometheus-valid names
+        and labels — exposition drift can never silently break the
+        federation scraper."""
+        from kubernetes_tpu.metrics import default_registry
+        from kubernetes_tpu.metrics.apf_metrics import apf_metrics
+        from kubernetes_tpu.metrics.autoscaler_metrics import (
+            autoscaler_metrics,
+        )
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+        from kubernetes_tpu.metrics.scheduler_metrics import (
+            SchedulerMetrics,
+        )
+        from kubernetes_tpu.metrics.solver_metrics import solver_metrics
+
+        apf_metrics(), autoscaler_metrics(), fabric_metrics()
+        freshness_metrics(), solver_metrics()
+        for reg in (default_registry(), SchedulerMetrics().registry):
+            truth = families_from_registry(reg)
+            parsed = parse_exposition(reg.expose())
+            _families_equal(truth, parsed)
+            for fam in truth.values():
+                assert lint_family(fam) == [], fam.name
+
+    def test_no_duplicate_registrations_across_modules(self):
+        """Every metric module keeps its family objects alive in the
+        shared registry: a second module registering the same name
+        would silently orphan the first module's series. Bind each
+        module to ONE fresh registry and require every name to appear
+        exactly once."""
+        from kubernetes_tpu.metrics.apf_metrics import ApfMetrics
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            FreshnessMetrics,
+        )
+
+        reg = MetricsRegistry()
+        seen = {}
+        for cls in (ApfMetrics, FreshnessMetrics):
+            before = {m.name: m for m in reg.all_metrics()}
+            cls(reg)
+            for m in reg.all_metrics():
+                if m.name in before:
+                    assert before[m.name] is m, \
+                        f"{cls.__name__} re-registered {m.name}"
+                else:
+                    assert m.name not in seen, m.name
+                    seen[m.name] = cls.__name__
+
+
+# ---------------------------------------------------------------------------
+# federation: merge + fold
+
+
+class TestFederationMerge:
+    def test_merge_two_instances_with_instance_label(self):
+        fed = MetricsFederation()
+        fed.absorb_text(_sample_registry().expose(), "a")
+        fed.absorb_text(_sample_registry().expose(), "b")
+        assert fed.instances() == {"a", "b"}
+        # 3 + 1.5 per instance
+        assert fed.counter_total("t_requests_total") == \
+            pytest.approx(9.0)
+        merged = fed.series("t_latency_seconds")
+        assert merged.label_names == ("instance", "kind")
+        assert ("a", "Pod") in merged._series
+        assert ("b", "Pod") in merged._series
+        assert merged.buckets == (0.1, 1.0, 5.0)
+
+    def test_repeat_scrape_same_instance_never_double_counts(self):
+        fed = MetricsFederation()
+        text = _sample_registry().expose()
+        fed.absorb_text(text, "a")
+        fed.absorb_text(text, "a")
+        assert fed.counter_total("t_requests_total") == \
+            pytest.approx(4.5)
+        assert fed.series("t_latency_seconds").count("a", "Pod") == 4
+
+    def test_fold_counter_deltas_and_reset_detection(self):
+        local = MetricsRegistry()
+        target = local.register(
+            Counter("t_requests_total", "x", ("verb", "code")))
+        fed = MetricsFederation(fold_registry=local)
+
+        def text(n: float) -> str:
+            reg = MetricsRegistry()
+            reg.register(Counter("t_requests_total", "x",
+                                 ("verb", "code"))).inc(
+                "GET", "200", amount=n)
+            return reg.expose()
+
+        fed.absorb_text(text(10), "child", fold=True)
+        fed.absorb_text(text(25), "child", fold=True)
+        assert target.get("GET", "200") == pytest.approx(25)
+        # counter reset (child restarted): full new total folds in
+        fed.absorb_text(text(4), "child", fold=True)
+        assert target.get("GET", "200") == pytest.approx(29)
+        # forget_instance restarts the baseline for a NEW child under
+        # the same name: its total folds in full, not as a delta
+        fed.forget_instance("child")
+        assert "child" not in fed.instances()
+        fed.absorb_text(text(30), "child", fold=True)
+        assert target.get("GET", "200") == pytest.approx(59)
+
+    def test_fold_skips_unknown_and_mismatched_families(self):
+        local = MetricsRegistry()
+        local.register(Counter("t_requests_total", "x", ("verb",)))
+        fed = MetricsFederation(fold_registry=local)
+        # remote labels (verb, code) != local (verb,): no fold, no crash
+        fed.absorb_text(_sample_registry().expose(), "a", fold=True)
+        assert local.get("t_requests_total").collect() == []
+
+    def test_absorb_snapshot_compat_shares_the_fold_ledger(self):
+        """The legacy /debug/apf JSON path now routes through the SAME
+        federation delta ledger as the scrape path: calling it twice
+        with cumulative totals folds the delta (not the sum), and a
+        scrape of the same instance afterwards cannot double-count."""
+        from kubernetes_tpu.metrics.apf_metrics import ApfMetrics
+
+        apfm = ApfMetrics(MetricsRegistry())
+        snap = {"levels": {"workload": {
+            "rejected": {"queue-full": 10}, "dispatched_total": 100,
+            "seats_dispatched_total": 120, "capacity": 8}}}
+        instance = "compat-test-child"
+        fed = metrics_federation()
+        fed.forget_instance(instance)
+        try:
+            apfm.absorb_snapshot(snap, instance=instance)
+            assert apfm.rejected_requests_total.get(
+                "workload", "queue-full") == pytest.approx(10)
+            assert apfm.dispatched_requests_total.get("workload") == \
+                pytest.approx(100)
+            # same totals again: cumulative, so the fold is a no-op
+            apfm.absorb_snapshot(snap, instance=instance)
+            assert apfm.rejected_requests_total.get(
+                "workload", "queue-full") == pytest.approx(10)
+            # grown totals: only the delta lands
+            snap["levels"]["workload"]["rejected"]["queue-full"] = 17
+            apfm.absorb_snapshot(snap, instance=instance)
+            assert apfm.rejected_requests_total.get(
+                "workload", "queue-full") == pytest.approx(17)
+            assert apfm.last_snapshot is snap
+        finally:
+            fed.forget_instance(instance)
+
+    def test_scrape_live_server_metrics(self):
+        store, server = _serve()
+        try:
+            store.create_pod(MakePod().name("m1").uid("u1").obj())
+            fed = MetricsFederation()
+            assert fed.scrape(server.url, instance="api") is True
+            assert "api" in fed.instances()
+            assert fed.scrape_errors == []
+        finally:
+            server.shutdown_server()
+
+    def test_scrape_failure_is_best_effort(self):
+        fed = MetricsFederation()
+        assert fed.scrape("http://127.0.0.1:9", instance="gone",
+                          timeout=0.5) is False
+        assert fed.scrape_errors
+
+
+# ---------------------------------------------------------------------------
+# freshness SLIs
+
+
+class TestFreshnessInstrumentation:
+    def test_store_dispatch_stamps_commit_ts(self):
+        store = ClusterStore()
+        seen = []
+        store.watch(lambda e: seen.append(e))
+        t0 = time.time()
+        store.create_pod(MakePod().name("f1").uid("u1").obj())
+        assert seen and seen[0].ts >= t0
+        # batch dispatch stamps once per batch
+        seen.clear()
+        store.create_pods(
+            [MakePod().name(f"fb{i}").uid(f"ub{i}").obj()
+             for i in range(3)])
+        stamped = [e.ts for e in seen if e.kind == "Pod"]
+        assert stamped and all(ts >= t0 for ts in stamped)
+
+    def test_prestamped_event_is_not_restamped(self):
+        store = ClusterStore()
+        seen = []
+        store.watch(lambda e: seen.append(e))
+        ev = Event("ADDED", "Pod", MakePod().name("p").uid("u").obj(),
+                   ts=123.0)
+        store._dispatch(ev)
+        assert seen[-1].ts == 123.0
+
+    def test_watch_delivery_measured_end_to_end(self):
+        """Commit → client decode over the real wire: the histogram
+        grows by the number of delivered stamped events, and the
+        measured lag is sane (sub-second on an idle loopback)."""
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+
+        fm = freshness_metrics()
+        before = fm.watch_delivery_seconds.count("Pod")
+        store, server = _serve()
+        client = RestClusterClient(server.url, watch_kinds=("Pod",))
+        got = []
+        handle = client.watch(lambda e: None,
+                              batch_fn=lambda evs: got.extend(evs))
+        try:
+            time.sleep(0.3)
+            store.create_pod(MakePod().name("wd1").uid("u1").obj())
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    fm.watch_delivery_seconds.count("Pod") <= before:
+                time.sleep(0.05)
+            grown = fm.watch_delivery_seconds.count("Pod") - before
+            assert grown >= 1
+            assert fm.watch_delivery_seconds.quantile(0.99, "Pod") < 10.0
+        finally:
+            handle.stop()
+            server.shutdown_server()
+
+    def test_informer_lag_observed_on_dispatch(self):
+        from kubernetes_tpu.client.informers import SharedInformerFactory
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+
+        fm = freshness_metrics()
+        before = fm.informer_lag_seconds.count("Pod")
+        store = ClusterStore()
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Pod")
+        inf.add_event_handler(lambda *a: None)
+        factory.start()
+        try:
+            factory.wait_for_cache_sync()
+            store.create_pod(MakePod().name("il1").uid("u1").obj())
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    fm.informer_lag_seconds.count("Pod") <= before:
+                time.sleep(0.02)
+            assert fm.informer_lag_seconds.count("Pod") > before
+            assert fm.informer_queue_depth.get() >= 1
+        finally:
+            factory.stop()
+
+    def test_cache_newest_event_anchor_keeps_max(self):
+        from kubernetes_tpu.scheduler.cache import SchedulerCache
+
+        cache = SchedulerCache()
+        assert cache.last_event_ts == 0.0
+        cache.note_event_ts(100.0)
+        cache.note_event_ts(50.0)    # relist replay out of order
+        assert cache.last_event_ts == 100.0
+        cache.note_event_ts(101.0)
+        assert cache.last_event_ts == 101.0
+
+    def test_row_summary_shape(self):
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            FreshnessMetrics,
+            freshness_row_summary,
+        )
+
+        fm = FreshnessMetrics(MetricsRegistry())
+        import kubernetes_tpu.metrics.freshness_metrics as fmod
+
+        prev = fmod._default
+        fmod._default = fm
+        try:
+            fm.watch_delivery_seconds.observe_many(
+                [0.001, 0.002, 0.4], "Pod")
+            out = freshness_row_summary(
+                {"max_staleness_s": 0.25},
+                {"watch_delivery": {"violated": True, "events_fast": 3},
+                 "schedule_latency": {"violated": False,
+                                      "events_fast": 0}})
+            assert out["watch_delivery_events"] == 3
+            assert out["watch_delivery_p99_ms"] > 0
+            assert out["max_snapshot_staleness_ms"] == \
+                pytest.approx(250.0)
+            # quiet SLOs with zero events are dropped; violations and
+            # active SLOs keep their verdicts
+            assert out["slo"] == {"watch_delivery": "violated"}
+        finally:
+            fmod._default = prev
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine
+
+
+def _latency_slo(threshold=1.0, objective=0.99, name="lat"):
+    return SLODef(name=name, description="d", metric="t_lat",
+                  threshold_s=threshold, objective=objective)
+
+
+def _engine(reg, slos, **kw):
+    kw.setdefault("enabled", True)
+    return SLOEngine(slos=slos, registries=[reg], **kw)
+
+
+class TestSLOEngine:
+    def _hist(self, reg):
+        return reg.register(Histogram("t_lat", "x",
+                                      buckets=(0.1, 1.0, 5.0)))
+
+    def test_green_run_stays_green(self):
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], clock=lambda: clock[0])
+        eng.tick()
+        h.observe_many([0.05] * 200)
+        clock[0] = 10.0
+        out = eng.evaluate()
+        s = out["slos"]["lat"]
+        assert out["healthy"] is True
+        assert s["violated"] is False and s["alerting"] is False
+        assert s["events_fast"] == 200
+        assert s["burn_fast"] == 0.0
+        assert s["budget_remaining_pct"] == 100.0
+
+    def test_violation_without_multiwindow_alert(self):
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], clock=lambda: clock[0])
+        eng.tick()
+        # 2% bad at a 1% budget: burn 2.0 — violated, but far below
+        # the 14.4x page threshold
+        h.observe_many([0.05] * 98 + [3.0] * 2)
+        clock[0] = 10.0
+        s = eng.evaluate()["slos"]["lat"]
+        assert s["violated"] is True
+        assert s["alerting"] is False
+        assert s["burn_fast"] == pytest.approx(2.0)
+
+    def test_multiwindow_burn_alert_latches_once_and_dumps(self,
+                                                          monkeypatch):
+        from kubernetes_tpu.metrics import default_registry
+        from kubernetes_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        dumps = []
+        monkeypatch.setattr(tracer, "enabled", True)
+        monkeypatch.setattr(
+            tracer, "dump",
+            lambda *a, **kw: dumps.append(kw.get("reason")) or "/x")
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], clock=lambda: clock[0])
+        eng.tick()
+        h.observe_many([3.0] * 100)   # 100% bad: burn 100x both windows
+        clock[0] = 10.0
+        alerts = default_registry().get("slo_alerts_total")
+        before = alerts.get("lat") if alerts else 0.0
+        s = eng.evaluate()["slos"]["lat"]
+        assert s["alerting"] is True
+        assert dumps == ["slo-lat"]
+        alerts = default_registry().get("slo_alerts_total")
+        assert alerts.get("lat") == before + 1
+        # still alerting on the next evaluation: latched, no re-fire
+        clock[0] = 11.0
+        assert eng.evaluate()["slos"]["lat"]["alerting"] is True
+        assert dumps == ["slo-lat"]
+        assert alerts.get("lat") == before + 1
+        # mirrors land in the default registry
+        burn = default_registry().get("slo_burn_rate")
+        assert burn.get("lat", "fast") >= 14.4
+        assert default_registry().get("slo_violated").get("lat") == 1.0
+
+    def test_fast_window_recovers_after_bad_burst_ages_out(self):
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], fast_window_s=60.0,
+                      slow_window_s=600.0, clock=lambda: clock[0])
+        eng.tick()
+        h.observe_many([3.0] * 50)
+        clock[0] = 10.0
+        assert eng.evaluate()["slos"]["lat"]["violated"] is True
+        # 100s later the burst is outside the fast window; fresh good
+        # traffic only
+        clock[0] = 110.0
+        h.observe_many([0.05] * 50)
+        s = eng.evaluate()["slos"]["lat"]
+        assert s["violated"] is False
+        assert s["burn_fast"] == 0.0
+
+    def test_error_ratio_slo_reads_bad_and_total_counters(self):
+        reg = MetricsRegistry()
+        bad = reg.register(Counter("t_rejected_total", "x", ("r",)))
+        ok = reg.register(Counter("t_dispatched_total", "x"))
+        slo = SLODef(name="avail", description="d",
+                     metric="t_rejected_total", kind="error_ratio",
+                     total_metric="t_dispatched_total", objective=0.999)
+        clock = [0.0]
+        eng = _engine(reg, [slo], clock=lambda: clock[0])
+        eng.tick()
+        ok.inc(amount=998)
+        bad.inc("429", amount=2)
+        clock[0] = 5.0
+        s = eng.evaluate()["slos"]["avail"]
+        # 2 bad / 1000 total at a 0.1% budget: burn 2x
+        assert s["burn_fast"] == pytest.approx(2.0, rel=1e-3)
+        assert s["violated"] is True
+        bad.inc("429", amount=98)
+        clock[0] = 6.0
+        assert eng.evaluate()["slos"]["avail"]["alerting"] is True
+
+    def test_windowed_p99_comes_from_bucket_deltas(self):
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], fast_window_s=60.0,
+                      clock=lambda: clock[0])
+        # lifetime history: a horrible warmup entirely before the window
+        h.observe_many([4.0] * 100)
+        eng.tick()
+        clock[0] = 100.0
+        h.observe_many([0.05] * 100)
+        s = eng.evaluate()["slos"]["lat"]
+        # the warmup is outside the window: p99 reflects the fresh
+        # traffic, not the lifetime histogram
+        assert s["sli_fast_p99_s"] <= 0.1
+        assert s["violated"] is False
+
+    def test_disabled_engine_answers_disabled(self):
+        eng = SLOEngine(enabled=False)
+        assert eng.evaluate() == {"enabled": False, "slos": {}}
+
+    def test_reset_rescales_windows_and_drops_latch(self):
+        reg = MetricsRegistry()
+        h = self._hist(reg)
+        clock = [0.0]
+        eng = _engine(reg, [_latency_slo()], clock=lambda: clock[0])
+        eng.tick()
+        h.observe_many([3.0] * 10)
+        clock[0] = 1.0
+        assert eng.evaluate()["slos"]["lat"]["violated"] is True
+        eng.reset(fast_window_s=30.0, slow_window_s=120.0)
+        assert eng.fast_window_s == 30.0
+        eng.tick()
+        clock[0] = 2.0
+        # fresh window: the old bad events are the new baseline
+        assert eng.evaluate()["slos"]["lat"]["violated"] is False
+
+
+# ---------------------------------------------------------------------------
+# /debug/slo + the report tool
+
+
+class TestDebugSloEndpoint:
+    def test_get_returns_live_evaluation(self):
+        store, server = _serve()
+        try:
+            client = RestClusterClient(server.url)
+            code, doc = client._request("GET", "/debug/slo")
+            assert code == 200
+            assert doc["enabled"] is True
+            assert "snapshot_staleness" in doc["slos"]
+            assert "watch_delivery" in doc["slos"]
+        finally:
+            server.shutdown_server()
+
+    def test_untrusted_identity_is_403(self):
+        store, server = _serve(tokens={"tok-w": "workload-user"})
+        try:
+            client = RestClusterClient(server.url, token="tok-w")
+            code, _ = client._request("GET", "/debug/slo")
+            assert code == 403
+        finally:
+            server.shutdown_server()
+
+    def test_non_get_is_405(self):
+        store, server = _serve()
+        try:
+            cp = RestClusterClient(server.url)   # loopback, tokenless
+            code, _ = cp._request("POST", "/debug/slo", {})
+            assert code == 405
+        finally:
+            server.shutdown_server()
+
+
+class TestSloReportTool:
+    def test_artifact_rows_table_and_strict_exit(self, tmp_path,
+                                                 capsys):
+        from tools.slo_report import main
+
+        rows = [
+            {"metric": "pods_scheduled_per_sec[clean]", "value": 100,
+             "freshness": {"watch_delivery_p99_ms": 4.2,
+                           "max_snapshot_staleness_ms": 120.0,
+                           "slo": {"watch_delivery": "ok"}}},
+            {"metric": "pods_scheduled_per_sec[stalled]", "value": 10,
+             "freshness": {"watch_delivery_p99_ms": 900.0,
+                           "slo": {"watch_delivery": "violated"}}},
+        ]
+        path = tmp_path / "rows.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        assert main(["--artifact", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "watch_delivery=VIOLATED" in out
+        assert "UNHEALTHY" in out
+        assert main(["--artifact", str(path), "--strict"]) == 1
+        capsys.readouterr()
+        # machine-readable mode names the violated SLOs
+        assert main(["--artifact", str(path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["violated"] == ["watch_delivery"]
+
+    def test_live_url_table(self, capsys):
+        from tools.slo_report import main
+
+        store, server = _serve()
+        try:
+            assert main(["--url", server.url]) == 0
+            out = capsys.readouterr().out
+            assert "snapshot_staleness" in out
+            assert "healthy" in out
+        finally:
+            server.shutdown_server()
+
+    def test_out_file_is_scratch(self, tmp_path, capsys):
+        from tools.slo_report import main
+
+        path = tmp_path / "rows.jsonl"
+        path.write_text(json.dumps(
+            {"metric": "m", "freshness": {"slo": {}}}) + "\n")
+        out_file = tmp_path / "slo_report.txt"
+        assert main(["--artifact", str(path),
+                     "--out", str(out_file)]) == 0
+        assert out_file.read_text() == capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# diag line: the slo[...] segment
+
+
+class TestDiagSloSegment:
+    def test_quiet_when_green(self):
+        from kubernetes_tpu.harness import diagfmt
+
+        assert diagfmt.format_slo(
+            {"slos": {"lat": {"violated": False}}}) == ""
+        assert diagfmt.format_slo({}) == ""
+
+    def test_violated_segment_round_trips_through_parser(self):
+        from kubernetes_tpu.harness import diagfmt
+
+        seg = diagfmt.format_slo({"slos": {
+            "watch_delivery": {"violated": True, "burn_fast": 22.13,
+                               "burn_slow": 8.0,
+                               "budget_remaining_pct": 0.0,
+                               "alerting": True},
+            "snapshot_staleness": {"violated": True, "burn_fast": 3.0,
+                                   "burn_slow": 1.0,
+                                   "budget_remaining_pct": 40.0},
+            "schedule_latency": {"violated": False},
+        }})
+        assert seg.startswith("slo[")
+        line = diagfmt.format_diag(["solve.commit=1.00s/2", seg])
+        parsed = diagfmt.parse_diag(line)
+        assert parsed["slo"]["violated"] == \
+            "snapshot_staleness,watch_delivery"
+        assert parsed["slo"]["worst"] == "watch_delivery"
+        assert parsed["slo"]["burn_fast"] == pytest.approx(22.1)
+        assert parsed["slo"]["alerting"] == "watch_delivery"
+        # the other segments survive alongside
+        assert parsed["phases"]["solve.commit"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the FaultGate acceptance: injected watch latency flips the freshness
+# SLOs; a clean run stays green
+
+
+def _bench_slos():
+    """Freshness objectives scaled to test timescales (the bench
+    harnesses rescale the same way via ``SLOEngine.reset``)."""
+    return [
+        SLODef(name="watch_delivery", description="d",
+               metric="watch_delivery_seconds", threshold_s=0.25,
+               objective=0.99),
+        SLODef(name="snapshot_staleness", description="d",
+               metric="snapshot_staleness_seconds", threshold_s=0.5,
+               objective=0.99),
+    ]
+
+
+def _run_sched_over_rest(server, n_pods=24, batch=True):
+    """Drive the real scheduler over the REST wire and return once all
+    pods are bound (the caller asserts on the SLIs the run produced)."""
+    from kubernetes_tpu.config.feature_gates import FeatureGates
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.sidecar import attach_batch_scheduler
+
+    client = RestClusterClient(server.url, qps=None)
+    sched = Scheduler.create(
+        client, feature_gates=FeatureGates({"TPUBatchScheduler": batch}))
+    bs = attach_batch_scheduler(sched, max_batch=32) if batch else None
+    try:
+        nodes = [MakeNode().name(f"n{i}")
+                 .capacity({"cpu": "16", "memory": "32Gi"}).obj()
+                 for i in range(4)]
+        code, _ = client._request(
+            "POST", "/api/v1/nodes",
+            {"kind": "NodeList", "items": nodes}, charge=len(nodes))
+        assert code == 201
+        sched.start()
+        # pods are created AFTER the watch streams are up: their events
+        # ride the (possibly stalled) live watch, stamped at commit
+        pods = [MakePod().name(f"p{i}").uid(f"u{i}")
+                .req({"cpu": "100m"}).obj() for i in range(n_pods)]
+        code, _ = client._request(
+            "POST", "/api/v1/namespaces/default/pods",
+            {"kind": "PodList", "items": pods}, charge=len(pods))
+        assert code == 201
+        deadline = time.time() + 60
+        bound = 0
+        while time.time() < deadline and bound < n_pods:
+            if bs is not None:
+                bs.run_batch(pop_timeout=0.05)
+            else:
+                sched.schedule_one(pop_timeout=0.05)
+            bound = sched.metrics.e2e_scheduling_duration.count(
+                "scheduled")
+        assert bound == n_pods
+    finally:
+        sched.stop()
+
+
+class TestFaultGateSloFlip:
+    def test_clean_run_stays_green(self):
+        from kubernetes_tpu.metrics import default_registry
+
+        eng = SLOEngine(slos=_bench_slos(),
+                        registries=[default_registry()], enabled=True)
+        eng.tick()
+        store, server = _serve()
+        try:
+            _run_sched_over_rest(server)
+        finally:
+            server.shutdown_server()
+        out = eng.evaluate()
+        assert out["healthy"] is True, out
+        assert out["slos"]["watch_delivery"]["events_fast"] > 0
+
+    def test_watch_stall_flips_freshness_slos(self, monkeypatch):
+        """A FaultGate-injected stall on the pod watch stream delays
+        commit→decode delivery past the objective: the freshness SLOs
+        flip to violated, the multi-window burn alert fires, and the
+        flight-recorder dump lands — the SLI layer detects a real
+        injected fabric fault end-to-end."""
+        from kubernetes_tpu.apiserver.faults import FaultGate, FaultRule
+        from kubernetes_tpu.metrics import default_registry
+        from kubernetes_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        dumps = []
+        monkeypatch.setattr(tracer, "enabled", True)
+        monkeypatch.setattr(
+            tracer, "dump",
+            lambda *a, **kw: dumps.append(kw.get("reason")) or "/x")
+        eng = SLOEngine(slos=_bench_slos(),
+                        registries=[default_registry()], enabled=True)
+        eng.tick()
+        gate = FaultGate()
+        gate.add_rule(FaultRule("watch_stall", resource="pods",
+                                duration=1.5))
+        store, server = _serve(fault_gate=gate)
+        try:
+            _run_sched_over_rest(server)
+        finally:
+            server.shutdown_server()
+        out = eng.evaluate()
+        wd = out["slos"]["watch_delivery"]
+        assert wd["violated"] is True, out
+        assert wd["alerting"] is True
+        assert any(r.startswith("slo-") for r in dumps)
+        # the solver snapshot aged past its objective while the watch
+        # was stalled (staleness is measured per solve cycle)
+        ss = out["slos"]["snapshot_staleness"]
+        if ss["events_fast"]:
+            assert ss["violated"] is True, out

@@ -1,0 +1,222 @@
+"""Human-readable SLO status table from a live server or a committed
+bench artifact.
+
+Two sources, one table:
+
+- ``--url http://host:port`` GETs ``/debug/slo`` (the SLO engine's
+  live evaluation — burn rates, windowed SLIs, verdicts) and renders
+  each SLO's row;
+- ``--artifact BENCH_rN.json`` (or a bare bench-row JSON-lines file)
+  reads the driver-committed artifact, pulls every bench row's
+  ``freshness`` sub-object (watch-delivery p99, max snapshot
+  staleness, SLO verdicts) and renders the per-row verdict table —
+  the SLI layer's numbers without re-running anything.
+
+Usage::
+
+    python tools/slo_report.py --url http://127.0.0.1:8080
+    python tools/slo_report.py --artifact BENCH_r08.json
+    python tools/slo_report.py --artifact BENCH_r08.json --strict
+    python tools/slo_report.py --url ... --json   # machine-readable
+
+``--strict`` exits 1 when any SLO is violated (CI gating). Output goes
+to stdout; ``--out FILE`` tees it to a scratch file (gitignored —
+telemetry runs must not re-pollute the tree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+
+# ---------------------------------------------------------------------------
+# sources
+
+
+def fetch_live(url: str, timeout: float = 5.0) -> dict:
+    """GET /debug/slo from a live server (control-plane envelope:
+    loopback on a tokenless server needs no token)."""
+    import http.client
+
+    rest = url.rstrip("/").split("://", 1)[-1]
+    host, _, port = rest.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 80),
+                                      timeout=timeout)
+    try:
+        conn.request("GET", "/debug/slo")
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/debug/slo answered HTTP {resp.status}: "
+                f"{body[:200]!r}")
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def rows_from_artifact(path: str) -> List[dict]:
+    """Bench rows (with their ``freshness`` sub-objects) from a
+    driver-committed BENCH_r*.json artifact, or from a plain file of
+    bench-row JSON lines."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        from tools.perf_report import _rows_from_tail
+
+        return _rows_from_tail(doc["tail"])
+    rows = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and "metric" in row:
+            rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt_live(doc: dict) -> tuple:
+    """(text, violated_names) for a live /debug/slo evaluation."""
+    lines = []
+    violated = []
+    slos = doc.get("slos", {})
+    if not doc.get("enabled", True):
+        return "SLO evaluation disabled (KTPU_SLO=off)\n", []
+    header = (f"{'SLO':<22} {'verdict':<9} {'burn fast':>9} "
+              f"{'burn slow':>9} {'budget':>8} {'events':>8}  sli")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, s in sorted(slos.items()):
+        verdict = "VIOLATED" if s.get("violated") else (
+            "alerting" if s.get("alerting") else "ok")
+        if s.get("violated"):
+            violated.append(name)
+        sli = ""
+        if "sli_fast_p99_s" in s:
+            sli = (f"p99 {s['sli_fast_p99_s'] * 1000:.0f}ms "
+                   f"(obj ≤{s.get('threshold_s', 0) * 1000:.0f}ms)")
+        elif s.get("kind") == "error_ratio":
+            ev = s.get("events_fast") or 0
+            bad = s.get("bad_fast") or 0
+            sli = f"{bad:.0f}/{ev:.0f} rejected"
+        lines.append(
+            f"{name:<22} {verdict:<9} {s.get('burn_fast', 0):>9.2f} "
+            f"{s.get('burn_slow', 0):>9.2f} "
+            f"{s.get('budget_remaining_pct', 100):>7.1f}% "
+            f"{s.get('events_fast', 0):>8.0f}  {sli}")
+    lines.append("")
+    lines.append("healthy" if doc.get("healthy") else
+                 f"UNHEALTHY: {', '.join(violated)}")
+    return "\n".join(lines) + "\n", violated
+
+
+def _fmt_rows(rows: List[dict]) -> tuple:
+    """(text, violated_names) for committed bench rows' freshness
+    sub-objects."""
+    lines = []
+    violated = []
+    any_fresh = False
+    header = (f"{'bench row':<58} {'wd p99':>8} {'stale max':>10}  "
+              f"slo verdicts")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for row in rows:
+        fresh = row.get("freshness")
+        if not fresh:
+            continue
+        any_fresh = True
+        metric = row.get("metric", "?")
+        short = metric[:56] + ".." if len(metric) > 58 else metric
+        wd = fresh.get("watch_delivery_p99_ms")
+        stale = fresh.get("max_snapshot_staleness_ms",
+                          fresh.get("snapshot_staleness_p99_ms"))
+        verdicts = fresh.get("slo") or {}
+        bad = sorted(n for n, v in verdicts.items() if v != "ok")
+        violated.extend(bad)
+        vtext = " ".join(
+            f"{n}={'VIOLATED' if v != 'ok' else 'ok'}"
+            for n, v in sorted(verdicts.items())) or "-"
+        lines.append(
+            f"{short:<58} "
+            f"{(f'{wd:.1f}ms' if wd is not None else '-'):>8} "
+            f"{(f'{stale:.0f}ms' if stale is not None else '-'):>10}  "
+            f"{vtext}")
+    if not any_fresh:
+        lines.append("(no rows carry a freshness sub-object — "
+                     "pre-SLI artifact?)")
+    lines.append("")
+    lines.append("healthy" if not violated else
+                 f"UNHEALTHY: {', '.join(sorted(set(violated)))}")
+    return "\n".join(lines) + "\n", sorted(set(violated))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="SLO status table from /debug/slo or a bench "
+                    "artifact")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="live server base URL")
+    src.add_argument("--artifact", help="BENCH_r*.json or bench-row "
+                                        "JSON-lines file")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any SLO is violated")
+    ap.add_argument("--out", help="also write the report to this file "
+                                  "(scratch output, gitignored)")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        doc = fetch_live(args.url)
+        if args.json:
+            text = json.dumps(doc, indent=2) + "\n"
+            violated = [n for n, s in doc.get("slos", {}).items()
+                        if s.get("violated")]
+        else:
+            text, violated = _fmt_live(doc)
+    else:
+        rows = rows_from_artifact(args.artifact)
+        if args.json:
+            fresh = [{"metric": r.get("metric"),
+                      "freshness": r.get("freshness")}
+                     for r in rows if r.get("freshness")]
+            violated = sorted({
+                n for r in rows
+                for n, v in ((r.get("freshness") or {}).get("slo")
+                             or {}).items() if v != "ok"})
+            text = json.dumps({"rows": fresh,
+                               "violated": violated}, indent=2) + "\n"
+        else:
+            text, violated = _fmt_rows(rows)
+
+    sys.stdout.write(text)
+    if args.out:
+        try:
+            with open(args.out, "w") as f:
+                f.write(text)
+        except OSError as e:
+            print(f"--out failed: {e}", file=sys.stderr)
+    return 1 if (args.strict and violated) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
