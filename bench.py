@@ -126,7 +126,7 @@ def matrix_row_order(include_extra: bool = False) -> list:
     order = ["1", "2", "3", "4", "5"]
     if include_extra:
         order += sorted(EXTRA_MATRIX)
-    return order + ["qos", "rest", "headline"]
+    return order + ["scale10x", "qos", "rest", "headline"]
 
 
 _APF_REJECTED_SEEN = 0.0   # cumulative-counter baseline for the apf diag
@@ -430,6 +430,33 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
     return row
 
 
+def run_scale10x_one(serial_rate: float, qps: float,
+                     quick: bool = False) -> dict:
+    """The 10×-tier row (ROADMAP "50k-node / 500k-pod tier"): the
+    partitioned control plane — P apiserver processes (one store
+    partition each), kubemark hollow fleet, M concurrently-scheduling
+    replicas — at ≥10× the headline scale, with a same-scale
+    single-partition arm as the A/B (sharding must pay for itself) and
+    the conflict chaos cell's verdict riding the row."""
+    from kubernetes_tpu.harness.scale import run_scale10x_row
+
+    if quick:
+        row = run_scale10x_row(
+            nodes=400, pods=2000, partitions=2, replicas=2,
+            use_batch=True, max_batch=512,
+            qps=qps if qps > 0 else None,
+            node_cpu=16, wait_timeout=600, progress=log)
+    else:
+        row = run_scale10x_row(
+            nodes=50_000, pods=500_000, partitions=4, replicas=2,
+            use_batch=True, max_batch=1024,
+            qps=qps if qps > 0 else None,
+            node_cpu=32, wait_timeout=2400, progress=log)
+    row["vs_baseline"] = round(
+        row["value"] / serial_rate, 2) if serial_rate > 0 else 0.0
+    return row
+
+
 def run_qos_one(nodes: int, measure_pods: int, serial_rate: float,
                 qps: float, tenants: int = 3,
                 solo_baseline: dict = None) -> dict:
@@ -622,7 +649,7 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
-                       "autoscale"])
+                       "autoscale", "scale10x"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -684,6 +711,12 @@ def main() -> None:
         print(json.dumps(row), flush=True)
         return
 
+    if args.config == "scale10x":
+        serial_rate = RECORDED_SERIAL_BASELINE["default"]
+        print(json.dumps(run_scale10x_one(
+            serial_rate, args.rest_qps, quick=args.quick)), flush=True)
+        return
+
     if args.config == "rest":
         nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)
         serial_rate = RECORDED_SERIAL_BASELINE["default"]
@@ -735,6 +768,26 @@ def main() -> None:
     matrix["headline"] = CONFIGS["headline"]
     rest_row_cache = None
     for key in matrix_row_order(args.all):
+        if key == "scale10x":
+            # the 10×-tier partitioned-control-plane row (both A/B arms
+            # + conflict cell) rides the default matrix right before
+            # the QoS/REST/headline tail — its failure must not lose
+            # the remaining rows
+            try:
+                scale_row = run_scale10x_one(serial_rate, args.rest_qps,
+                                             quick=args.quick)
+                scale_row["baseline"] = \
+                    "SchedulingBasic 5k-node serial rate"
+                print(json.dumps(scale_row), flush=True)
+            except Exception as e:  # noqa: BLE001
+                log(f"[scale10x] FAILED: {e}")
+                print(json.dumps({
+                    "metric": "pods_scheduled_per_sec"
+                              "[Scale10x partitioned fabric]",
+                    "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                    "error": str(e),
+                }), flush=True)
+            continue
         if key == "qos":
             # the noisy-tenant QoS row: the REST workload with 3
             # aggressor tenants hammering the fabric — APF's headline

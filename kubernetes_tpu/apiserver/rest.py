@@ -430,7 +430,8 @@ class _Handler(BaseHTTPRequestHandler):
     # blind the metrics scraper, and (via ADMIN_ROUTES) never lock out
     # the debug surfaces mid-overload.
     _EXEMPT_PATHS = ("/healthz", "/livez", "/readyz",
-                     "/metrics", "/metrics/resources")
+                     "/metrics", "/metrics/resources",
+                     "/api/v1/partitiontopology")
 
     def _admission_exempt(self, path: str) -> bool:
         return path in self.ADMIN_ROUTES or path in self._EXEMPT_PATHS
@@ -1159,6 +1160,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/api/v1/partitiontopology":
+            # partition identity: which shard of the partitioned control
+            # plane this server is, and how many exist — the client-side
+            # router's sanity check (a misrouted client fails loudly
+            # instead of silently reading a half-empty shard). Exempt
+            # like the health probes: topology must be discoverable
+            # even mid-overload.
+            self._send_json(200, {
+                "partition": self.server.partition_index,
+                "partitions": self.server.partition_count,
+            })
+            return
         if u.path in ("/api", "/apis") or self._is_discovery_path(u.path):
             self._serve_discovery(u.path)
             return
@@ -1555,6 +1568,34 @@ class _Handler(BaseHTTPRequestHandler):
             return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
+            if sub == "acquire" and name is not None:
+                # lease CAS verb (POST .../leases/{name}/acquire): the
+                # in-process try_acquire_or_renew, made remote — hollow
+                # kubelets' heartbeat leases and leader election over
+                # the REST fabric. ``now`` is server-side on purpose:
+                # one clock must arbitrate expiry across processes.
+                try:
+                    self._check_authz("update", "Lease", "")
+                except Forbidden as e:
+                    self._send_error(403, "Forbidden", str(e))
+                    return
+                try:
+                    body = self._read_body()
+                except json.JSONDecodeError as e:
+                    self._send_error(400, "BadRequest",
+                                     f"invalid JSON: {e}")
+                    return
+                holder = str(body.get("holder") or "")
+                if not holder:
+                    self._send_error(400, "BadRequest",
+                                     "holder is required")
+                    return
+                acquired = self.server.store.try_acquire_or_renew(
+                    name, holder, time.time(),
+                    float(body.get("duration") or 15.0))
+                self._send_json(200, {"acquired": bool(acquired),
+                                      "holder": holder})
+                return
             self._send_error(405, "MethodNotAllowed",
                              "Lease objects are read-only over REST")
             return
@@ -2212,8 +2253,16 @@ class APIServer(ThreadingHTTPServer):
         fault_gate: Optional[FaultGate] = None,
         watch_flush_window: float = 0.002,
         flow_control: Any = "default",
+        partition: Optional[Tuple[int, int]] = None,
     ):
         super().__init__((host, port), _Handler)
+        # partitioned-control-plane identity: (index, count) when this
+        # server is one shard of a partitioned fabric (its store holds
+        # ONLY partition ``index`` of the keyspace — one server process
+        # per partition is the sharded-coordinator deployment shape).
+        # Served at /api/v1/partitiontopology for client-side sanity
+        # checks; (0, 1) = the classic unsharded server.
+        self.partition_index, self.partition_count = partition or (0, 1)
         # pipelined watch delivery: after the first event of a chunk,
         # wait up to this long for more so a steady producer (informer
         # catch-up, bulk creates) ships hundreds of events per syscall.

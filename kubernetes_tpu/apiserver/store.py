@@ -102,13 +102,18 @@ class _Lease:
 
 
 class ClusterStore:
-    def __init__(self):
+    def __init__(self, rv_source: Optional[Callable[[], int]] = None):
         from kubernetes_tpu.metrics.freshness_metrics import (
             freshness_metrics,
         )
 
         self._lock = threading.RLock()
         self._rv = 0
+        # optional shared resourceVersion allocator (the partitioned
+        # store hands every partition the same atomic counter so RVs
+        # stay globally unique and comparable across partitions; None =
+        # this store owns its own sequence, exactly as before)
+        self._rv_source = rv_source
         # commit-time event stamping rides the freshness toggle with
         # the rest of the SLI layer: the ``freshab`` on/off A/B (and
         # ``KTPU_FRESHNESS=off``) must shed the stamping cost too, not
@@ -175,7 +180,13 @@ class ClusterStore:
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
-        self._rv += 1
+        if self._rv_source is not None:
+            # allocated from the shared counter, but remembered locally:
+            # current_rv()/list RVs stay "the newest revision THIS
+            # store committed" (the per-partition cursor component)
+            self._rv = self._rv_source()
+        else:
+            self._rv += 1
         return str(self._rv)
 
     def kind_seq(self, kind: str) -> int:

@@ -261,12 +261,15 @@ class SharedInformerFactory:
             inf = self._informers.get(kind)
             if inf is None:
                 list_name = _KIND_LISTS.get(kind)
-                if list_name is not None:
-                    list_fn = getattr(self._store, list_name)
-                else:
-                    # kinds without a typed list accessor (Secret,
-                    # ConfigMap, CSR, RBAC kinds, CRD-registered kinds)
-                    # ride the generic registry surface
+                # store-shaped clients without the typed accessor (the
+                # partition-aware RestClusterClient) and kinds without
+                # one at all (Secret, ConfigMap, CSR, RBAC kinds,
+                # CRD-registered kinds) ride the generic registry
+                # surface; the typed store methods stay the in-process
+                # fast path
+                list_fn = getattr(self._store, list_name, None) \
+                    if list_name is not None else None
+                if list_fn is None:
                     list_fn = (
                         lambda kind=kind: self._store.list_objects(kind)
                     )

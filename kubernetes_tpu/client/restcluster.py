@@ -186,11 +186,26 @@ class RestClusterClient:
         breaker_threshold: int = 5,
         retry_seed: Optional[int] = None,
         flow_id: str = "",
+        partition_urls: Optional[List[str]] = None,
     ):
-        self.base_url = base_url.rstrip("/")
-        rest = self.base_url.split("://", 1)[1]
-        host, _, port = rest.partition(":")
-        self._host, self._port = host, int(port or 80)
+        # partition-aware mode (apiserver/partition.py): one apiserver
+        # endpoint per store partition. Single-object calls route by the
+        # shared crc32 partition function, lists fan in across the
+        # partitions a kind can live in, bulk verbs split by partition
+        # and fan out, and watch opens ONE stream per (kind, partition)
+        # — the merged delivery preserves per-partition ordering, which
+        # is all the store ever guaranteed. ``partition_urls=None``
+        # (the default) is exactly the old single-endpoint client.
+        urls = [u.rstrip("/") for u in (partition_urls or [base_url])]
+        self.base_url = urls[0]
+        self.partition_urls = urls
+        self.partitions = len(urls)
+        self._endpoints: List[Tuple[str, int]] = []
+        for u in urls:
+            rest = u.split("://", 1)[1]
+            host, _, port = rest.partition(":")
+            self._endpoints.append((host, int(port or 80)))
+        self._host, self._port = self._endpoints[0]
         self.token = token
         # flow distinguisher refinement for the server's API Priority &
         # Fairness layer (X-Flow-Id): several logical tenants behind one
@@ -203,13 +218,18 @@ class RestClusterClient:
         self.watch_kinds = watch_kinds
         self.cache_ttl = cache_ttl
         self.limiter = TokenBucket(qps, burst) if qps else None
-        # keep-alive pools per lane (mirroring the server's readonly/
-        # mutating in-flight lanes): checked out per request, pre-warmed
-        # on failure so retries ride an established connection
-        self._pools: Dict[str, _ConnPool] = {
-            "ro": _ConnPool(self._host, self._port),
-            "rw": _ConnPool(self._host, self._port),
+        # keep-alive pools per (partition, lane) (mirroring the server's
+        # readonly/mutating in-flight lanes): checked out per request,
+        # pre-warmed on failure so retries ride an established connection
+        self._pools: Dict[Tuple[int, str], _ConnPool] = {
+            (p, lane): _ConnPool(host, port)
+            for p, (host, port) in enumerate(self._endpoints)
+            for lane in ("ro", "rw")
         }
+        # lazy executors (_fan_pool, _bind_pool) are created under this
+        # lock: fan-out workers can reach the bind pool concurrently,
+        # and a lost check-then-create race would leak live threads
+        self._pool_init_lock = threading.Lock()
         # active batched-status-write buffers per thread (see
         # batched_status_writes)
         self._status_buffers = threading.local()
@@ -293,8 +313,8 @@ class RestClusterClient:
             pass
 
     def _request(self, method: str, path: str, payload: Any = None,
-                 charge: float = 1.0, body_binary: Optional[bool] = None
-                 ) -> Tuple[int, Any]:
+                 charge: float = 1.0, body_binary: Optional[bool] = None,
+                 partition: int = 0) -> Tuple[int, Any]:
         if self.limiter is not None:
             self.limiter.charge(charge)
         body_binary = self.binary if body_binary is None else body_binary
@@ -302,7 +322,8 @@ class RestClusterClient:
         if payload is not None:
             data = codec.encode(payload) if body_binary \
                 else json.dumps(payload).encode()
-        pool = self._pools["ro" if method in ("GET", "HEAD") else "rw"]
+        pool = self._pools[(partition,
+                            "ro" if method in ("GET", "HEAD") else "rw")]
         headers = self._headers(body_binary)
         if charge > 1:
             # declare the per-object count so the server's APF width
@@ -426,31 +447,82 @@ class RestClusterClient:
             items = [from_wire(i, kind) for i in items]
         return items
 
-    def _list(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
-        code, payload = self._request("GET", self._path(kind, namespace))
-        self._raise_for(code, payload)
-        return self._items(payload, kind)
+    # -- partition routing (apiserver/partition.py's crc32 function —
+    # stores, servers and clients must all compute the same shard) ----
+    def _pk(self, kind: str, namespace: Optional[str] = None,
+            name: Optional[str] = None) -> int:
+        if self.partitions == 1:
+            return 0
+        from kubernetes_tpu.apiserver.partition import partition_for
 
-    def _list_with_rv(self, kind: str,
-                      namespace: Optional[str] = None) -> Tuple[List[Any], int]:
-        code, payload = self._request("GET", self._path(kind, namespace))
-        self._raise_for(code, payload)
-        rv = payload.get("resourceVersion")
-        if rv is None:
-            rv = (payload.get("metadata") or {}).get("resourceVersion", 0)
-        rv = int(rv)
-        with self._rv_lock:
-            last = self._last_rv.get(kind, 0)
-            if rv < last:
-                self.rv_regressions.append((kind, last, rv))
-            else:
-                self._last_rv[kind] = rv
-        return self._items(payload, kind), rv
+        return partition_for(kind, namespace, name, self.partitions)
+
+    def _pset(self, kind: str,
+              namespace: Optional[str] = None) -> List[int]:
+        if self.partitions == 1:
+            return [0]
+        from kubernetes_tpu.apiserver.partition import partitions_for
+
+        return partitions_for(kind, self.partitions, namespace)
+
+    def _list(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
+        parts = self._pset(kind, namespace)
+
+        def one(p: int) -> List[Any]:
+            code, payload = self._request(
+                "GET", self._path(kind, namespace), partition=p)
+            self._raise_for(code, payload)
+            return self._items(payload, kind)
+
+        if len(parts) == 1:
+            return one(parts[0])
+        # the biggest lists in the system (a replica's start() replay
+        # of 500k pods) fan in CONCURRENTLY — wall time is the slowest
+        # partition, not the sum
+        pool = self._fan_out()
+        out: List[Any] = []
+        for got in pool.map(one, parts):
+            out.extend(got)
+        return out
+
+    def _list_with_rv(self, kind: str, namespace: Optional[str] = None,
+                      partition: Optional[int] = None
+                      ) -> Tuple[List[Any], int]:
+        """List + consistency RV. With an explicit ``partition`` (the
+        per-partition watch loops), exactly that shard is listed and
+        the RV is that partition's — the composite-cursor component the
+        stream resumes from. Fan-in calls return the max component.
+        The RV-monotonicity watchdog is keyed per (kind, partition):
+        partitions advance independently, and only the per-partition
+        sequence is promised monotonic."""
+        out: List[Any] = []
+        max_rv = 0
+        parts = [partition] if partition is not None \
+            else self._pset(kind, namespace)
+        for p in parts:
+            code, payload = self._request(
+                "GET", self._path(kind, namespace), partition=p)
+            self._raise_for(code, payload)
+            rv = payload.get("resourceVersion")
+            if rv is None:
+                rv = (payload.get("metadata") or {}).get(
+                    "resourceVersion", 0)
+            rv = int(rv)
+            with self._rv_lock:
+                last = self._last_rv.get((kind, p), 0)
+                if rv < last:
+                    self.rv_regressions.append((kind, last, rv))
+                else:
+                    self._last_rv[(kind, p)] = rv
+            out.extend(self._items(payload, kind))
+            max_rv = max(max_rv, rv)
+        return out, max_rv
 
     def _get(self, kind: str, namespace: Optional[str],
              name: str) -> Optional[Any]:
         code, payload = self._request(
-            "GET", self._path(kind, namespace, name))
+            "GET", self._path(kind, namespace, name),
+            partition=self._pk(kind, namespace, name))
         if code == 404:
             return None
         self._raise_for(code, payload)
@@ -477,6 +549,69 @@ class RestClusterClient:
 
     def get_pod(self, namespace: str, name: str) -> Optional[Any]:
         return self._get("Pod", namespace, name)
+
+    # -- kubelet surface (kubemark hollow nodes over the REST fabric:
+    # node registration, heartbeat leases, pod lifecycle writes) -------
+    def get_node(self, name: str) -> Optional[Any]:
+        return self._get("Node", None, name)
+
+    def add_node(self, node) -> None:
+        """Upsert like ``store.add_node`` (kubelet registration is an
+        upsert: re-registration after a restart must not 409)."""
+        try:
+            self.create_object("Node", node)
+        except ValueError:
+            self.update_object("Node", node)
+
+    def update_node(self, node) -> None:
+        self.update_object("Node", node)
+
+    def delete_node(self, name: str) -> None:
+        code, payload = self._request(
+            "DELETE", self._path("Node", None, name),
+            partition=self._pk("Node", None, name))
+        if code >= 400 and code != 404:
+            self._raise_for(code, payload)
+
+    def create_pod(self, pod) -> Any:
+        """Single-pod create (the kubelet's mirror-pod path)."""
+        return self.create_object("Pod", pod)
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str,
+                      pod_ip: str = "", host_ip: str = "") -> bool:
+        status: Dict[str, Any] = {}
+        if phase:
+            status["phase"] = phase
+        if pod_ip:
+            status["podIP"] = pod_ip
+        if host_ip:
+            status["hostIP"] = host_ip
+        code, payload = self._request(
+            "PUT", self._path("Pod", namespace, name, "status"),
+            {"status": status}, body_binary=False,
+            partition=self._pk("Pod", namespace))
+        if code == 404:
+            return False
+        self._raise_for(code, payload)
+        return True
+
+    def try_acquire_or_renew(self, name: str, holder: str, now: float,
+                             duration: float) -> bool:
+        """Heartbeat/leader lease over REST (POST
+        .../leases/{name}/acquire — rest.py's lease verb; the
+        in-process ``_Lease`` CAS, made remote). ``now`` is evaluated
+        server-side (one clock must arbitrate)."""
+        code, payload = self._request(
+            "POST", f"/api/v1/leases/{name}/acquire",
+            {"holder": holder, "duration": duration},
+            body_binary=False)
+        self._raise_for(code, payload)
+        return bool(payload.get("acquired"))
+
+    def lease_holder(self, name: str) -> Optional[str]:
+        obj = self._get("Lease", "kube-system", name)
+        return getattr(obj, "holder_identity", None) if obj is not None \
+            else None
 
     # -- cycle reads (TTL-cached: informer-cache consistency) ----------
     def list_services(self, namespace: str) -> List[Any]:
@@ -525,7 +660,7 @@ class RestClusterClient:
         code, payload = self._request(
             "POST", self._path("Pod", namespace, name, "binding"),
             {"kind": "Binding", "uid": uid, "target": {"name": node_name}},
-            body_binary=False,
+            body_binary=False, partition=self._pk("Pod", namespace),
         )
         self._raise_for(code, payload)
 
@@ -534,28 +669,113 @@ class RestClusterClient:
     # overlap a single blocking round trip cannot have
     _BIND_SPLIT = 1024
 
+    def _fan_out(self):
+        """Shared executor for per-partition bulk-verb fan-out (bulk
+        verbs split by partition and ship concurrently — each
+        partition's server applies its slice under its own lock/GIL).
+        Creation is serialized: fan-out workers themselves reach the
+        split-bind pool, and a check-then-create race would leak a
+        live executor."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._pool_init_lock:
+            pool = getattr(self, "_fan_pool", None)
+            if pool is None:
+                pool = self._fan_pool = ThreadPoolExecutor(
+                    max_workers=max(2, min(self.partitions, 8)),
+                    thread_name_prefix="partition-fan")
+        return pool
+
+    def check_partition_topology(self) -> None:
+        """Validate that every configured endpoint serves the partition
+        index this client will route to it (GET
+        /api/v1/partitiontopology) — a client built with shuffled or
+        wrong-count URLs must fail HERE, loudly, not silently read
+        half-empty shards. Servers predating the endpoint (404) are
+        skipped best-effort."""
+        for i in range(self.partitions):
+            code, topo = self._request(
+                "GET", "/api/v1/partitiontopology", partition=i)
+            if code == 404:
+                continue
+            if code != 200 or not isinstance(topo, dict):
+                raise RuntimeError(
+                    f"partition {i} topology probe failed: HTTP {code}")
+            if topo.get("partition") != i \
+                    or topo.get("partitions") != self.partitions:
+                raise RuntimeError(
+                    f"partition_urls[{i}] ({self.partition_urls[i]}) "
+                    f"serves partition {topo.get('partition')} of "
+                    f"{topo.get('partitions')}, not {i} of "
+                    f"{self.partitions} — misconfigured routing")
+
+    def _group_by_partition(self, items, key_fn):
+        """[(partition, [(orig_index, item), ...]), ...] preserving
+        per-partition order."""
+        groups: Dict[int, list] = {}
+        for i, item in enumerate(items):
+            groups.setdefault(key_fn(item), []).append((i, item))
+        return sorted(groups.items())
+
+    def _fan_by_partition(self, items, key_fn, call_fn):
+        """The bulk-verb fan-out scaffold, once: split positional
+        ``items`` by partition, run ``call_fn(partition, slice)`` per
+        group (concurrently when several partitions are involved), and
+        merge each slice's positional results back into item order."""
+        results: List[Any] = [None] * len(items)
+        groups = self._group_by_partition(items, key_fn)
+        if len(groups) == 1:
+            p, entries = groups[0]
+            outs = [(entries, call_fn(p, [it for _, it in entries]))]
+        else:
+            pool = self._fan_out()
+            futures = [
+                (entries, pool.submit(call_fn, p,
+                                      [it for _, it in entries]))
+                for p, entries in groups
+            ]
+            outs = [(entries, fut.result()) for entries, fut in futures]
+        for entries, got in outs:
+            for (i, _item), r in zip(entries, got):
+                results[i] = r
+        return results
+
     def bind_many(
         self, bindings: List[Tuple[str, str, str, str]]
     ) -> List[Optional[Exception]]:
         """Bulk POST ../bindings; per-item failures come back
-        positionally — the exact contract of store.bind_many."""
+        positionally — the exact contract of store.bind_many. With a
+        partitioned fabric the batch splits by the pod's partition and
+        the slices fan out concurrently."""
         if not bindings:
             return []
+        if self.partitions == 1:
+            return self._bind_partition(0, bindings)
+        return self._fan_by_partition(
+            bindings, lambda b: self._pk("Pod", b[0]),
+            self._bind_partition)
+
+    def _bind_partition(
+        self, partition: int, bindings: List[Tuple[str, str, str, str]]
+    ) -> List[Optional[Exception]]:
         if len(bindings) > self._BIND_SPLIT:
             from concurrent.futures import ThreadPoolExecutor
 
-            pool = getattr(self, "_bind_pool", None)
-            if pool is None:
-                pool = self._bind_pool = ThreadPoolExecutor(
-                    max_workers=2, thread_name_prefix="bind-many")
+            with self._pool_init_lock:
+                pool = getattr(self, "_bind_pool", None)
+                if pool is None:
+                    pool = self._bind_pool = ThreadPoolExecutor(
+                        max_workers=2, thread_name_prefix="bind-many")
             mid = len(bindings) // 2
-            left = pool.submit(self._bind_chunk, bindings[:mid])
-            right = self._bind_chunk(bindings[mid:])
+            left = pool.submit(self._bind_chunk, bindings[:mid],
+                               partition)
+            right = self._bind_chunk(bindings[mid:], partition)
             return left.result() + right
-        return self._bind_chunk(bindings)
+        return self._bind_chunk(bindings, partition)
 
     def _bind_chunk(
-        self, bindings: List[Tuple[str, str, str, str]]
+        self, bindings: List[Tuple[str, str, str, str]],
+        partition: int = 0,
     ) -> List[Optional[Exception]]:
         if self.binary:
             payload: Any = {"kind": "BindingList",
@@ -567,7 +787,8 @@ class RestClusterClient:
                 for ns, n, u, node in bindings
             ]}
         code, resp = self._request("POST", "/api/v1/bindings", payload,
-                                   charge=len(bindings))
+                                   charge=len(bindings),
+                                   partition=partition)
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -591,7 +812,8 @@ class RestClusterClient:
             return
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
-            {"status": status}, body_binary=False)
+            {"status": status}, body_binary=False,
+            partition=self._pk("Pod", namespace))
         if code == 404:
             return   # pod deleted under us: store semantics are no-op
         self._raise_for(code, payload)
@@ -599,7 +821,8 @@ class RestClusterClient:
     def write_pod_statuses(self, updates: List[dict]
                            ) -> List[Optional[Exception]]:
         """Bulk POST /api/v1/statuses (PodStatusList): N status writes,
-        one round trip, positional failures. Each item is
+        one round trip per PARTITION (the batch splits by the pod's
+        partition and fans out), positional failures. Each item is
         ``{"namespace", "name", "status": {...}}`` with the exact
         per-item semantics of PUT pods/{name}/status; the token bucket
         charges per ITEM, so bulk status writes stay rate-equivalent to
@@ -607,10 +830,18 @@ class RestClusterClient:
         ``_put_status``."""
         if not updates:
             return []
+        if self.partitions == 1:
+            return self._statuses_partition(0, list(updates))
+        return self._fan_by_partition(
+            updates, lambda u: self._pk("Pod", u.get("namespace")),
+            self._statuses_partition)
+
+    def _statuses_partition(self, partition: int, updates: List[dict]
+                            ) -> List[Optional[Exception]]:
         code, resp = self._request(
             "POST", "/api/v1/statuses",
-            {"kind": "PodStatusList", "items": list(updates)},
-            charge=len(updates), body_binary=False)
+            {"kind": "PodStatusList", "items": updates},
+            charge=len(updates), body_binary=False, partition=partition)
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -672,7 +903,8 @@ class RestClusterClient:
 
     def delete_pod(self, namespace: str, name: str) -> None:
         code, payload = self._request(
-            "DELETE", self._path("Pod", namespace, name))
+            "DELETE", self._path("Pod", namespace, name),
+            partition=self._pk("Pod", namespace))
         if code >= 400 and code != 404:
             self._raise_for(code, payload)
 
@@ -704,38 +936,70 @@ class RestClusterClient:
 
     # -- generic objects (event recorder, extenders) -------------------
     def create_object(self, kind: str, obj) -> Any:
+        ns = getattr(obj.metadata, "namespace", None)
         code, payload = self._request(
-            "POST",
-            self._path(kind, getattr(obj.metadata, "namespace", None)),
-            obj if self.binary else to_wire(obj))
+            "POST", self._path(kind, ns),
+            obj if self.binary else to_wire(obj),
+            partition=self._pk(kind, ns, obj.metadata.name))
         self._raise_for(code, payload)
         return obj
 
     def create_objects_bulk(self, kind: str, objs: List[Any]) -> int:
         if not objs:
             return 0
+        if self.partitions == 1:
+            return self._create_bulk_partition(0, kind, objs)
+        # ride the shared scaffold by spreading each slice's created
+        # COUNT over per-item 0/1 flags (only the sum is contractual)
+        def create_slice(p: int, group: List[Any]) -> List[int]:
+            created = self._create_bulk_partition(p, kind, group)
+            return [1] * created + [0] * (len(group) - created)
+
+        flags = self._fan_by_partition(
+            objs,
+            lambda o: self._pk(
+                kind, getattr(o.metadata, "namespace", None),
+                o.metadata.name),
+            create_slice)
+        return sum(flags)
+
+    def _create_bulk_partition(self, partition: int, kind: str,
+                               objs: List[Any]) -> int:
+        # a batch spanning namespaces must POST the cluster-scoped
+        # collection (the path namespace overrides per-item namespaces
+        # server-side)
         ns = getattr(objs[0].metadata, "namespace", None)
+        if ns is not None and any(
+                getattr(o.metadata, "namespace", None) != ns
+                for o in objs):
+            ns = None
         payload = {"kind": f"{kind}List",
                    "items": objs if self.binary
                    else [to_wire(o) for o in objs]}
         code, resp = self._request("POST", self._path(kind, ns), payload,
-                                   charge=len(objs))
+                                   charge=len(objs), partition=partition)
         self._raise_for(code, resp)
         return resp.get("created", 0)
 
     def update_object(self, kind: str, obj,
                       expect_rv: Optional[str] = None) -> Any:
+        ns = getattr(obj.metadata, "namespace", None)
         code, payload = self._request(
-            "PUT",
-            self._path(kind, getattr(obj.metadata, "namespace", None),
-                       obj.metadata.name),
-            obj if self.binary else to_wire(obj))
+            "PUT", self._path(kind, ns, obj.metadata.name),
+            obj if self.binary else to_wire(obj),
+            partition=self._pk(kind, ns, obj.metadata.name))
         self._raise_for(code, payload)
         return obj
 
     def get_object(self, kind: str, namespace: str, name: str):
         return self._get(
             kind, namespace if namespace else None, name)
+
+    def list_objects(self, kind: str,
+                     namespace: Optional[str] = None) -> List[Any]:
+        """Generic list (the informer factory's fallback surface):
+        fans in across the partitions the kind can live in."""
+        return self._list(kind, namespace)
 
     def prune_expired_events(self, now: Optional[float] = None) -> int:
         return 0   # server-side Events TTL owns expiry over REST
@@ -748,23 +1012,30 @@ class RestClusterClient:
         through the same (fn, batch_fn) contract as store.watch. Binary
         streams arrive as server-batched frames — one frame, one
         batch_fn call (the store's own batched dispatch, preserved over
-        the wire)."""
+        the wire). Against a partitioned fabric this opens ONE stream
+        per (kind, partition) and merges: each stream is its own
+        reflector with its own resume cursor component and relist
+        scope, so a torn/stalled stream on one partition never delays
+        (or forces a relist of) another."""
         self._stopping.clear()
         for kind in self.watch_kinds:
-            t = threading.Thread(
-                target=self._watch_loop, args=(kind, fn, batch_fn),
-                daemon=True, name=f"watch-{kind}")
-            t.start()
-            self._watch_threads.append(t)
+            for p in self._pset(kind):
+                t = threading.Thread(
+                    target=self._watch_loop, args=(kind, p, fn, batch_fn),
+                    daemon=True, name=f"watch-{kind}-p{p}")
+                t.start()
+                self._watch_threads.append(t)
         return _WatchHandle(self)
 
     def _stop_watches(self) -> None:
         self._stopping.set()
 
-    def _watch_loop(self, kind: str, fn, batch_fn) -> None:
+    def _watch_loop(self, kind: str, partition: int, fn, batch_fn) -> None:
         first = True
         # objects this stream has shown the consumer, for reflector
-        # Replace semantics on reconnect: (ns, name) -> last-seen obj
+        # Replace semantics on reconnect: (ns, name) -> last-seen obj.
+        # Per (kind, partition): a partition stream relists only ITS
+        # slice, so the diff is against what THIS stream showed.
         known: Dict[tuple, Any] = {}
 
         def key_of(obj) -> tuple:
@@ -785,7 +1056,7 @@ class RestClusterClient:
 
         while not self._stopping.is_set():
             try:
-                objs, rv = self._list_with_rv(kind)
+                objs, rv = self._list_with_rv(kind, partition=partition)
                 if first:
                     # Scheduler.start() replays the first list itself;
                     # this stream only has to remember what exists
@@ -810,17 +1081,19 @@ class RestClusterClient:
                         {key_of(o): o for o in objs})
                     if events:
                         deliver(events)
-                self._stream_watch(kind, rv, deliver)
+                self._stream_watch(kind, rv, deliver,
+                                   partition=partition)
             except (http.client.HTTPException, OSError, RuntimeError):
                 pass
             if self._stopping.is_set():
                 return
             time.sleep(0.2)   # relist-and-rewatch (reflector restart)
 
-    def _stream_watch(self, kind: str, rv: int, deliver) -> None:
+    def _stream_watch(self, kind: str, rv: int, deliver,
+                      partition: int = 0) -> None:
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
-        conn = http.client.HTTPConnection(self._host, self._port,
-                                          timeout=300)
+        host, port = self._endpoints[partition]
+        conn = http.client.HTTPConnection(host, port, timeout=300)
         conn.connect()
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         headers = {}

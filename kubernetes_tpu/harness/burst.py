@@ -37,14 +37,20 @@ def make_burst_pods(
     labels: Optional[Dict[str, str]] = None,
     safe_to_evict: bool = False,
     owner_ref: Optional[dict] = None,
+    namespaces: Optional[Sequence[str]] = None,
 ) -> List[Pod]:
     """N plain resource pods named ``{name_prefix}{i}`` for i in
     [offset, offset+count) — the pending-burst shape every elastic
-    suite shares."""
+    suite shares. ``namespaces`` spreads the pods round-robin over
+    several namespaces (the partitioned control plane shards pods by
+    (kind, namespace-hash), so a multi-namespace burst exercises every
+    store partition instead of hashing whole into one)."""
     out: List[Pod] = []
     for i in range(offset, offset + count):
         d = basic_pod(i, cpu=f"{cpu_milli}m", memory=memory, labels=labels)
         d["metadata"]["name"] = f"{name_prefix}{i}"
+        if namespaces:
+            d["metadata"]["namespace"] = namespaces[i % len(namespaces)]
         pod = Pod.from_dict(d)
         pod.metadata.uid = f"{uid_prefix}{i}"
         if safe_to_evict:

@@ -115,6 +115,29 @@ def format_slo(evaluation: dict) -> str:
     return "slo[" + " ".join(parts) + "]"
 
 
+def format_shards(info: Dict) -> str:
+    """The partitioned-control-plane segment: topology (partitions ×
+    scheduler replicas), conflict ledger (same-pod CAS losses +
+    capacity-guard refusals, all resolved by the stale-commit path),
+    and the partition balance ratio (min/max objects per partition —
+    1.0 is perfectly even). Emitted by the scale harness whenever the
+    row ran sharded; parsed by the generic bracket scan in
+    ``parse_diag`` (key ``shards``)."""
+    if not info:
+        return ""
+    parts = [
+        f"partitions={int(info.get('partitions', 1))}",
+        f"replicas={int(info.get('replicas', 1))}",
+        f"conflicts={int(info.get('conflicts', 0))}",
+        f"capacity_rejects={int(info.get('capacity_rejects', 0))}",
+    ]
+    if info.get("balance") is not None:
+        parts.append(f"balance={float(info['balance']):.2f}")
+    if info.get("watch_streams") is not None:
+        parts.append(f"watch_streams={int(info['watch_streams'])}")
+    return "shards[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy
@@ -169,7 +192,8 @@ def parse_diag(line: str) -> Optional[dict]:
     the line is not a diag line. Keys (all optional): ``phases``
     (name → total_s/count/p99_ms), ``session``, ``chunk``,
     ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
-    ``autoscaler``, ``apf``, ``slo``, ``e2e_p99_ms``, ``e2e_buckets``
+    ``autoscaler``, ``apf``, ``slo``, ``shards``, ``e2e_p99_ms``,
+    ``e2e_buckets``
     (upper-edge str → count). Handles both the current diagfmt output
     and the legacy hand-rolled format in committed BENCH_r* tails."""
     marker = "diag:"

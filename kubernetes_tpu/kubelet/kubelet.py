@@ -155,11 +155,18 @@ class Kubelet:
                 self._mark_dirty(pod.uid)
         self._watch_handle = self.store.watch(self._on_event)
         # pods/log provider (the apiserver proxies log requests to the
-        # node's kubelet; this registry is that connection in-process)
-        self.store.register_log_source(self.node_name, self.container_logs)
-        self.store.register_exec_source(self.node_name, self.container_exec)
-        self.store.register_portforward_source(self.node_name,
-                                               self.forward_port)
+        # node's kubelet; this registry is that connection in-process).
+        # A REST-backed store (kubemark hollow nodes over the fabric)
+        # has no in-process registration surface — the proxy dial the
+        # registry stands in for doesn't exist over plain HTTP — so the
+        # providers are simply not offered there.
+        if hasattr(self.store, "register_log_source"):
+            self.store.register_log_source(self.node_name,
+                                           self.container_logs)
+            self.store.register_exec_source(self.node_name,
+                                            self.container_exec)
+            self.store.register_portforward_source(self.node_name,
+                                                   self.forward_port)
         self._thread = threading.Thread(
             target=self._sync_loop, daemon=True, name=f"kubelet-{self.node_name}"
         )
@@ -168,9 +175,10 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
-        self.store.unregister_log_source(self.node_name)
-        self.store.unregister_exec_source(self.node_name)
-        self.store.unregister_portforward_source(self.node_name)
+        if hasattr(self.store, "unregister_log_source"):
+            self.store.unregister_log_source(self.node_name)
+            self.store.unregister_exec_source(self.node_name)
+            self.store.unregister_portforward_source(self.node_name)
         if self._watch_handle is not None:
             self._watch_handle.stop()
         if self._thread is not None:

@@ -1,1 +1,1 @@
-from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowNode
+from kubernetes_tpu.kubemark.hollow import HollowCluster, HollowFleet, HollowNode

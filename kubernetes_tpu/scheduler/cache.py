@@ -198,6 +198,44 @@ class SchedulerCache:
                     flagged[name] = node
         return flagged
 
+    def commit_fits(self, items) -> List[Optional[str]]:
+        """Commit-time capacity probe for a batch of (pod, node_name)
+        bind targets — the multi-replica conflict guard's cache half:
+        a replica about to commit checks that each target still has
+        room AGAINST THE LIVE CACHE, which by now includes the pods its
+        sibling replicas bound since this batch was solved (their bind
+        events apply to every replica's cache). Cumulative within the
+        batch (two pods of this batch on one node charge it twice).
+        Returns a positional reason-or-None list; node existence and
+        taint staleness remain ``commit_target_flags``'s job."""
+        from kubernetes_tpu.scheduler.types import (
+            compute_pod_resource_request,
+        )
+
+        out: List[Optional[str]] = [None] * len(items)
+        with self._lock:
+            extra: Dict[str, List[int]] = {}
+            for i, (pod, node_name) in enumerate(items):
+                item = self._nodes.get(node_name)
+                if item is None or item.info.node is None:
+                    continue
+                info = item.info
+                req = compute_pod_resource_request(pod)
+                add = extra.setdefault(node_name, [0, 0, 0])
+                alloc = info.allocatable
+                if (alloc.milli_cpu and info.requested.milli_cpu + add[0]
+                        + req.milli_cpu > alloc.milli_cpu) or \
+                   (alloc.memory and info.requested.memory + add[1]
+                        + req.memory > alloc.memory) or \
+                   (alloc.allowed_pod_number and len(info.pods) + add[2]
+                        + 1 > alloc.allowed_pod_number):
+                    out[i] = "capacity"
+                    continue
+                add[0] += req.milli_cpu
+                add[1] += req.memory
+                add[2] += 1
+        return out
+
     def note_external_mutation(self) -> None:
         """Record a state change the cache itself doesn't track (PV /
         PVC / StorageClass / CSINode / Service object churn). The batch

@@ -32,7 +32,23 @@ class EventHandlers:
         self.sched = scheduler
 
     def responsible_for(self, pod: Pod) -> bool:
-        return pod.spec.scheduler_name in self.sched.profiles
+        """Profile match + (multi-replica mode) pod-hash queue
+        ownership: a pending pod belongs to exactly one replica's
+        queue. Assigned-pod events are NOT filtered here — every
+        replica caches every bound pod, whoever bound it, or the
+        capacity its siblings consumed would be invisible."""
+        if pod.spec.scheduler_name not in self.sched.profiles:
+            return False
+        shard = self.sched.pod_shard
+        return shard is None or shard(pod)
+
+    def caches_node(self, name: str) -> bool:
+        """Node-pool sharding (multi-replica mode): a replica given a
+        disjoint node pool caches — and therefore solves over — only
+        its own nodes, so concurrent replicas cannot conflict on
+        capacity by construction."""
+        shard = self.sched.node_shard
+        return shard is None or shard(name)
 
     # ------------------------------------------------------------------
     def handle_many(self, events) -> None:
@@ -100,6 +116,8 @@ class EventHandlers:
 
         for event in events:
             if event.kind == "Node" and event.type == ADDED:
+                if not self.caches_node(event.obj.name):
+                    continue   # another replica's node pool
                 run_for(node_run).append(event.obj)
                 continue
             if event.kind == "Pod":
@@ -238,6 +256,8 @@ class EventHandlers:
         sched = self.sched
         node: Node = event.obj
         old: Node = event.old_obj
+        if not self.caches_node(node.name):
+            return   # another replica's node pool (multi-replica mode)
         if event.type == ADDED:
             sched.cache.add_node(node)
             sched.queue.move_all_to_active_or_backoff_queue(ev.NODE_ADD)

@@ -21,7 +21,7 @@ import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.api.types import (
     NO_SCHEDULE,
@@ -175,6 +175,19 @@ class Scheduler:
         # commits made every gang batch invalidate the session (the
         # r5 state-only-rebuild-per-batch churn).
         self.last_bulk_commit_mutations = 0
+        # -- multi-replica mode (scheduler/replicas.py installs these):
+        # pod_shard(pod)->bool decides queue ownership (pod-hash
+        # sharding: each pending pod belongs to exactly one replica);
+        # node_shard(name)->bool restricts this replica's cache to a
+        # disjoint node pool; commit_capacity_guard adds a commit-time
+        # cache capacity probe (the optimistic-conflict guard for
+        # replicas sharing ALL nodes — a sibling's binds land in this
+        # cache via watch events, so a fit that evaporated since the
+        # solve is refused and requeued instead of oversubscribing).
+        self.pod_shard: Optional[Callable[[Pod], bool]] = None
+        self.node_shard: Optional[Callable[[str], bool]] = None
+        self.commit_capacity_guard = False
+        self.replica_name = ""
 
     # ------------------------------------------------------------------
     @classmethod
@@ -265,6 +278,9 @@ class Scheduler:
             set_listener(self.set_degraded)
         # replay current state (the initial List of ListAndWatch)
         for node in self.client.list_nodes():
+            if self.node_shard is not None and \
+                    not self.node_shard(node.name):
+                continue
             self.cache.add_node(node)
         for pod in self.client.list_pods():
             if assigned(pod):
@@ -545,6 +561,17 @@ class Scheduler:
                     fwk, qpi, result.suggested_host, reason, "serial",
                     pod_scheduling_cycle)
                 return False
+        if self.commit_capacity_guard and self.cache.commit_fits(
+                ((pod, result.suggested_host),))[0] is not None:
+            # multi-replica optimistic conflict: a sibling's binds
+            # (applied to this cache via watch events) consumed the
+            # capacity this solve counted on — refuse and requeue, the
+            # next attempt solves against the post-conflict world
+            self._reject_stale_commit(
+                fwk, qpi, result.suggested_host,
+                "out of capacity (concurrent replica commits)",
+                "capacity", pod_scheduling_cycle)
+            return False
         # assume: tell the cache the pod is (going to be) bound (scheduler.go:359)
         assumed_pod = shallow_copy(pod)
         assumed_pod.spec = shallow_copy(pod.spec)
@@ -653,6 +680,27 @@ class Scheduler:
                         cycle)
                     stale_failed += 1
             commits = live_commits
+        if self.commit_capacity_guard and commits:
+            # multi-replica optimistic conflict guard: ONE cache probe
+            # for the whole batch, cumulative per node — targets whose
+            # remaining capacity a sibling replica consumed since the
+            # solve are refused before assume and requeued
+            verdicts = self.cache.commit_fits(
+                [(qpi.pod, r.suggested_host)
+                 for qpi, r, _, _ in commits])
+            if any(v is not None for v in verdicts):
+                live_commits = []
+                for item, verdict in zip(commits, verdicts):
+                    if verdict is None:
+                        live_commits.append(item)
+                    else:
+                        qpi, result, cycle, _start = item
+                        self._reject_stale_commit(
+                            fwk, qpi, result.suggested_host,
+                            "out of capacity (concurrent replica "
+                            "commits)", "capacity", cycle)
+                        stale_failed += 1
+                commits = live_commits
         # --- assume (bulk): share the queue's parse via PodInfo.derived
         prepared: List[tuple] = []
         assumed_pods: List[Pod] = []
@@ -997,8 +1045,31 @@ class Scheduler:
             pass
         self._record_failure(fwk, qpi, err, "SchedulerError", "", cycle)
 
+    @staticmethod
+    def _note_bind_conflict(err: Exception) -> None:
+        """Count a bind the STORE refused because another writer got
+        there first — the same-pod CAS losing half of multi-replica
+        optimistic concurrency ("already assigned": a sibling replica
+        bound this pod; "uid mismatch": it was deleted and recreated in
+        flight; "capacity conflict": the partitioned store's bind-time
+        ledger arbitrated a node race). The loser unwinds through the
+        normal unreserve/forget/requeue path; this just makes the
+        conflict visible on the stale-bind series the chaos invariants
+        watch."""
+        msg = str(err)
+        if "capacity conflict" in msg:
+            path = "bind_conflict"
+        elif "already assigned" in msg or "uid mismatch" in msg:
+            path = "replica_conflict"
+        else:
+            return
+        from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+        fabric_metrics().stale_binds_rejected_total.inc(path)
+
     def _unreserve_forget_fail(self, fwk, state, qpi, assumed_pod, result,
                                err, cycle) -> None:
+        self._note_bind_conflict(err)
         fwk.run_reserve_plugins_unreserve(state, assumed_pod,
                                           result.suggested_host)
         gang = fwk.get_plugin("Coscheduling")

@@ -570,7 +570,21 @@ class TPUBatchScheduler:
         stale_flags = sched.cache.commit_target_flags(
             {cluster.node_names[int(a)] for a in assignments if a >= 0}
         )
+        # multi-replica capacity guard (replicas sharing all nodes):
+        # ONE cumulative cache probe for the whole batch — targets
+        # whose remaining capacity a sibling replica consumed since
+        # this solve route to the serial path, which re-places them
+        # against the post-conflict cache instead of burning a backoff
+        # round on a bind the guard would refuse anyway.
+        cap_verdicts = None
+        if sched.commit_capacity_guard:
+            cap_verdicts = sched.cache.commit_fits([
+                (qpi.pod,
+                 cluster.node_names[int(a)] if a >= 0 else "")
+                for (qpi, _), a in zip(batchable, assignments)
+            ])
         stale_routed = 0
+        capacity_routed = 0
         for bi, ((qpi, cycle), assignment) in enumerate(
             zip(batchable, assignments)
         ):
@@ -582,6 +596,10 @@ class TPUBatchScheduler:
             if flag is not False and \
                     commit_target_stale(qpi.pod, flag) is not None:
                 stale_routed += 1
+                serial.append(qpi)
+                continue
+            if cap_verdicts is not None and cap_verdicts[bi] is not None:
+                capacity_routed += 1
                 serial.append(qpi)
                 continue
             if self.validate and not self._host_validates(fwk, qpi, node_name):
@@ -611,6 +629,14 @@ class TPUBatchScheduler:
             # the device counted these pods onto nodes that are gone:
             # static planes drifted, force a full re-encode
             self.session.note_drift()
+        if capacity_routed:
+            from kubernetes_tpu.metrics.fabric_metrics import fabric_metrics
+
+            fabric_metrics().stale_binds_rejected_total.inc(
+                "capacity", amount=capacity_routed)
+            # sibling commits drifted the state planes (not the node
+            # set): the mirror no longer matches the cluster
+            self.session.invalidate()
         if commits:
             committed, failed = sched.commit_assignments_bulk(fwk, commits)
             self._cycle_mutations += sched.last_bulk_commit_mutations

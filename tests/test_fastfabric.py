@@ -54,8 +54,8 @@ def _pod(name: str, uid: str = "") -> Pod:
     )
 
 
-def _serve():
-    server = APIServer(store=ClusterStore()).start()
+def _serve(store_factory=ClusterStore):
+    server = APIServer(store=store_factory()).start()
     return server.store, server
 
 
@@ -277,6 +277,38 @@ class TestListCache:
 
 
 # ---------------------------------------------------------------------------
+# the fastfabric matrix over the PARTITIONED store (differential guard
+# satellite of the sharded-control-plane PR): with partitions=1 the
+# sharded store must be behaviorally identical to a bare ClusterStore,
+# and with partitions>1 every bulk-verb/watch/list-cache semantic above
+# must hold unchanged through the same REST surface.
+
+
+class TestPartitionedFabricMatrix:
+    @pytest.mark.parametrize("parts", [1, 3])
+    def test_fabric_matrix_over_partitioned_store(self, parts,
+                                                  monkeypatch):
+        import sys as _sys
+
+        from kubernetes_tpu.apiserver.partition import PartitionedStore
+
+        mod = _sys.modules[__name__]
+        monkeypatch.setattr(
+            mod, "_serve",
+            lambda: _orig_serve(lambda: PartitionedStore(parts)))
+        TestBulkVerbRoundTrip(
+        ).test_create_bind_status_bulk_binary_cross_checked()
+        TestBulkVerbRoundTrip(
+        ).test_bulk_status_reports_positional_failures()
+        TestCoalescedWatchFraming(
+        ).test_batched_chunks_decode_and_carry_old()
+        TestListCache().test_cached_list_refreshes_when_rv_compacts_out()
+
+
+_orig_serve = _serve
+
+
+# ---------------------------------------------------------------------------
 # per-object vs bulk: identical store mutation sequences
 
 
@@ -451,9 +483,23 @@ class TestBenchRowOrder:
                     "vs_baseline": 48.0, "p99_ratio_vs_solo": 1.3,
                     "qos_ok": True}
 
+        def fake_run_scale10x_one(serial_rate, qps, quick=False):
+            return {"metric": "pods_scheduled_per_sec[Scale10x "
+                              "400nodes/2000pods, partitioned fabric "
+                              "2p x 2r]",
+                    "value": 2000.0, "unit": "pods/s",
+                    "vs_baseline": 32.0,
+                    "ab": {"partitioned_pods_per_sec": 2000.0,
+                           "single_partition_pods_per_sec": 1500.0,
+                           "speedup": 1.33, "sharding_pays": True},
+                    "invariants": {"lost_pods": 0, "double_binds": 0},
+                    "conflict_cell": {"conflicts_total": 9, "ok": True}}
+
         monkeypatch.setattr(bench, "run_one", fake_run_one)
         monkeypatch.setattr(bench, "run_rest_one", fake_run_rest_one)
         monkeypatch.setattr(bench, "run_qos_one", fake_run_qos_one)
+        monkeypatch.setattr(bench, "run_scale10x_one",
+                            fake_run_scale10x_one)
         monkeypatch.setattr(bench.sys, "argv",
                             ["bench.py", "--skip-serial"])
         bench.main()
@@ -472,6 +518,13 @@ class TestBenchRowOrder:
                        if "noisy_tenant_qos" in r["metric"])
         assert idx_qos == idx_rest - 1
         assert rows[idx_qos]["qos_ok"] is True
+        # the 10×-tier partitioned-control-plane row rides right
+        # before the QoS/REST/headline tail with its A/B intact
+        idx_scale = next(i for i, r in enumerate(rows)
+                         if "Scale10x" in r["metric"])
+        assert idx_scale == idx_qos - 1
+        assert rows[idx_scale]["ab"]["sharding_pays"] is True
+        assert rows[idx_scale]["conflict_cell"]["ok"] is True
         # smoke: the REST row parses with its required fields
         rest = rows[idx_rest]
         assert rest["value"] > 0 and rest["unit"] == "pods/s"
@@ -485,8 +538,9 @@ class TestBenchRowOrder:
         assert order[-1] == "headline"
         assert order[-2] == "rest"
         assert order[-3] == "qos"
+        assert order[-4] == "scale10x"
         order_all = bench.matrix_row_order(include_extra=True)
-        assert order_all[-3:] == ["qos", "rest", "headline"]
+        assert order_all[-4:] == ["scale10x", "qos", "rest", "headline"]
         assert set(bench.EXTRA_MATRIX) < set(order_all)
 
 

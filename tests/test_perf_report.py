@@ -371,9 +371,20 @@ class TestBenchTailGuard:
                     "vs_baseline": 48.0, "p99_ratio_vs_solo": 1.3,
                     "qos_ok": True}
 
+        def fake_run_scale10x_one(serial_rate, qps, quick=False):
+            return {"metric": "pods_scheduled_per_sec[Scale10x "
+                              "50000nodes/500000pods, partitioned "
+                              "fabric 4p x 2r]",
+                    "value": 4200.0, "unit": "pods/s",
+                    "vs_baseline": 68.0,
+                    "ab": {"sharding_pays": True},
+                    "invariants": {"lost_pods": 0, "double_binds": 0}}
+
         monkeypatch.setattr(bench, "run_one", fake_run_one)
         monkeypatch.setattr(bench, "run_rest_one", fake_run_rest_one)
         monkeypatch.setattr(bench, "run_qos_one", fake_run_qos_one)
+        monkeypatch.setattr(bench, "run_scale10x_one",
+                            fake_run_scale10x_one)
         monkeypatch.setattr(bench.sys, "argv",
                             ["bench.py", "--skip-serial"])
         bench.main()

@@ -76,7 +76,7 @@ def main() -> int:
         description="seeded chaos matrices (wire faults + node churn)")
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
-                                 "both", "all"))
+                                 "partition", "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -143,6 +143,41 @@ def main() -> int:
         _run_suite(args, progress, rows, "overload", run_chaos_overload,
                    "overload_profile",
                    [p for p in args.overload.split(",") if p])
+    if args.suite in ("partition", "all"):
+        # partitioned-control-plane conflict cells: replica sets with
+        # overlapping responsibility racing over a tight cluster — the
+        # bind CAS + capacity guards must resolve every collision
+        # (conflicts REQUIRED: a quiet cell proved nothing), with zero
+        # lost pods and zero double-binds/oversubscription
+        from kubernetes_tpu.harness.scale import run_conflict_cell
+
+        for shape, (p_count, r_count) in (("2px2r", (2, 2)),
+                                          ("1px3r", (1, 3)),
+                                          ("4px2r", (4, 2))):
+            t0 = time.monotonic()
+            try:
+                # 2-cpu nodes, 500m pods: 4 slots per node; fill to
+                # 2 short of capacity so every brain races over an
+                # almost-full cluster but the burst still fits
+                cell_nodes = max(8, args.nodes // 2)
+                r = run_conflict_cell(
+                    nodes=cell_nodes, pods=cell_nodes * 4 - 2,
+                    partitions=p_count, replicas=r_count,
+                    progress=progress)
+                r.setdefault("stats", {
+                    "conflicts": r.get("conflicts_total", 0)})
+            except Exception as e:  # noqa: BLE001 — crashed cell = FAIL
+                r = {"ok": False,
+                     "failure": f"{type(e).__name__}: {e}", "stats": {}}
+            r["suite"] = "partition"
+            r["profile"] = shape
+            r["seed"] = "-"
+            r["elapsed"] = time.monotonic() - t0
+            rows.append(r)
+            status = "PASS" if r["ok"] else "FAIL"
+            print(f"  [{status}] partition/{shape} "
+                  f"({r['elapsed']:.1f}s)", flush=True)
+
     if args.suite in ("scale", "all"):
         from kubernetes_tpu.harness.elastic import run_scale_cell
 

@@ -284,6 +284,54 @@ def summarize_telemetry(telemetry_dir: str) -> dict:
 # rendering
 
 
+def scale_ab_flags(rounds: List[dict]) -> List[dict]:
+    """The scale10x row family's own checks — throughput trend alone
+    can't judge it. Each committed Scale10x row carries a same-scale
+    partitioned-vs-single A/B, hard invariants, and the conflict
+    chaos cell's verdict; flag the round when any of them fails:
+
+    - ``ab.sharding_pays`` false (partitioned arm slower than the
+      single-partition arm: the sharded fabric stopped paying for
+      itself — a partition-layer regression even if the headline value
+      still looks fine);
+    - nonzero ``invariants`` (lost pods / double-binds);
+    - a conflict cell that either broke an invariant or never
+      conflicted (``ok`` false — a cell with zero conflicts proved
+      nothing about the resolution path)."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if "Scale10x" not in str(row.get("metric", "")) \
+                    or "error" in row:
+                continue
+            problems = []
+            ab = row.get("ab") or {}
+            if ab and not ab.get("sharding_pays", True):
+                problems.append(
+                    f"partitioned {ab.get('partitioned_pods_per_sec')} "
+                    f"< single-partition "
+                    f"{ab.get('single_partition_pods_per_sec')} pods/s")
+            inv = row.get("invariants") or {}
+            for key in ("lost_pods", "double_binds"):
+                if inv.get(key):
+                    problems.append(f"{key}={inv[key]}")
+            cell = row.get("conflict_cell") or {}
+            if cell and not cell.get("ok", True):
+                problems.append(
+                    f"conflict cell failed (conflicts="
+                    f"{cell.get('conflicts_total')}, lost="
+                    f"{cell.get('lost_pods')}, double="
+                    f"{cell.get('double_binds')})")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -347,6 +395,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     series = build_series(rounds)
     flags = detect_regressions(series, band_floor=args.band)
+    scale_flags = scale_ab_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -358,10 +407,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 for m, pts in series.items()
             },
             "regressions": flags,
+            "scale_flags": scale_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
         print(render(series, flags, band_floor=args.band))
+        if scale_flags:
+            print("\nscale10x A/B / invariant flags:")
+            for f in scale_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -369,7 +424,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{telemetry['compiles']} compiles, "
                   f"device-wait share {telemetry['device_wait_share']:.0%}, "
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
-    return 1 if (args.strict and flags) else 0
+    return 1 if (args.strict and (flags or scale_flags)) else 0
 
 
 if __name__ == "__main__":
