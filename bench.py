@@ -164,6 +164,7 @@ def _diagnose(sched, bs) -> None:
         buckets = diagfmt.format_e2e(sched.metrics.e2e_scheduling_duration)
         sess = ""
         devprof_seg = ""
+        mesh_seg = ""
         if bs is not None:
             sess = " " + diagfmt.format_session(
                 bs.session, bs._chunk, bs.max_cycle_s, bs.pad_warms)
@@ -174,6 +175,11 @@ def _diagnose(sched, bs) -> None:
                 summary = dp.summary()
                 if summary["cycles"] or summary["warm_compiles"]:
                     devprof_seg = " " + diagfmt.format_devprof(summary)
+            # mesh segment, only when the row actually solved on the
+            # sharded tier: mesh width, shard count, donation — the
+            # provenance a devscale (or sharded-default REST) row's
+            # diag needs to be attributable from the line alone
+            mesh_seg = " " + diagfmt.format_mesh(bs.mesh_info())
         # node-churn segment, only when churn actually happened this
         # process (chaos_nodes harness / a churn-enabled run): the
         # eviction/stale-reject/rescue numbers explain a degraded row
@@ -277,8 +283,9 @@ def _diagnose(sched, bs) -> None:
         if engine.enabled:
             slo_seg = diagfmt.format_slo(engine.evaluate())
         log(diagfmt.format_diag(
-            segs + [sess.strip(), devprof_seg.strip(), churn.strip(),
-                    autoscale.strip(), apf.strip(), slo_seg] + buckets))
+            segs + [sess.strip(), devprof_seg.strip(), mesh_seg.strip(),
+                    churn.strip(), autoscale.strip(), apf.strip(),
+                    slo_seg] + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -649,7 +656,7 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
-                       "autoscale", "scale10x"])
+                       "autoscale", "scale10x", "devscale"])
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -662,16 +669,42 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.sharded_cpu:
-        # fresh interpreter: bench_sharded must set XLA_FLAGS (8 virtual
-        # CPU devices) before any JAX backend initializes
+        # fresh interpreter: the virtual-device bootstrap must set
+        # XLA_FLAGS before any JAX backend initializes — devscale owns
+        # the ONE spawn-with-XLA_FLAGS entrypoint. The child imports
+        # the package by module name, so it needs the repo root on its
+        # path whatever cwd the parent was launched from.
         import os
         import subprocess
 
-        cmd = [sys.executable, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "bench_sharded.py")]
+        env = dict(os.environ)
+        root = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        cmd = [sys.executable, "-m", "kubernetes_tpu.harness.devscale",
+               "--sharded-cpu"]
         if args.quick:
             cmd.append("--quick")
-        raise SystemExit(subprocess.run(cmd).returncode)
+        raise SystemExit(subprocess.run(cmd, env=env).returncode)
+
+    if args.config == "devscale":
+        # the devices×throughput scaling row (sharded-by-default
+        # solve): 1/2/4/8 virtual devices in spawned children, solve
+        # throughput + donation on/off telemetry A/B per arm
+        from kubernetes_tpu.harness.devscale import (
+            QUICK_BATCH, QUICK_NODES, QUICK_PODS, run_devscale_row,
+        )
+
+        if args.quick:
+            row = run_devscale_row(
+                nodes=QUICK_NODES, pods=QUICK_PODS,
+                max_batch=QUICK_BATCH, device_counts=(1, 2),
+                donation_ab_devices=2, progress=log)
+        else:
+            row = run_devscale_row(progress=log)
+        print(json.dumps(row), flush=True)
+        return
 
     if args.config == "traceab":
         nodes, measure_pods = (200, 1000) if args.quick else (5000, 30000)

@@ -1,264 +1,27 @@
-"""Multi-chip scaling-shape benchmark on the virtual CPU device mesh
-(VERDICT r2 #4: "show the multi-chip scaling shape, not just
-correctness").
+"""(thin shim) Multi-chip scaling-shape benchmark on the virtual CPU
+device mesh — the implementation lives in
+``kubernetes_tpu/harness/devscale.py`` since the devscale row landed,
+so there is ONE spawn-with-XLA_FLAGS virtual-device bootstrap instead
+of two diverging copies. Kept so the committed ``sharded_scaling.log``
+workflow (``python bench_sharded.py [--quick]``) keeps working.
 
-Runs the headline workload (SchedulingBasic, 5k nodes / 30k pods by
-default) END-TO-END through the full sidecar on:
-
-- the single-device XLA planes scan (the same solver the sharded
-  backend distributes), and
-- the mesh-sharded planes backend over 2/4/8-device meshes
-  (``parallel/sharded.py`` — node axis sharded over the mesh, XLA
-  collectives over ICI on real hardware).
-
-Absolute CPU wall-times say nothing about TPU rates; the SHAPE — device
-solve-time vs mesh size at a fixed problem size — is the evidence that
-the node-axis sharding pays (strong scaling) before multi-chip hardware
-exists. Emits one JSON line per configuration:
-
-    {"metric": "sharded_cpu[SchedulingBasic ...]", "devices": N,
-     "device_solve_s": ..., "solve_speedup_vs_1dev": ...,
-     "pods_per_second": ...}
-
-Run via ``python bench.py --sharded-cpu`` or directly
-(``python bench_sharded.py [--quick]``). Must own the interpreter's JAX
-platform: forces an 8-device CPU host before any backend initializes
-(the same mechanism as tests/conftest.py).
+Must own the interpreter's JAX platform: forces an 8-device CPU host
+before any backend initializes (``ensure_virtual_devices`` is the
+shared mechanism; tests/conftest.py uses the same trick inline).
 """
 
 from __future__ import annotations
 
-import os
-
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 import argparse
-import json
-import sys
-import time
 
+# jax-free import chain: devscale only touches jax inside its runner
+# functions, so the bootstrap below still precedes backend init
+from kubernetes_tpu.harness.devscale import (
+    ensure_virtual_devices,
+    run_sharded_cpu,
+)
 
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def _measure(name: str, nodes: int, pods: int, devices: int,
-             init_pods: int = 0) -> dict:
-    """One end-to-end run; returns the JSON row. devices=1 uses the
-    single-device planes scan, >1 the mesh-sharded backend."""
-    from kubernetes_tpu.harness import make_workload, run_workload
-
-    if devices == 1:
-        def backend_factory():
-            from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
-
-            return XlaPlanesBackend()
-    else:
-        def backend_factory():
-            from kubernetes_tpu.parallel import ShardedBackend, make_mesh
-
-            return ShardedBackend(make_mesh(devices, batch_axis=1))
-
-    seg = {}
-    mem = {}
-
-    def _shard_bytes(x) -> int:
-        """Bytes ONE device holds for array x (sharded arrays report a
-        single shard; replicated/host arrays their full size)."""
-        try:
-            return x.addressable_shards[0].data.nbytes
-        except Exception:  # noqa: BLE001 — numpy / non-jax fields
-            return int(getattr(x, "nbytes", 0))
-
-    def hook(sched, bs):
-        series = sched.metrics.batch_solve_duration._series
-        for key, (_counts, total, count) in series.items():
-            seg[key[0]] = (total, count)
-        # per-device footprint of the resident mirror (static planes +
-        # carried state): the multi-chip memory story — per-device bytes
-        # shrink ~1/N with the node axis sharded, so clusters larger
-        # than one chip's HBM fit the mesh
-        import dataclasses
-
-        total_b = 0
-        for obj in (bs.session._static, bs.session._state):
-            if obj is None:
-                continue
-            if dataclasses.is_dataclass(obj):
-                for f in dataclasses.fields(obj):
-                    v = getattr(obj, f.name)
-                    if hasattr(v, "nbytes") or hasattr(
-                            v, "addressable_shards"):
-                        total_b += _shard_bytes(v)
-            elif isinstance(obj, (tuple, list)):
-                for v in obj:
-                    total_b += _shard_bytes(v)
-        mem["per_device_bytes"] = total_b
-
-    ops = make_workload(name, nodes=nodes, init_pods=init_pods,
-                        measure_pods=pods)
-    t0 = time.time()
-    # adaptive_chunk=False: every mesh size must solve the IDENTICAL
-    # batch partition (the latency tuner would shrink slow
-    # configurations' chunks and inflate their batch counts — round-3's
-    # 13-vs-29 artifact measured the tuner, not the sharding)
-    r = run_workload(
-        f"{name}/sharded-{devices}dev", ops, use_batch=True,
-        max_batch=4096, wait_timeout=3600, progress=log,
-        backend_factory=backend_factory, result_hook=hook,
-        adaptive_chunk=False,
-    )
-    dev_total, dev_batches = seg.get("device", (0.0, 0))
-    return {
-        "metric": f"sharded_cpu[{name} {nodes}nodes/{pods}pods]",
-        "devices": devices,
-        "pods_per_second": round(r.pods_per_second, 1),
-        "device_solve_s": round(dev_total, 3),
-        "solve_batches": dev_batches,
-        "mirror_bytes_per_device": mem.get("per_device_bytes", 0),
-        "wall_s": round(time.time() - t0, 1),
-    }
-
-
-def _breakdown(n_nodes: int, batch_pods: int, device_counts) -> list:
-    """Per-batch compute-vs-collective split on one representative
-    solve batch. The ablated build (``collectives=False``) replaces
-    every cross-shard op with a local stand-in of identical arithmetic
-    shape, so full-minus-ablated wall time isolates pure collective
-    cost — the quantity shared-silicon virtual devices inflate (every
-    shard's collective work serializes onto the same cores) and real
-    ICI does not."""
-    import jax
-
-    from kubernetes_tpu.ops import BatchEncoder
-    from kubernetes_tpu.ops.pallas_solver import XlaPlanesBackend
-    from kubernetes_tpu.ops.solver import SolverParams, pack_podin
-    from kubernetes_tpu.parallel.sharded import (
-        _build_solve,
-        _prepare_sharded,
-        make_mesh,
-    )
-    from kubernetes_tpu.scheduler.snapshot import new_snapshot
-    from kubernetes_tpu.testing import MakeNode, MakePod
-
-    nodes = [
-        MakeNode().name(f"n{i}")
-        .capacity({"cpu": "32", "memory": "64Gi", "pods": "110"}).obj()
-        for i in range(n_nodes)
-    ]
-    pods = [
-        MakePod().name(f"p{i}").uid(f"u{i}")
-        .req({"cpu": "100m", "memory": "200Mi"}).obj()
-        for i in range(batch_pods)
-    ]
-    snap = new_snapshot([], nodes)
-    cluster, batch = BatchEncoder(snap, pad_nodes=128).encode(
-        pods, pad_pods=batch_pods
-    )
-    params = SolverParams()
-    ints, floats = pack_podin(batch)
-
-    def timed(fn, reps: int = 3) -> float:
-        fn()  # warm (compile)
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn())
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    rows = []
-    # single-device reference: the same planes scan the sharded build
-    # distributes
-    be = XlaPlanesBackend()
-    static1, state1 = be.prepare(cluster, batch)
-    base_s = timed(
-        lambda: be.solve(params, static1, state1, ints, floats)[0]
-    )
-    rows.append({
-        "metric": f"sharded_breakdown[{n_nodes}nodes/{batch_pods}pod-batch]",
-        "devices": 1, "batch_solve_s": round(base_s, 3),
-        "compute_s": round(base_s, 3), "collective_s": 0.0,
-        "collective_frac": 0.0,
-    })
-    # 1-shard control: the SAME shard_map build on a 1-device mesh —
-    # collectives are no-ops, so (control - planes-scan baseline)
-    # isolates the shard_map machinery's constant overhead from
-    # anything that scales with shard count
-    for d in [1] + list(device_counts):
-        mesh = make_mesh(d, batch_axis=1)
-        sstatic, sstate = _prepare_sharded(cluster, batch, mesh)
-        args = (sstatic.sc_meta, sstatic.ints, sstatic.f32s,
-                sstate.planes, sstate.totals, ints, floats, ints,
-                sstatic.has_dom)
-        times = {}
-        for collectives in (True, False):
-            run = _build_solve(
-                mesh, params, sstatic.r, sstatic.sc, sstatic.t,
-                sstatic.u, sstatic.v, with_counts=False,
-                any_hard=sstatic.any_hard, collectives=collectives,
-            )
-            with mesh:
-                times[collectives] = timed(lambda: run(*args)[0])
-        coll = max(times[True] - times[False], 0.0)
-        rows.append({
-            "metric":
-                f"sharded_breakdown[{n_nodes}nodes/{batch_pods}pod-batch]"
-                + ("(1-shard shard_map control)" if d == 1 else ""),
-            "devices": d,
-            "batch_solve_s": round(times[True], 3),
-            "compute_s": round(times[False], 3),
-            "collective_s": round(coll, 3),
-            "collective_frac": round(coll / max(times[True], 1e-9), 3),
-        })
-    return rows
-
-
-def main(quick: bool = False, breakdown_only: bool = False) -> None:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    n_dev = len(jax.devices())
-    if n_dev < 8:
-        log(f"WARNING: only {n_dev} CPU devices (wanted 8); "
-            "XLA_FLAGS was set too late for this interpreter — run "
-            "bench_sharded.py directly")
-    name = "SchedulingBasic"
-    nodes, pods = (512, 4096) if quick else (5000, 30000)
-    rows = []
-    for devices in (1, 2, 4, 8):
-        if devices > n_dev or breakdown_only:
-            continue
-        log(f"--- {devices} device(s) ---")
-        rows.append(_measure(name, nodes, pods, devices))
-    # preemption-heavy scaling row (VERDICT r4 next #4): the mass-
-    # decline -> vectorized screen -> victim-planner flow on the mesh
-    # path; fillers exactly fill the cluster so every measured pod
-    # preempts
-    p_nodes, p_pods = (256, 256) if quick else (1000, 1000)
-    for devices in (1, 8):
-        if devices > n_dev or breakdown_only:
-            continue
-        log(f"--- Preemption, {devices} device(s) ---")
-        row = _measure("Preemption", p_nodes, p_pods, devices,
-                       init_pods=p_nodes)
-        print(json.dumps(row), flush=True)
-    base = next((r for r in rows if r["devices"] == 1), None)
-    for r in rows:
-        if base and r["device_solve_s"] > 0:
-            r["solve_speedup_vs_1dev"] = round(
-                base["device_solve_s"] / r["device_solve_s"], 2
-            )
-        print(json.dumps(r), flush=True)
-    log("--- per-batch compute/collective breakdown ---")
-    bd_nodes, bd_pods = (512, 1024) if quick else (5000, 4096)
-    for row in _breakdown(bd_nodes, bd_pods,
-                          [d for d in (2, 4, 8) if d <= n_dev]):
-        print(json.dumps(row), flush=True)
+ensure_virtual_devices(8)
 
 
 if __name__ == "__main__":
@@ -266,4 +29,4 @@ if __name__ == "__main__":
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--breakdown-only", action="store_true")
     a = ap.parse_args()
-    main(quick=a.quick, breakdown_only=a.breakdown_only)
+    run_sharded_cpu(quick=a.quick, breakdown_only=a.breakdown_only)

@@ -75,6 +75,10 @@ def format_devprof(summary: dict) -> str:
     mc = summary.get("max_cycle")
     if mc:
         parts.append(f"max_cycle_phase={max_cycle_phase(mc)}")
+    if summary.get("donated_bytes"):
+        # bytes donated device-resident buffers kept OFF the link this
+        # window — printed only when the sharded donation path ran
+        parts.append(f"donated_mb={summary['donated_bytes'] / 1e6:.1f}")
     parts.append(f"detector={summary['compile_detector']}")
     return "devprof[" + " ".join(parts) + "]"
 
@@ -138,6 +142,24 @@ def format_shards(info: Dict) -> str:
     return "shards[" + " ".join(parts) + "]"
 
 
+def format_mesh(info: Optional[Dict]) -> str:
+    """The sharded-solve segment: mesh width (``devices``), node-axis
+    shard count (``shards``), and whether the solve donates its state
+    buffers (``donated`` 1/0). Emitted by bench rows whenever the
+    session's ACTIVE backend is the mesh tier (``TPUBatchScheduler
+    .mesh_info``); parsed by the generic bracket scan in ``parse_diag``
+    (key ``mesh``) — tools/perf_report.py reads it to attribute a
+    devscale regression to mesh shape or a donation regression."""
+    if not info:
+        return ""
+    parts = [
+        f"devices={int(info.get('devices', 1))}",
+        f"shards={int(info.get('shards', 1))}",
+        f"donated={1 if info.get('donated') else 0}",
+    ]
+    return "mesh[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy
@@ -192,8 +214,8 @@ def parse_diag(line: str) -> Optional[dict]:
     the line is not a diag line. Keys (all optional): ``phases``
     (name → total_s/count/p99_ms), ``session``, ``chunk``,
     ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
-    ``autoscaler``, ``apf``, ``slo``, ``shards``, ``e2e_p99_ms``,
-    ``e2e_buckets``
+    ``autoscaler``, ``apf``, ``slo``, ``shards``, ``mesh``,
+    ``e2e_p99_ms``, ``e2e_buckets``
     (upper-edge str → count). Handles both the current diagfmt output
     and the legacy hand-rolled format in committed BENCH_r* tails."""
     marker = "diag:"

@@ -159,6 +159,7 @@ class DevProfiler:
             compile_suspected=False,
             h2d_bytes=0,
             d2h_bytes=0,
+            donated_bytes=0,
         )
         rec.pending_block = False
         rec.done = False
@@ -184,7 +185,14 @@ class DevProfiler:
     def add_bytes(self, direction: str, n: int) -> None:
         """Account a host↔device transfer (direction: h2d | d2h),
         computed by the caller from the encoded array shapes/dtypes —
-        measuring the planes we *ship*, not interconnect counters."""
+        measuring the planes we *ship*, not interconnect counters.
+        Direction ``donated`` is the separate ledger for planes a
+        donated device-persistent buffer made REUSABLE this cycle —
+        bytes that never crossed the link. They are excluded from the
+        h2d total and the ``solver_transfer_bytes_total`` mirror (a
+        resident buffer counted as an upload would make the transfer
+        metric lie), but surfaced in ``summary()`` so the donation win
+        is a number."""
         rec = getattr(self._local, "active", None)
         if rec is not None and not rec.done:
             rec[direction + "_bytes"] += int(n)
@@ -384,6 +392,7 @@ class DevProfiler:
             "pad_waste_pct": 0.0,
             "h2d_bytes": 0,
             "d2h_bytes": 0,
+            "donated_bytes": 0,
             "compile_detector": "listener" if self.listener_active
             else "heuristic",
         }
@@ -402,6 +411,7 @@ class DevProfiler:
             out["compile_s"] += r["compile_s"]
             out["h2d_bytes"] += r["h2d_bytes"]
             out["d2h_bytes"] += r["d2h_bytes"]
+            out["donated_bytes"] += r.get("donated_bytes", 0)
             stale = r.get("staleness_s")
             if stale is not None and (max_staleness is None
                                       or stale > max_staleness):

@@ -35,7 +35,9 @@ clean-fallback contract.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -88,6 +90,21 @@ def _kib(b: int) -> int:
 
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
+
+
+# below this many real nodes a sharded fill costs more in thread
+# handoff than it wins — the per-node plugin loop is microseconds there
+ENCODE_SHARD_MIN_NODES = 512
+
+
+def _node_slices(n: int, shards: int) -> List[slice]:
+    """Contiguous node-column ranges, one per encode worker — the SAME
+    even split the mesh's NamedSharding uses for the node axis, so each
+    worker emits exactly the columns one device shard will hold."""
+    if shards <= 1 or n <= 0:
+        return [slice(0, n)]
+    step = -(-n // shards)
+    return [slice(s, min(s + step, n)) for s in range(0, n, step)]
 
 
 def _constraint_key(pod: Pod, c, sel: labelslib.Selector) -> tuple:
@@ -261,8 +278,21 @@ class BatchEncoder:
     Generation-LRU of the device mirror, SURVEY.md section 7 hard part 1)."""
 
     def __init__(self, snapshot: Snapshot, pad_nodes: int = 128,
-                 client=None, extra_nodes: Optional[List] = None):
+                 client=None, extra_nodes: Optional[List] = None,
+                 node_shards: int = 1):
         self.snapshot = snapshot
+        # sharded encode stage (the mesh-native planes contract): the
+        # node-column fill — resource rows and the per-profile static
+        # predicate/score plugin sweeps, the O(U × N) host cost of a
+        # rebuild — splits into ``node_shards`` contiguous column
+        # ranges, the SAME even split the solve mesh's NamedSharding
+        # uses, and runs on an encode worker pool. Workers write
+        # disjoint column slices of preallocated arrays (deterministic:
+        # no ordering-dependent state crosses a shard boundary), so a
+        # 50k-node plane is emitted per-shard instead of serializing on
+        # one host thread before upload. ``node_shards=1`` (every
+        # non-mesh backend) is the exact serial path.
+        self.node_shards = max(1, int(node_shards))
         self.node_infos = [ni for ni in snapshot.list() if ni.node is not None]
         # virtual node columns (the cluster autoscaler's what-if hook):
         # hypothetical template nodes appended AFTER the snapshot's real
@@ -319,6 +349,36 @@ class BatchEncoder:
         self._term_match_idx = ({}, [])
 
     # ------------------------------------------------------------------
+    def _sharding_active(self) -> bool:
+        return (self.node_shards > 1
+                and len(self.node_infos) >= ENCODE_SHARD_MIN_NODES)
+
+    def _run_encode_workers(self, tasks: List) -> None:
+        """Run zero-arg encode tasks (each owning a disjoint node-column
+        slice) on the worker pool; exceptions propagate to the caller
+        exactly like the serial loop's would."""
+        tasks = list(tasks)
+        if len(tasks) <= 1:
+            for t in tasks:
+                t()
+            return
+        with ThreadPoolExecutor(
+                max_workers=min(len(tasks), self.node_shards)) as pool:
+            for f in [pool.submit(t) for t in tasks]:
+                f.result()
+
+    def _for_node_shards(self, fill) -> None:
+        """Apply ``fill(node_slice)`` to every node-column shard —
+        concurrently when sharding is active, else one full-range call
+        (the exact serial path)."""
+        n = len(self.node_infos)
+        if not self._sharding_active():
+            fill(slice(0, n))
+            return
+        self._run_encode_workers(
+            [partial(fill, sl)
+             for sl in _node_slices(n, self.node_shards)])
+
     def encode(self, pods: List[Pod], pad_pods: int = 64) -> Tuple[
         EncodedCluster, EncodedBatch
     ]:
@@ -344,15 +404,21 @@ class BatchEncoder:
         nonzero_req = np.zeros((n_pad, 2), dtype=np.int32)
         pod_count = np.zeros(n_pad, dtype=np.int32)
         max_pods = np.zeros(n_pad, dtype=np.int32)
-        for i, ni in enumerate(nis):
-            allocatable[i] = _resource_row(ni.allocatable, resource_names)
-            requested[i] = _resource_row(ni.requested, resource_names)
-            nonzero_req[i] = (
-                ni.non_zero_requested.milli_cpu,
-                _kib(ni.non_zero_requested.memory),
-            )
-            pod_count[i] = len(ni.pods)
-            max_pods[i] = ni.allocatable.allowed_pod_number or 1_000_000
+        def fill_node_rows(sl: slice) -> None:
+            for i in range(sl.start, sl.stop):
+                ni = nis[i]
+                allocatable[i] = _resource_row(ni.allocatable,
+                                               resource_names)
+                requested[i] = _resource_row(ni.requested, resource_names)
+                nonzero_req[i] = (
+                    ni.non_zero_requested.milli_cpu,
+                    _kib(ni.non_zero_requested.memory),
+                )
+                pod_count[i] = len(ni.pods)
+                max_pods[i] = ni.allocatable.allowed_pod_number \
+                    or 1_000_000
+
+        self._for_node_shards(fill_node_rows)
         sv_attached = None
         sv_keys = None
         if self._attach_col:
@@ -620,9 +686,28 @@ class BatchEncoder:
         static_masks = np.zeros((u, n_pad), dtype=bool)
         affinity_masks = np.zeros((u, n_pad), dtype=bool)
         static_scores = np.zeros((u, n_pad), dtype=np.float32)
-        for ui, pod in enumerate(profile_pods):
-            self._compute_static(pod, static_masks[ui], affinity_masks[ui],
-                                 static_scores[ui])
+        if self._sharding_active():
+            # the O(U × N) plugin sweep is the rebuild's dominant host
+            # cost: one task per (profile, node shard), each emitting
+            # the columns of exactly one device shard. The per-POD
+            # volume context (host-only verdict, plugin construction,
+            # vb.pre_filter's client resolution) is hoisted out and
+            # computed once per profile — only the per-NODE loops fan
+            # out to the workers.
+            contexts = [self._volume_ctx(pod) for pod in profile_pods]
+            self._run_encode_workers([
+                partial(self._compute_static, pod, static_masks[ui],
+                        affinity_masks[ui], static_scores[ui], sl,
+                        contexts[ui])
+                for ui, pod in enumerate(profile_pods)
+                for sl in _node_slices(len(self.node_infos),
+                                       self.node_shards)
+            ])
+        else:
+            for ui, pod in enumerate(profile_pods):
+                self._compute_static(pod, static_masks[ui],
+                                     affinity_masks[ui],
+                                     static_scores[ui])
 
         # retain the encoding space, then fill the pod-side arrays with
         # THE SAME code the incremental path uses — a single
@@ -961,13 +1046,58 @@ class BatchEncoder:
             ident.append(("pv", repr(pv.node_affinity), zones))
         return tuple(sorted(ident))
 
+    # sentinel: "compute the volume context yourself" (the serial path);
+    # the sharded sweep precomputes one context per profile and shares
+    # it across that profile's shard tasks
+    _VOL_CTX_UNSET = object()
+
+    def _volume_ctx(self, pod: Pod):
+        """Per-POD half of the volume-feasibility work: the host-only
+        verdict, plugin construction and ``vb.pre_filter``'s client
+        resolution — node-independent, so the sharded sweep computes it
+        ONCE per profile instead of once per (profile, shard). Returns
+        None when the pod imposes no expressible volume constraint,
+        else ``(vb, vz, state, prefilter_failed)``; the CycleState is
+        written only by pre_filter here and read-only in the per-node
+        filters, so sharing it across shard workers is safe."""
+        if not (
+            self._client is not None
+            and any(v.persistent_volume_claim for v in pod.spec.volumes)
+            and not is_host_only(pod, self._client, self._wfc_cache)
+        ):
+            return None
+        from kubernetes_tpu.scheduler.framework.plugins.volume_binding import (  # noqa: E501
+            VolumeBinding,
+        )
+        from kubernetes_tpu.scheduler.framework.plugins.volume_zone import (
+            VolumeZone,
+        )
+
+        handle = _ClientHandle(self._client)
+        vb = VolumeBinding(handle)
+        vz = VolumeZone(handle)
+        state = CycleState()
+        failed = vb.pre_filter(state, pod) is not None
+        return (vb, vz, state, failed)
+
     def _compute_static(self, pod: Pod, mask: np.ndarray,
                         affinity_mask: np.ndarray,
-                        scores: np.ndarray) -> None:
+                        scores: np.ndarray,
+                        node_range: Optional[slice] = None,
+                        vol_ctx=_VOL_CTX_UNSET) -> None:
         """Evaluate node-static predicates/scores with the real host
-        plugins so the device path is differentially exact."""
+        plugins so the device path is differentially exact.
+        ``node_range`` restricts the sweep to one node-column shard
+        (the sharded encode stage) — every plugin here is per-node
+        stateless, so a sharded sweep is bit-identical to the serial
+        one. ``vol_ctx`` is the precomputed per-pod volume context
+        (``_volume_ctx``); left unset, it is computed here (the serial
+        path's one call per profile)."""
+        if node_range is None:
+            node_range = slice(0, len(self.node_infos))
         state = CycleState()
-        for i, ni in enumerate(self.node_infos):
+        for i in range(node_range.start, node_range.stop):
+            ni = self.node_infos[i]
             node = ni.node
             ok_affinity = pod_matches_node_selector_and_affinity(pod, node)
             affinity_mask[i] = ok_affinity
@@ -981,14 +1111,15 @@ class BatchEncoder:
             mask[i] = ok
             if ok:
                 scores[i] = self._static_score(pod, ni)
-        if (
-            self._client is not None
-            and any(v.persistent_volume_claim for v in pod.spec.volumes)
-            and not is_host_only(pod, self._client, self._wfc_cache)
-        ):
-            self._apply_volume_feasibility(pod, mask)
+        if vol_ctx is self._VOL_CTX_UNSET:
+            vol_ctx = self._volume_ctx(pod)
+        if vol_ctx is not None:
+            self._apply_volume_feasibility(pod, mask, node_range,
+                                           vol_ctx)
 
-    def _apply_volume_feasibility(self, pod: Pod, mask: np.ndarray) -> None:
+    def _apply_volume_feasibility(self, pod: Pod, mask: np.ndarray,
+                                  node_range: Optional[slice],
+                                  vol_ctx) -> None:
         """Fold PV node-affinity + zone feasibility into the static mask
         using the REAL host plugins (differential exactness, like the
         other static predicates). Only reached for expressible pods —
@@ -1002,21 +1133,16 @@ class BatchEncoder:
         equivalent — evicting pods never fixes a PV affinity/zone
         conflict, so the reference's dry-run re-filter would reject the
         node anyway."""
-        from kubernetes_tpu.scheduler.framework.plugins.volume_binding import (
-            VolumeBinding,
-        )
-        from kubernetes_tpu.scheduler.framework.plugins.volume_zone import (
-            VolumeZone,
-        )
-
-        handle = _ClientHandle(self._client)
-        vb = VolumeBinding(handle)
-        vz = VolumeZone(handle)
-        state = CycleState()
-        if vb.pre_filter(state, pod) is not None:
-            mask[: len(self.node_infos)] = False
+        if node_range is None:
+            node_range = slice(0, len(self.node_infos))
+        vb, vz, state, prefilter_failed = vol_ctx
+        if prefilter_failed:
+            # each shard worker clears ITS columns; the verdict is
+            # per-pod, so every shard reaches the same branch
+            mask[node_range] = False
             return
-        for i, ni in enumerate(self.node_infos):
+        for i in range(node_range.start, node_range.stop):
+            ni = self.node_infos[i]
             if not mask[i]:
                 continue
             if (

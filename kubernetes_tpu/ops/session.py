@@ -48,8 +48,12 @@ _logger = logging.getLogger(__name__)
 def _tree_nbytes(tree) -> int:
     """Byte size of every array leaf in a backend's prepared static or
     state pytree — the devprof host→device transfer accounting is
-    computed from the shapes/dtypes we actually ship, so it works
-    identically for numpy staging and committed device buffers."""
+    computed from the shapes/dtypes we actually ship. Only meaningful
+    for backends whose ``prepare`` genuinely uploads everything it
+    returns; backends that keep donated/persistent device buffers
+    declare ``self_accounting`` and report their own transfer bytes
+    (counting a device-resident donated plane as an upload would make
+    ``solver_transfer_bytes_total`` lie — the devscale proof metric)."""
     import jax
 
     try:
@@ -93,10 +97,42 @@ class XlaBackend:
         return self.materialize(h), new_state
 
 
+def _mesh_width(n_devices: int) -> int:
+    """Mesh node-axis width for the sharded tier: the largest power of
+    two ≤ the visible device count. Pad buckets are multiples of 128
+    lanes, so a power-of-two axis always divides the padded node count
+    (a 6-wide mesh would trip the divisibility contract and demote on
+    the very first rebuild)."""
+    width = 1
+    while width * 2 <= n_devices:
+        width *= 2
+    return width
+
+
 def default_backend():
-    """Pallas kernel on real TPU hardware, gather-free XLA planes scan
-    elsewhere (Mosaic does not target CPU; interpret mode is for tests
-    only). Override with KTPU_SOLVER=pallas|xla."""
+    """Backend tiering, mesh-aware since the sharded-by-default solve:
+
+    - ``KTPU_SOLVER=xla|pallas|cpp`` pin the legacy single-device
+      backends exactly as before;
+    - ``KTPU_SOLVER=sharded`` forces the mesh backend over every
+      visible device (a power-of-two mesh; even a 1-device mesh, for
+      the shard_map-machinery control arm);
+    - ``KTPU_SOLVER=auto`` — and UNSET on real multi-device hardware
+      (tpu/gpu) — takes the mesh tier whenever more than one device is
+      visible: the hardware, not the host, becomes the ceiling.
+      On a CPU host the unset default keeps the single-device planes
+      scan even when virtual devices are forced
+      (``--xla_force_host_platform_device_count``): virtual host
+      devices share the same silicon, so sharding there is a scaling
+      test vehicle (bench/devscale set ``auto`` explicitly), not a
+      production win — and the tier-1 suite must not silently pay
+      mesh compile costs;
+    - otherwise: Pallas kernel on TPU, native C++ planes solver when
+      the library builds, else the gather-free XLA planes scan.
+
+    A single visible device NEVER constructs a mesh on the auto/unset
+    paths (guarded by tests/test_backend_guard.py): single-device
+    startup pays zero mesh machinery."""
     import os
 
     import jax
@@ -114,6 +150,17 @@ def default_backend():
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
         return PallasBackend(interpret=jax.default_backend() == "cpu")
+    n_devices = jax.device_count()
+    mesh_tier = (
+        choice == "sharded"
+        or (choice == "auto" and n_devices > 1)
+        or (choice == "" and n_devices > 1
+            and jax.default_backend() in ("tpu", "gpu"))
+    )
+    if mesh_tier:
+        from kubernetes_tpu.parallel import ShardedBackend, make_mesh
+
+        return ShardedBackend(make_mesh(_mesh_width(n_devices)))
     if jax.default_backend() == "tpu":
         from kubernetes_tpu.ops.pallas_solver import PallasBackend
 
@@ -259,14 +306,25 @@ class SolverSession:
                 return None
             ints, floats = pack_podin(pb)
             dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
+            # a backend whose solve DONATES its state buffers (the
+            # mesh-sharded tier) must warm against a disposable clone:
+            # jax-array immutability no longer protects the resident
+            # mirror once the executable aliases inputs into outputs
+            state = self._state
+            clone = getattr(self._active, "warm_state", None)
+            if clone is not None and getattr(self._active, "donate",
+                                             False):
+                state = clone(state)
             t0 = time.monotonic()
             handle, _discarded_state = self._active.solve_lazy(
-                self.params, self._static, self._state, ints, floats
+                self.params, self._static, state, ints, floats
             )
             t_disp = time.monotonic()
             out = self._active.materialize(handle)  # block: compile+run
-            dp.phase("dispatch", t_disp - t0)
-            dp.phase("block", time.monotonic() - t_disp)
+            staging = self._take_staging_s()
+            dp.phase("dispatch", max(0.0, t_disp - t0 - staging))
+            dp.phase("block", time.monotonic() - t_disp + staging)
+            self._flush_backend_bytes(dp)
             dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
             # measured compile count when the listener is live; the
             # timing heuristic can only classify at cycle completion,
@@ -381,7 +439,15 @@ class SolverSession:
                         self.params, self._static, self._state,
                         ints, floats
                     )
-                    dp.phase("dispatch", time.monotonic() - t0)
+                    staging = self._take_staging_s()
+                    dp.phase("dispatch",
+                             max(0.0, time.monotonic() - t0 - staging))
+                    if staging:
+                        # synchronous host↔device plane staging (the
+                        # un-donated arm): the device sat fed-or-idle on
+                        # this copy — device wait, not dispatch work
+                        dp.phase("block", staging)
+                    self._flush_backend_bytes(dp)
                     if lazy:
                         self.last_materializer = \
                             self._timed_materializer(rec)
@@ -448,6 +514,41 @@ class SolverSession:
                                  staleness_ms=round(stale * 1000, 2))
         except Exception:  # noqa: BLE001 — SLIs must never break solves
             pass
+
+    def _flush_backend_bytes(self, dp, backend=None) -> None:
+        """Book a self-accounting backend's pending transfer ledgers
+        (real uploads/readbacks as h2d/d2h, donated resident planes in
+        the excluded ``donated`` ledger) into the open devprof cycle.
+        Called only AFTER a successful solve — the same
+        charge-only-after-success rule the generic ``_tree_nbytes``
+        accounting follows, so a failed chain link's upload never
+        pollutes the cycle of the backend that actually solved."""
+        take = getattr(backend or self._active, "take_transfer_bytes",
+                       None)
+        if take is None:
+            return
+        try:
+            for direction, n in take().items():
+                if n:
+                    dp.add_bytes(direction, int(n))
+        except Exception:  # noqa: BLE001 — accounting must never break
+            pass
+
+    def _take_staging_s(self, backend=None) -> float:
+        """Consume a backend's synchronous host↔device staging seconds
+        for the last solve (0.0 for backends without staging — only the
+        un-donated sharded arm stages). Defaults to the ACTIVE backend;
+        the rebuild chain passes its candidate explicitly (``_active``
+        is only re-pointed after success). The caller subtracts this
+        from its dispatch timing and books it as block: time spent
+        feeding the device is device wait."""
+        take = getattr(backend or self._active, "take_staging_s", None)
+        if take is None:
+            return 0.0
+        try:
+            return float(take())
+        except Exception:  # noqa: BLE001 — accounting must never break
+            return 0.0
 
     def _timed_materializer(self, rec):
         """Wrap the backend's materialize so a lazy solve's
@@ -543,6 +644,10 @@ class SolverSession:
         self._encoder = BatchEncoder(
             self.sched.algorithm.snapshot, pad_nodes=self.pad_nodes,
             client=getattr(self.sched, "client", None),
+            # sharded encode: split the node-column fill by the SAME
+            # shard boundaries the mesh solve uses, so a 50k-node plane
+            # never serializes on one host thread before upload
+            node_shards=getattr(self.backend, "encode_shards", 1),
         )
         cluster, batch = self._encoder.encode(
             pods, pad_pods=pad or self.max_batch
@@ -593,13 +698,22 @@ class SolverSession:
                 t_block = time.monotonic()
                 out = self._active.materialize(handle)
                 t_end = time.monotonic()
-                dp.phase("dispatch", t_block - t_disp)
-                dp.phase("block", t_end - t_block)
+                staging = self._take_staging_s()
+                dp.phase("dispatch",
+                         max(0.0, t_block - t_disp - staging))
+                dp.phase("block", t_end - t_block + staging)
                 # bytes accounted only after the solve SUCCEEDS (same
                 # rule as the chain loop below): a failed state-only
                 # attempt falls through to the full path, which charges
-                # its own static+state upload for this cycle
-                dp.add_bytes("h2d", _tree_nbytes(state))
+                # its own static+state upload for this cycle. A
+                # self-accounting backend (sharded tier) reports its
+                # real uploads via the pending-ledger hand-over —
+                # _tree_nbytes would count donated device-resident
+                # buffers as shipped.
+                if getattr(self._active, "self_accounting", False):
+                    self._flush_backend_bytes(dp)
+                else:
+                    dp.add_bytes("h2d", _tree_nbytes(state))
                 dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
                 self.last_materializer = None
                 self._observe("device", t_end - t0)
@@ -655,10 +769,16 @@ class SolverSession:
                 # phases recorded only for the backend that SUCCEEDED —
                 # a failed chain link's dispatch attempt must not read
                 # as device time of the solve that actually ran
-                dp.phase("dispatch", t_block - t_disp)
-                dp.phase("block", time.monotonic() - t_block)
-                dp.add_bytes("h2d", _tree_nbytes(self._static)
-                             + _tree_nbytes(state))
+                staging = self._take_staging_s(backend)
+                dp.phase("dispatch",
+                         max(0.0, t_block - t_disp - staging))
+                dp.phase("block",
+                         time.monotonic() - t_block + staging)
+                if getattr(backend, "self_accounting", False):
+                    self._flush_backend_bytes(dp, backend)
+                else:
+                    dp.add_bytes("h2d", _tree_nbytes(self._static)
+                                 + _tree_nbytes(state))
                 dp.add_bytes("d2h", int(getattr(out, "nbytes", 0)))
                 self._active = backend
                 self.last_materializer = None  # already materialized

@@ -284,6 +284,21 @@ def pack_podin(batch) -> Tuple[np.ndarray, np.ndarray]:
     return ints, np.asarray(batch.pref_weight, dtype=np.float32)
 
 
+def place_podin(ints: np.ndarray, floats: np.ndarray, sharding=None):
+    """Commit the packed pod stream to device. With ``sharding`` (the
+    mesh tier passes its replicated NamedSharding) the two buffers are
+    PLACED in one step, so the jitted shard_map solve reads them where
+    they landed instead of resharding from the default device at every
+    dispatch — the pod-stream half of the NamedSharding-placed-uploads
+    contract (the plane half lives in ``parallel/sharded.py``)."""
+    if sharding is None:
+        return jnp.asarray(ints), jnp.asarray(floats)
+    import jax as _jax
+
+    return (_jax.device_put(np.asarray(ints), sharding),
+            _jax.device_put(np.asarray(floats), sharding))
+
+
 def _unpack_podin(ints: jnp.ndarray, floats: jnp.ndarray,
                   r: int, sc: int, t: int) -> _PodIn:
     """Device-side inverse of ``pack_podin`` (column widths are static,

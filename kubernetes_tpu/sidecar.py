@@ -517,6 +517,26 @@ class TPUBatchScheduler:
             _logger.exception("solver warmup failed (continuing cold)")
         return time.monotonic() - t0
 
+    def mesh_info(self) -> Optional[Dict]:
+        """Sharded-solve topology of the session's ACTIVE backend, or
+        None off the mesh tier: mesh width, node-axis shard count, and
+        whether the solve donates its state buffers. Feeds the bench
+        ``diag:`` line's ``mesh[...]`` segment (harness/diagfmt.py) and
+        the devscale row's per-arm provenance."""
+        be = self.session._active
+        mesh = getattr(be, "mesh", None)
+        if mesh is None:
+            return None
+        try:
+            shards = int(dict(mesh.shape).get("nodes", mesh.size))
+        except Exception:  # noqa: BLE001 — diagnostics only
+            shards = int(getattr(mesh, "size", 1))
+        return {
+            "devices": int(mesh.size),
+            "shards": shards,
+            "donated": bool(getattr(be, "donate", False)),
+        }
+
     def _needs_serial(self, pod, cache=None) -> bool:
         if is_host_only(pod, self.sched.client, cache):
             return True

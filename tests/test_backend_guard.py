@@ -1,0 +1,103 @@
+"""Backend-tier guard (sharded-by-default solve, satellite): fast
+import-time assertions on ``ops.session.default_backend``.
+
+The mesh tier must be impossible to reach by accident on a
+single-device host — ``default_backend()`` must not even CONSTRUCT a
+mesh when ``jax.device_count() == 1`` (no regression of single-device
+startup latency), and the explicit ``KTPU_SOLVER=xla|pallas|cpp``
+pins must keep selecting the legacy backends no matter how many
+devices are visible. Mesh construction is trapped by monkeypatching
+the parallel module's constructors to raise, not by inspecting the
+returned object — "never constructs" is the contract, not "returns
+something else".
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import jax
+
+from kubernetes_tpu.ops import session as session_mod
+
+
+@pytest.fixture
+def no_mesh_allowed(monkeypatch):
+    """Any mesh construction under this fixture is a test failure."""
+    import kubernetes_tpu.parallel as parallel
+
+    def boom(*_a, **_k):
+        raise AssertionError(
+            "default_backend constructed a mesh on a single-device host")
+
+    monkeypatch.setattr(parallel, "make_mesh", boom)
+    monkeypatch.setattr(parallel, "ShardedBackend", boom)
+
+
+class TestSingleDeviceNeverMeshes:
+    @pytest.mark.parametrize("choice", ["", "auto"])
+    def test_no_mesh_at_one_device(self, monkeypatch, no_mesh_allowed,
+                                   choice):
+        monkeypatch.setattr(jax, "device_count", lambda: 1)
+        if choice:
+            monkeypatch.setenv("KTPU_SOLVER", choice)
+        else:
+            monkeypatch.delenv("KTPU_SOLVER", raising=False)
+        be = session_mod.default_backend()
+        # CPU single-device tiering unchanged: native C++ planes solver
+        # where the library builds, else the XLA planes scan
+        assert be.name in ("cpp", "xla-planes")
+        assert not hasattr(be, "mesh")
+
+    def test_unset_on_cpu_never_meshes_even_multi_device(
+            self, monkeypatch, no_mesh_allowed):
+        """The tier-1 environment itself: 8 forced virtual CPU devices
+        with KTPU_SOLVER unset must keep the single-device default —
+        virtual host devices share silicon, so the mesh tier is opt-in
+        (auto/sharded) on CPU hosts."""
+        monkeypatch.delenv("KTPU_SOLVER", raising=False)
+        assert jax.device_count() > 1  # conftest forces 8
+        be = session_mod.default_backend()
+        assert not hasattr(be, "mesh")
+
+
+class TestLegacyPinsStillPin:
+    def test_xla_pin(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "xla")
+        assert session_mod.default_backend().name == "xla-planes"
+
+    def test_pallas_pin(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "pallas")
+        be = session_mod.default_backend()
+        assert be.name == "pallas"
+        assert be.interpret  # cpu host
+
+    def test_cpp_pin(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "cpp")
+        assert session_mod.default_backend().name == "cpp"
+
+
+class TestMeshTier:
+    def test_auto_multi_device_takes_the_mesh(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "auto")
+        be = session_mod.default_backend()
+        assert be.name == "sharded"
+        # power-of-two node axis over the 8 virtual devices; donation
+        # is the default contract of the tier
+        assert dict(be.mesh.shape)["nodes"] == 8
+        assert be.donate
+        assert be.encode_shards == 8
+
+    def test_forced_sharded(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "sharded")
+        assert session_mod.default_backend().name == "sharded"
+
+    def test_donation_env_gate(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SOLVER", "auto")
+        monkeypatch.setenv("KTPU_SHARDED_DONATE", "0")
+        assert not session_mod.default_backend().donate
+
+    def test_mesh_width_is_largest_pow2(self):
+        assert [session_mod._mesh_width(n)
+                for n in (1, 2, 3, 4, 6, 8, 12, 100)] \
+            == [1, 2, 2, 4, 4, 8, 8, 64]

@@ -227,6 +227,45 @@ class TestCompileDetection:
         assert prof.cycles()[-1]["compile_suspected"] is True
 
 
+class TestDonatedBytes:
+    """Donated/persistent device buffers must not be counted as
+    transfers (sharded-by-default satellite): the tentpole's proof
+    metric — ``solver_transfer_bytes_total`` strictly lower with
+    donation on — would lie if resident planes were booked as
+    re-uploads every cycle."""
+
+    def test_donated_bytes_excluded_from_transfer_totals(self, prof):
+        from kubernetes_tpu.metrics.solver_metrics import solver_metrics
+
+        sm = solver_metrics()
+        h2d_before = sm.transfer_bytes_total.get("h2d")
+        d2h_before = sm.transfer_bytes_total.get("d2h")
+        rec = prof.begin_cycle(cycle=1, pad=64, real=64)
+        prof.add_bytes("h2d", 1_000)        # the pod stream: a real upload
+        prof.add_bytes("donated", 50_000)   # resident donated planes
+        prof.end_cycle(rec)
+        (cycle,) = prof.cycles()
+        # the record keeps the two ledgers apart
+        assert cycle["h2d_bytes"] == 1_000
+        assert cycle["donated_bytes"] == 50_000
+        # the /metrics mirror counts ONLY the real transfer
+        assert sm.transfer_bytes_total.get("h2d") == h2d_before + 1_000
+        assert sm.transfer_bytes_total.get("d2h") == d2h_before
+        # the summary surfaces both, h2d excluding donated
+        s = prof.summary()
+        assert s["h2d_bytes"] == 1_000
+        assert s["donated_bytes"] == 50_000
+
+    def test_legacy_records_without_donated_field_summarize(self, prof):
+        """Ring records written before the donated ledger existed (or
+        hand-built in tests) must not break the summary."""
+        rec = prof.begin_cycle(cycle=1, pad=8, real=8)
+        del rec["donated_bytes"]
+        prof.phase("block", 0.01)
+        prof.end_cycle(rec)
+        assert prof.summary()["donated_bytes"] == 0
+
+
 class TestMetricsMirror:
     def test_completed_cycle_updates_solver_metrics(self, prof):
         from kubernetes_tpu.metrics.solver_metrics import solver_metrics

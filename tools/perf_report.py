@@ -245,7 +245,8 @@ def summarize_telemetry(telemetry_dir: str) -> dict:
     out = {"cycles": 0, "warming_cycles": 0, "compiles": 0,
            "unexpected_compiles": 0, "block_s": 0.0, "dispatch_s": 0.0,
            "encode_s": 0.0, "h2d_bytes": 0, "d2h_bytes": 0,
-           "real_rows": 0, "padded_rows": 0, "files": 0}
+           "donated_bytes": 0, "real_rows": 0, "padded_rows": 0,
+           "files": 0}
     for path in sorted(glob.glob(
             os.path.join(telemetry_dir, "solvercycles-*.jsonl"))):
         out["files"] += 1
@@ -268,6 +269,7 @@ def summarize_telemetry(telemetry_dir: str) -> dict:
                     + rec.get("pack_s", 0.0)
                 out["h2d_bytes"] += rec.get("h2d_bytes", 0)
                 out["d2h_bytes"] += rec.get("d2h_bytes", 0)
+                out["donated_bytes"] += rec.get("donated_bytes", 0)
                 out["real_rows"] += rec.get("real", 0)
                 out["padded_rows"] += rec.get("pad", 0) or rec.get(
                     "real", 0)
@@ -322,6 +324,63 @@ def scale_ab_flags(rounds: List[dict]) -> List[dict]:
                     f"{cell.get('conflicts_total')}, lost="
                     f"{cell.get('lost_pods')}, double="
                     f"{cell.get('double_binds')})")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
+def devscale_flags(rounds: List[dict]) -> List[dict]:
+    """The devscale row family's own checks — a devices×throughput row
+    can't be judged by its throughput trend alone. Flag the round when:
+
+    - the solve fails its scaling bar: speedup at 4 devices < 1.5× vs
+      the 1-device arm (the row's acceptance criterion), or — on REAL
+      hardware rows only (``virtual_devices`` false/absent) — scaling
+      efficiency (speedup ÷ devices) at 4 devices below 0.6: the mesh
+      is mostly burning collective latency, a sharding-layer regression
+      even when absolute throughput still looks fine. Virtual-device
+      rows are exempt from the efficiency gate by construction: the
+      forced host devices share silicon AND the 1-device baseline is
+      intra-op multithreaded, so their efficiency understates any real
+      mesh;
+    - the donation A/B stopped paying: per-cycle h2d bytes or
+      device-wait share NOT strictly lower with donation on — either
+      the donated buffers regressed to real uploads or the transfer
+      accounting started counting resident planes again (the metric-
+      lies case the donated-bytes ledger exists to prevent)."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if "devscale" not in str(row.get("metric", "")) \
+                    or "error" in row:
+                continue
+            problems = []
+            speedup = (row.get("solve_speedup_vs_1dev") or {}).get("4")
+            if speedup is not None and speedup < 1.5:
+                problems.append(
+                    f"4-device solve speedup {speedup} < 1.5x")
+            eff = row.get("scaling_efficiency_4dev")
+            if eff is not None and eff < 0.6 \
+                    and not row.get("virtual_devices"):
+                problems.append(
+                    f"scaling efficiency {eff} < 0.6 at 4 devices")
+            ab = row.get("donation_ab") or {}
+            if ab and not ab.get("donation_pays", True):
+                on = ab.get("on") or {}
+                off = ab.get("off") or {}
+                problems.append(
+                    "donation A/B not paying (h2d/cycle "
+                    f"on={on.get('h2d_bytes_per_cycle')} "
+                    f"off={off.get('h2d_bytes_per_cycle')}, d2h/cycle "
+                    f"on={on.get('d2h_bytes_per_cycle')} "
+                    f"off={off.get('d2h_bytes_per_cycle')}, wait share "
+                    f"on={on.get('device_wait_share')} "
+                    f"off={off.get('device_wait_share')})")
             if problems:
                 flags.append({
                     "metric": row["metric"],
@@ -396,6 +455,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     series = build_series(rounds)
     flags = detect_regressions(series, band_floor=args.band)
     scale_flags = scale_ab_flags(rounds)
+    dev_flags = devscale_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -408,6 +468,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             },
             "regressions": flags,
             "scale_flags": scale_flags,
+            "devscale_flags": dev_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -417,6 +478,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in scale_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if dev_flags:
+            print("\ndevscale scaling / donation flags:")
+            for f in dev_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -424,7 +490,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{telemetry['compiles']} compiles, "
                   f"device-wait share {telemetry['device_wait_share']:.0%}, "
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
-    return 1 if (args.strict and (flags or scale_flags)) else 0
+    return 1 if (args.strict
+                 and (flags or scale_flags or dev_flags)) else 0
 
 
 if __name__ == "__main__":
