@@ -656,7 +656,12 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
-                       "autoscale", "scale10x", "devscale"])
+                       "autoscale", "scale10x", "devscale",
+                       "replay:storm", "replay:gangs",
+                       "replay:tenancy"])
+    ap.add_argument("--replay-seed", type=int, default=11,
+                    help="trace seed for the replay:<family> rows "
+                         "(same seed + trace → identical arrivals)")
     ap.add_argument("--rest-qps", type=float, default=5000.0)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true")
@@ -703,6 +708,30 @@ def main() -> None:
                 donation_ab_devices=2, progress=log)
         else:
             row = run_devscale_row(progress=log)
+        print(json.dumps(row), flush=True)
+        return
+
+    if args.config and args.config.startswith("replay:"):
+        # the trace-replay rows (ISSUE 13): a scenario family driven
+        # OPEN-LOOP through the REST fabric — pods arrive on a clock,
+        # lifetimes expire into deletions, per-pod latency measured
+        # from arrival, SLO verdicts + family invariants as the
+        # verdict. --quick compresses the trace clock and scale.
+        from kubernetes_tpu.workloads import run_replay_row
+
+        family = args.config.split(":", 1)[1]
+        if args.quick:
+            row = run_replay_row(
+                family, seed=args.replay_seed, scale=0.15,
+                time_scale=0.3, rest=True, max_batch=256,
+                qps=args.rest_qps if args.rest_qps > 0 else None,
+                wait_timeout=300, progress=log)
+        else:
+            row = run_replay_row(
+                family, seed=args.replay_seed, scale=1.0,
+                time_scale=1.0, rest=True, max_batch=1024,
+                qps=args.rest_qps if args.rest_qps > 0 else None,
+                wait_timeout=900, progress=log)
         print(json.dumps(row), flush=True)
         return
 

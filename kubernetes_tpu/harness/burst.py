@@ -79,15 +79,96 @@ class BurstResult:
         return self.injected / self.time_to_all_bound
 
 
-def _create(store, pods: Sequence[Pod]) -> None:
-    """Bulk-admit when the target supports it (the in-process store's
-    one-lock path); fall back to per-object creates (REST clients)."""
+def sample_percentile(samples: Sequence[float], q: float) -> float:
+    """Exact-sample percentile (index ``int(len*q)``, clamped) — THE
+    shared copy for harnesses that hold raw samples (the throughput
+    collector, the replay engine's arrival→bind latencies). Histogram
+    consumers use ``metrics.registry.quantile_from_counts`` instead;
+    this lives here because this module is the jax-free harness
+    commons the REST children may import."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(int(len(s) * q), len(s) - 1)]
+
+
+def create_chunk(store, pods: Sequence[Pod]) -> None:
+    """Bulk-admit one chunk: the in-process store's one-lock path
+    (``create_pods``), the REST bulk verb (``create_objects_bulk``),
+    then per-object creates as the last resort."""
     create_bulk = getattr(store, "create_pods", None)
     if create_bulk is not None:
         create_bulk(list(pods))
         return
+    bulk_verb = getattr(store, "create_objects_bulk", None)
+    if bulk_verb is not None:
+        bulk_verb("Pod", list(pods))
+        return
     for pod in pods:
         store.create_object("Pod", pod)
+
+
+def stream_arrivals(
+    arrivals,
+    send: Callable[[List], None],
+    *,
+    chunk: int = 512,
+    time_scale: float = 1.0,
+    flush_window: float = 0.0,
+    clock: Callable[[], float] = time.monotonic,
+    stop=None,
+    on_sent: Optional[Callable[[object, float], None]] = None,
+) -> int:
+    """THE open-loop arrival-injection loop (one implementation for the
+    replay engine, the pre-created burst path, and the REST creator
+    children — the ISSUE-13 no-copy-paste contract).
+
+    ``arrivals`` is an iterable of ``(due_s, item)`` pairs ordered by
+    ``due_s``; ``send(items)`` delivers one chunk (raises on failure).
+    The loop is OPEN-LOOP: an item whose due time has passed is sent
+    regardless of what happened to earlier items — nothing here waits
+    on binds. ``time_scale`` compresses/stretches the trace clock;
+    ``time_scale=0`` collapses every due time to NOW, which reduces the
+    loop to today's chunked-burst path exactly (the rate=∞ differential
+    guard rides on this). ``flush_window`` coalesces items due within
+    the next window into one send (fewer wire round-trips at high
+    rates). ``on_sent(item, offset_s)`` stamps each item with its real
+    send offset from loop start — the replay engine's arrival clock.
+    ``stop`` (threading.Event) aborts between sends. Returns the number
+    of items sent."""
+    t0 = clock()
+    sent = 0
+    batch: List = []
+
+    def flush() -> None:
+        nonlocal sent, batch
+        while batch:
+            part, batch = batch[:chunk], batch[chunk:]
+            send(part)
+            now_off = clock() - t0
+            if on_sent is not None:
+                for item in part:
+                    on_sent(item, now_off)
+            sent += len(part)
+
+    for due_s, item in arrivals:
+        if stop is not None and stop.is_set():
+            break
+        due = due_s * time_scale
+        while True:
+            wait = due - (clock() - t0) - flush_window
+            if wait <= 0:
+                break
+            if batch:
+                flush()
+            if stop is not None and stop.is_set():
+                return sent
+            time.sleep(min(wait, 0.05))
+        batch.append(item)
+        if len(batch) >= chunk:
+            flush()
+    flush()
+    return sent
 
 
 def count_bound(store, names: Sequence[str]) -> int:
@@ -134,7 +215,14 @@ def run_pending_burst(
     to ``make_burst_pods``), then measure time-to-all-bound."""
     pods = make_burst_pods(count, **make_kwargs)
     names = [p.metadata.name for p in pods]
-    _create(store, pods)
+    # the burst path IS the replay loop at rate=∞: every due time
+    # collapses to now. chunk=len(pods) keeps this the ONE bulk-admit
+    # call (one store lock, one batched watch delivery) the committed
+    # rows have always measured — the helper unifies the loop, not
+    # the chunking
+    stream_arrivals(((0.0, p) for p in pods),
+                    lambda chunk_pods: create_chunk(store, chunk_pods),
+                    chunk=max(len(pods), 1), time_scale=0.0)
     elapsed = wait_all_bound(store, names, timeout, progress=progress)
     return BurstResult(
         injected=count,

@@ -160,6 +160,33 @@ def format_mesh(info: Optional[Dict]) -> str:
     return "mesh[" + " ".join(parts) + "]"
 
 
+def format_replay(info: Optional[Dict]) -> str:
+    """The trace-replay segment: which family ran, the offered
+    open-loop arrival rate, the arrival→bind p99 (the latency a
+    submitting user experiences), the preemption ledger, and the gang
+    atomicity verdict (``gangs_intact`` 1/0 — 1 also when the trace
+    carried no gangs). Emitted by every replay row/cell; parsed by the
+    generic bracket scan in ``parse_diag`` (key ``replay``) —
+    tools/perf_report.py reads it to gate the ``replay_*`` families."""
+    if not info:
+        return ""
+    parts = [
+        f"family={info.get('family', '?')}",
+        f"rate={float(info.get('rate', 0.0)):.1f}",
+        f"p99_arrival_to_bind="
+        f"{float(info.get('p99_arrival_to_bind_ms', 0.0)):.0f}ms",
+        f"preempted={int(info.get('preempted', 0))}",
+        f"gangs_intact={1 if info.get('gangs_intact', True) else 0}",
+    ]
+    if info.get("lost") is not None:
+        parts.append(f"lost={int(info['lost'])}")
+    if info.get("expired") is not None:
+        parts.append(f"expired={int(info['expired'])}")
+    if info.get("inversions") is not None:
+        parts.append(f"inversions={int(info['inversions'])}")
+    return "replay[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy
@@ -215,7 +242,7 @@ def parse_diag(line: str) -> Optional[dict]:
     (name → total_s/count/p99_ms), ``session``, ``chunk``,
     ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
     ``autoscaler``, ``apf``, ``slo``, ``shards``, ``mesh``,
-    ``e2e_p99_ms``, ``e2e_buckets``
+    ``replay``, ``e2e_p99_ms``, ``e2e_buckets``
     (upper-edge str → count). Handles both the current diagfmt output
     and the legacy hand-rolled format in committed BENCH_r* tails."""
     marker = "diag:"

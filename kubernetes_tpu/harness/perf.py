@@ -22,11 +22,11 @@ from kubernetes_tpu.sidecar import attach_batch_scheduler
 
 
 def _percentile(samples: List[float], q: float) -> float:
-    if not samples:
-        return 0.0
-    s = sorted(samples)
-    idx = min(int(len(s) * q), len(s) - 1)
-    return s[idx]
+    # delegates to the shared jax-free copy (harness/burst.py) — one
+    # implementation of the exact-sample percentile across harnesses
+    from kubernetes_tpu.harness.burst import sample_percentile
+
+    return sample_percentile(samples, q)
 
 
 class ThroughputCollector:

@@ -210,6 +210,7 @@ def _creator_main(conn, url: str, name: str, nodes: int, init_pods: int,
     5000-QPS bucket, the reference's per-client discipline)."""
     from kubernetes_tpu.api.types import Node, Pod
     from kubernetes_tpu.client.restcluster import RestClusterClient
+    from kubernetes_tpu.harness.burst import stream_arrivals
 
     profiler = _maybe_profiler(f"creator-{name}")
     clients = [RestClusterClient(url, token=CREATOR_TOKEN, qps=qps)
@@ -242,24 +243,29 @@ def _creator_main(conn, url: str, name: str, nodes: int, init_pods: int,
             template = op["podTemplate"]
             offset = op.get("offset", 0)
             count = op["count"]
-            sent = 0
-            failed = None
-            for lo in range(0, count, CHUNK):
-                n = min(CHUNK, count - lo)
-                chunk = [Pod.from_dict(template(offset + lo + i))
-                         for i in range(n)]
-                client = clients[(lo // CHUNK) % len(clients)]
+            # the shared open-loop injection helper at rate=∞: lazy
+            # per-chunk pod construction, per-chunk client rotation —
+            # the same loop the replay engine paces with real due times
+            rotation = [0]
+
+            def send(items):
+                client = clients[rotation[0] % len(clients)]
+                rotation[0] += 1
                 code, resp = client._request(
                     "POST", "/api/v1/namespaces/default/pods",
-                    {"kind": "PodList", "items": chunk}, charge=n)
+                    {"kind": "PodList", "items": items},
+                    charge=len(items))
                 if code >= 400 or _real_failures(resp):
-                    failed = str(resp)[:500]
-                    break
-                sent += n
-            if failed is not None:
-                conn.send(("error", op_idx, failed))
-            else:
+                    raise RuntimeError(str(resp)[:500])
+
+            try:
+                sent = stream_arrivals(
+                    ((0.0, Pod.from_dict(template(offset + i)))
+                     for i in range(count)),
+                    send, chunk=CHUNK, time_scale=0.0)
                 conn.send(("done", op_idx, sent))
+            except RuntimeError as e:
+                conn.send(("error", op_idx, str(e)))
             continue
         conn.send(("done", op_idx, 0))
     _stop_profiler(profiler)

@@ -36,7 +36,7 @@ import multiprocessing as mp
 import time
 from typing import Callable, Dict, List, Optional
 
-from kubernetes_tpu.harness.burst import make_burst_pods
+from kubernetes_tpu.harness.burst import make_burst_pods, stream_arrivals
 
 SCHEDULER_TOKEN = "scale-scheduler-token"
 CREATOR_TOKEN = "scale-creator-token"
@@ -171,25 +171,34 @@ def _scale_driver_main(conn, urls: List[str], qps: Optional[float],
             conn.send(("done", count))
         elif cmd == "pods":
             _cmd, count, offset, namespaces = msg
-            sent = 0
-            err = None
-            for lo in range(0, count, CHUNK):
-                n = min(CHUNK, count - lo)
-                chunk = make_burst_pods(
-                    n, cpu_milli=POD_CPU_MILLI, memory=POD_MEMORY,
-                    name_prefix="scale-", uid_prefix="sc-",
-                    offset=offset + lo, namespaces=namespaces)
-                client = creators[(lo // CHUNK) % len(creators)]
-                try:
-                    created = client.create_objects_bulk("Pod", chunk)
-                except Exception as e:  # noqa: BLE001
-                    err = str(e)[:500]
-                    break
-                sent += created
-            if err is not None:
-                conn.send(("error", err))
-            else:
-                conn.send(("done", sent))
+            # shared open-loop injection helper at rate=∞ (lazy
+            # per-chunk pod construction: a 500k-pod burst must never
+            # materialize at once), per-chunk client rotation. The
+            # reported count is the SERVER-CONFIRMED create total —
+            # a partial bulk create must not masquerade as complete
+            rotation, confirmed = [0], [0]
+
+            def send(items):
+                client = creators[rotation[0] % len(creators)]
+                rotation[0] += 1
+                confirmed[0] += client.create_objects_bulk(
+                    "Pod", items)
+
+            def gen():
+                for lo in range(0, count, CHUNK):
+                    for p in make_burst_pods(
+                            min(CHUNK, count - lo),
+                            cpu_milli=POD_CPU_MILLI, memory=POD_MEMORY,
+                            name_prefix="scale-", uid_prefix="sc-",
+                            offset=offset + lo, namespaces=namespaces):
+                        yield (0.0, p)
+
+            try:
+                stream_arrivals(gen(), send, chunk=CHUNK,
+                                time_scale=0.0)
+                conn.send(("done", confirmed[0]))
+            except Exception as e:  # noqa: BLE001
+                conn.send(("error", str(e)[:500]))
     fleet.stop()
     conn.send("stopped")
 

@@ -45,6 +45,7 @@ import numpy as np
 from kubernetes_tpu.api import labels as labelslib
 from kubernetes_tpu.api.types import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Pod
 from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework.plugins import mesh_locality
 from kubernetes_tpu.scheduler.framework.plugins.helpers import (
     pod_matches_node_selector_and_affinity,
 )
@@ -998,6 +999,10 @@ class BatchEncoder:
             ),
             tuple(sorted(c.image for c in spec.containers)),
             self._volume_profile_identity(pod),
+            # mesh-block component: two gangs anchor to different mesh
+            # coordinates, so their static score columns must differ;
+            # () for every unlabeled pod — existing keys unchanged
+            mesh_locality.profile_component(pod),
         )
 
     def _volume_profile_identity(self, pod: Pod) -> tuple:
@@ -1096,6 +1101,17 @@ class BatchEncoder:
         if node_range is None:
             node_range = slice(0, len(self.node_infos))
         state = CycleState()
+        # mesh-adjacency scorer, hoisted per profile: the anchor/grid
+        # extent is a whole-cluster property, so it is computed from
+        # the FULL node list even when this task sweeps one shard —
+        # the sharded sweep stays bit-identical to the serial one.
+        # Label-gated BEFORE materializing the node list: unlabeled
+        # profiles (every existing workload) must not pay an O(N)
+        # allocation per (profile, shard) task
+        mesh_fn = None
+        if mesh_locality.enabled() and mesh_locality.mesh_block(pod):
+            mesh_fn = mesh_locality.profile_scorer(
+                pod, [n.node for n in self.node_infos])
         for i in range(node_range.start, node_range.stop):
             ni = self.node_infos[i]
             node = ni.node
@@ -1111,6 +1127,8 @@ class BatchEncoder:
             mask[i] = ok
             if ok:
                 scores[i] = self._static_score(pod, ni)
+                if mesh_fn is not None:
+                    scores[i] += mesh_fn(node)
         if vol_ctx is self._VOL_CTX_UNSET:
             vol_ctx = self._volume_ctx(pod)
         if vol_ctx is not None:

@@ -31,6 +31,7 @@ def new_in_tree_registry() -> Registry:
         volume_restrictions,
         volume_zone,
         coscheduling,
+        mesh_locality,
     )
 
     r = Registry()
@@ -104,5 +105,8 @@ def new_in_tree_registry() -> Registry:
     r.register(
         coscheduling.CoschedulingSort.NAME,
         coscheduling.CoschedulingSort.factory,
+    )
+    r.register(
+        mesh_locality.MeshLocality.NAME, mesh_locality.MeshLocality.factory
     )
     return r

@@ -1,0 +1,198 @@
+"""MeshLocality: device-mesh-adjacency scoring for multi-chip gangs.
+
+PAPERS.md's Pathways case names the workload that matters for ML
+control planes: gang placement on MESH-ADJACENT accelerators — a
+multi-chip program pays inter-chip latency proportional to how far
+apart its hosts sit on the device mesh, so the scheduler should pull a
+gang's members onto neighboring mesh coordinates, not merely onto any
+N feasible nodes.
+
+Topology label scheme (nodes):
+
+    ktpu.io/mesh-x: "<col>"
+    ktpu.io/mesh-y: "<row>"
+
+— the node's coordinate on the accelerator mesh (the harness stamps
+these from the node index over a cols×rows grid; on real fleets they
+come from the fabric inventory). Pods opt in with:
+
+    ktpu.io/mesh-block: "<block-name>"
+
+(normally the pod's gang name). Every member of a block shares a
+deterministic ANCHOR coordinate — crc32(block) hashed onto the grid —
+and a node scores by Manhattan closeness to that anchor:
+
+    score = MAX_NODE_SCORE / (1 + d(node, anchor))
+
+Strictly decreasing in distance, so the argmax packs members onto the
+anchor's neighborhood; capacity pressure spills them to the NEXT
+nearest ring rather than across the mesh. Unlabeled pods and unlabeled
+nodes score 0 — the plugin is free for every existing workload.
+
+ONE closeness function feeds BOTH scheduling paths: the serial
+framework path via this ScorePlugin (quantized to the framework's
+integer score contract, like every in-tree Score plugin), the batch
+path via ``BatchEncoder._compute_static`` at full float precision
+(which also folds ``profile_component`` into the static-profile key
+so two gangs with different anchors never share a score column). The
+paths share the function, not bit-equal totals — batch score
+composition is its own float formulation throughout (image locality,
+preferred-affinity weights), so no serial≡batch score-equality
+contract exists to preserve here.
+
+``configure(enabled=False)`` is the adjacency-blind baseline arm of
+the replay gang family's A/B — scoring vanishes, gang semantics stay.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler.framework.interface import (
+    MAX_NODE_SCORE,
+    ScorePlugin,
+    Status,
+)
+
+MESH_X_LABEL = "ktpu.io/mesh-x"
+MESH_Y_LABEL = "ktpu.io/mesh-y"
+MESH_BLOCK_LABEL = "ktpu.io/mesh-block"
+
+# adjacency-blind switch (the gang family's baseline arm); module-level
+# because the batch encoder calls the free function, not the plugin
+_ENABLED = True
+
+
+def configure(enabled: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(enabled)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def mesh_node_labels(index: int, cols: int, rows: int = 0) -> dict:
+    """The label scheme for node ``index`` on a cols×rows grid (rows
+    defaults to unbounded — index//cols). Shared by the scenario
+    harness, the chaos cells, and the tests."""
+    x, y = index % cols, index // cols
+    if rows:
+        y %= rows
+    return {MESH_X_LABEL: str(x), MESH_Y_LABEL: str(y)}
+
+
+def node_coord(node) -> Optional[Tuple[int, int]]:
+    labels = node.metadata.labels
+    sx, sy = labels.get(MESH_X_LABEL), labels.get(MESH_Y_LABEL)
+    if sx is None or sy is None:
+        return None
+    try:
+        return int(sx), int(sy)
+    except ValueError:
+        return None
+
+
+def block_anchor(block: str, cols: int, rows: int) -> Tuple[int, int]:
+    """Deterministic anchor coordinate for a mesh block: crc32 of the
+    block name hashed onto the grid. Every scheduler replica — and the
+    batch encoder — derives the identical anchor with no coordination."""
+    h = zlib.crc32(block.encode())
+    return (h % max(cols, 1), (h // max(cols, 1)) % max(rows, 1))
+
+
+def mesh_block(pod: Pod) -> str:
+    return pod.metadata.labels.get(MESH_BLOCK_LABEL, "")
+
+
+def profile_component(pod: Pod) -> tuple:
+    """Static-profile-key component: pods of different blocks must NOT
+    share a static score column (their anchors differ). Empty for
+    unlabeled pods, so every existing workload's key is unchanged."""
+    block = mesh_block(pod)
+    return ("mesh", block) if block else ()
+
+
+def _grid_extent(snapshot_nodes) -> Tuple[int, int]:
+    """Grid extent from the labeled nodes actually present (anchors
+    must land on real coordinates). Cached per call site — cheap:
+    O(nodes) over labels only."""
+    cols = rows = 0
+    for node in snapshot_nodes:
+        c = node_coord(node)
+        if c is not None:
+            cols = max(cols, c[0] + 1)
+            rows = max(rows, c[1] + 1)
+    return cols, rows
+
+
+def profile_scorer(pod: Pod, all_nodes):
+    """The shared closeness function, hoisted per pod-profile: returns
+    None when the pod doesn't participate (no block label, plugin
+    disabled, or no labeled grid present), else ``fn(node) -> float``
+    computing MAX/(1+manhattan distance to the block anchor). The batch
+    encoder calls this once per static profile and sweeps nodes; the
+    serial plugin caches one scorer per (pod, snapshot) — both paths
+    evaluate the IDENTICAL function (differential exactness).
+    ``all_nodes`` must be the FULL candidate node-object list (the
+    anchor grid extent) even when the caller sweeps only a shard."""
+    if not _ENABLED:
+        return None
+    block = mesh_block(pod)
+    if not block:
+        return None
+    cols, rows = _grid_extent(all_nodes)
+    if not cols or not rows:
+        return None
+    ax, ay = block_anchor(block, cols, rows)
+
+    def score(node) -> float:
+        c = node_coord(node)
+        if c is None:
+            return 0.0
+        d = abs(c[0] - ax) + abs(c[1] - ay)
+        return float(MAX_NODE_SCORE) / (1.0 + d)
+
+    return score
+
+
+class MeshLocality(ScorePlugin):
+    """The serial-path face of the shared closeness function."""
+
+    NAME = "MeshLocality"
+
+    @staticmethod
+    def factory(args, handle):
+        return MeshLocality(handle)
+
+    def __init__(self, handle=None):
+        self.handle = handle
+        # (pod uid, snapshot) -> scorer: the framework scores one pod
+        # against many nodes per cycle; rebuild the anchor/extent once
+        # per (pod, snapshot), not once per node. The memo holds a
+        # STRONG reference to the snapshot and compares by identity —
+        # an id()-keyed memo could hand a retried pod a scorer built
+        # from a freed snapshot whose address got reused
+        self._memo_uid = None
+        self._memo_snap = None
+        self._memo_fn = None
+
+    def score(self, state, pod: Pod, node_name: str
+              ) -> Tuple[int, Optional[Status]]:
+        if not _ENABLED or not mesh_block(pod):
+            return 0, None
+        snapshot = self.handle.snapshot()
+        ni = snapshot.get(node_name)
+        if ni is None or ni.node is None:
+            return 0, Status(1, f"node {node_name} not found")
+        if pod.uid != self._memo_uid or snapshot is not self._memo_snap:
+            nodes = [i.node for i in snapshot.list()
+                     if i.node is not None]
+            self._memo_fn = profile_scorer(pod, nodes)
+            self._memo_uid = pod.uid
+            self._memo_snap = snapshot
+        if self._memo_fn is None:
+            return 0, None
+        return int(round(self._memo_fn(ni.node))), None

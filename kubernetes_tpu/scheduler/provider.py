@@ -56,6 +56,10 @@ def default_plugins(feature_gates=None) -> Plugins:
             PluginEntry("NodePreferAvoidPods", 10000),
             PluginEntry("PodTopologySpread", 2),
             PluginEntry("TaintToleration", 1),
+            # device-mesh adjacency for multi-chip gangs (scores 0 for
+            # every pod without a ktpu.io/mesh-block label, so the
+            # entry is free for non-mesh workloads)
+            PluginEntry("MeshLocality", 1),
         ]
     )
     p.reserve = PluginSet(enabled=[PluginEntry("VolumeBinding")])

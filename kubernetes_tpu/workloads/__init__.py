@@ -1,0 +1,54 @@
+"""Trace-replay workload engine (ROADMAP "trace-replay workload engine
++ hard scenario families").
+
+Every committed bench row before this subsystem measured the same
+shape: a synthetic, uniform, PRE-CREATED burst — the one workload a
+production cluster never sees. This package models a cluster trace
+(arrival process, pod-lifetime distributions, heavy-tailed resource
+sizes, priority/tenant mix) as a seeded deterministic generator plus a
+JSONL loader, and replays it OPEN-LOOP: pods arrive on a clock,
+lifetimes expire into deletions so the scheduler faces sustained
+churn, and per-pod schedule latency is measured from ARRIVAL — the
+number a user submitting one pod experiences, not the batch-amortized
+throughput figure.
+
+Lazy exports (PEP 562, same contract as ``harness/__init__``): the
+trace/replay/scenario layers are jax-free by design — REST-harness
+child processes import them — while the bench-row harness
+(``replay_bench``) transitively pulls the solver and must only load in
+the parent.
+"""
+
+from kubernetes_tpu.workloads.trace import (
+    Trace,
+    TraceEvent,
+    generate_trace,
+    load_trace_jsonl,
+    write_trace_jsonl,
+)
+from kubernetes_tpu.workloads.scenarios import (
+    REPLAY_FAMILIES,
+    build_family,
+)
+
+__all__ = [
+    "Trace", "TraceEvent", "generate_trace",
+    "load_trace_jsonl", "write_trace_jsonl",
+    "REPLAY_FAMILIES", "build_family",
+    "ReplayEngine", "ReplayStats",
+    "run_replay_row", "run_replay_cell", "run_replay_once",
+]
+
+
+def __getattr__(name):
+    if name in ("ReplayEngine", "ReplayStats"):
+        from kubernetes_tpu.workloads import replay
+
+        return getattr(replay, name)
+    if name in ("run_replay_row", "run_replay_cell",
+                "run_replay_once"):
+        # lazy: replay_bench transitively imports the jax solver
+        from kubernetes_tpu.workloads import replay_bench
+
+        return getattr(replay_bench, name)
+    raise AttributeError(name)

@@ -1,0 +1,512 @@
+"""Open-loop trace-replay engine + quiesce invariants.
+
+The engine drives a :class:`~kubernetes_tpu.workloads.trace.Trace`
+against a cluster target (the in-process ``ClusterStore`` or a
+``RestClusterClient`` — anything exposing the store surface):
+
+- **arrival**: pods are created ON A CLOCK by the shared
+  arrival-injection loop (``harness/burst.py::stream_arrivals``) —
+  open-loop, nothing waits on binds, so a slow scheduler faces a
+  growing backlog exactly like a production control plane;
+- **lifetime churn**: a bound pod whose trace lifetime elapses is
+  EXPIRED into a deletion (bulk ``delete_pods`` — the mass-delete path
+  in ``scheduler/eventhandlers.py``), so capacity continuously
+  recycles and the solver never sees a monotone fill;
+- **latency from arrival**: the engine stamps each pod at send and
+  observes its bind on its OWN watch stream — arrival→bind is the
+  latency a submitting user experiences, including queue wait, solver
+  batching, and watch delivery;
+- **quiesce classification**: at the end every injected pod is
+  accounted bound/pending/expired/preempted — anything else is LOST,
+  and zero-lost is a hard invariant of every replay row and chaos
+  cell.
+
+jax-free by design (the REST harness's child processes and the chaos
+matrix import this); numpy only for the quiesce invariant math.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.harness.burst import (
+    create_chunk,
+    sample_percentile,
+    stream_arrivals,
+)
+from kubernetes_tpu.workloads.trace import Trace, TraceEvent, events_to_pods
+
+
+@dataclass
+class ReplayStats:
+    """The engine's postmortem (everything a replay row/cell reports)."""
+
+    family: str
+    injected: int
+    expected: int            # trace size; injected < expected = faults
+    ever_bound: int
+    bound_at_end: int
+    pending_at_end: int
+    expired: int
+    preempted: int
+    lost: int
+    offered_rate: float            # arrivals/s actually offered
+    duration_s: float              # injection start → stats collection
+    arrival_to_bind: Dict[str, Dict[str, float]]   # cls -> {p50,p99,...}
+    gangs_total: int = 0
+    gangs_placed: int = 0
+    gangs_partial: int = 0         # the atomicity violation counter
+    mean_gang_adjacency: Optional[float] = None
+    priority_inversions: int = 0
+    last_bind_s: float = 0.0       # offset of the final observed bind
+    lost_names: List[str] = field(default_factory=list)
+    send_errors: List[str] = field(default_factory=list)
+
+    @property
+    def gangs_intact(self) -> bool:
+        return self.gangs_partial == 0
+
+    def latency_p99_ms(self, cls: str = "all") -> float:
+        return self.arrival_to_bind.get(cls, {}).get("p99", 0.0) * 1000
+
+
+class ReplayEngine:
+    """One replay run against one target. Lifecycle::
+
+        eng = ReplayEngine(target, trace)
+        eng.start()            # watch + injector + expirer threads
+        ... caller pumps its scheduler ...
+        eng.wait_injected()    # trace exhausted
+        ... caller pumps to quiescence ...
+        stats = eng.finish()   # stop threads, classify, compute stats
+
+    ``time_scale`` compresses the trace clock (0 = inject everything
+    immediately: the pre-created-burst degenerate case the rate=∞
+    differential guard compares against). ``expire`` gates lifetime
+    churn. The engine never touches the scheduler — arrival, expiry and
+    observation ride the same API surface every other client uses.
+    """
+
+    def __init__(
+        self,
+        target,
+        trace: Trace,
+        *,
+        time_scale: float = 1.0,
+        expire: bool = True,
+        chunk: int = 256,
+        flush_window: float = 0.02,
+        tenant_targets: Optional[Dict[str, object]] = None,
+        progress: Optional[Callable[[str], None]] = None,
+    ):
+        self.target = target
+        # per-tenant clients (the REST tenancy family: each tenant's
+        # arrivals and expiries ride ITS OWN authenticated client, so
+        # APF fair-queues the tenants as separate flows); unmapped
+        # tenants fall back to the default target
+        self.tenant_targets = tenant_targets or {}
+        self.trace = trace
+        self.time_scale = time_scale
+        self.expire = expire
+        self.chunk = chunk
+        self.flush_window = flush_window
+        self.progress = progress
+        self._events: Dict[str, TraceEvent] = {
+            e.name: e for e in trace.events}
+        self._lock = threading.Lock()
+        self._arrival: Dict[str, float] = {}
+        self._bind: Dict[str, Tuple[float, str]] = {}   # name -> (t, node)
+        self._deleted: Dict[str, str] = {}   # name -> "expired"|"other"
+        self._expiry_heap: List[Tuple[float, str]] = []
+        self._expired_sent: set = set()
+        self._stop = threading.Event()
+        self.injection_done = threading.Event()
+        self._t0: Optional[float] = None
+        self._watch_handle = None
+        self._threads: List[threading.Thread] = []
+        self._send_errors: List[str] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        self._t0 = time.monotonic()
+        # observe binds/deletes on our own stream BEFORE injecting: a
+        # bind landing between create and watch-attach must not vanish
+        self._watch_handle = self.target.watch(
+            self._on_event, batch_fn=self._on_events)
+        inj = threading.Thread(target=self._inject, daemon=True,
+                               name="replay-inject")
+        inj.start()
+        self._threads.append(inj)
+        if self.expire:
+            exp = threading.Thread(target=self._expirer, daemon=True,
+                                   name="replay-expire")
+            exp.start()
+            self._threads.append(exp)
+
+    def wait_injected(self, timeout: Optional[float] = None) -> bool:
+        return self.injection_done.wait(timeout)
+
+    def pending_expiries(self) -> int:
+        with self._lock:
+            return len(self._expiry_heap)
+
+    def due_expiries(self) -> int:
+        """Expiries already due (bound pods whose lifetime has elapsed
+        but whose delete hasn't been sent yet) — the caller's quiesce
+        condition waits for THESE, not for far-future lifetimes."""
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for t, _ in self._expiry_heap if t <= now)
+
+    def finish(self) -> ReplayStats:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self._watch_handle is not None:
+            stop = getattr(self._watch_handle, "stop", None)
+            if stop is not None:
+                stop()
+        return self._collect()
+
+    # ------------------------------------------------------------------
+    # injector / expirer threads
+
+    def _inject(self) -> None:
+        try:
+            n = stream_arrivals(
+                ((e.t, e) for e in self.trace.events),
+                self._send_chunk,
+                chunk=self.chunk,
+                time_scale=self.time_scale,
+                flush_window=self.flush_window,
+                stop=self._stop,
+                on_sent=self._note_sent,
+            )
+            if self.progress:
+                self.progress(f"replay: {n} arrivals injected")
+        except Exception as e:  # noqa: BLE001 — surfaced via stats
+            self._send_errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            self.injection_done.set()
+
+    def _target_for(self, tenant: str):
+        return self.tenant_targets.get(tenant, self.target)
+
+    def _send_chunk(self, events: List[TraceEvent]) -> None:
+        if not self.tenant_targets:
+            create_chunk(self.target, events_to_pods(events))
+            return
+        by_tenant: Dict[str, List[TraceEvent]] = {}
+        for e in events:
+            by_tenant.setdefault(e.tenant, []).append(e)
+        for tenant, evs in by_tenant.items():
+            create_chunk(self._target_for(tenant), events_to_pods(evs))
+
+    def _note_sent(self, event: TraceEvent, offset_s: float) -> None:
+        with self._lock:
+            self._arrival[event.name] = offset_s
+
+    def _expirer(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            due: List[str] = []
+            with self._lock:
+                while self._expiry_heap and \
+                        self._expiry_heap[0][0] <= now:
+                    _, name = heapq.heappop(self._expiry_heap)
+                    if name not in self._deleted:
+                        due.append(name)
+                        self._expired_sent.add(name)
+            if due:
+                by_tenant: Dict[str, List[str]] = {}
+                for n in due:
+                    by_tenant.setdefault(self._events[n].tenant,
+                                         []).append(n)
+                for tenant, names in by_tenant.items():
+                    target = self._target_for(tenant)
+                    for lo in range(0, len(names), self.chunk):
+                        part = names[lo:lo + self.chunk]
+                        try:
+                            target.delete_pods(
+                                [(self._events[n].namespace, n)
+                                 for n in part])
+                        except Exception:  # noqa: BLE001 — a pod
+                            # already deleted (preempted under us) is
+                            # fine; retry one-by-one so siblings still
+                            # expire
+                            for n in part:
+                                try:
+                                    target.delete_pod(
+                                        self._events[n].namespace, n)
+                                except Exception:  # noqa: BLE001
+                                    pass
+            self._stop.wait(0.05)
+
+    # ------------------------------------------------------------------
+    # watch observation
+
+    def _on_event(self, event) -> None:
+        self._on_events([event])
+
+    def _on_events(self, events) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for e in events:
+                if getattr(e, "kind", "Pod") != "Pod":
+                    continue
+                obj = e.obj
+                name = obj.metadata.name
+                ev = self._events.get(name)
+                if ev is None:
+                    continue
+                if e.type == "DELETED":
+                    if name not in self._deleted:
+                        self._deleted[name] = (
+                            "expired" if name in self._expired_sent
+                            else "other")
+                    continue
+                if obj.spec.node_name and name not in self._bind:
+                    self._bind[name] = (now - self._t0,
+                                        obj.spec.node_name)
+                    if self.expire and ev.lifetime_s is not None:
+                        heapq.heappush(
+                            self._expiry_heap,
+                            (now + ev.lifetime_s * self.time_scale
+                             if self.time_scale > 0
+                             else now + ev.lifetime_s, name))
+
+    # ------------------------------------------------------------------
+    # postmortem
+
+    def _collect(self) -> ReplayStats:
+        duration = time.monotonic() - self._t0 if self._t0 else 0.0
+        live: Dict[str, object] = {}
+        for pod in self.target.list_pods():
+            if pod.metadata.name in self._events:
+                live[pod.metadata.name] = pod
+        with self._lock:
+            arrival = dict(self._arrival)
+            bind = dict(self._bind)
+            deleted = dict(self._deleted)
+            expired_intent = set(self._expired_sent)
+        bound_now = [n for n, p in live.items() if p.spec.node_name]
+        pending_now = [n for n, p in live.items()
+                       if not p.spec.node_name]
+        # classification consults the engine's own delete INTENT
+        # (_expired_sent) as well as the observed watch events: the
+        # final DELETED events may still be in flight when finish()
+        # stops the stream, and an intentionally-expired (or
+        # preempted-after-bind) pod must not flip to LOST on that race
+        expired_set = {
+            n for n in arrival if n not in live
+            and (n in expired_intent or deleted.get(n) == "expired")}
+        preempted = [n for n in arrival
+                     if n not in live and n not in expired_set
+                     and n in bind]
+        lost = [n for n in arrival
+                if n not in live and n not in expired_set
+                and n not in bind]
+        # arrival→bind latency, per workload class + overall
+        lat_by_cls: Dict[str, List[float]] = {"all": []}
+        for n, (t_bind, _node) in bind.items():
+            t_arr = arrival.get(n)
+            if t_arr is None:
+                continue
+            lat = max(0.0, t_bind - t_arr)
+            lat_by_cls["all"].append(lat)
+            cls = self._events[n].cls
+            if cls:
+                lat_by_cls.setdefault(cls, []).append(lat)
+        lat_summary = {
+            cls: {
+                "count": len(vals),
+                "p50": sample_percentile(vals, 0.50),
+                "p90": sample_percentile(vals, 0.90),
+                "p99": sample_percentile(vals, 0.99),
+                "max": max(vals) if vals else 0.0,
+            }
+            for cls, vals in lat_by_cls.items()
+        }
+        gangs = self._gang_integrity(bind)
+        stats = ReplayStats(
+            family=self.trace.family,
+            injected=len(arrival),
+            expected=len(self.trace.events),
+            ever_bound=len(bind),
+            bound_at_end=len(bound_now),
+            pending_at_end=len(pending_now),
+            expired=len(expired_set),
+            preempted=len(preempted),
+            lost=len(lost),
+            offered_rate=(
+                len(arrival) / (self.trace.duration_s * self.time_scale)
+                if self.time_scale > 0 and self.trace.duration_s > 0
+                else 0.0),
+            duration_s=duration,
+            arrival_to_bind=lat_summary,
+            gangs_total=gangs[0],
+            gangs_placed=gangs[1],
+            gangs_partial=gangs[2],
+            mean_gang_adjacency=self._adjacency(bind),
+            priority_inversions=self._priority_inversions(live),
+            last_bind_s=max((t for t, _ in bind.values()), default=0.0),
+            lost_names=sorted(lost)[:20],
+        )
+        stats.send_errors = list(self._send_errors)
+        return stats
+
+    def _gang_integrity(self, bind: Dict) -> Tuple[int, int, int]:
+        """(total, fully-placed, PARTIAL) over the trace's gangs —
+        partial means some but not all members ever bound: the
+        atomicity violation gang semantics must prevent."""
+        members: Dict[str, List[str]] = {}
+        size: Dict[str, int] = {}
+        for e in self.trace.events:
+            if e.gang and e.gang_size > 1:
+                members.setdefault(e.gang, []).append(e.name)
+                size[e.gang] = e.gang_size
+        placed = partial = 0
+        for gang, names in members.items():
+            n_bound = sum(1 for n in names if n in bind)
+            if n_bound == 0:
+                continue
+            if n_bound >= size[gang]:
+                placed += 1
+            else:
+                partial += 1
+        return len(members), placed, partial
+
+    def _adjacency(self, bind: Dict) -> Optional[float]:
+        """Mean over placed gangs of the mean pairwise Manhattan
+        distance between member nodes on the device mesh; None when no
+        gang landed on labeled nodes. Lower is better — the gang
+        family's scored arm must beat its adjacency-blind arm here."""
+        from kubernetes_tpu.scheduler.framework.plugins.mesh_locality import (  # noqa: E501
+            node_coord,
+        )
+
+        coords = {}
+        for node in self.target.list_nodes():
+            c = node_coord(node)
+            if c is not None:
+                coords[node.metadata.name] = c
+        if not coords:
+            return None
+        members: Dict[str, List[Tuple[int, int]]] = {}
+        for e in self.trace.events:
+            if not e.gang:
+                continue
+            hit = bind.get(e.name)
+            if hit is None:
+                continue
+            c = coords.get(hit[1])
+            if c is not None:
+                members.setdefault(e.gang, []).append(c)
+        dists = []
+        for pts in members.values():
+            if len(pts) < 2:
+                continue
+            acc = cnt = 0
+            for i in range(len(pts)):
+                for j in range(i + 1, len(pts)):
+                    acc += (abs(pts[i][0] - pts[j][0])
+                            + abs(pts[i][1] - pts[j][1]))
+                    cnt += 1
+            dists.append(acc / cnt)
+        return (sum(dists) / len(dists)) if dists else None
+
+    def _priority_inversions(self, live: Dict) -> int:
+        """No-priority-inversion-at-quiesce check: a PENDING pod whose
+        request would fit on some node after evicting only
+        strictly-lower-priority pods is an inversion — preemption
+        should have placed it. Gang members count only when the WHOLE
+        gang could be placed that way simultaneously (a partially
+        fitting gang is correctly pending, not inverted). cpu+memory
+        accounting only — same granularity as the preemption screen."""
+        from kubernetes_tpu.scheduler.types import (
+            Resource,
+            compute_pod_resource_request,
+        )
+
+        nodes = list(self.target.list_nodes())
+        if not nodes:
+            return 0
+        name_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+        alloc = np.zeros((len(nodes), 2), dtype=np.int64)
+        for i, n in enumerate(nodes):
+            r = Resource.from_resource_list(n.status.allocatable)
+            alloc[i, 0] = r.milli_cpu
+            alloc[i, 1] = r.memory
+        # per-node, per-priority usage by BOUND pods
+        used = np.zeros((len(nodes), 2), dtype=np.int64)
+        by_prio: Dict[int, np.ndarray] = {}
+        for pod in self.target.list_pods():
+            node_i = name_idx.get(pod.spec.node_name or "")
+            if node_i is None:
+                continue
+            req = compute_pod_resource_request(pod)
+            row = np.array([req.milli_cpu, req.memory], dtype=np.int64)
+            used[node_i] += row
+            p = pod.priority()
+            if p not in by_prio:
+                by_prio[p] = np.zeros((len(nodes), 2), dtype=np.int64)
+            by_prio[p][node_i] += row
+        prios = sorted(by_prio)
+        free = alloc - used
+
+        def headroom_below(prio: int) -> np.ndarray:
+            h = free.copy()
+            for p in prios:
+                if p < prio:
+                    h += by_prio[p]
+            return h
+
+        pending = [p for p in live.values() if not p.spec.node_name]
+        inversions = 0
+        gangs_seen: Dict[str, List] = {}
+        for pod in pending:
+            ev = self._events.get(pod.metadata.name)
+            if ev is not None and ev.gang and ev.gang_size > 1:
+                gangs_seen.setdefault(ev.gang, []).append(pod)
+                continue
+            req = compute_pod_resource_request(pod)
+            need = np.array([req.milli_cpu, req.memory],
+                            dtype=np.int64)
+            if np.any(np.all(headroom_below(pod.priority()) >= need,
+                             axis=1)):
+                inversions += 1
+        for gang, pods in gangs_seen.items():
+            size = next((self._events[p.metadata.name].gang_size
+                         for p in pods), 0)
+            bound_members = sum(
+                1 for e in self.trace.events
+                if e.gang == gang and e.name not in
+                {p.metadata.name for p in pods}
+                and e.name in self._bind)
+            if bound_members + len(pods) < size:
+                continue   # members missing entirely; not placeable
+            # greedy first-fit-decreasing of the pending members into
+            # lower-priority headroom: all fit → inversion
+            h = headroom_below(max(p.priority() for p in pods))
+            reqs = sorted(
+                (compute_pod_resource_request(p) for p in pods),
+                key=lambda r: -r.milli_cpu)
+            ok = True
+            for r in reqs:
+                need = np.array([r.milli_cpu, r.memory], dtype=np.int64)
+                fits = np.nonzero(np.all(h >= need, axis=1))[0]
+                if fits.size == 0:
+                    ok = False
+                    break
+                h[fits[0]] -= need
+            if ok:
+                inversions += len(pods)
+        return inversions

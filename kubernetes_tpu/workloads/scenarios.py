@@ -1,0 +1,319 @@
+"""The hard scenario families (ISSUE 13): three trace builders that
+stress the dense-tensor solver where it hurts, each judged by SLO
+verdicts and hard invariants rather than raw throughput.
+
+- ``storm`` — **preemption storm under priority inversion**: a
+  low-priority flood pins ~120% of cluster capacity while
+  high-priority GANGS arrive mid-storm; the solver must mass-decline,
+  the preemption screen must plan victims at batch rate, and the
+  mass-delete path in ``scheduler/eventhandlers.py`` absorbs the
+  evictions. Invariants: zero lost pods, gang atomicity, and NO
+  priority inversion at quiesce (no pending pod that could fit by
+  evicting only strictly-lower-priority pods).
+
+- ``gangs`` — **device-locality gangs**: nodes carry mesh coordinates
+  (``ktpu.io/mesh-x``/``-y``), multi-chip gangs carry
+  ``ktpu.io/mesh-block``, and the MeshLocality score pulls members
+  onto mesh-adjacent hosts while short-lived filler churn fragments
+  the grid. Members are sized so no two share a node (one chip host
+  each). The bench row A/Bs the scored arm against an
+  adjacency-blind arm — mean gang adjacency must be strictly better.
+
+- ``tenancy`` — **mixed serve+batch tenancy**: a latency-sensitive
+  serve tenant (small, short-lived, steady Poisson) shares the fabric
+  with a throughput batch tenant (heavy-tailed sizes, bursty, long
+  lifetimes), with the PR 4 autoscaler buying capacity and PR 6 APF
+  fair-queuing the tenants. The row's verdict is the serve class's
+  arrival→bind p99 staying within budget WHILE batch floods.
+
+Every builder is a pure function of (seed, scale) — the determinism
+contract of ``workloads/trace.py`` extends here (asserted in tier-1).
+jax-free by design.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from random import Random
+from typing import Callable, Dict, List, Tuple
+
+from kubernetes_tpu.harness.workloads import node_template
+from kubernetes_tpu.scheduler.framework.plugins.mesh_locality import (
+    MESH_BLOCK_LABEL,
+    mesh_node_labels,
+)
+from kubernetes_tpu.workloads.trace import (
+    Trace,
+    TraceEvent,
+    arrivals_exactly,
+    bounded_pareto,
+    lognormal_mixture,
+    poisson_arrivals,
+)
+
+
+@dataclass
+class FamilySpec:
+    """One scenario family: the trace builder, the node fleet it
+    assumes, and which quiesce invariants its rows/cells must enforce
+    (``checks`` ⊆ {lost, inversion, gangs, adjacency, serve_latency})."""
+
+    name: str
+    title: str
+    build: Callable[[int, float], Trace]
+    node_specs: Callable[[float], List[dict]]
+    checks: Tuple[str, ...]
+    tenants: Tuple[str, ...] = ()
+    autoscale: bool = False
+    description: str = ""
+    # SLOs excluded from the row's strict verdict: a preemption storm
+    # (and a capacity-acquiring tenancy trace) INTENDS some pods to
+    # wait multiple seconds — schedule-latency violations there are
+    # the scenario, not a regression. The excluded verdicts still ride
+    # the row's ``freshness.slo`` sub-object; only the pass/fail gate
+    # skips them (``slo_gated`` on the row names what WAS gated).
+    slo_exempt: Tuple[str, ...] = ()
+    extras: Dict = field(default_factory=dict)
+
+
+def _sorted_trace(events: List[TraceEvent], family: str, seed: int,
+                  duration_s: float) -> Trace:
+    events.sort(key=lambda e: (e.t, e.name))
+    return Trace(events=events, family=family, seed=seed,
+                 duration_s=duration_s)
+
+
+# ---------------------------------------------------------------------------
+# storm: preemption storm under priority inversion
+
+STORM_DURATION_S = 45.0
+STORM_NODE_CPU = 4          # cores per node (the Preemption bench shape)
+STORM_GANG_SIZE = 6
+STORM_GANG_CPU_MILLI = 2500
+STORM_FLOOD_PRIO = 1
+STORM_GANG_PRIO = 100
+
+
+def _storm_nodes(scale: float) -> int:
+    return max(16, int(round(120 * scale)))
+
+
+def storm_nodes(scale: float) -> List[dict]:
+    return [node_template(i, cpu=str(STORM_NODE_CPU), memory="8Gi")
+            for i in range(_storm_nodes(scale))]
+
+
+def build_storm(seed: int, scale: float = 1.0) -> Trace:
+    rng = Random(seed * 7919 + 1)
+    n_nodes = _storm_nodes(scale)
+    capacity_milli = n_nodes * STORM_NODE_CPU * 1000
+    # flood sized to ~120% of capacity at its mean request: capacity
+    # pins, the tail stays pending — the inversion bait
+    flood_lo, flood_hi = 500, 3000
+    # empirical mean of bounded-Pareto(1.6, 500, 3000) — measured at
+    # 932.3 over 200k draws; an overstated mean would quietly shrink
+    # the flood below the oversubscription the scenario promises
+    mean_cpu = 932.0
+    n_flood = max(8, int(capacity_milli * 1.2 / mean_cpu))
+    # gangs sized to need ~45% of capacity back: preemption at rates no
+    # pre-created row reaches
+    n_gangs = max(2, int(capacity_milli * 0.45
+                         / (STORM_GANG_SIZE * STORM_GANG_CPU_MILLI)))
+    d = STORM_DURATION_S
+    events: List[TraceEvent] = []
+    flood_ts = arrivals_exactly(rng, n_flood, 0.45 * d,
+                                burst_factor=3.0, burst_period_s=6.0)
+    for i, t in enumerate(flood_ts):
+        cpu = int(bounded_pareto(rng, 1.6, flood_lo, flood_hi))
+        events.append(TraceEvent(
+            t=round(t, 6), name=f"flood-{i}", cpu_milli=cpu,
+            memory_mib=max(128, cpu), priority=STORM_FLOOD_PRIO,
+            # mid-length lifetimes: enough churn that the scheduler
+            # never sees a static fill, long enough that capacity
+            # stays pinned when the gangs arrive
+            lifetime_s=round(lognormal_mixture(
+                rng, ((0.7, math.log(18.0), 0.5),
+                      (0.3, math.log(60.0), 0.4))), 3),
+            cls="flood",
+        ))
+    for g in range(n_gangs):
+        t_g = 0.42 * d + (0.48 * d) * g / max(n_gangs - 1, 1)
+        for m in range(STORM_GANG_SIZE):
+            events.append(TraceEvent(
+                t=round(t_g + rng.uniform(0.0, 0.25), 6),
+                name=f"hp-gang-{g}-{m}",
+                cpu_milli=STORM_GANG_CPU_MILLI,
+                memory_mib=2048, priority=STORM_GANG_PRIO,
+                lifetime_s=None,    # the preemptors keep what they take
+                cls="gang", gang=f"hp-gang-{g}",
+                gang_size=STORM_GANG_SIZE,
+            ))
+    return _sorted_trace(events, "storm", seed, d)
+
+
+# ---------------------------------------------------------------------------
+# gangs: device-locality gangs on the mesh grid
+
+GANGS_DURATION_S = 30.0
+GANGS_NODE_CPU = 8
+GANG_SIZE = 4
+GANG_MEMBER_CPU_MILLI = 4500    # > half a node: one chip host each
+
+
+def mesh_grid(scale: float) -> Tuple[int, int]:
+    side = max(4, int(round(8 * math.sqrt(scale))))
+    return side, side
+
+
+def gangs_nodes(scale: float) -> List[dict]:
+    cols, rows = mesh_grid(scale)
+    out = []
+    for i in range(cols * rows):
+        d = node_template(i, cpu=str(GANGS_NODE_CPU), memory="16Gi")
+        d["metadata"]["labels"].update(mesh_node_labels(i, cols, rows))
+        out.append(d)
+    return out
+
+
+def build_gangs(seed: int, scale: float = 1.0) -> Trace:
+    rng = Random(seed * 104729 + 2)
+    cols, rows = mesh_grid(scale)
+    n_nodes = cols * rows
+    n_gangs = max(3, n_nodes // 5)
+    d = GANGS_DURATION_S
+    events: List[TraceEvent] = []
+    # background filler: short-lived fragmentation pressure arriving
+    # the whole run (so gang placement happens against churn, not a
+    # pristine grid)
+    fill_rate = max(4.0, n_nodes / 3.0)
+    for i, t in enumerate(poisson_arrivals(rng, fill_rate, d,
+                                           burst_factor=2.0,
+                                           burst_period_s=5.0)):
+        cpu = int(bounded_pareto(rng, 1.8, 200, 900))
+        events.append(TraceEvent(
+            t=round(t, 6), name=f"fill-{i}", cpu_milli=cpu,
+            memory_mib=max(128, cpu),
+            lifetime_s=round(rng.uniform(3.0, 9.0), 3),
+            cls="filler",
+        ))
+    # the gangs: multi-chip pods that must land mesh-adjacent; members
+    # carry the mesh-block label (anchor = crc32(block) on the grid)
+    for g in range(n_gangs):
+        t_g = 0.08 * d + (0.8 * d) * g / max(n_gangs - 1, 1)
+        block = f"mc-gang-{g}"
+        for m in range(GANG_SIZE):
+            events.append(TraceEvent(
+                t=round(t_g + rng.uniform(0.0, 0.2), 6),
+                name=f"mc-gang-{g}-{m}",
+                cpu_milli=GANG_MEMBER_CPU_MILLI, memory_mib=4096,
+                priority=10,
+                lifetime_s=round(rng.uniform(12.0, 20.0), 3),
+                cls="gang", gang=block, gang_size=GANG_SIZE,
+                labels={MESH_BLOCK_LABEL: block,
+                        "ktpu.io/chips": "4"},
+            ))
+    return _sorted_trace(events, "gangs", seed, d)
+
+
+# ---------------------------------------------------------------------------
+# tenancy: mixed serve+batch tenants (autoscaler + APF active)
+
+TENANCY_DURATION_S = 45.0
+TENANCY_NODE_CPU = 8
+SERVE_TENANT, BATCH_TENANT = "tenant-serve", "tenant-batch"
+
+
+def _tenancy_sizing(scale: float) -> Tuple[int, int, int]:
+    """(serve pods, batch pods, initial nodes). Initial capacity is
+    ~45% of what the combined steady state needs — the autoscaler buys
+    the rest while both tenants stream."""
+    n_serve = max(30, int(round(500 * scale)))
+    n_batch = max(20, int(round(380 * scale)))
+    # steady-state demand estimate: serve ~250m × short overlap, batch
+    # heavy-tailed mean ~1200m × long overlap
+    demand_milli = int(n_serve * 250 * 0.25 + n_batch * 1200 * 0.7)
+    need = max(4, math.ceil(demand_milli / (TENANCY_NODE_CPU * 1000)))
+    return n_serve, n_batch, max(3, int(math.ceil(0.45 * need)))
+
+
+def tenancy_nodes(scale: float) -> List[dict]:
+    _, _, initial = _tenancy_sizing(scale)
+    return [node_template(i, cpu=str(TENANCY_NODE_CPU), memory="32Gi")
+            for i in range(initial)]
+
+
+def build_tenancy(seed: int, scale: float = 1.0) -> Trace:
+    rng = Random(seed * 65537 + 3)
+    n_serve, n_batch, _ = _tenancy_sizing(scale)
+    d = TENANCY_DURATION_S
+    events: List[TraceEvent] = []
+    # serve: latency-sensitive, small, short-lived, steady Poisson
+    serve_ts = arrivals_exactly(rng, n_serve, d)
+    for i, t in enumerate(serve_ts):
+        cpu = int(rng.uniform(100, 400))
+        events.append(TraceEvent(
+            t=round(t, 6), name=f"serve-{i}", cpu_milli=cpu,
+            memory_mib=max(128, cpu), priority=50,
+            lifetime_s=round(rng.uniform(6.0, 14.0), 3),
+            tenant=SERVE_TENANT, cls="serve",
+        ))
+    # batch: throughput tenant — heavy-tailed sizes, bursty arrivals,
+    # long lifetimes (they hold what they take)
+    batch_ts = arrivals_exactly(rng, n_batch, 0.85 * d,
+                                burst_factor=4.0, burst_period_s=8.0)
+    for i, t in enumerate(batch_ts):
+        cpu = int(bounded_pareto(rng, 1.5, 400, 4000))
+        events.append(TraceEvent(
+            t=round(t, 6), name=f"batch-{i}", cpu_milli=cpu,
+            memory_mib=max(256, cpu), priority=0,
+            lifetime_s=round(lognormal_mixture(
+                rng, ((0.6, math.log(25.0), 0.5),
+                      (0.4, math.log(70.0), 0.4))), 3),
+            tenant=BATCH_TENANT, cls="batch",
+        ))
+    return _sorted_trace(events, "tenancy", seed, d)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+REPLAY_FAMILIES: Dict[str, FamilySpec] = {
+    "storm": FamilySpec(
+        name="storm",
+        title="preemption storm under priority inversion",
+        build=build_storm,
+        node_specs=storm_nodes,
+        checks=("lost", "inversion", "gangs"),
+        slo_exempt=("schedule_latency",),
+        description="low-priority flood pins capacity; high-priority "
+                    "gangs preempt their way in mid-storm",
+    ),
+    "gangs": FamilySpec(
+        name="gangs",
+        title="device-locality gangs on the mesh grid",
+        build=build_gangs,
+        node_specs=gangs_nodes,
+        checks=("lost", "gangs", "adjacency"),
+        description="multi-chip gangs must land mesh-adjacent against "
+                    "filler churn; scored vs adjacency-blind A/B",
+        extras={"grid": mesh_grid},
+    ),
+    "tenancy": FamilySpec(
+        name="tenancy",
+        title="mixed serve+batch tenancy (autoscaler + APF)",
+        build=build_tenancy,
+        node_specs=tenancy_nodes,
+        checks=("lost", "serve_latency"),
+        tenants=(SERVE_TENANT, BATCH_TENANT),
+        autoscale=True,
+        slo_exempt=("schedule_latency",),
+        description="latency-sensitive serve pods vs heavy-tailed "
+                    "batch pods from separate tenants",
+    ),
+}
+
+
+def build_family(family: str, seed: int, scale: float = 1.0) -> Trace:
+    spec = REPLAY_FAMILIES[family]
+    return spec.build(seed, scale)

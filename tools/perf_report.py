@@ -123,14 +123,25 @@ def build_series(rounds: List[dict]) -> Dict[str, List[dict]]:
         for row in rnd["rows"]:
             if row.get("unit") != "pods/s" or "error" in row:
                 continue
-            series.setdefault(row["metric"], []).append({
+            point = {
                 "round": rnd["round"],
                 "value": float(row.get("value", 0.0)),
-                "p99_ms": row.get("p99_latency_ms"),
+                "p99_ms": row.get("p99_latency_ms",
+                                  row.get("p99_arrival_to_bind_ms")),
                 "runs": row.get("runs"),
                 "telemetry": row.get("telemetry"),
                 "diags": row.get("_diags", []),
-            })
+            }
+            if row.get("rate_normalized_throughput") is not None:
+                # replay rows are OPEN-LOOP: raw pods/s tracks the
+                # trace's offered rate, not the scheduler — the trend
+                # (and regression detection) must compare bound-rate ÷
+                # offered-rate, or a re-paced trace masquerades as a
+                # perf move. Raw value kept for the table.
+                point["raw_value"] = point["value"]
+                point["value"] = float(
+                    row["rate_normalized_throughput"])
+            series.setdefault(row["metric"], []).append(point)
     for points in series.values():
         points.sort(key=lambda p: p["round"])
     return series
@@ -391,6 +402,58 @@ def devscale_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def replay_flags(rounds: List[dict]) -> List[dict]:
+    """The ``replay_*`` family's own checks (ISSUE 13 satellite):
+    throughput trend alone cannot judge an open-loop trace-replay row.
+    Flag the round when:
+
+    - the row LOST pods (``lost_pods`` > 0, or short-injected — the
+      zero-lost invariant is the suite's hardest bar);
+    - any family invariant failed (``invariants_ok`` false: gang
+      atomicity, priority inversion at quiesce, serve-latency budget);
+    - the row's gated SLO verdicts went red (``slo_verdicts_ok``
+      false — the family-exempt SLOs are already excluded row-side);
+    - the gang family's adjacency A/B stopped paying
+      (``adjacency_ab.scored_beats_blind`` false: MeshLocality scoring
+      no longer beats the adjacency-blind arm).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if not str(row.get("metric", "")).startswith("replay_") \
+                    or "error" in row:
+                continue
+            problems = []
+            if row.get("lost_pods"):
+                problems.append(f"lost_pods={row['lost_pods']}")
+            if row.get("invariants_ok") is False:
+                bad = [k for k, v in
+                       (row.get("invariants") or {}).items() if not v]
+                problems.append(
+                    "invariants failed: " + (", ".join(bad) or "?"))
+            if row.get("slo_verdicts_ok") is False:
+                slo = (row.get("freshness") or {}).get("slo") or {}
+                bad = [n for n, v in slo.items() if v != "ok"
+                       and n in (row.get("slo_gated") or slo)]
+                problems.append(
+                    "slo violated: " + (", ".join(sorted(bad)) or "?"))
+            ab = row.get("adjacency_ab") or {}
+            if ab and not ab.get("scored_beats_blind", True):
+                problems.append(
+                    f"adjacency A/B not paying (scored="
+                    f"{ab.get('scored_mean_gang_adjacency')} vs blind="
+                    f"{ab.get('blind_mean_gang_adjacency')})")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -456,6 +519,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     flags = detect_regressions(series, band_floor=args.band)
     scale_flags = scale_ab_flags(rounds)
     dev_flags = devscale_flags(rounds)
+    rep_flags = replay_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -469,6 +533,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "regressions": flags,
             "scale_flags": scale_flags,
             "devscale_flags": dev_flags,
+            "replay_flags": rep_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -483,6 +548,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in dev_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if rep_flags:
+            print("\nreplay SLO / invariant flags:")
+            for f in rep_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -491,7 +561,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"device-wait share {telemetry['device_wait_share']:.0%}, "
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
     return 1 if (args.strict
-                 and (flags or scale_flags or dev_flags)) else 0
+                 and (flags or scale_flags or dev_flags
+                      or rep_flags)) else 0
 
 
 if __name__ == "__main__":
