@@ -165,6 +165,7 @@ def _diagnose(sched, bs) -> None:
         sess = ""
         devprof_seg = ""
         mesh_seg = ""
+        pipe_seg = ""
         if bs is not None:
             sess = " " + diagfmt.format_session(
                 bs.session, bs._chunk, bs.max_cycle_s, bs.pad_warms)
@@ -175,6 +176,11 @@ def _diagnose(sched, bs) -> None:
                 summary = dp.summary()
                 if summary["cycles"] or summary["warm_compiles"]:
                     devprof_seg = " " + diagfmt.format_devprof(summary)
+                # streaming-pipeline segment: stage depth + how much of
+                # the in-flight device window host work hid (only when
+                # the pipeline is on — the off arm prints nothing)
+                pipe_seg = " " + diagfmt.format_pipeline(
+                    bs.pipeline_info(summary))
             # mesh segment, only when the row actually solved on the
             # sharded tier: mesh width, shard count, donation — the
             # provenance a devscale (or sharded-default REST) row's
@@ -283,9 +289,9 @@ def _diagnose(sched, bs) -> None:
         if engine.enabled:
             slo_seg = diagfmt.format_slo(engine.evaluate())
         log(diagfmt.format_diag(
-            segs + [sess.strip(), devprof_seg.strip(), mesh_seg.strip(),
-                    churn.strip(), autoscale.strip(), apf.strip(),
-                    slo_seg] + buckets))
+            segs + [sess.strip(), devprof_seg.strip(), pipe_seg.strip(),
+                    mesh_seg.strip(), churn.strip(), autoscale.strip(),
+                    apf.strip(), slo_seg] + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -656,7 +662,7 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
-                       "autoscale", "scale10x", "devscale",
+                       "autoscale", "scale10x", "devscale", "sustained",
                        "replay:storm", "replay:gangs",
                        "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
@@ -732,6 +738,25 @@ def main() -> None:
                 time_scale=1.0, rest=True, max_batch=1024,
                 qps=args.rest_qps if args.rest_qps > 0 else None,
                 wait_timeout=900, progress=log)
+        print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "sustained":
+        # the streaming-scheduler row (ISSUE 14): the headline-shaped
+        # workload arriving OPEN-LOOP at 5k QPS through the replay
+        # engine (not pre-created) — p99 arrival→bind is the headline,
+        # the pipeline's overlap_share and the staleness SLO verdict
+        # ride the row as its acceptance surface
+        from kubernetes_tpu.harness.sustained import run_sustained_row
+
+        if args.quick:
+            row = run_sustained_row(pods=2000, qps=1000.0, node_cpu=16,
+                                    max_batch=512, wait_timeout=300,
+                                    progress=log)
+        else:
+            row = run_sustained_row(pods=30_000, qps=5000.0,
+                                    node_cpu=32, max_batch=4096,
+                                    wait_timeout=900, progress=log)
         print(json.dumps(row), flush=True)
         return
 

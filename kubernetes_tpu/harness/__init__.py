@@ -21,10 +21,16 @@ __all__ = [
     "BurstResult", "make_burst_pods", "run_pending_burst",
     "wait_all_bound",
     "run_autoscale_bench", "run_scale_cell",
+    "run_sustained_row", "run_sustained_cell",
 ]
 
 
 def __getattr__(name):
+    if name in ("run_sustained_row", "run_sustained_cell"):
+        # lazy: sustained transitively imports the jax solver
+        from kubernetes_tpu.harness import sustained
+
+        return getattr(sustained, name)
     if name in ("BenchmarkResult", "run_workload", "ThroughputCollector"):
         from kubernetes_tpu.harness import perf
 

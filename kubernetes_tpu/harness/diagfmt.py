@@ -160,6 +160,27 @@ def format_mesh(info: Optional[Dict]) -> str:
     return "mesh[" + " ".join(parts) + "]"
 
 
+def format_pipeline(info: Optional[Dict]) -> str:
+    """The streaming-scheduler segment: pipeline depth (how many
+    batches were in flight at once — drain/encode N+1, solve N, commit
+    N−1 — max observed over the row) and the overlap share (fraction of
+    the in-flight device window hidden under host work; 0.0 = the old
+    barrier, 1.0 = the materializer never waited). Emitted by bench
+    rows whenever the batch path ran with the pipeline enabled; parsed
+    by the generic bracket scan in ``parse_diag`` (key ``pipeline``) —
+    tools/perf_report.py reads it to attribute a sustained-arrival
+    regression to lost overlap."""
+    if not info:
+        return ""
+    parts = [
+        f"depth={int(info.get('depth', 0))}",
+        f"overlap={float(info.get('overlap', 0.0)):.2f}",
+    ]
+    if info.get("cycles") is not None:
+        parts.append(f"cycles={int(info['cycles'])}")
+    return "pipeline[" + " ".join(parts) + "]"
+
+
 def format_replay(info: Optional[Dict]) -> str:
     """The trace-replay segment: which family ran, the offered
     open-loop arrival rate, the arrival→bind p99 (the latency a
@@ -242,7 +263,7 @@ def parse_diag(line: str) -> Optional[dict]:
     (name → total_s/count/p99_ms), ``session``, ``chunk``,
     ``max_cycle_s``, ``pad_warms``, ``devprof``, ``churn``,
     ``autoscaler``, ``apf``, ``slo``, ``shards``, ``mesh``,
-    ``replay``, ``e2e_p99_ms``, ``e2e_buckets``
+    ``replay``, ``pipeline``, ``e2e_p99_ms``, ``e2e_buckets``
     (upper-edge str → count). Handles both the current diagfmt output
     and the legacy hand-rolled format in committed BENCH_r* tails."""
     marker = "diag:"

@@ -74,9 +74,12 @@ _HEURISTIC_FLOOR_S = 0.25
 class _Cycle(dict):
     """One solve cycle's record. A dict subclass so JSONL serialization
     and ring consumers get plain keys, with the few non-serialized
-    control fields kept as attributes."""
+    control fields kept as attributes. ``dispatch_end`` is the
+    monotonic instant the lazy dispatch returned — the start of the
+    in-flight device window the streaming pipeline hides host work
+    under; ``note_block`` turns it into the cycle's ``overlap_s``."""
 
-    __slots__ = ("pending_block", "done")
+    __slots__ = ("pending_block", "done", "dispatch_end")
 
 
 class DevProfiler:
@@ -163,6 +166,7 @@ class DevProfiler:
         )
         rec.pending_block = False
         rec.done = False
+        rec.dispatch_end = None
         self._local.active = rec
         self._ring.append(rec)
         return rec
@@ -209,7 +213,11 @@ class DevProfiler:
         if getattr(self._local, "active", None) is rec:
             self._local.active = None
         if pending_block:
+            # the in-flight device window opens HERE: host time spent
+            # before the materializer finally blocks is work the
+            # pipeline hid under the dispatched solve (overlap_s)
             rec.pending_block = True
+            rec.dispatch_end = time.monotonic()
             return
         self._complete(rec)
 
@@ -228,14 +236,22 @@ class DevProfiler:
             pass
 
     def note_block(self, rec: Optional[_Cycle], seconds: float,
-                   d2h_bytes: int = 0) -> None:
+                   d2h_bytes: int = 0,
+                   start_mono: Optional[float] = None) -> None:
         """Late completion for lazy solves: the timed materializer calls
         this with the measured ``block_until_ready`` wait and the
         assignments' device→host bytes. May run on a different thread
         and several cycles after ``end_cycle`` (the sidecar pipelines
-        commit N while N+1 solves)."""
+        commit N while N+1 solves). ``start_mono`` is the monotonic
+        instant the materializer began blocking: the gap back to this
+        cycle's ``dispatch_end`` is host work performed WHILE the solve
+        was in flight — the pipeline's ``overlap_s``, the time the
+        double-buffered loop won back from the old barrier."""
         if rec is None or rec.done:
             return
+        if start_mono is not None and rec.dispatch_end is not None:
+            rec["overlap_s"] = round(
+                max(0.0, start_mono - rec.dispatch_end), 6)
         rec["block_s"] += seconds
         rec["d2h_bytes"] += int(d2h_bytes)
         rec.pending_block = False
@@ -386,6 +402,9 @@ class DevProfiler:
             "unexpected_compiles": self.unexpected_compiles,
             "warm_compiles": self.warm_compiles,
             "device_wait_share": 0.0,
+            "overlap_share": 0.0,
+            "overlap_s": 0.0,
+            "overlapped_cycles": 0,
             "dispatch_s": 0.0,
             "block_s": 0.0,
             "encode_s": 0.0,
@@ -404,9 +423,20 @@ class DevProfiler:
         slowest = None
         slowest_total = -1.0
         max_staleness = None
+        # pipeline overlap: judged over the LAZY cycles only (the ones
+        # that actually opened an in-flight device window) — an eager
+        # cycle's block is a barrier by construction and must not
+        # dilute the share of the window the host managed to hide
+        ov_total = ov_block = 0.0
+        overlapped = 0
         for r in recs:
             for k in tot:
                 tot[k] += r[k]
+            ov = r.get("overlap_s")
+            if ov is not None:
+                ov_total += ov
+                ov_block += r["block_s"]
+                overlapped += 1
             out["compiles"] += r["compiles"]
             out["compile_s"] += r["compile_s"]
             out["h2d_bytes"] += r["h2d_bytes"]
@@ -430,6 +460,14 @@ class DevProfiler:
         if phase_total > 0:
             out["device_wait_share"] = round(
                 tot["block_s"] / phase_total, 4)
+        out["overlap_s"] = round(ov_total, 4)
+        out["overlapped_cycles"] = overlapped
+        if ov_total + ov_block > 0:
+            # share of the in-flight device window hidden under host
+            # work (drain/encode/commit of neighboring batches): 1.0 =
+            # the materializer never waited, 0.0 = pure barrier
+            out["overlap_share"] = round(
+                ov_total / (ov_total + ov_block), 4)
         if padded > 0:
             out["pad_waste_pct"] = round(100.0 * (1.0 - real / padded), 2)
         if max_staleness is not None:

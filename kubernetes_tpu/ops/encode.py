@@ -348,6 +348,20 @@ class BatchEncoder:
         self._num_values: int = 0
         self._con_match_idx = ({}, [])
         self._term_match_idx = ({}, [])
+        # delta-column pod-plane pool (streaming-scheduler encode
+        # stage): the padded pod-side matrices stay RESIDENT between
+        # batches, keyed by their shape tuple, and each encode zeroes
+        # only the rows the previous batch dirtied before filling the
+        # new batch's rows — a b_pad-sized allocation per cycle becomes
+        # an O(real rows) touch. Safe to reuse while a solve is in
+        # flight because ``pack_podin`` COPIES every pooled array into
+        # the packed upload buffer before dispatch (np.concatenate /
+        # astype); the arrays a consumer retains past the call
+        # (profile_idx, inexpressible — the sidecar carries them in its
+        # pending commit dict) and the one ``pack_podin`` returns as a
+        # no-copy view (pref_weight) are deliberately allocated fresh
+        # every batch and never pooled.
+        self._pod_plane_pool: Dict[tuple, Dict] = {}
 
     # ------------------------------------------------------------------
     def _sharding_active(self) -> bool:
@@ -835,21 +849,52 @@ class BatchEncoder:
         sc = max(len(constraints), 1)
         t_n = max(len(terms), 1)
 
-        requests = np.zeros((b_pad, r), dtype=np.int32)
-        nonzero_requests = np.zeros((b_pad, 2), dtype=np.int32)
+        # pooled (delta-column) planes: zero only the previously-dirty
+        # rows, then fill the new batch's — see _pod_plane_pool
+        key = (b_pad, r, sc, t_n, self._sv_pad)
+        bufs = self._pod_plane_pool.get(key)
+        if bufs is None:
+            bufs = {
+                "requests": np.zeros((b_pad, r), dtype=np.int32),
+                "nonzero_requests": np.zeros((b_pad, 2),
+                                             dtype=np.int32),
+                "pod_sc": np.zeros((b_pad, sc), dtype=bool),
+                "pod_sc_match": np.zeros((b_pad, sc), dtype=bool),
+                "match_by": np.zeros((b_pad, t_n), dtype=bool),
+                "own_aff": np.zeros((b_pad, t_n), dtype=bool),
+                "own_anti": np.zeros((b_pad, t_n), dtype=bool),
+                "dirty": 0,
+            }
+            if self._sv_pad:
+                # sentinel slot = the padded dim (never a real plane)
+                bufs["pod_sv"] = np.full((b_pad, 2), (self._sv_pad, 0),
+                                         dtype=np.int32)
+            self._pod_plane_pool[key] = bufs
+        else:
+            dirty = bufs["dirty"]
+            for name in ("requests", "nonzero_requests", "pod_sc",
+                         "pod_sc_match", "match_by", "own_aff",
+                         "own_anti"):
+                bufs[name][:dirty] = 0
+            if self._sv_pad:
+                bufs["pod_sv"][:dirty] = (self._sv_pad, 0)
+        # rows filled below — recorded BEFORE the loop so an early
+        # bail (pod outside the space → rebuild) still marks them
+        bufs["dirty"] = b_real
+        requests = bufs["requests"]
+        nonzero_requests = bufs["nonzero_requests"]
+        pod_sc = bufs["pod_sc"]
+        pod_sc_match = bufs["pod_sc_match"]
+        match_by = bufs["match_by"]
+        own_aff = bufs["own_aff"]
+        own_anti = bufs["own_anti"]
+        pod_sv = bufs.get("pod_sv")
+        # NOT pooled: retained by the sidecar's pending dict past this
+        # call (profile_idx, inexpressible) or returned as a no-copy
+        # view by pack_podin (pref_weight)
         profile_idx = np.zeros(b_pad, dtype=np.int32)
         inexpressible = np.zeros(b_pad, dtype=bool)
-        pod_sc = np.zeros((b_pad, sc), dtype=bool)
-        pod_sc_match = np.zeros((b_pad, sc), dtype=bool)
-        match_by = np.zeros((b_pad, t_n), dtype=bool)
-        own_aff = np.zeros((b_pad, t_n), dtype=bool)
-        own_anti = np.zeros((b_pad, t_n), dtype=bool)
         pref_weight = np.zeros((b_pad, t_n), dtype=np.float32)
-        pod_sv = None
-        if self._sv_pad:
-            # sentinel slot = the padded dim (never a real plane)
-            pod_sv = np.full((b_pad, 2), (self._sv_pad, 0),
-                             dtype=np.int32)
 
         for bi, pod in enumerate(pods):
             pi = PodInfo.of(pod)

@@ -255,6 +255,18 @@ class SolverSession:
         self.incremental_hits = 0
         self.rebuilds = 0
         self.state_only_rebuilds = 0
+        # pipeline stage handoff: an incremental solve dispatched while
+        # the PREVIOUS lazy solve's handle was still unmaterialized
+        # chained directly onto its in-flight state carry — the device
+        # runs back-to-back batches with zero host round trip, and (on
+        # the donating mesh tier) the carry consumed by solve N is
+        # NEVER re-encoded or re-uploaded for N+1: XLA aliases it
+        # straight into N+1's inputs. ``carry_chained`` counts those
+        # dispatches; the differential guard and the sustained-arrival
+        # cell read it to prove the pipeline actually pipelines.
+        self.carry_chained = 0
+        self._dispatch_seq = 0      # lazy handles handed out
+        self._materialize_seq = 0   # lazy handles consumed
         # scheduling-cycle id stamped by the sidecar before each solve so
         # the per-cycle phase spans correlate with the pods' queue cycles
         self.trace_cycle = -1
@@ -434,11 +446,20 @@ class SolverSession:
                     dp.phase("encode", t_pack - t0)
                     dp.phase("pack", t_done - t_pack)
                     dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
+                    # stage handoff: with the previous lazy handle
+                    # still in flight, this dispatch chains onto its
+                    # UNMATERIALIZED state carry — jax sequences the
+                    # two solves on device with no host sync, and a
+                    # donating backend aliases the consumed carry into
+                    # this solve's inputs (never re-encoded host-side)
+                    chained = self._dispatch_seq > self._materialize_seq
                     t0 = time.monotonic()
                     handle, self._state = self._active.solve_lazy(
                         self.params, self._static, self._state,
                         ints, floats
                     )
+                    if chained and not warming:
+                        self.carry_chained += 1
                     staging = self._take_staging_s()
                     dp.phase("dispatch",
                              max(0.0, time.monotonic() - t0 - staging))
@@ -556,21 +577,29 @@ class SolverSession:
         the commit pipeline — is measured and attributed to the cycle
         that dispatched it (devprof ``note_block`` completes the record;
         a ``solve.block`` tracer span carries the same cycle id so
-        ``/debug/trace`` shows the wait next to the dispatch). With
-        devprof disabled the raw materializer is returned: the off mode
-        costs nothing."""
+        ``/debug/trace`` shows the wait next to the dispatch). The
+        wrapper also advances the dispatch/materialize sequence the
+        ``carry_chained`` stage-handoff counter reads, so it is
+        returned even with devprof off (``rec`` None — ``note_block``
+        then no-ops); the residual cost is one closure per CYCLE."""
         mat = self._active.materialize
-        if rec is None:
-            return mat
+        self._dispatch_seq += 1
+        token = self._dispatch_seq
         dp = get_devprof()
 
         def _timed(handle):
             t0 = time.monotonic()
             out = mat(handle)
             end = time.monotonic()
+            if token > self._materialize_seq:
+                self._materialize_seq = token
             try:
+                # start_mono lets devprof compute overlap_s: the host
+                # work performed between dispatch and this block is the
+                # time the pipeline hid under the in-flight solve
                 dp.note_block(rec, end - t0,
-                              int(getattr(out, "nbytes", 0)))
+                              int(getattr(out, "nbytes", 0)),
+                              start_mono=t0)
                 tracer = get_tracer()
                 if tracer.enabled:
                     tracer.record("solve.block", t0, end,
@@ -617,6 +646,11 @@ class SolverSession:
         if not self._warming:
             self.rebuilds += 1
         self._poisoned = False
+        # a pending handle the sidecar discarded (mirror drift) is
+        # never materialized; re-sync the stage-handoff sequence so the
+        # dangling token can't make every later dispatch read as
+        # chained onto a carry that no longer exists
+        self._materialize_seq = self._dispatch_seq
         dp = get_devprof()
         rec = dp.begin_cycle(
             cycle=self.trace_cycle, pad=pad or self.max_batch,

@@ -511,6 +511,30 @@ class SchedulingQueue(PodNominator):
         with self._qlock:
             return len(self._active_q) + len(self._backoff_q)
 
+    def pending_hint(self) -> Tuple[int, Optional[int]]:
+        """Non-blocking drain hint for the streaming scheduler: the
+        active-queue size and the priority of the pod the next pop
+        would return (the heap root under the QueueSort less-func),
+        WITHOUT popping, waiting, or consuming a scheduling cycle.
+        The pipelined batch loop reads it while a solve is in flight
+        to decide whether a drain for batch N+1 is worth attempting
+        at all (and so whether the queue lock is worth taking) —
+        stage overlap must never park on an empty queue while a
+        commit is pending. The pad bucket itself is sized from the
+        drained-and-partitioned batch: the raw hint would overstate
+        it whenever serial-fallback pods ride the drain.
+        Returns ``(0, None)`` when the active queue is empty. Purely
+        advisory: concurrent adds/pops may change the queue before
+        the caller acts on it (the hint-vs-pop consistency contract
+        is only that a quiet queue reports exactly what pop_batch
+        would then drain — tested in tests/test_queue.py)."""
+        with self._qlock:
+            n = len(self._active_q)
+            if n == 0:
+                return 0, None
+            top: QueuedPodInfo = self._active_q.peek()
+            return n, top.pod.priority()
+
 
 def _pod_updated_may_help(old: Pod, new: Pod) -> bool:
     """Reference isPodUpdated: strip ResourceVersion/Status-y fields and

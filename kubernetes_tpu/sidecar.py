@@ -8,6 +8,22 @@ through the framework's assume → Reserve → Permit → Bind pipeline so every
 host-side contract (cache assume/TTL, volume reservations, gang permits,
 events, metrics) is preserved.
 
+The solve loop is a STREAMING PIPELINE (double-buffered, Pathways-style
+host/device overlap): per pump cycle the host drains batch N+1 under a
+non-blocking queue hint, encodes its delta columns against the live
+snapshot, and dispatches its solve — jax dispatch is async, so the
+dispatch chains onto batch N's in-flight state carry with no host
+sync — then commits batch N−1 while the device crunches, with remote
+clients' bulk binds flying on the binding pool (batch N−2 may still be
+on the wire). Every correctness guard runs in its original stage:
+stale-node probes and ``commit_fits`` at commit time, drift re-encode
+via ``mirror_current``/``note_drift``, the mutation-ledger arithmetic
+per cycle. ``KTPU_PIPELINE=off`` is the kill-switch: the exact
+serialized barrier loop (drain → encode → solve → commit per call),
+held bit-identical to the pipeline by the differential guard in
+tests/test_pipeline.py. ``devprof`` measures what the overlap wins as
+``overlap_share`` (the ``pipeline[...]`` diag segment).
+
 Fallback contract (mirrors how extenders are ``IsIgnorable``,
 ``core/extender.go:154``; SURVEY.md section 5): any pod the tensor model
 can't express — unbound/shared PVC volumes, inline cloud-disk volumes,
@@ -28,6 +44,7 @@ Enable with::
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -153,9 +170,24 @@ class TPUBatchScheduler:
         validate: bool = False,
         backend=None,
         adaptive_chunk: bool = True,
+        pipeline: Optional[bool] = None,
     ):
         self.sched = scheduler
         self.max_batch = max_batch
+        # streaming pipeline kill-switch: ``KTPU_PIPELINE=off`` (or
+        # pipeline=False) runs the serialized barrier loop — drain →
+        # encode → solve (eager) → commit in ONE call, nothing carried
+        # across cycles. The differential guard
+        # (tests/test_pipeline.py) asserts a bit-identical bound set
+        # between the two arms over identical seeded event sequences.
+        if pipeline is None:
+            pipeline = os.environ.get(
+                "KTPU_PIPELINE", "").lower() not in ("off", "0", "false")
+        self.pipeline_enabled = bool(pipeline)
+        # max batches simultaneously in flight across the stages
+        # (solve N dispatched + commit N−1 pending + N−2's bulk binds
+        # on the binding pool) — the ``pipeline[depth=...]`` diag
+        self.pipeline_depth_max = 0
         # False pins the drain/pad size at max_batch (no latency-budget
         # tuning): the multi-chip scaling bench needs every mesh size to
         # solve the IDENTICAL batch partition, or slower configurations
@@ -250,69 +282,55 @@ class TPUBatchScheduler:
             self._need_warm_pad = self._chunk
 
     def run_batch(self, pop_timeout: Optional[float] = 0.2) -> int:
-        """One pump cycle, PIPELINED: dispatch this cycle's solve (jax
-        dispatch is async), then commit the PREVIOUS cycle's solved batch
-        while the device crunches the new one. A solved batch is held at
-        most one cycle and commits immediately when the queue is empty,
-        so single-shot callers see their pods bound in the same call.
+        """One pump cycle. Default (``KTPU_PIPELINE`` unset): the
+        STREAMING pipeline — drain batch N+1 under a non-blocking
+        hint, encode its delta columns and dispatch its solve (jax
+        dispatch is async, chaining onto batch N's in-flight state
+        carry), then commit batch N−1 while the device crunches, its
+        bulk binds flying on the binding pool for remote clients. A
+        solved batch is held at most one cycle and commits immediately
+        when the queue is empty, so single-shot callers see their pods
+        bound in the same call. With ``KTPU_PIPELINE=off``: the
+        serialized barrier loop (one batch per call, solve blocks,
+        commit follows — the differential guard's reference arm).
         Returns the number of pods worked on this cycle."""
+        if not self.pipeline_enabled:
+            return self._run_batch_serialized(pop_timeout)
+        return self._run_batch_pipelined(pop_timeout)
+
+    # -- shared stages --------------------------------------------------
+    def _degraded_pause(self, pop_timeout: Optional[float]) -> None:
+        # circuit open: the batch path pauses exactly like the
+        # serial loop — solved-but-uncommitted work stays pending
+        # and commits on the first cycle after recovery. Always
+        # sleep: flush() drives this with pop_timeout=0.0 in a
+        # while-_pending loop, which must not become a busy spin.
+        time.sleep(min(pop_timeout, 0.05) if pop_timeout else 0.01)
+
+    def _service_warm_pad(self) -> None:
+        if self._need_warm_pad is None:
+            return
+        # session.warm_pad discards its outputs, so the resident
+        # state — and any pipelined batch's lazy handle — survive;
+        # this runs on the very next cycle after a shrink, even
+        # under sustained load where something is always in flight
+        pad = self._need_warm_pad
+        self._need_warm_pad = None
+        if pad not in self._warmed_pads and self._warm_samples:
+            warmed = self.session.warm_pad(self._warm_samples, pad)
+            if warmed is not None:
+                # the bucket is live either way; pad_warms counts
+                # the compiles devprof MEASURED (0 = executable was
+                # already cached and the warm cost ~nothing)
+                self._warmed_pads.add(pad)
+                self.pad_warms += warmed
+
+    def _partition(self, qpis: List[tuple]):
+        """Batchable vs serial-fallback split (one wfc-class scan per
+        drain, not one per pod) — identical in both pipeline arms."""
         sched = self.sched
-        if sched.is_degraded():
-            # circuit open: the batch path pauses exactly like the
-            # serial loop — solved-but-uncommitted work stays pending
-            # and commits on the first cycle after recovery. Always
-            # sleep: flush() drives this with pop_timeout=0.0 in a
-            # while-_pending loop, which must not become a busy spin.
-            time.sleep(min(pop_timeout, 0.05) if pop_timeout else 0.01)
-            return 0
-        prev = self._pending
-        self._pending = None
-
-        if self._need_warm_pad is not None:
-            # session.warm_pad discards its outputs, so the resident
-            # state — and any pipelined batch's lazy handle — survive;
-            # this runs on the very next cycle after a shrink, even
-            # under sustained load where something is always in flight
-            pad = self._need_warm_pad
-            self._need_warm_pad = None
-            if pad not in self._warmed_pads and self._warm_samples:
-                warmed = self.session.warm_pad(self._warm_samples, pad)
-                if warmed is not None:
-                    # the bucket is live either way; pad_warms counts
-                    # the compiles devprof MEASURED (0 = executable was
-                    # already cached and the warm cost ~nothing)
-                    self._warmed_pads.add(pad)
-                    self.pad_warms += warmed
-
-        # a pending batch solved against a mirror that has since
-        # diverged (external events, failed commits) is suspect: its
-        # assignments are discarded and its pods RE-SOLVED this cycle
-        # (the solve below rebuilds from a fresh snapshot), keeping
-        # them on the batch path instead of serializing up to
-        # max_batch pods. Carried-over pods go back through the SAME
-        # partition as freshly drained ones, against the live store
-        # object — the divergence that discarded the batch may be the
-        # pod itself being deleted or updated (e.g. gaining a PVC)
-        # while its batch was in flight.
         batchable: List[tuple] = []
         serial: List[QueuedPodInfo] = []
-        if prev is not None and not self.session.mirror_current():
-            qpis = []
-            for qpi, cycle in prev["batchable"]:
-                pod = qpi.pod
-                live = sched.client.get_pod(pod.namespace, pod.name)
-                if live is None or live.uid != pod.uid:
-                    continue  # deleted (and maybe recreated) in flight
-                if live is not pod:
-                    qpi.pod_info = PodInfo.of(live)
-                qpis.append((qpi, cycle))
-            prev = None
-        else:
-            qpis = self._drain(0.0 if prev is not None else pop_timeout)
-        processed = len(qpis)
-
-        # partition: batchable vs serial-fallback (one wfc-class scan
-        # per drain, not one per pod)
         host_only_cache: dict = {}
         for qpi, cycle in qpis:
             pod = qpi.pod
@@ -326,23 +344,78 @@ class TPUBatchScheduler:
                 serial.append(qpi)
             else:
                 batchable.append((qpi, cycle))
+        return batchable, serial
+
+    def _select_pad(self, n_batch: int) -> int:
+        """Right-size the pad: a partial drain (creator still
+        streaming, queue trickle) pays the device scan of its
+        SMALLEST already-compiled pow-2 bucket, not the full
+        chunk — device latency scales with the padded size, and
+        only warmed buckets are eligible so this never compiles
+        inside a measured cycle."""
+        pad = self._chunk
+        for b in sorted(self._warmed_pads):
+            if n_batch <= b < pad:
+                return b
+        return pad
+
+    # -- the pipelined loop ---------------------------------------------
+    def _run_batch_pipelined(self, pop_timeout: Optional[float]) -> int:
+        sched = self.sched
+        if sched.is_degraded():
+            self._degraded_pause(pop_timeout)
+            return 0
+        prev = self._pending
+        self._pending = None
+        self._service_warm_pad()
+
+        # a pending batch solved against a mirror that has since
+        # diverged (external events, failed commits) is suspect: its
+        # assignments are discarded and its pods RE-SOLVED this cycle
+        # (the solve below rebuilds from a fresh snapshot), keeping
+        # them on the batch path instead of serializing up to
+        # max_batch pods. Carried-over pods go back through the SAME
+        # partition as freshly drained ones, against the live store
+        # object — the divergence that discarded the batch may be the
+        # pod itself being deleted or updated (e.g. gaining a PVC)
+        # while its batch was in flight.
+        if prev is not None and not self.session.mirror_current():
+            qpis = []
+            for qpi, cycle in prev["batchable"]:
+                pod = qpi.pod
+                live = sched.client.get_pod(pod.namespace, pod.name)
+                if live is None or live.uid != pod.uid:
+                    continue  # deleted (and maybe recreated) in flight
+                if live is not pod:
+                    qpi.pod_info = PodInfo.of(live)
+                qpis.append((qpi, cycle))
+            prev = None
+        else:
+            # drain stage, hint-gated: the non-blocking peek decides
+            # whether a drain is worth attempting at all — with batch
+            # N−1's commit pending and nothing queued, skip the pop
+            # (and its condition wait) entirely so stage overlap never
+            # parks on an empty queue; with work queued, drain without
+            # waiting. Only a fully idle pipeline blocks for
+            # pop_timeout (the pump loops' idle-wait contract).
+            hint_n, _hint_prio = sched.queue.pending_hint()
+            if prev is not None and hint_n == 0:
+                qpis = []
+            else:
+                qpis = self._drain(
+                    0.0 if (prev is not None or hint_n) else pop_timeout)
+        processed = len(qpis)
+
+        batchable, serial = self._partition(qpis)
 
         committed = 0
         self._cycle_mutations = 0
         seq_anchor = sched.cache.mutation_seq
         if batchable:
-            # right-size the pad: a partial drain (creator still
-            # streaming, queue trickle) pays the device scan of its
-            # SMALLEST already-compiled pow-2 bucket, not the full
-            # chunk — device latency scales with the padded size, and
-            # only warmed buckets are eligible so this never compiles
-            # inside a measured cycle
-            pad = self._chunk
-            n_batch = len(batchable)
-            for b in sorted(self._warmed_pads):
-                if n_batch <= b < pad:
-                    pad = b
-                    break
+            # pad sized from the PARTITIONED batchable count — the raw
+            # hint overstates it whenever serial-fallback pods rode the
+            # drain, and an overstated bucket is a larger device scan
+            pad = self._select_pad(len(batchable))
             # correlate this batch's solver phase spans with its pods'
             # scheduling cycles (the flight recorder's cycle id)
             self.session.trace_cycle = batchable[0][1]
@@ -388,6 +461,13 @@ class TPUBatchScheduler:
                     "start": time.monotonic(),
                     "pad": pad,
                 }
+                # pipeline depth at this instant: solve N in flight,
+                # batch N−1 solved-but-uncommitted, batch N−2's bulk
+                # binds still on the binding pool
+                depth = 1 + (1 if prev is not None else 0) + (
+                    1 if getattr(sched, "_inflight_bindings", 0) else 0)
+                if depth > self.pipeline_depth_max:
+                    self.pipeline_depth_max = depth
             except Exception:  # noqa: BLE001 — popped pods must not be lost
                 _logger.exception(
                     "batch solve failed; %d pods fall back to the serial path",
@@ -397,6 +477,8 @@ class TPUBatchScheduler:
                 serial.extend(q for q, _ in batchable)
 
         # commit the previous cycle's batch while the device solves
+        # (every guard — stale-node probes, commit_fits, drift
+        # re-encode — runs inside _commit_pending, stage-unchanged)
         if prev is not None:
             committed += self._commit_pending_safe(prev, serial)
             processed += len(prev["batchable"])
@@ -417,6 +499,76 @@ class TPUBatchScheduler:
         # invalidate the mirror.
         self.session.note_committed(self._cycle_mutations, seq_anchor)
         return processed
+
+    # -- the serialized (kill-switch) loop ------------------------------
+    def _run_batch_serialized(self, pop_timeout: Optional[float]) -> int:
+        """The ``KTPU_PIPELINE=off`` barrier loop: drain → encode →
+        solve (eager — the materializer blocks inside the solve) →
+        commit, one batch per call, nothing carried across cycles.
+        Every guard runs exactly as in the pipelined arm (same
+        ``_commit_pending``); only the overlap is gone. This is the
+        differential guard's reference arm and the operational
+        kill-switch if the pipeline ever misbehaves in production."""
+        sched = self.sched
+        if sched.is_degraded():
+            self._degraded_pause(pop_timeout)
+            return 0
+        self._service_warm_pad()
+        qpis = self._drain(pop_timeout)
+        processed = len(qpis)
+        batchable, serial = self._partition(qpis)
+        committed = 0
+        self._cycle_mutations = 0
+        seq_anchor = sched.cache.mutation_seq
+        if batchable:
+            pad = self._select_pad(len(batchable))
+            self.session.trace_cycle = batchable[0][1]
+            start = time.monotonic()
+            try:
+                res = self.session.solve(
+                    [q.pod for q, _ in batchable], lazy=False,
+                    pad_to=pad,
+                )
+                handle, cluster, _ = res
+                self._warmed_pads.add(pad)
+                self._warm_samples = [q.pod for q, _ in batchable[:8]]
+                committed += self._commit_pending_safe({
+                    "batchable": batchable,
+                    "handle": handle,
+                    "materializer": None,   # already materialized
+                    "cluster": cluster,
+                    "profiles": self.session.last_profile_idx,
+                    "inexpressible": self.session.last_inexpressible,
+                    "masks": self.session.static_masks_host,
+                    "start": start,
+                    "pad": pad,
+                }, serial)
+            except Exception:  # noqa: BLE001 — popped pods must not be lost
+                _logger.exception(
+                    "batch solve failed; %d pods fall back to the serial path",
+                    len(batchable),
+                )
+                self.session.invalidate()
+                serial.extend(q for q, _ in batchable)
+        self._run_serial(serial)
+        self.session.note_committed(self._cycle_mutations, seq_anchor)
+        return processed
+
+    def pipeline_info(self, telemetry: Optional[Dict] = None
+                      ) -> Optional[Dict]:
+        """The ``pipeline[...]`` diag segment's payload: max observed
+        stage depth plus (when a devprof summary is supplied) the
+        overlap share and how many cycles actually overlapped. None
+        when the pipeline is off OR never dispatched a batch (a
+        serial-only or empty row) — those rows print nothing, the
+        quiet-row convention the other diag segments follow."""
+        if not self.pipeline_enabled or self.pipeline_depth_max == 0:
+            return None
+        info: Dict = {"depth": self.pipeline_depth_max}
+        if telemetry:
+            info["overlap"] = float(telemetry.get("overlap_share", 0.0))
+            info["cycles"] = int(telemetry.get("overlapped_cycles", 0))
+        return info
 
     def flush(self, timeout: float = 60.0) -> int:
         """Commit any held solved-but-uncommitted batch (the pipelining
@@ -1011,14 +1163,18 @@ def attach_batch_scheduler(
     validate: bool = False,
     backend=None,
     adaptive_chunk: bool = True,
+    pipeline: Optional[bool] = None,
 ) -> Optional[TPUBatchScheduler]:
     """Install the batch path iff the TPUBatchScheduler gate is enabled
-    (the --feature-gates=TPUBatchScheduler wiring)."""
+    (the --feature-gates=TPUBatchScheduler wiring). ``pipeline``
+    overrides the ``KTPU_PIPELINE`` kill-switch (None = read the env;
+    False = the serialized barrier loop)."""
     if not sched.feature_gates.enabled("TPUBatchScheduler"):
         return None
     bs = TPUBatchScheduler(sched, max_batch=max_batch, params=params,
                            validate=validate, backend=backend,
-                           adaptive_chunk=adaptive_chunk)
+                           adaptive_chunk=adaptive_chunk,
+                           pipeline=pipeline)
     sched.batch_scheduler = bs
     try:
         # the schedule-latency SLO reads the e2e histogram from THIS

@@ -131,19 +131,35 @@ class TestDiagFmtRoundTrip:
         hist = Histogram("e2e", "t", ("result",))
         assert diagfmt.format_e2e(hist) == []
 
+    def test_pipeline_segment_round_trips(self):
+        """The streaming-scheduler segment (ISSUE 14 satellite):
+        depth + overlap share through the one writer / one parser."""
+        seg = diagfmt.format_pipeline(
+            {"depth": 3, "overlap": 0.437, "cycles": 12})
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert parsed["pipeline"]["depth"] == 3
+        assert parsed["pipeline"]["overlap"] == pytest.approx(0.44)
+        assert parsed["pipeline"]["cycles"] == 12
+        # quiet conventions: no info (pipeline off) renders nothing
+        assert diagfmt.format_pipeline(None) == ""
+        assert diagfmt.format_pipeline({}) == ""
+
 
 # ---------------------------------------------------------------------------
 # synthetic trajectory: the flagging semantics
 
 
 def _artifact(dirpath, n: int, value: float, runs=None, telemetry=None,
-              diag: str = _LEGACY_DIAG) -> None:
-    row = {"metric": _HEADLINE, "value": value, "unit": "pods/s",
+              diag: str = _LEGACY_DIAG, metric: str = _HEADLINE,
+              extra: dict = None) -> None:
+    row = {"metric": metric, "value": value, "unit": "pods/s",
            "p99_latency_ms": 994}
     if runs:
         row["runs"] = runs
     if telemetry:
         row["telemetry"] = telemetry
+    if extra:
+        row.update(extra)
     tail = "\n".join([
         "SchedulingBasic/batch: 30000 pods created",
         diag,
@@ -211,6 +227,49 @@ class TestSyntheticTrajectory:
         assert flag["round"] == 3
         assert flag["band_pct"] == pytest.approx(30.0)  # prior floor
 
+    def test_persistent_regression_stays_flagged(self, tmp_path):
+        """The r5 GangScheduling shape: a drop with NO later recovery
+        round stays an open flag and still gates --strict."""
+        from tools.perf_report import main, open_regressions
+
+        gang = ("pods_scheduled_per_sec[GangScheduling 5000nodes/"
+                "30000pods, TPU batch path]")
+        _artifact(tmp_path, 1, 4400.0, metric=gang)
+        _artifact(tmp_path, 2, 4390.0, metric=gang)
+        _artifact(tmp_path, 3, 2846.0, metric=gang)
+        flags = detect_regressions(
+            build_series(load_rounds(str(tmp_path))))
+        assert len(flags) == 1
+        assert "recovered_round" not in flags[0]
+        assert open_regressions(flags) == flags
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_recovered_regression_stops_gating(self, tmp_path):
+        """ISSUE 14 satellite: once a later round lands back inside
+        the band the drop was judged against, the old flag retires —
+        it no longer gates --strict, but stays reported as recovered
+        provenance. (The GangScheduling acceptance: the pipeline row
+        landing in-band must silence the r5 flag without rewriting
+        committed artifacts.)"""
+        from tools.perf_report import main, open_regressions
+
+        gang = ("pods_scheduled_per_sec[GangScheduling 5000nodes/"
+                "30000pods, TPU batch path]")
+        _artifact(tmp_path, 1, 4400.0, metric=gang)
+        _artifact(tmp_path, 2, 4390.0, metric=gang)
+        _artifact(tmp_path, 3, 2846.0, metric=gang)
+        _artifact(tmp_path, 4, 4300.0, metric=gang)   # back in band
+        flags = detect_regressions(
+            build_series(load_rounds(str(tmp_path))))
+        assert len(flags) == 1
+        assert flags[0]["recovered_round"] == 4
+        assert open_regressions(flags) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+        # the human report still names the recovery
+        text = render(build_series(load_rounds(str(tmp_path))), flags)
+        assert "recovered" in text
+        assert "REGRESSION" not in text
+
     def test_stray_bench_named_files_are_ignored(self, tmp_path):
         _artifact(tmp_path, 1, 7000.0)
         # matches the glob, not the round-name contract — must be
@@ -230,6 +289,59 @@ class TestSyntheticTrajectory:
         p.write_text(json.dumps({"n": 1, "cmd": "x", "rc": 0}))  # no tail
         with pytest.raises(ValueError, match="tail"):
             load_round(str(p))
+
+
+# ---------------------------------------------------------------------------
+# the sustained-arrival family gate (ISSUE 14 satellite)
+
+
+class TestSustainedFlags:
+    _METRIC = ("sustained_arrival[open-loop 5000/s 240nodes/30000pods "
+               "seed=14, store-direct replay engine]")
+
+    def _row(self, tmp_path, n, **extra):
+        base = {"p99_arrival_to_bind_ms": 180, "lost_pods": 0,
+                "rate_normalized_throughput": 0.99,
+                "telemetry": {"overlap_share": 0.6,
+                              "overlapped_cycles": 40},
+                "freshness": {"slo": {"snapshot_staleness": "ok",
+                                      "schedule_latency": "ok"}}}
+        base.update(extra)
+        _artifact(tmp_path, n, 4900.0, metric=self._METRIC, extra=base)
+
+    def test_green_row_passes(self, tmp_path):
+        from tools.perf_report import main, sustained_flags
+
+        self._row(tmp_path, 1)
+        assert sustained_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_p99_over_budget_gates_strict(self, tmp_path):
+        from tools.perf_report import main, sustained_flags
+
+        self._row(tmp_path, 1, p99_arrival_to_bind_ms=812)
+        (flag,) = sustained_flags(load_rounds(str(tmp_path)))
+        assert "812ms > 500ms" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_lost_pods_and_red_staleness_flagged(self, tmp_path):
+        from tools.perf_report import sustained_flags
+
+        self._row(tmp_path, 1, lost_pods=3,
+                  freshness={"slo": {"snapshot_staleness": "violated"}})
+        (flag,) = sustained_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "lost_pods=3" in probs
+        assert "snapshot_staleness SLO violated" in probs
+
+    def test_zero_overlap_flagged(self, tmp_path):
+        from tools.perf_report import sustained_flags
+
+        self._row(tmp_path, 1,
+                  telemetry={"overlap_share": 0.0,
+                             "overlapped_cycles": 0})
+        (flag,) = sustained_flags(load_rounds(str(tmp_path)))
+        assert "degenerated" in flag["problems"][0]
 
 
 # ---------------------------------------------------------------------------

@@ -144,6 +144,71 @@ class TestNominator:
         assert [pi.pod.name for pi in q.nominated_pods_for_node("n1")] == ["p"]
 
 
+class TestPendingHint:
+    """The streaming scheduler's non-blocking drain hint: size +
+    max-priority peek without popping, consistent with what pop_batch
+    would then drain."""
+
+    def test_empty_queue(self):
+        q = SchedulingQueue(clock=FakeClock())
+        assert q.pending_hint() == (0, None)
+
+    def test_hint_matches_next_pop(self):
+        q = SchedulingQueue(clock=FakeClock())
+        q.add(qpod("low", 1))
+        q.add(qpod("high", 10))
+        q.add(qpod("mid", 5))
+        n, prio = q.pending_hint()
+        assert n == 3
+        assert prio == 10
+        items, _cycle = q.pop_batch(10)
+        assert items[0].pod.priority() == prio
+        assert len(items) == n
+        # the hint consumed nothing: no cycles, no attempts
+        assert all(i.attempts == 1 for i in items)
+
+    def test_hint_does_not_consume_cycles(self):
+        q = SchedulingQueue(clock=FakeClock())
+        q.add(qpod("p"))
+        before = q.scheduling_cycle
+        for _ in range(5):
+            q.pending_hint()
+        assert q.scheduling_cycle == before
+
+    def test_hint_under_concurrent_adds(self):
+        """Hints taken while writers stream adds are advisory but
+        never wrong about the quiet state: every mid-stream hint size
+        is within [0, total], and after the writers join, the hint
+        agrees exactly with a full drain."""
+        import threading
+
+        q = SchedulingQueue(clock=FakeClock())
+        total = 300
+        writers = [
+            threading.Thread(target=lambda lo=lo: [
+                q.add(qpod(f"c{lo}-{i}", priority=(lo + i) % 7,
+                           uid=f"cu{lo}-{i}"))
+                for i in range(100)
+            ])
+            for lo in range(3)
+        ]
+        hints = []
+        for w in writers:
+            w.start()
+        while any(w.is_alive() for w in writers):
+            hints.append(q.pending_hint())
+        for w in writers:
+            w.join()
+        assert all(0 <= n <= total for n, _ in hints)
+        n, prio = q.pending_hint()
+        assert n == total
+        assert prio == 6
+        items, _ = q.pop_batch(total)
+        assert len(items) == total
+        assert items[0].pod.priority() == prio
+        assert q.pending_hint() == (0, None)
+
+
 class TestDeleteAndUpdate:
     def test_delete_everywhere(self):
         q = SchedulingQueue(clock=FakeClock())

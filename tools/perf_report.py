@@ -216,7 +216,15 @@ def detect_regressions(series: Dict[str, List[dict]],
                        ) -> List[dict]:
     """Out-of-band drops, newest rounds judged against the median of
     the prior rounds (a single hot round must not become a baseline
-    every later round 'regresses' from)."""
+    every later round 'regresses' from).
+
+    Recovery (ISSUE 14): a flagged drop whose family LATER landed back
+    inside the band it was judged against is history, not an open
+    regression — the r5 GangScheduling flag must retire the round a
+    fixed row is committed, without rewriting old artifacts. Such
+    flags stay in the list carrying ``recovered_round`` (provenance
+    for the report) but no longer gate ``--strict``
+    (``open_regressions`` filters them)."""
     flags: List[dict] = []
     for metric, points in series.items():
         if len(points) < 2:
@@ -233,7 +241,7 @@ def detect_regressions(series: Dict[str, List[dict]],
                 continue
             delta = (points[i]["value"] - baseline) / baseline
             if delta < -band:
-                flags.append({
+                flag = {
                     "metric": metric,
                     "round": points[i]["round"],
                     "value": points[i]["value"],
@@ -241,8 +249,21 @@ def detect_regressions(series: Dict[str, List[dict]],
                     "delta_pct": round(100.0 * delta, 1),
                     "band_pct": round(100.0 * band, 1),
                     "attribution": _attribute(points[i], points[i - 1]),
-                })
+                }
+                floor_v = baseline * (1.0 - band)
+                recovered = next(
+                    (p["round"] for p in points[i + 1:]
+                     if p["value"] >= floor_v), None)
+                if recovered is not None:
+                    flag["recovered_round"] = recovered
+                flags.append(flag)
     return flags
+
+
+def open_regressions(flags: List[dict]) -> List[dict]:
+    """The flags that still gate ``--strict``: drops no later round
+    has recovered from."""
+    return [f for f in flags if "recovered_round" not in f]
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +278,8 @@ def summarize_telemetry(telemetry_dir: str) -> dict:
            "unexpected_compiles": 0, "block_s": 0.0, "dispatch_s": 0.0,
            "encode_s": 0.0, "h2d_bytes": 0, "d2h_bytes": 0,
            "donated_bytes": 0, "real_rows": 0, "padded_rows": 0,
-           "files": 0}
+           "overlap_s": 0.0, "overlap_block_s": 0.0,
+           "overlapped_cycles": 0, "files": 0}
     for path in sorted(glob.glob(
             os.path.join(telemetry_dir, "solvercycles-*.jsonl"))):
         out["files"] += 1
@@ -284,9 +306,18 @@ def summarize_telemetry(telemetry_dir: str) -> dict:
                 out["real_rows"] += rec.get("real", 0)
                 out["padded_rows"] += rec.get("pad", 0) or rec.get(
                     "real", 0)
+                if rec.get("overlap_s") is not None:
+                    # pipeline overlap: lazy cycles only (mirrors
+                    # DevProfiler.summary's overlap_share definition)
+                    out["overlap_s"] += rec["overlap_s"]
+                    out["overlap_block_s"] += rec.get("block_s", 0.0)
+                    out["overlapped_cycles"] += 1
     phase_total = out["block_s"] + out["dispatch_s"] + out["encode_s"]
     out["device_wait_share"] = round(
         out["block_s"] / phase_total, 4) if phase_total > 0 else 0.0
+    ov_window = out["overlap_s"] + out["overlap_block_s"]
+    out["overlap_share"] = round(
+        out["overlap_s"] / ov_window, 4) if ov_window > 0 else 0.0
     out["pad_waste_pct"] = round(
         100.0 * (1.0 - out["real_rows"] / out["padded_rows"]), 2) \
         if out["padded_rows"] else 0.0
@@ -454,6 +485,63 @@ def replay_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def sustained_flags(rounds: List[dict]) -> List[dict]:
+    """The ``sustained_arrival`` family's own checks (ISSUE 14
+    satellite): the streaming scheduler's open-loop row cannot be
+    judged by throughput — the offered rate pins it. Flag the round
+    when:
+
+    - p99 arrival→bind exceeds the 500 ms budget (the pipeline's
+      latency acceptance bar — the barrier quantized p99 at
+      whole-cycle time, and this is the number that proves it's gone);
+    - the row LOST pods (``lost_pods`` > 0 or short-injected — the
+      replay engine's hardest invariant);
+    - the snapshot-staleness SLO verdict went red (a deeper in-flight
+      window must never mean solving stale truth);
+    - the pipeline stopped overlapping (``telemetry.overlap_share``
+      == 0 on a row whose telemetry is present: the streaming loop
+      silently degenerated back to the barrier).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if not str(row.get("metric", "")).startswith(
+                    "sustained_arrival") or "error" in row:
+                continue
+            problems = []
+            p99 = row.get("p99_arrival_to_bind_ms")
+            if p99 is not None and p99 > 500:
+                problems.append(
+                    f"p99 arrival→bind {p99}ms > 500ms budget")
+            if row.get("lost_pods"):
+                problems.append(f"lost_pods={row['lost_pods']}")
+            if row.get("invariants_ok") is False:
+                bad = [k for k, v in
+                       (row.get("invariants") or {}).items() if not v]
+                problems.append(
+                    "invariants failed: " + (", ".join(bad) or "?"))
+            slo = (row.get("freshness") or {}).get("slo") or {}
+            verdict = slo.get("snapshot_staleness")
+            if verdict is not None and verdict != "ok":
+                problems.append(
+                    f"snapshot_staleness SLO {verdict}")
+            tel = row.get("telemetry") or {}
+            if tel and "overlap_share" in tel \
+                    and not tel.get("overlap_share"):
+                problems.append(
+                    "overlap_share=0 (pipeline degenerated to the "
+                    "barrier)")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -462,7 +550,9 @@ def _short_metric(metric: str) -> str:
 def render(series: Dict[str, List[dict]], flags: List[dict],
            band_floor: float = DEFAULT_NOISE_BAND) -> str:
     lines: List[str] = []
-    flagged = {(f["metric"], f["round"]) for f in flags}
+    open_flags = open_regressions(flags)
+    recovered = [f for f in flags if "recovered_round" in f]
+    flagged = {(f["metric"], f["round"]) for f in open_flags}
     for metric in sorted(series):
         points = series[metric]
         band = noise_band(points, floor=band_floor)
@@ -482,9 +572,9 @@ def render(series: Dict[str, List[dict]], flags: List[dict],
                          f"{p99:>8} {delta:>10}  {mark}")
             prev = p["value"]
         lines.append("")
-    if flags:
+    if open_flags:
         lines.append("flagged regressions:")
-        for f in flags:
+        for f in open_flags:
             lines.append(
                 f"  r{f['round']} {_short_metric(f['metric'])}: "
                 f"{f['value']:.1f} vs baseline {f['baseline']:.1f} "
@@ -493,6 +583,14 @@ def render(series: Dict[str, List[dict]], flags: List[dict],
     else:
         lines.append("no out-of-band regressions "
                      f"(band floor ±{band_floor * 100:.0f}%)")
+    if recovered:
+        lines.append("recovered regressions (back inside the band, "
+                     "no longer gating):")
+        for f in recovered:
+            lines.append(
+                f"  r{f['round']} {_short_metric(f['metric'])}: "
+                f"{f['value']:.1f} ({f['delta_pct']}%) — recovered "
+                f"in r{f['recovered_round']}")
     return "\n".join(lines)
 
 
@@ -517,9 +615,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     series = build_series(rounds)
     flags = detect_regressions(series, band_floor=args.band)
+    open_flags = open_regressions(flags)
     scale_flags = scale_ab_flags(rounds)
     dev_flags = devscale_flags(rounds)
     rep_flags = replay_flags(rounds)
+    sus_flags = sustained_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -530,10 +630,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     for p in pts]
                 for m, pts in series.items()
             },
-            "regressions": flags,
+            "regressions": open_flags,
+            "recovered": [f for f in flags
+                          if "recovered_round" in f],
             "scale_flags": scale_flags,
             "devscale_flags": dev_flags,
             "replay_flags": rep_flags,
+            "sustained_flags": sus_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -553,16 +656,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in rep_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if sus_flags:
+            print("\nsustained-arrival latency / pipeline flags:")
+            for f in sus_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
                   f"({telemetry['warming_cycles']} warming), "
                   f"{telemetry['compiles']} compiles, "
                   f"device-wait share {telemetry['device_wait_share']:.0%}, "
+                  f"overlap share {telemetry['overlap_share']:.0%}, "
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
     return 1 if (args.strict
-                 and (flags or scale_flags or dev_flags
-                      or rep_flags)) else 0
+                 and (open_flags or scale_flags or dev_flags
+                      or rep_flags or sus_flags)) else 0
 
 
 if __name__ == "__main__":
