@@ -663,7 +663,7 @@ def main() -> None:
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
                        "autoscale", "scale10x", "devscale", "sustained",
-                       "replay:storm", "replay:gangs",
+                       "hotspot", "replay:storm", "replay:gangs",
                        "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
                     help="trace seed for the replay:<family> rows "
@@ -757,6 +757,28 @@ def main() -> None:
             row = run_sustained_row(pods=30_000, qps=5000.0,
                                     node_cpu=32, max_batch=4096,
                                     wait_timeout=900, progress=log)
+        print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "hotspot":
+        # the elastic-control-plane row (ISSUE 15): one namespace takes
+        # 80% of the write load across three arms — balanced (honest
+        # ceiling), hotspot (the failure mode, rebalancer off), and
+        # rebalanced (the PartitionRebalancer splits the hot tenant
+        # across the keyspace mid-run). The headline is the recovery
+        # ratio (rebalanced steady-state rate / balanced rate, ≥0.8),
+        # gated by zero lost pods / zero lost watch events / zero
+        # relists of unmoved slices
+        from kubernetes_tpu.harness.hotspot import run_hotspot_row
+
+        if args.quick:
+            row = run_hotspot_row(pods=6000, partitions=3,
+                                  wait_timeout=300,
+                                  rebalance_interval_s=0.12,
+                                  cooldown_s=0.5, progress=log)
+        else:
+            row = run_hotspot_row(pods=24_000, partitions=3,
+                                  wait_timeout=900, progress=log)
         print(json.dumps(row), flush=True)
         return
 

@@ -177,6 +177,12 @@ class ClusterStore:
         # global _rv advances on every write of any kind, so it cannot
         # validate a per-kind cache
         self._kind_seq: Dict[str, int] = {}
+        # silent mutation sinks (``watch_silent``): observers of the
+        # adopt/evict channel the live resharding machinery uses to move
+        # objects between partitions WITHOUT watch events (the objects
+        # did not change — only their placement did). The WAL subscribes
+        # here so a migrated object survives a partition failover.
+        self._silent_sinks: List[Callable[[List[Event]], None]] = []
 
     # ------------------------------------------------------------------
     def _next_rv(self) -> str:
@@ -240,6 +246,90 @@ class ClusterStore:
         with self._lock:
             if handle in self._watches:
                 self._watches.remove(handle)
+
+    # ------------------------------------------------------------------
+    # silent placement channel (live partition resharding)
+    def watch_silent(self, batch_fn: Callable[[List[Event]], None]):
+        """Observe SILENT mutations (``adopt_objects``/``evict_objects``)
+        — placement moves that must reach durability (the WAL) but must
+        NOT reach watchers: the object didn't change, only which
+        partition holds it, and a watch event here would double-deliver
+        state every consumer already has. Returns a stop() handle."""
+        with self._lock:
+            self._silent_sinks.append(batch_fn)
+
+        class _SilentHandle:
+            def __init__(self, store, fn):
+                self._store, self._fn = store, fn
+
+            def stop(self) -> None:
+                with self._store._lock:
+                    if self._fn in self._store._silent_sinks:
+                        self._store._silent_sinks.remove(self._fn)
+
+        return _SilentHandle(self, batch_fn)
+
+    def _dispatch_silent(self, events: List[Event]) -> None:
+        for e in events:
+            # the pre-encoded REST list cache keys on kind_seq — an
+            # adopted object MUST invalidate it even though no watcher
+            # hears about the move
+            self._bump_kind(e.kind)
+        for fn in list(self._silent_sinks):
+            fn(events)
+
+    def adopt_objects(self, kind: str, objs: List[Any]) -> int:
+        """Insert objects PRESERVING their resourceVersions and firing
+        no watch events — the receiving half of a live slice migration
+        (the source partition committed these revisions; re-stamping or
+        re-announcing them would duplicate history). Existing entries
+        are only overwritten by an equal-or-newer revision (a late
+        retry must never regress a post-migration write). Returns the
+        number adopted."""
+        events: List[Event] = []
+        with self._lock:
+            for obj in objs:
+                table, key = self._table_key(
+                    kind, obj.metadata.namespace, obj.metadata.name)
+                try:
+                    rv = int(obj.metadata.resource_version or 0)
+                except (TypeError, ValueError):
+                    rv = 0
+                cur = table.get(key)
+                if cur is not None:
+                    try:
+                        if int(cur.metadata.resource_version or 0) > rv:
+                            continue
+                    except (TypeError, ValueError):
+                        pass
+                table[key] = obj
+                # the etcd-restore rule, applied across the shard seam:
+                # this store's future revisions must exceed every
+                # revision it adopted, or per-object RV monotonicity —
+                # which every watch consumer and the client's handoff
+                # filter depend on — would break at the migration
+                self._rv = max(self._rv, rv)
+                events.append(Event(MODIFIED, kind, obj))
+            self._dispatch_silent(events)
+        return len(events)
+
+    def evict_objects(self, kind: str,
+                      keys: List[Tuple[str, str]]) -> List[Any]:
+        """Remove objects silently — the source half of a live slice
+        migration (the object lives on in its new partition, so a
+        DELETED event here would be a lie every watcher acts on).
+        Returns the evicted objects."""
+        events: List[Event] = []
+        out: List[Any] = []
+        with self._lock:
+            for namespace, name in keys:
+                table, key = self._table_key(kind, namespace, name)
+                obj = table.pop(key, None)
+                if obj is not None:
+                    out.append(obj)
+                    events.append(Event(DELETED, kind, obj))
+            self._dispatch_silent(events)
+        return out
 
     # ------------------------------------------------------------------
     # pods

@@ -81,6 +81,13 @@ class WalHandle:
             self._writer.start()
         self._watch = store.watch(self._on_event,
                                   batch_fn=self._on_events)
+        # the silent placement channel (adopt/evict during live
+        # partition resharding): watcher-invisible by design, but it
+        # MUST reach the log — a failover restore that misses an
+        # adopted slice loses it, one that misses an eviction
+        # resurrects it on the wrong partition
+        self._silent_watch = store.watch_silent(self._on_events) \
+            if hasattr(store, "watch_silent") else None
 
     # ------------------------------------------------------------------
     def _on_events(self, events) -> None:
@@ -189,6 +196,8 @@ class WalHandle:
 
     def close(self) -> None:
         self._watch.stop()
+        if self._silent_watch is not None:
+            self._silent_watch.stop()
         if self._writer is not None:
             self.drain()
             self._queue.put(None)

@@ -27,13 +27,19 @@ from kubernetes_tpu.autoscaler.nodegroups import (
 __all__ = [
     "ClusterAutoscaler",
     "EXPANDERS",
+    "InprocElasticDriver",
     "NODE_GROUP_LABEL",
     "NodeGroup",
     "NodeGroupRegistry",
+    "PartitionGroup",
+    "PartitionRebalancer",
+    "RebalancePolicy",
+    "RestElasticDriver",
     "SAFE_TO_EVICT_ANNOTATION",
     "ScaleUpOption",
     "ScaleUpPlan",
     "SimulatedProvisioner",
+    "plan_rebalance",
     "plan_scale_up",
     "pods_fit_elsewhere",
     "run_whatif",
@@ -43,6 +49,13 @@ __all__ = [
 _SIMULATOR_EXPORTS = (
     "EXPANDERS", "ScaleUpOption", "ScaleUpPlan", "plan_scale_up",
     "pods_fit_elsewhere", "run_whatif", "scale_up_option",
+)
+
+# control-plane elasticity (live partition resharding): jax-free, but
+# lazy like the rest so light importers stay light
+_PARTITION_EXPORTS = (
+    "InprocElasticDriver", "PartitionGroup", "PartitionRebalancer",
+    "RebalancePolicy", "RestElasticDriver", "plan_rebalance",
 )
 
 
@@ -55,4 +68,8 @@ def __getattr__(name):
         from kubernetes_tpu.autoscaler import simulator
 
         return getattr(simulator, name)
+    if name in _PARTITION_EXPORTS:
+        from kubernetes_tpu.autoscaler import partitions
+
+        return getattr(partitions, name)
     raise AttributeError(name)

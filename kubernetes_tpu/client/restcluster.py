@@ -91,6 +91,44 @@ class TokenBucket:
             remaining -= take
 
 
+class StaleRouteError(RuntimeError):
+    """A bulk verb hit a server that no longer owns part of its batch
+    (topology epoch moved underneath the split). The caller re-splits
+    against the refreshed topology and resends — raised only on the
+    internal fan-out path, never surfaced to API callers."""
+
+
+def _sever(conn) -> None:
+    """Cross-thread stream teardown: shut the RAW socket down instead
+    of ``conn.close()`` — closing an http.client connection while its
+    owner thread is blocked in a read deadlocks on the buffered
+    reader's lock; a socket shutdown just errors the read out."""
+    if conn is None:
+        return
+    sock = getattr(conn, "sock", None)
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _key_of(obj) -> tuple:
+    return (getattr(obj.metadata, "namespace", ""), obj.metadata.name)
+
+
+def _rv_of(obj) -> int:
+    try:
+        return int(obj.metadata.resource_version or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 class _WatchHandle:
     def __init__(self, client: "RestClusterClient"):
         self._client = client
@@ -257,6 +295,44 @@ class RestClusterClient:
         self._rv_lock = threading.Lock()
         self._last_rv: Dict[str, int] = {}
         self.rv_regressions: List[Tuple[str, int, int]] = []
+        # -- elastic control plane (live resharding) -------------------
+        # None = the static PR 9 router (everything above, unchanged).
+        # ``enable_topology()`` fetches the server-side routing document
+        # and switches this client to epoch-aware routing: single calls
+        # route by slot owner, watches become restartable per-partition
+        # streams with client-held reflector state, and an epoch change
+        # re-routes everything WITHOUT relisting unmoved slices.
+        self._topology = None              # PartitionTopology when live
+        self.topology_epoch = 0
+        self._topology_lock = threading.Lock()
+        self._elastic_watching = False
+        self._watch_fn: Optional[Callable] = None
+        self._watch_batch_fn: Optional[Callable] = None
+        # client-held per-(kind, partition) reflector state: what this
+        # stream has shown the consumer — the client-side half of the
+        # composite cursor a migration must preserve
+        self._known_lock = threading.Lock()
+        self._stream_known: Dict[Tuple[str, int], Dict[tuple, Any]] = {}
+        self._stream_stops: Dict[Tuple[str, int], threading.Event] = {}
+        self._stream_conns: Dict[Tuple[str, int], Any] = {}
+        self._handoff_lock = threading.Lock()
+        self.stream_relists: Dict[Tuple[str, int], int] = {}
+        self.handoff_fetches = 0
+        self._topology_stop = threading.Event()
+        self._topology_thread: Optional[threading.Thread] = None
+        # replumb bookkeeping: routing can learn an epoch on any thread
+        # (the 429 fast path), but stream surgery belongs to replumb-
+        # capable callers — track which epoch the streams have caught
+        # up to, and which partition indices changed since, so the
+        # catch-up is never lost to an early equal-epoch return
+        self._replumb_epoch = 0
+        self._pending_changed: set = set()
+        # partitions that GAINED keyspace since the last re-plumb: a
+        # write committed on the source inside the freeze window whose
+        # event never reached the source stream before the flip is in
+        # NO known map — only a reconcile fetch of the gaining
+        # partition can recover it
+        self._pending_gained: set = set()
 
     def set_degraded_listener(
             self, listener: Callable[[bool], None]) -> None:
@@ -314,7 +390,9 @@ class RestClusterClient:
 
     def _request(self, method: str, path: str, payload: Any = None,
                  charge: float = 1.0, body_binary: Optional[bool] = None,
-                 partition: int = 0) -> Tuple[int, Any]:
+                 partition: int = 0,
+                 route: Optional[Callable[[], int]] = None,
+                 raise_on_stale: bool = False) -> Tuple[int, Any]:
         if self.limiter is not None:
             self.limiter.charge(charge)
         body_binary = self.binary if body_binary is None else body_binary
@@ -322,8 +400,10 @@ class RestClusterClient:
         if payload is not None:
             data = codec.encode(payload) if body_binary \
                 else json.dumps(payload).encode()
-        pool = self._pools[(partition,
-                            "ro" if method in ("GET", "HEAD") else "rw")]
+        if route is not None:
+            partition = route()
+        lane = "ro" if method in ("GET", "HEAD") else "rw"
+        pool = self._pools[(partition, lane)]
         headers = self._headers(body_binary)
         if charge > 1:
             # declare the per-object count so the server's APF width
@@ -359,6 +439,59 @@ class RestClusterClient:
                 pool.prewarm(1)
                 time.sleep(self._backoff.delay(attempt))
                 attempt += 1
+                continue
+            if resp.status == 429 \
+                    and resp.headers.get("X-Partition-Epoch"):
+                # MOVED-slice pushback: the server no longer owns part
+                # of this request's keyspace and named the live epoch.
+                # (A FROZEN slice never carries the header — its cure
+                # is the ordinary Retry-After wait below, since the
+                # routing is already correct.) Refresh routing so the
+                # retry (or the caller's re-split) lands on the owner.
+                # Overload ≠ outage: breaker-healthy, like APF 429s.
+                try:
+                    new_epoch = int(
+                        resp.headers.get("X-Partition-Epoch") or 0)
+                except ValueError:
+                    new_epoch = 0
+                if resp.will_close:
+                    _ConnPool.discard(conn)
+                else:
+                    pool.release(conn)
+                conn = None
+                self.breaker.record_success()
+                if new_epoch > self.topology_epoch:
+                    try:
+                        # the rejecting server carries the newer doc
+                        self.refresh_topology(partition=partition,
+                                              replumb=False)
+                    except Exception:  # noqa: BLE001 — retry below
+                        pass
+                if raise_on_stale and route is None:
+                    # re-split against the (possibly already-) current
+                    # topology: even an equal epoch re-groups the batch
+                    # correctly when this split predated the flip
+                    raise StaleRouteError(
+                        f"topology epoch {new_epoch}: re-split needed")
+                if attempt >= self.max_retries \
+                        or not self._retry_budget.try_spend():
+                    ctype = resp.headers.get("Content-Type") or ""
+                    if ctype.startswith(codec.BINARY_CONTENT_TYPE):
+                        return resp.status, codec.decode(raw)
+                    return resp.status, (json.loads(raw) if raw else {})
+                try:
+                    advertised = float(
+                        resp.headers.get("Retry-After") or 0.0)
+                except ValueError:
+                    advertised = 0.0
+                self._note_retry(method, "reshard")
+                time.sleep(min(max(advertised,
+                                   self._backoff.delay(attempt)),
+                               self.retry_after_cap))
+                attempt += 1
+                if route is not None:
+                    partition = route()
+                pool = self._pools[(partition, lane)]
                 continue
             if resp.status in (429, 503) and attempt < self.max_retries \
                     and self._retry_budget.try_spend():
@@ -451,6 +584,9 @@ class RestClusterClient:
     # stores, servers and clients must all compute the same shard) ----
     def _pk(self, kind: str, namespace: Optional[str] = None,
             name: Optional[str] = None) -> int:
+        topo = self._topology
+        if topo is not None:
+            return topo.partition_of(kind, namespace, name)
         if self.partitions == 1:
             return 0
         from kubernetes_tpu.apiserver.partition import partition_for
@@ -459,11 +595,154 @@ class RestClusterClient:
 
     def _pset(self, kind: str,
               namespace: Optional[str] = None) -> List[int]:
+        topo = self._topology
+        if topo is not None:
+            return topo.partitions_for(kind, namespace)
         if self.partitions == 1:
             return [0]
         from kubernetes_tpu.apiserver.partition import partitions_for
 
         return partitions_for(kind, self.partitions, namespace)
+
+    # -- elastic topology (live resharding) ----------------------------
+    def enable_topology(self, poll_interval: float = 0.5) -> bool:
+        """Switch to epoch-aware elastic routing: fetch the live
+        topology document and (with ``poll_interval`` > 0) start a
+        poller that re-routes this client — including its watch
+        streams — whenever ``/api/v1/partitiontopology`` changes epoch.
+        Returns False when the servers predate live resharding (the
+        client stays on static routing)."""
+        got = self.refresh_topology()
+        if got and poll_interval > 0 and self._topology_thread is None:
+            self._topology_stop.clear()
+            self._topology_thread = threading.Thread(
+                target=self._topology_poll_loop, args=(poll_interval,),
+                daemon=True, name="topology-poll")
+            self._topology_thread.start()
+        return got
+
+    def stop_topology_watch(self) -> None:
+        self._topology_stop.set()
+        t, self._topology_thread = self._topology_thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _topology_poll_loop(self, interval: float) -> None:
+        offset = 0
+        while not self._topology_stop.wait(interval):
+            # round-robin the endpoints: the canonical partition 0 may
+            # be the one that just died (failover is exactly when the
+            # topology changes)
+            for i in range(len(self._endpoints)):
+                p = (offset + i) % len(self._endpoints)
+                try:
+                    if self.refresh_topology(partition=p,
+                                             replumb=True):
+                        break
+                    break   # reached a server; nothing newer
+                except Exception:  # noqa: BLE001 — dead endpoint: next
+                    continue
+            offset += 1
+
+    def refresh_topology(self, partition: int = 0,
+                         replumb: bool = True) -> bool:
+        """Fetch the topology document from one endpoint and apply it
+        if its epoch is newer. ``replumb=False`` updates routing only
+        (the 429-retry path runs on arbitrary threads — watch-stream
+        surgery belongs to the poller)."""
+        code, doc = self._request("GET", "/api/v1/partitiontopology",
+                                  partition=partition)
+        if code != 200 or not isinstance(doc, dict) \
+                or "owner" not in doc:
+            return False
+        from kubernetes_tpu.apiserver.partition import PartitionTopology
+
+        topo = PartitionTopology.from_dict(doc)
+        self._apply_topology(topo, replumb=replumb)
+        return True
+
+    def apply_topology(self, topo, replumb: bool = True) -> None:
+        """Install a topology object directly (coordinators that just
+        committed a migration hand it over instead of waiting a poll
+        interval)."""
+        self._apply_topology(topo, replumb=replumb)
+
+    def _apply_topology(self, topo, replumb: bool) -> None:
+        """Install routing for a newer epoch (any thread), and — for
+        replumb-capable callers (the poller, a coordinator) — catch the
+        stream layer up to whatever epoch routing has reached. Routing
+        and stream surgery are tracked SEPARATELY (``_replumb_epoch``):
+        the 429 fast path may apply an epoch routing-only on an
+        arbitrary thread, and the owed re-plumb must not be lost to an
+        equal-epoch early return."""
+        do_streams = False
+        changed: set = set()
+        gained: set = set()
+        with self._topology_lock:
+            if self._topology is None or topo.epoch > self.topology_epoch:
+                self._install_routing_locked(topo)
+            if replumb and self._elastic_watching \
+                    and self._replumb_epoch < self.topology_epoch:
+                do_streams = True
+                self._replumb_epoch = self.topology_epoch
+                changed = set(self._pending_changed)
+                self._pending_changed = set()
+                gained = set(self._pending_gained)
+                self._pending_gained = set()
+                topo = self._topology
+        if do_streams:
+            self._replumb_streams(topo, changed, gained)
+
+    def _install_routing_locked(self, topo) -> None:
+        """Under _topology_lock: routing tables, pools, and the RV
+        watchdog reset for a NEWER epoch."""
+        old_urls = list(self.partition_urls)
+        old_topo = self._topology
+        new_urls = [u.rstrip("/") for u in topo.urls] \
+            if topo.urls else old_urls
+        # which partitions GAINED keyspace under this epoch: a changed
+        # spread set can land a namespace's keys anywhere (per-name
+        # slots), so every partition gains; an owner-vector change
+        # gains exactly the slots' new owners
+        if old_topo is not None:
+            if topo.spread != old_topo.spread:
+                self._pending_gained |= set(range(len(new_urls)))
+            else:
+                for s, o in enumerate(topo.owner):
+                    if s >= len(old_topo.owner) \
+                            or old_topo.owner[s] != o:
+                        self._pending_gained.add(o)
+        changed = {p for p in range(len(new_urls))
+                   if p >= len(old_urls)
+                   or new_urls[p] != old_urls[p]}
+        self.partition_urls = new_urls
+        self.partitions = len(new_urls)
+        endpoints = []
+        for u in new_urls:
+            rest = u.split("://", 1)[1]
+            host, _, port = rest.partition(":")
+            endpoints.append((host, int(port or 80)))
+        self._endpoints = endpoints
+        for p in changed:
+            host, port = endpoints[p]
+            for lane in ("ro", "rw"):
+                old_pool = self._pools.get((p, lane))
+                if old_pool is not None:
+                    old_pool.close_all()
+                self._pools[(p, lane)] = _ConnPool(host, port)
+        self._topology = topo
+        self.topology_epoch = topo.epoch
+        self._pending_changed |= changed
+        # the RV watchdog and reflector resume state are keyed by
+        # (kind, partition INDEX) — after an epoch change an index can
+        # denote a different server (a split's new process, a failover
+        # restart with a rebuilt store). Carrying the old high-water
+        # mark across that boundary would flag a FALSE RV regression on
+        # the first list; reset exactly the changed indices (unchanged
+        # partitions keep their real monotonicity promise).
+        with self._rv_lock:
+            for key in [k for k in self._last_rv if k[1] in changed]:
+                del self._last_rv[key]
 
     def _list(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
         parts = self._pset(kind, namespace)
@@ -522,7 +801,7 @@ class RestClusterClient:
              name: str) -> Optional[Any]:
         code, payload = self._request(
             "GET", self._path(kind, namespace, name),
-            partition=self._pk(kind, namespace, name))
+            route=lambda: self._pk(kind, namespace, name))
         if code == 404:
             return None
         self._raise_for(code, payload)
@@ -569,7 +848,7 @@ class RestClusterClient:
     def delete_node(self, name: str) -> None:
         code, payload = self._request(
             "DELETE", self._path("Node", None, name),
-            partition=self._pk("Node", None, name))
+            route=lambda: self._pk("Node", None, name))
         if code >= 400 and code != 404:
             self._raise_for(code, payload)
 
@@ -589,7 +868,7 @@ class RestClusterClient:
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
             {"status": status}, body_binary=False,
-            partition=self._pk("Pod", namespace))
+            route=lambda: self._pk("Pod", namespace, name))
         if code == 404:
             return False
         self._raise_for(code, payload)
@@ -660,7 +939,8 @@ class RestClusterClient:
         code, payload = self._request(
             "POST", self._path("Pod", namespace, name, "binding"),
             {"kind": "Binding", "uid": uid, "target": {"name": node_name}},
-            body_binary=False, partition=self._pk("Pod", namespace),
+            body_binary=False,
+            route=lambda: self._pk("Pod", namespace, name),
         )
         self._raise_for(code, payload)
 
@@ -717,16 +997,29 @@ class RestClusterClient:
             groups.setdefault(key_fn(item), []).append((i, item))
         return sorted(groups.items())
 
-    def _fan_by_partition(self, items, key_fn, call_fn):
+    def _fan_by_partition(self, items, key_fn, call_fn, _depth: int = 0):
         """The bulk-verb fan-out scaffold, once: split positional
         ``items`` by partition, run ``call_fn(partition, slice)`` per
         group (concurrently when several partitions are involved), and
-        merge each slice's positional results back into item order."""
+        merge each slice's positional results back into item order.
+
+        A group that hits a mid-migration stale route re-splits ALONE
+        against the refreshed topology — groups that already committed
+        keep their results (a wholesale retry would re-send them, read
+        the resulting 409s as failures, and under-count the batch)."""
         results: List[Any] = [None] * len(items)
         groups = self._group_by_partition(items, key_fn)
+        retry: List[Tuple[int, Any]] = []
+        outs = []
         if len(groups) == 1:
             p, entries = groups[0]
-            outs = [(entries, call_fn(p, [it for _, it in entries]))]
+            try:
+                outs.append(
+                    (entries, call_fn(p, [it for _, it in entries])))
+            except StaleRouteError:
+                if _depth >= 3:
+                    raise
+                retry.extend(entries)
         else:
             pool = self._fan_out()
             futures = [
@@ -734,11 +1027,37 @@ class RestClusterClient:
                                       [it for _, it in entries]))
                 for p, entries in groups
             ]
-            outs = [(entries, fut.result()) for entries, fut in futures]
+            for entries, fut in futures:
+                try:
+                    outs.append((entries, fut.result()))
+                except StaleRouteError:
+                    if _depth >= 3:
+                        raise
+                    retry.extend(entries)
         for entries, got in outs:
             for (i, _item), r in zip(entries, got):
                 results[i] = r
+        if retry:
+            time.sleep(0.05)
+            sub = self._fan_by_partition(
+                [it for _, it in retry], key_fn, call_fn,
+                _depth=_depth + 1)
+            for (i, _item), r in zip(retry, sub):
+                results[i] = r
         return results
+
+    def _with_resplit(self, fn):
+        """Run a bulk fan-out, re-splitting against the refreshed
+        topology when a server answers a stale-epoch 429 mid-migration
+        (``StaleRouteError``). Bounded: a torn topology that never
+        converges surfaces the error instead of spinning."""
+        for _ in range(4):
+            try:
+                return fn()
+            except StaleRouteError:
+                time.sleep(0.05)
+                continue
+        return fn()
 
     def bind_many(
         self, bindings: List[Tuple[str, str, str, str]]
@@ -749,11 +1068,15 @@ class RestClusterClient:
         the slices fan out concurrently."""
         if not bindings:
             return []
-        if self.partitions == 1:
-            return self._bind_partition(0, bindings)
-        return self._fan_by_partition(
-            bindings, lambda b: self._pk("Pod", b[0]),
-            self._bind_partition)
+
+        def run():
+            if self.partitions == 1:
+                return self._bind_partition(0, bindings)
+            return self._fan_by_partition(
+                bindings, lambda b: self._pk("Pod", b[0], b[1]),
+                self._bind_partition)
+
+        return self._with_resplit(run)
 
     def _bind_partition(
         self, partition: int, bindings: List[Tuple[str, str, str, str]]
@@ -788,7 +1111,9 @@ class RestClusterClient:
             ]}
         code, resp = self._request("POST", "/api/v1/bindings", payload,
                                    charge=len(bindings),
-                                   partition=partition)
+                                   partition=partition,
+                                   raise_on_stale=self._topology
+                                   is not None)
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -813,7 +1138,7 @@ class RestClusterClient:
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
             {"status": status}, body_binary=False,
-            partition=self._pk("Pod", namespace))
+            route=lambda: self._pk("Pod", namespace, name))
         if code == 404:
             return   # pod deleted under us: store semantics are no-op
         self._raise_for(code, payload)
@@ -830,18 +1155,25 @@ class RestClusterClient:
         ``_put_status``."""
         if not updates:
             return []
-        if self.partitions == 1:
-            return self._statuses_partition(0, list(updates))
-        return self._fan_by_partition(
-            updates, lambda u: self._pk("Pod", u.get("namespace")),
-            self._statuses_partition)
+
+        def run():
+            if self.partitions == 1:
+                return self._statuses_partition(0, list(updates))
+            return self._fan_by_partition(
+                updates,
+                lambda u: self._pk("Pod", u.get("namespace"),
+                                   u.get("name")),
+                self._statuses_partition)
+
+        return self._with_resplit(run)
 
     def _statuses_partition(self, partition: int, updates: List[dict]
                             ) -> List[Optional[Exception]]:
         code, resp = self._request(
             "POST", "/api/v1/statuses",
             {"kind": "PodStatusList", "items": updates},
-            charge=len(updates), body_binary=False, partition=partition)
+            charge=len(updates), body_binary=False, partition=partition,
+            raise_on_stale=self._topology is not None)
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -904,7 +1236,7 @@ class RestClusterClient:
     def delete_pod(self, namespace: str, name: str) -> None:
         code, payload = self._request(
             "DELETE", self._path("Pod", namespace, name),
-            partition=self._pk("Pod", namespace))
+            route=lambda: self._pk("Pod", namespace, name))
         if code >= 400 and code != 404:
             self._raise_for(code, payload)
 
@@ -940,28 +1272,34 @@ class RestClusterClient:
         code, payload = self._request(
             "POST", self._path(kind, ns),
             obj if self.binary else to_wire(obj),
-            partition=self._pk(kind, ns, obj.metadata.name))
+            route=lambda: self._pk(kind, ns, obj.metadata.name))
         self._raise_for(code, payload)
         return obj
 
     def create_objects_bulk(self, kind: str, objs: List[Any]) -> int:
         if not objs:
             return 0
-        if self.partitions == 1:
-            return self._create_bulk_partition(0, kind, objs)
-        # ride the shared scaffold by spreading each slice's created
-        # COUNT over per-item 0/1 flags (only the sum is contractual)
-        def create_slice(p: int, group: List[Any]) -> List[int]:
-            created = self._create_bulk_partition(p, kind, group)
-            return [1] * created + [0] * (len(group) - created)
 
-        flags = self._fan_by_partition(
-            objs,
-            lambda o: self._pk(
-                kind, getattr(o.metadata, "namespace", None),
-                o.metadata.name),
-            create_slice)
-        return sum(flags)
+        def run():
+            if self.partitions == 1:
+                return self._create_bulk_partition(0, kind, objs)
+
+            # ride the shared scaffold by spreading each slice's
+            # created COUNT over per-item 0/1 flags (only the sum is
+            # contractual)
+            def create_slice(p: int, group: List[Any]) -> List[int]:
+                created = self._create_bulk_partition(p, kind, group)
+                return [1] * created + [0] * (len(group) - created)
+
+            flags = self._fan_by_partition(
+                objs,
+                lambda o: self._pk(
+                    kind, getattr(o.metadata, "namespace", None),
+                    o.metadata.name),
+                create_slice)
+            return sum(flags)
+
+        return self._with_resplit(run)
 
     def _create_bulk_partition(self, partition: int, kind: str,
                                objs: List[Any]) -> int:
@@ -977,7 +1315,9 @@ class RestClusterClient:
                    "items": objs if self.binary
                    else [to_wire(o) for o in objs]}
         code, resp = self._request("POST", self._path(kind, ns), payload,
-                                   charge=len(objs), partition=partition)
+                                   charge=len(objs), partition=partition,
+                                   raise_on_stale=self._topology
+                                   is not None)
         self._raise_for(code, resp)
         return resp.get("created", 0)
 
@@ -987,7 +1327,7 @@ class RestClusterClient:
         code, payload = self._request(
             "PUT", self._path(kind, ns, obj.metadata.name),
             obj if self.binary else to_wire(obj),
-            partition=self._pk(kind, ns, obj.metadata.name))
+            route=lambda: self._pk(kind, ns, obj.metadata.name))
         self._raise_for(code, payload)
         return obj
 
@@ -1018,6 +1358,18 @@ class RestClusterClient:
         scope, so a torn/stalled stream on one partition never delays
         (or forces a relist of) another."""
         self._stopping.clear()
+        if self._topology is not None:
+            # elastic mode: restartable per-(kind, partition) streams
+            # with CLIENT-HELD reflector state, so a topology-epoch
+            # change can hand a moved slice to its new partition's
+            # stream without relisting anything that didn't move
+            self._elastic_watching = True
+            self._watch_fn, self._watch_batch_fn = fn, batch_fn
+            with self._handoff_lock:
+                for kind in self.watch_kinds:
+                    for p in self._pset(kind):
+                        self._start_stream(kind, p, handoff=False)
+            return _WatchHandle(self)
         for kind in self.watch_kinds:
             for p in self._pset(kind):
                 t = threading.Thread(
@@ -1029,6 +1381,255 @@ class RestClusterClient:
 
     def _stop_watches(self) -> None:
         self._stopping.set()
+        self._elastic_watching = False
+        for ev in list(self._stream_stops.values()):
+            ev.set()
+        for conn in list(self._stream_conns.values()):
+            _sever(conn)
+        self._stream_conns.clear()
+        self.stop_topology_watch()
+
+    # -- elastic watch streams (cursor-preserving handoff) -------------
+    def _start_stream(self, kind: str, p: int, handoff: bool) -> None:
+        """Start (or replace) the stream for one (kind, partition).
+        ``handoff=True`` = mid-run start after a topology change: the
+        first list DELIVERS the diff against the (transferred) known
+        map — exactly the window the consumer missed — instead of the
+        silent seeding a boot-time stream does."""
+        old_stop = self._stream_stops.get((kind, p))
+        if old_stop is not None:
+            old_stop.set()
+        _sever(self._stream_conns.pop((kind, p), None))
+        stop = threading.Event()
+        self._stream_stops[(kind, p)] = stop
+        t = threading.Thread(
+            target=self._watch_elastic_loop,
+            args=(kind, p, stop, handoff),
+            daemon=True, name=f"watch-{kind}-p{p}")
+        t.start()
+        self._watch_threads.append(t)
+
+    def _deliver(self, kind: str, p: int, events: List[Event]) -> bool:
+        """Forward events to the consumer through the stream's known
+        map with an RV-MONOTONIC filter per object: a replayed event
+        (watch-cache resume past the handoff seam) or a late pre-freeze
+        delivery that a reconcile fetch already superseded is dropped —
+        the 'zero duplicated, never backwards' half of the handoff
+        contract. Returns False when the consumer is gone."""
+        topo = self._topology
+        out: List[Event] = []
+        with self._known_lock:
+            known = self._stream_known.setdefault((kind, p), {})
+            for e in events:
+                key = _key_of(e.obj)
+                rv = _rv_of(e.obj)
+                prev = known.get(key)
+                prev_rv = _rv_of(prev) if prev is not None else -1
+                if prev is None and topo is not None \
+                        and topo.partition_of(kind, key[0],
+                                              key[1]) != p:
+                    # a key this stream does not own and has no state
+                    # for: either a late pre-transfer delivery (its
+                    # entry moved to the new owner, whose reconcile
+                    # fetch covers the window) or an early post-flip
+                    # one (the owner's stream delivers it). Forwarding
+                    # it here would double-deliver — and re-polluting
+                    # this stream's known map would turn a future
+                    # relist into a synthetic DELETE of a live object.
+                    continue
+                if e.type == DELETED:
+                    if prev is None or (rv and prev_rv > rv):
+                        continue
+                    known.pop(key, None)
+                else:
+                    if prev is not None and rv and prev_rv >= rv:
+                        continue
+                    known[key] = e.obj
+                out.append(e)
+        if not out:
+            return True
+        fn, batch_fn = self._watch_fn, self._watch_batch_fn
+        if fn is None and batch_fn is None:
+            return False
+        if batch_fn is not None:
+            batch_fn(out)
+        else:
+            for e in out:
+                fn(e)
+        return True
+
+    def _watch_elastic_loop(self, kind: str, p: int,
+                            stop: threading.Event,
+                            handoff: bool) -> None:
+        from kubernetes_tpu.client.informers import replace_diff
+
+        first = True
+        while not self._stopping.is_set() and not stop.is_set():
+            try:
+                objs, rv = self._list_with_rv(kind, partition=p)
+                live = {_key_of(o): o for o in objs}
+                if first and not handoff:
+                    # boot-time stream: Scheduler.start() replays the
+                    # first list itself; just remember what exists
+                    with self._known_lock:
+                        self._stream_known.setdefault(
+                            (kind, p), {}).update(live)
+                    events = []
+                else:
+                    with self._known_lock:
+                        snapshot = dict(self._stream_known.setdefault(
+                            (kind, p), {}))
+                    events = replace_diff(kind, snapshot, live)
+                    if first:
+                        self.handoff_fetches += 1
+                    else:
+                        # a torn stream relists ITS slice only; the
+                        # mini-cell asserts unmoved slices never land
+                        # here during a migration
+                        from kubernetes_tpu.metrics.fabric_metrics \
+                            import fabric_metrics
+
+                        fabric_metrics().client_relists_total.inc(kind)
+                        self.stream_relists[(kind, p)] = \
+                            self.stream_relists.get((kind, p), 0) + 1
+                first = False
+                if events:
+                    self._deliver(kind, p, events)
+                self._stream_watch(kind, rv,
+                                   lambda evs: self._deliver(kind, p,
+                                                             evs),
+                                   partition=p, stream_key=(kind, p),
+                                   stop=stop)
+            except (http.client.HTTPException, OSError, RuntimeError):
+                pass
+            if self._stopping.is_set() or stop.is_set():
+                return
+            time.sleep(0.2)   # relist-and-rewatch (reflector restart)
+
+    def _reconcile_stream(self, kind: str, p: int,
+                          keys: List[tuple]) -> None:
+        """One-shot catch-up for keys just transferred INTO partition
+        p's live stream (a move to an existing partition, a retire
+        draining into survivors): list p once and deliver the diff for
+        exactly those keys. The live stream was attached throughout, so
+        everything committed on p after the flip arrives through it;
+        this covers the pre-flip window the SOURCE stream may not have
+        delivered before the transfer.
+
+        The diff is FULL-LIST on the add/update side: a write committed
+        inside the freeze window whose event never left the source
+        stream is in NO known map, so only the live list can surface it
+        (the RV-monotonic filter in ``_deliver`` collapses the overlap
+        with the live stream's own delivery). DELETE detection stays
+        restricted to the transferred ``keys``: inferring deletes from
+        a full diff would race the live stream (a create delivered
+        between this snapshot and list would read as a false DELETED).
+        The known snapshot is taken BEFORE the list for the same
+        reason, in the safe direction: anything that lands in between
+        shows up as a duplicate the RV filter drops, never as a
+        fabricated event."""
+        self.handoff_fetches += 1
+        with self._known_lock:
+            snapshot = dict(self._stream_known.setdefault((kind, p), {}))
+        try:
+            objs, _rv = self._list_with_rv(kind, partition=p)
+        except (http.client.HTTPException, OSError, RuntimeError):
+            return
+        live = {_key_of(o): o for o in objs}
+        events: List[Event] = []
+        for key, cur in live.items():
+            old = snapshot.get(key)
+            if old is None:
+                events.append(Event(ADDED, kind, cur))
+            elif _rv_of(old) != _rv_of(cur):
+                events.append(Event(MODIFIED, kind, cur, old))
+        for key in keys:
+            if key not in live and key in snapshot:
+                events.append(Event(DELETED, kind, snapshot[key]))
+        if events:
+            self._deliver(kind, p, events)
+
+    def _replumb_streams(self, topo, changed_urls,
+                         gained: Optional[set] = None) -> None:
+        """Re-route the watch layer after a topology-epoch change:
+
+        1. stop streams whose partition left the fan set (retired) or
+           whose endpoint changed (failover restart) — and JOIN their
+           delivery so no late event races the transfer;
+        2. redistribute each stopped/moved key's reflector entry to its
+           new owner's known map (the client-side cursor transfer);
+        3. start handoff streams for partitions that lack one (a
+           split's new partition, a restarted endpoint) — their first
+           list delivers the missed window as a diff;
+        4. reconcile-fetch existing live streams that RECEIVED
+           keyspace — whether or not any KNOWN key moved with it: a
+           freeze-window write the source stream never delivered is in
+           no known map, and only the gaining partition's list shows it.
+
+        Unmoved slices: their streams are never touched — no relist."""
+        gained = gained or set()
+        with self._handoff_lock:
+            fan: Dict[str, set] = {
+                kind: set(topo.partitions_for(kind))
+                for kind in self.watch_kinds}
+            # 1. stop departing/re-pointed streams
+            stopped: List[Tuple[str, int]] = []
+            for (kind, p) in list(self._stream_stops):
+                if kind not in fan:
+                    continue
+                if p not in fan[kind] or p in changed_urls:
+                    ev = self._stream_stops.get((kind, p))
+                    if ev is not None:
+                        ev.set()
+                    _sever(self._stream_conns.pop((kind, p), None))
+                    stopped.append((kind, p))
+            if stopped:
+                time.sleep(0.05)   # let their delivery drain
+            # 2. redistribute known entries to new owners
+            to_reconcile: Dict[Tuple[str, int], List[tuple]] = {}
+            with self._known_lock:
+                for kind in self.watch_kinds:
+                    for (k, p), known in list(self._stream_known.items()):
+                        if k != kind:
+                            continue
+                        for key in list(known):
+                            ns, name = key
+                            # partition_of keys Pods by namespace (and
+                            # name once spread) and Nodes by name —
+                            # stray namespace metadata on cluster-
+                            # scoped kinds is ignored by the slot fn
+                            q = topo.partition_of(kind, ns, name)
+                            if q == p and (kind, p) not in stopped:
+                                continue
+                            obj = known.pop(key)
+                            if q == p:
+                                # re-pointed endpoint, same owner: the
+                                # restarted handoff stream diffs it
+                                known[key] = obj
+                                continue
+                            self._stream_known.setdefault(
+                                (kind, q), {})[key] = obj
+                            to_reconcile.setdefault(
+                                (kind, q), []).append(key)
+            # 3. start handoff streams where the fan set lacks one
+            started: set = set()
+            for kind in self.watch_kinds:
+                for q in fan[kind]:
+                    ev = self._stream_stops.get((kind, q))
+                    if ev is None or ev.is_set():
+                        self._start_stream(kind, q, handoff=True)
+                        started.add((kind, q))
+            # 4. reconcile live streams that received keyspace: streams
+            # holding transferred known keys, plus every GAINING
+            # partition's stream (freeze-window writes the source never
+            # delivered live in no known map — only the list has them)
+            for kind in self.watch_kinds:
+                for q in gained & fan[kind]:
+                    if (kind, q) not in to_reconcile:
+                        to_reconcile[(kind, q)] = []
+            for (kind, q), keys in to_reconcile.items():
+                if (kind, q) not in started:
+                    self._reconcile_stream(kind, q, keys)
 
     def _watch_loop(self, kind: str, partition: int, fn, batch_fn) -> None:
         first = True
@@ -1090,12 +1691,18 @@ class RestClusterClient:
             time.sleep(0.2)   # relist-and-rewatch (reflector restart)
 
     def _stream_watch(self, kind: str, rv: int, deliver,
-                      partition: int = 0) -> None:
+                      partition: int = 0, stream_key=None,
+                      stop: Optional[threading.Event] = None) -> None:
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
         host, port = self._endpoints[partition]
         conn = http.client.HTTPConnection(host, port, timeout=300)
         conn.connect()
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if stream_key is not None:
+            # registered so a topology re-plumb can sever a stream
+            # blocked mid-read (stop events alone can't interrupt a
+            # socket read)
+            self._stream_conns[stream_key] = conn
         headers = {}
         if self.binary:
             headers["Accept"] = codec.BINARY_CONTENT_TYPE
@@ -1118,7 +1725,8 @@ class RestClusterClient:
                 return
             binary = (resp.headers.get("Content-Type") or "").startswith(
                 codec.BINARY_CONTENT_TYPE)
-            while not self._stopping.is_set():
+            while not self._stopping.is_set() \
+                    and (stop is None or not stop.is_set()):
                 if binary:
                     try:
                         batch = codec.read_frame(resp)
@@ -1165,6 +1773,9 @@ class RestClusterClient:
                 self._observe_delivery(kind, events)
                 deliver(events)
         finally:
+            if stream_key is not None \
+                    and self._stream_conns.get(stream_key) is conn:
+                self._stream_conns.pop(stream_key, None)
             try:
                 conn.close()
             except OSError:

@@ -208,6 +208,28 @@ def format_replay(info: Optional[Dict]) -> str:
     return "replay[" + " ".join(parts) + "]"
 
 
+def format_reshard(info: Optional[Dict]) -> str:
+    """The elastic-control-plane segment: how many slice migrations the
+    row performed (``moves`` — splits, moves, merges, failovers), the
+    cumulative freeze-window time (``frozen_ms`` — the bounded
+    unavailability the migrations cost), the topology epoch the row
+    ended at, and ``lost_watches`` (informer-vs-server-truth delta at
+    quiesce — MUST be 0; printed so a red row is attributable from the
+    line alone). Emitted by the hotspot bench and reshard chaos cells;
+    parsed by the generic bracket scan in ``parse_diag`` (key
+    ``reshard``) — tools/perf_report.py reads it to gate the
+    ``hotspot`` family."""
+    if not info:
+        return ""
+    parts = [
+        f"moves={int(info.get('moves', 0))}",
+        f"frozen_ms={float(info.get('frozen_ms', 0.0)):.1f}",
+        f"epoch={int(info.get('epoch', 0))}",
+        f"lost_watches={int(info.get('lost_watches', 0))}",
+    ]
+    return "reshard[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy

@@ -1,0 +1,793 @@
+"""Elastic control plane: live partition resharding, cursor-preserving
+watch handoff, hotspot rebalancing, and partition failover.
+
+Covers the layers ISSUE 15 stacked on the PR 9 partitioned fabric:
+
+- the runtime ``PartitionTopology`` (hash slots, epoch-monotonic
+  evolution, spread namespaces, wire round-trip);
+- ``PartitionedStore(reshardable=True)`` slice migrations — move /
+  split / merge / buy / failover — under the bounded freeze-and-drain
+  protocol, with the SILENT adopt/evict placement channel (no watch
+  events, RVs preserved, WAL-durable);
+- the REST surface: the full topology document at
+  ``/api/v1/partitiontopology``, epoch-monotonic installs, the
+  freeze/ownership write gate answering 429 + computed Retry-After +
+  ``X-Partition-Epoch``, and the ``/debug/partition`` admin ops;
+- the ``ReshardCoordinator`` driving real migrations over the wire,
+  including rollback when a destination dies mid-copy;
+- the elastic client: the per-(kind, partition) RV watchdog and
+  reflector state surviving a topology-epoch change (the false-
+  regression fix), and the cursor-preserving watch handoff;
+- the pure ``plan_rebalance`` decision function (split > move > buy,
+  failover first, retire when idle);
+- the perf_report ``hotspot`` family gates and the ``reshard[...]``
+  diag segment round-trip;
+- the tier-1 mini-cell: a live 2→3-partition split at ~200 hollow
+  nodes with writes and an informer active THROUGH the migration —
+  informer ≡ server truth, zero lost, no relist of unmoved slices.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.partition import (
+    NUM_SLOTS,
+    PartitionedStore,
+    PartitionTopology,
+    SliceFrozenError,
+    slot_for,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _node(name, cpu="4", memory="8Gi", pods="110"):
+    return MakeNode().name(name).capacity(
+        {"cpu": cpu, "memory": memory, "pods": pods}).obj()
+
+
+def _pod(name, ns="default", uid=None, cpu="100m", memory="50Mi"):
+    p = MakePod().name(name).uid(uid or f"u-{ns}-{name}").req(
+        {"cpu": cpu, "memory": memory}).obj()
+    p.metadata.namespace = ns
+    return p
+
+
+# ---------------------------------------------------------------------------
+# the runtime topology
+
+
+class TestTopology:
+    def test_default_layout_and_wire_round_trip(self):
+        topo = PartitionTopology.default(3, urls=["http://a", "http://b",
+                                                  "http://c"])
+        assert topo.epoch == 1 and topo.slots == NUM_SLOTS
+        assert set(topo.owner) == {0, 1, 2}
+        back = PartitionTopology.from_dict(
+            json.loads(json.dumps(topo.to_dict())))
+        assert back.owner == topo.owner
+        assert back.epoch == topo.epoch
+        assert back.spread == topo.spread
+        assert back.urls == topo.urls
+
+    def test_evolve_bumps_epoch_and_preserves_original(self):
+        topo = PartitionTopology.default(2)
+        owner = list(topo.owner)
+        owner[0] = 1
+        nxt = topo.evolve(owner=owner)
+        assert nxt.epoch == topo.epoch + 1
+        assert topo.owner[0] == 0 and nxt.owner[0] == 1
+
+    def test_namespace_colocated_until_spread(self):
+        # unspread: every pod of a namespace shares one slot
+        slots = {slot_for("Pod", "tenant-a", n)
+                 for n in ("p1", "p2", "p3", "p4")}
+        assert len(slots) == 1
+        # spread: the namespace fans per object name
+        spread = frozenset({"tenant-a"})
+        fanned = {slot_for("Pod", "tenant-a", f"p{i}", spread=spread)
+                  for i in range(40)}
+        assert len(fanned) > 8
+        # other namespaces are untouched by the spread set
+        assert slot_for("Pod", "tenant-b", "p1") == \
+            slot_for("Pod", "tenant-b", "p1", spread=spread)
+
+    def test_non_sharded_kinds_have_no_slot(self):
+        assert slot_for("Service", "ns", "x") is None
+        topo = PartitionTopology.default(4)
+        assert topo.partition_of("ConfigMap", "ns", "x") == 0
+        assert topo.partitions_for("Lease") == [0]
+
+    def test_partitions_for_narrows_unspread_namespace(self):
+        topo = PartitionTopology.default(4)
+        assert len(topo.partitions_for("Pod", "tenant-a")) == 1
+        spread = topo.evolve(spread={"tenant-a"})
+        assert spread.partitions_for("Pod", "tenant-a") == \
+            sorted(set(spread.owner))
+
+
+# ---------------------------------------------------------------------------
+# reshardable PartitionedStore: migrations under the freeze protocol
+
+
+def _fill(store, namespaces=("ns-a", "ns-b", "ns-c"), per_ns=6):
+    for ns in namespaces:
+        for i in range(per_ns):
+            store.create_pod(_pod(f"p{i}", ns=ns))
+    for i in range(4):
+        store.add_node(_node(f"n{i}"))
+
+
+class TestReshardableStore:
+    def test_migrate_slots_moves_objects_preserving_rvs(self):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        _fill(store)
+        topo = store.topology
+        slot = topo.slot_of("Pod", "ns-a", None)
+        src = topo.owner[slot]
+        dest = 1 - src
+        before = {(p.namespace, p.metadata.name):
+                  p.metadata.resource_version
+                  for p in store.list_pods("ns-a")}
+        report = store.migrate_slots({slot: dest})
+        assert report["moved_objects"] >= len(before)
+        assert store.topology.epoch == topo.epoch + 1
+        assert store.topology.owner[slot] == dest
+        # objects now live on the destination, same RVs
+        moved = {(p.namespace, p.metadata.name):
+                 p.metadata.resource_version
+                 for p in store.parts[dest].list_pods("ns-a")}
+        for key, rv in before.items():
+            assert moved[key] == rv
+        # and evicted from the source
+        assert not store.parts[src].list_pods("ns-a")
+        # router follows the new layout
+        assert store.get_pod("ns-a", "p0") is not None
+
+    def test_migration_is_watch_silent(self):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        _fill(store)
+        events = []
+        handle = store.watch(events.append)
+        topo = store.topology
+        slot = topo.slot_of("Pod", "ns-b", None)
+        store.migrate_slots({slot: 1 - topo.owner[slot]})
+        store.create_pod(_pod("after", ns="ns-b"))
+        assert [e.obj.metadata.name for e in events
+                if e.kind == "Pod"] == ["after"]
+        handle.stop()
+
+    def test_spread_namespace_fans_hot_tenant(self):
+        store = PartitionedStore(partitions=3, reshardable=True)
+        for i in range(48):
+            store.create_pod(_pod(f"hot{i}", ns="hot"))
+        report = store.spread_namespace("hot")
+        assert "hot" in store.topology.spread
+        assert report["moved_objects"] > 0
+        holders = [i for i, part in enumerate(store.parts)
+                   if part.list_pods("hot")]
+        assert len(holders) > 1
+        # no key lost or duplicated across the fan
+        seen = {}
+        for part in store.parts:
+            for p in part.list_pods("hot"):
+                assert p.metadata.name not in seen
+                seen[p.metadata.name] = True
+        assert len(seen) == 48
+
+    def test_retire_partition_drains_to_survivors(self):
+        store = PartitionedStore(partitions=3, reshardable=True)
+        _fill(store)
+        store.retire_partition(2)
+        assert 2 in store.topology.retired
+        assert not store.topology.slots_of_partition(2)
+        assert sum(len(part.list_pods()) for part in store.parts[:2]) \
+            == len(store.list_pods())
+        with pytest.raises(ValueError):
+            store.retire_partition(1), store.retire_partition(0)
+
+    def test_add_partition_then_move_routes_and_watches(self):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        _fill(store)
+        events = []
+        handle = store.watch(events.append)
+        idx = store.add_partition()
+        assert idx == 2 and store.partitions == 3
+        topo = store.topology
+        slot = topo.slot_of("Pod", "ns-c", None)
+        store.migrate_slots({slot: idx})
+        # a write routed to the NEW partition reaches the fleet watch
+        store.create_pod(_pod("fresh", ns="ns-c"))
+        assert store.parts[idx].get_pod("ns-c", "fresh") is not None
+        assert "fresh" in [e.obj.metadata.name for e in events
+                           if e.kind == "Pod"]
+        handle.stop()
+
+    def test_frozen_slot_blocks_writer_until_thaw(self):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        slot = store.topology.slot_of("Pod", "frozen-ns", None)
+        with store._freeze_cond:
+            store._frozen[slot] = time.monotonic() + 5.0
+        landed = threading.Event()
+
+        def writer():
+            store.create_pod(_pod("w", ns="frozen-ns"))
+            landed.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert not landed.wait(0.15), "write went through a frozen slot"
+        with store._freeze_cond:
+            store._frozen.pop(slot, None)
+            store._freeze_cond.notify_all()
+        assert landed.wait(2.0)
+        t.join(timeout=2.0)
+
+    def test_freeze_extension_past_budget_raises_retryable(self):
+        # a waiter sleeps until the freeze deadline it observed; if the
+        # migration EXTENDED the freeze meanwhile, the waiter's budget
+        # is exhausted and it pushes back with a computed retry-after
+        # instead of waiting open-endedly
+        store = PartitionedStore(partitions=2, reshardable=True)
+        slot = store.topology.slot_of("Pod", "stuck-ns", None)
+        with store._freeze_cond:
+            store._frozen[slot] = time.monotonic() + 0.2
+
+        def extend():
+            time.sleep(0.05)
+            with store._freeze_cond:
+                store._frozen[slot] = time.monotonic() + 30.0
+
+        threading.Thread(target=extend, daemon=True).start()
+        with pytest.raises(SliceFrozenError) as exc:
+            store._wait_unfrozen(slot)
+        assert exc.value.retry_after > 0
+        with store._freeze_cond:
+            store._frozen.pop(slot, None)
+        # an expired freeze auto-thaws: the backstop for a crashed
+        # migration that never unfroze
+        with store._freeze_cond:
+            store._frozen[slot] = time.monotonic() - 0.01
+        store._wait_unfrozen(slot)
+        assert slot not in store._frozen
+
+    def test_adopt_never_regresses_a_newer_local_write(self):
+        store = ClusterStore()
+        store.create_pod(_pod("x", ns="a"))
+        store.set_pod_phase("a", "x", "Running")   # bump the live RV
+        live = store.get_pod("a", "x")
+        stale = _pod("x", ns="a")
+        stale.metadata.resource_version = "1"
+        assert store.adopt_objects("Pod", [stale]) == 0
+        assert store.get_pod("a", "x").metadata.resource_version \
+            == live.metadata.resource_version
+        # an equal-or-newer adopt lands (the migration's normal case)
+        newer = _pod("x", ns="a")
+        newer.metadata.resource_version = str(
+            int(live.metadata.resource_version) + 5)
+        assert store.adopt_objects("Pod", [newer]) == 1
+
+    def test_failover_restores_adopted_slice_from_wal(self, tmp_path):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        store.attach_wal(str(tmp_path))
+        _fill(store)
+        topo = store.topology
+        slot = topo.slot_of("Pod", "ns-a", None)
+        src = topo.owner[slot]
+        dest = 1 - src
+        store.migrate_slots({slot: dest})
+        before = {(p.namespace, p.metadata.name):
+                  p.metadata.resource_version
+                  for p in store.parts[dest].list_pods()}
+        epoch_before = store.topology.epoch
+        report = store.restart_partition(dest)
+        assert report["restored_objects"] >= len(before)
+        # the adopted slice survived the failover; the evicted source
+        # copies did NOT resurrect
+        after = {(p.namespace, p.metadata.name):
+                 p.metadata.resource_version
+                 for p in store.parts[dest].list_pods()}
+        assert after == before
+        assert not store.parts[src].list_pods("ns-a")
+        assert store.topology.epoch == epoch_before + 1
+        # the restored partition keeps serving through the router
+        store.create_pod(_pod("post-failover", ns="ns-a"))
+        assert store.get_pod("ns-a", "post-failover") is not None
+
+    def test_reshard_stats_feed(self):
+        store = PartitionedStore(partitions=2, reshardable=True)
+        _fill(store)
+        stats = store.reshard_stats()
+        assert stats["epoch"] == 1
+        assert len(stats["partitions"]) == 2
+        assert sum(stats["slot_writes"].values()) > 0
+        assert set(stats["ns_writes"]) == {"ns-a", "ns-b", "ns-c"}
+
+
+# ---------------------------------------------------------------------------
+# REST surface + coordinator over real (in-process) servers
+
+
+def _spin(n):
+    from kubernetes_tpu.apiserver.rest import APIServer
+
+    servers = [APIServer(store=ClusterStore(), partition=(i, n)).start()
+               for i in range(n)]
+    urls = [s.url for s in servers]
+    topo = PartitionTopology.default(n, urls=urls)
+    for s in servers:
+        s.install_topology(topo)
+    return servers, urls
+
+
+class TestRestSurface:
+    def test_topology_document_and_epoch_monotonic_install(self):
+        servers, urls = _spin(2)
+        try:
+            from kubernetes_tpu.client.restcluster import (
+                RestClusterClient,
+            )
+
+            client = RestClusterClient(urls[0], partition_urls=urls)
+            try:
+                code, doc = client._request(
+                    "GET", "/api/v1/partitiontopology")
+                assert code == 200
+                assert doc["epoch"] == 1 and len(doc["owner"]) == NUM_SLOTS
+                assert doc["urls"] == urls
+                # stale install refused; newer accepted
+                topo = PartitionTopology.from_dict(doc)
+                assert not servers[0].install_topology(topo)
+                assert servers[0].install_topology(topo.evolve())
+                assert servers[0].partition_topology.epoch == 2
+            finally:
+                client._drop_conn()
+        finally:
+            for s in servers:
+                s.shutdown_server()
+
+    def test_frozen_and_moved_slices_answer_topology_429(self):
+        import http.client as hc
+
+        servers, urls = _spin(2)
+        try:
+            topo = servers[0].partition_topology
+            pod = _pod("gated", ns="gate-ns")
+            slot = topo.slot_of("Pod", "gate-ns", None)
+            owner = topo.owner[slot]
+            host, port = urls[owner].split("://")[1].split(":")
+
+            def post(path, body):
+                conn = hc.HTTPConnection(host, int(port), timeout=10)
+                try:
+                    conn.request("POST", path, json.dumps(body),
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    return resp.status, dict(resp.getheaders()), \
+                        resp.read()
+                finally:
+                    conn.close()
+
+            wire = {"kind": "Pod",
+                    "metadata": {"name": "gated",
+                                 "namespace": "gate-ns"},
+                    "spec": {}}
+            # freeze the slot on its owner: 429 + computed Retry-After
+            # and NO epoch header (frozen = the routing is correct,
+            # the only cure is waiting — the epoch header is the
+            # re-route signal and rides only MOVED rejections)
+            servers[owner].frozen_slots[slot] = \
+                (time.monotonic() + 3.0, 3.0)
+            code, headers, _ = post(
+                "/api/v1/namespaces/gate-ns/pods", wire)
+            assert code == 429
+            assert float(headers["Retry-After"]) > 0
+            assert "X-Partition-Epoch" not in headers
+            servers[owner].frozen_slots.clear()
+            # move the slot away: the old owner answers 429 + new epoch
+            new_owner = [1 - topo.owner[slot] if s == slot else o
+                         for s, o in enumerate(topo.owner)]
+            moved = topo.evolve(owner=new_owner)
+            assert servers[owner].install_topology(moved)
+            code, headers, body = post(
+                "/api/v1/namespaces/gate-ns/pods", wire)
+            assert code == 429
+            assert int(headers["X-Partition-Epoch"]) == moved.epoch
+            assert b"no longer owns" in body
+            del pod
+        finally:
+            for s in servers:
+                s.shutdown_server()
+
+    def test_coordinator_move_and_rollback(self):
+        from kubernetes_tpu.apiserver.reshard import (
+            ReshardCoordinator,
+            ReshardError,
+        )
+        from kubernetes_tpu.client.restcluster import RestClusterClient
+
+        servers, urls = _spin(2)
+        client = RestClusterClient(urls[0], partition_urls=urls)
+        try:
+            assert client.enable_topology(poll_interval=0)
+            for i in range(10):
+                client.create_object("Pod", _pod(f"m{i}", ns="mv-ns"))
+            coordinator = ReshardCoordinator(client, freeze_eta=3.0,
+                                             evict_grace_s=0.0)
+            topo = coordinator.fetch_topology()
+            slot = topo.slot_of("Pod", "mv-ns", None)
+            src = topo.owner[slot]
+            report = coordinator.move_slots({slot: 1 - src})
+            assert report["moved_objects"] >= 10
+            assert coordinator.fetch_topology().epoch == topo.epoch + 1
+            assert not servers[src].store.list_pods("mv-ns")
+            assert len(servers[1 - src].store.list_pods("mv-ns")) == 10
+            # rollback: the destination's adopt fails after the copy —
+            # the old topology stands, the source keeps its slice, and
+            # nothing is half-routed (a SIGKILLed real process is the
+            # chaos suite's job; the injected failure pins the
+            # protocol deterministically)
+            topo2 = coordinator.fetch_topology()
+            slot2 = topo2.slot_of("Pod", "mv2-ns", None)
+            src2 = topo2.owner[slot2]
+            dest2 = 1 - src2
+            for i in range(5):
+                client.create_object("Pod", _pod(f"r{i}", ns="mv2-ns"))
+            orig_admin = coordinator._admin
+
+            def failing_admin(p, payload, _orig=orig_admin):
+                if p == dest2 and payload.get("op") == "adopt":
+                    raise ReshardError(
+                        "injected: destination unreachable")
+                return _orig(p, payload)
+
+            coordinator._admin = failing_admin
+            with pytest.raises(ReshardError):
+                coordinator.move_slots({slot2: dest2})
+            coordinator._admin = orig_admin
+            assert coordinator.fetch_topology().epoch == topo2.epoch
+            assert len(servers[src2].store.list_pods("mv2-ns")) == 5
+            assert not servers[dest2].store.list_pods("mv2-ns")
+            # and the thaw happened: a post-rollback write lands
+            client.create_object("Pod", _pod("thawed", ns="mv2-ns"))
+            assert len(servers[src2].store.list_pods("mv2-ns")) == 6
+        finally:
+            client._stop_watches()
+            client._drop_conn()
+            for s in servers:
+                s.shutdown_server()
+
+
+# ---------------------------------------------------------------------------
+# elastic client: RV watchdog + reflector state across an epoch change
+
+
+class TestEpochChangeSurvival:
+    def test_rv_watchdog_survives_failover_epoch_bump_mid_watch(self):
+        """Satellite: partition 1 'fails over' to a FRESH server whose
+        store restarts at low RVs while the client is mid-watch. The
+        per-(kind, partition) RV watchdog must reset for exactly that
+        index — no false regression — and the stream must keep
+        delivering through the seam."""
+        from kubernetes_tpu.apiserver.rest import APIServer
+        from kubernetes_tpu.client.restcluster import RestClusterClient
+
+        servers, urls = _spin(2)
+        fresh = None
+        client = RestClusterClient(urls[0], partition_urls=urls,
+                                   watch_kinds=("Pod",))
+        seen = []
+        seen_lock = threading.Lock()
+
+        def on_events(evs):
+            with seen_lock:
+                seen.extend(e.obj.metadata.name for e in evs)
+
+        try:
+            assert client.enable_topology(poll_interval=0.1)
+            client.watch(lambda e: on_events([e]), batch_fn=on_events)
+            time.sleep(0.3)
+            # drive RVs on partition 1's namespaces well past zero
+            p1_ns = next(
+                ns for ns in (f"ns-{i}" for i in range(50))
+                if client._topology.partition_of("Pod", ns, None) == 1)
+            for i in range(30):
+                client.create_object("Pod", _pod(f"hw{i}", ns=p1_ns))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with seen_lock:
+                    if len([n for n in seen
+                            if n.startswith("hw")]) >= 30:
+                        break
+                time.sleep(0.05)
+            # the per-(kind, partition) high-water marks a reflector's
+            # lists would have recorded against the OLD partition 1:
+            # the fresh server's RVs restart far below 10_000, so a
+            # watchdog that carried this across the epoch change would
+            # flag a false regression on the handoff list
+            with client._rv_lock:
+                client._last_rv[("Pod", 0)] = 7
+                client._last_rv[("Pod", 1)] = 10_000
+            # failover: fresh server, EMPTY store (RVs restart at 0),
+            # topology epoch bump re-points partition 1 mid-watch
+            fresh = APIServer(store=ClusterStore(),
+                              partition=(1, 2)).start()
+            topo = client._topology
+            new_urls = [urls[0], fresh.url]
+            new_topo = topo.evolve(urls=new_urls)
+            servers[0].install_topology(new_topo)
+            fresh.install_topology(new_topo)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and client.topology_epoch < new_topo.epoch:
+                time.sleep(0.05)
+            assert client.topology_epoch == new_topo.epoch
+            time.sleep(0.4)   # the re-plumb's handoff stream attaches
+            # the watchdog did NOT flag the restarted partition's low
+            # RVs as a regression, and exactly the CHANGED index was
+            # reset — the unchanged partition keeps its real
+            # monotonicity promise
+            assert client.rv_regressions == []
+            with client._rv_lock:
+                assert client._last_rv.get(("Pod", 0), 0) >= 7
+                assert client._last_rv.get(("Pod", 1), 0) < 10_000
+            # and the stream keeps delivering from the new endpoint
+            client.create_object("Pod", _pod("post-epoch", ns=p1_ns))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with seen_lock:
+                    if "post-epoch" in seen:
+                        break
+                time.sleep(0.05)
+            with seen_lock:
+                assert "post-epoch" in seen
+        finally:
+            client._stop_watches()
+            client._drop_conn()
+            for s in servers:
+                s.shutdown_server()
+            if fresh is not None:
+                fresh.shutdown_server()
+
+
+# ---------------------------------------------------------------------------
+# the pure rebalancing planner
+
+
+class TestPlanRebalance:
+    def _mk(self, partitions=3):
+        from kubernetes_tpu.autoscaler.partitions import (
+            PartitionGroup,
+            RebalancePolicy,
+        )
+
+        return (PartitionTopology.default(partitions),
+                RebalancePolicy(), PartitionGroup())
+
+    def test_failover_beats_everything(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        action = plan_rebalance({0: 9999.0}, {"hot": 9999.0}, topo,
+                                dead=[2], policy=policy, group=group)
+        assert action == {"op": "failover", "partition": 2}
+
+    def test_dominant_namespace_splits(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        hot_slot = topo.slot_of("Pod", "hot", None)
+        action = plan_rebalance(
+            {hot_slot: 800.0}, {"hot": 780.0, "cold": 20.0}, topo,
+            dead=[], policy=policy, group=group)
+        assert action == {"op": "split", "namespace": "hot"}
+
+    def test_no_dominant_tenant_moves_hot_slots(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        hot = 0
+        slots = topo.slots_of_partition(hot)[:6]
+        slot_rates = {s: 100.0 for s in slots}
+        ns_rates = {f"t{i}": 40.0 for i in range(15)}
+        action = plan_rebalance(slot_rates, ns_rates, topo, dead=[],
+                                policy=policy, group=group)
+        assert action["op"] == "move"
+        assert set(action["assignments"]).issubset(set(slots))
+        assert all(dest != hot
+                   for dest in action["assignments"].values())
+
+    def test_saturated_balanced_fleet_buys(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        slot_rates = {s: 60.0 for s in range(topo.slots)}
+        action = plan_rebalance(slot_rates, {}, topo, dead=[],
+                                policy=policy, group=group)
+        assert action == {"op": "buy"}
+        # pinned fleet: no buy available
+        group.max_partitions = 3
+        assert plan_rebalance(slot_rates, {}, topo, dead=[],
+                              policy=policy, group=group) is None
+
+    def test_idle_fleet_retires_above_floor(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        action = plan_rebalance({0: 1.0}, {}, topo, dead=[],
+                                policy=policy, group=group)
+        assert action is not None and action["op"] == "retire"
+        group.min_partitions = 3
+        assert plan_rebalance({0: 1.0}, {}, topo, dead=[],
+                              policy=policy, group=group) is None
+
+    def test_quiet_or_balanced_fleet_no_action(self):
+        from kubernetes_tpu.autoscaler.partitions import plan_rebalance
+
+        topo, policy, group = self._mk()
+        group.min_partitions = 3
+        assert plan_rebalance({}, {}, topo, dead=[],
+                              policy=policy, group=group) is None
+        balanced = {s: 5.0 for s in range(topo.slots)}
+        assert plan_rebalance(balanced, {}, topo, dead=[],
+                              policy=policy, group=group) is None
+
+    def test_inproc_buy_grows_and_drains(self):
+        from kubernetes_tpu.autoscaler.partitions import (
+            InprocElasticDriver,
+        )
+
+        store = PartitionedStore(partitions=2, reshardable=True)
+        _fill(store)
+        driver = InprocElasticDriver(store)
+        report = driver.apply({"op": "buy"})
+        assert report["new_partition"] == 2
+        assert store.partitions == 3
+        assert store.topology.slots_of_partition(2)
+
+
+# ---------------------------------------------------------------------------
+# diag + perf_report family
+
+
+class TestReshardDiagAndReport:
+    def test_reshard_diag_round_trip(self):
+        from kubernetes_tpu.harness import diagfmt
+
+        seg = diagfmt.format_reshard({
+            "moves": 3, "frozen_ms": 214.7, "epoch": 5,
+            "lost_watches": 0})
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert parsed["reshard"]["moves"] == 3
+        assert parsed["reshard"]["frozen_ms"] == pytest.approx(214.7)
+        assert parsed["reshard"]["epoch"] == 5
+        assert parsed["reshard"]["lost_watches"] == 0
+
+    def _row(self, tmp_path, **extra):
+        import os
+
+        base = {
+            "metric": ("hotspot_recovery[3p, one namespace 80% of "
+                       "24000 writes, elastic control plane]"),
+            "value": 0.91, "unit": "ratio", "recovery_ratio": 0.91,
+            "lost_watches": 0, "invariants_ok": True,
+            "invariants": {"lost_pods": 0, "duplicated_pods": 0,
+                           "lost_watches": 0, "unmoved_relists": 0,
+                           "rv_regressions": 0,
+                           "rebalancer_acted": True},
+        }
+        base.update(extra)
+        tail = "\n".join([
+            "[hotspot] rebalanced arm: split committed",
+            "    diag: reshard[moves=1 frozen_ms=812.0 epoch=2 "
+            "lost_watches=0]",
+            json.dumps(base),
+        ])
+        doc = {"n": 1, "cmd": "timeout 3600 python bench.py", "rc": 0,
+               "tail": tail}
+        with open(os.path.join(str(tmp_path), "BENCH_r01.json"),
+                  "w") as f:
+            json.dump(doc, f)
+
+    def test_green_hotspot_row_passes_strict(self, tmp_path):
+        from tools.perf_report import hotspot_flags, load_rounds, main
+
+        self._row(tmp_path)
+        assert hotspot_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_lost_watches_gate_strict(self, tmp_path):
+        from tools.perf_report import hotspot_flags, load_rounds, main
+
+        self._row(tmp_path, lost_watches=4)
+        (flag,) = hotspot_flags(load_rounds(str(tmp_path)))
+        assert "lost_watches=4" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_low_recovery_and_failed_invariants_flagged(self, tmp_path):
+        from tools.perf_report import hotspot_flags, load_rounds, main
+
+        self._row(tmp_path, value=0.55, recovery_ratio=0.55,
+                  invariants_ok=False,
+                  invariants={"lost_pods": 0, "duplicated_pods": 2,
+                              "rebalancer_acted": False})
+        (flag,) = hotspot_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "0.550 < 0.8" in probs
+        assert "duplicated_pods" in probs
+        assert "rebalancer_acted" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# compressed chaos cells (the full seeded matrix rides
+# tools/chaos_matrix.py --suite reshard; sigkill spawns real processes
+# and stays behind the slow/chaos markers)
+
+
+class TestReshardChaosCells:
+    def test_midstorm_cell(self):
+        from kubernetes_tpu.harness.chaos_reshard import (
+            run_reshard_midstorm,
+        )
+
+        r = run_reshard_midstorm(11)
+        assert r["ok"], r["failure"]
+        assert r["stats"]["migrations"] == 3
+        assert r["stats"]["moved"] > 0
+
+    def test_rebalance_cell(self):
+        from kubernetes_tpu.harness.chaos_reshard import (
+            run_reshard_rebalance,
+        )
+
+        r = run_reshard_rebalance(11)
+        assert r["ok"], r["failure"]
+        assert "split" in r["stats"]["actions"]
+        assert r["stats"]["hot_partitions"] > 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestReshardChaosSigkill:
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_sigkill_mid_migration(self, seed):
+        from kubernetes_tpu.harness.chaos_reshard import (
+            run_reshard_sigkill,
+        )
+
+        r = run_reshard_sigkill(seed)
+        assert r["ok"], r["failure"]
+        assert r["stats"]["outcome"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 mini-cell: live 2→3 split under writes + informer
+
+
+class TestReshardMiniCell:
+    def test_live_split_zero_loss_no_relist(self):
+        from kubernetes_tpu.harness.hotspot import run_reshard_mini_cell
+
+        r = run_reshard_mini_cell()
+        assert r["errors"] == []
+        assert r["confirmed"] > 0
+        # informer ≡ server truth at quiesce: nothing missing, nothing
+        # extra, nothing stale — the zero-lost-watch-events bar
+        assert r["lost_watches"] == 0, (r["missing"], r["extra"],
+                                        r["stale"])
+        assert r["informer_pods"] == r["server_pods"] == r["confirmed"]
+        assert r["duplicates"] == 0
+        assert r["informer_nodes"] == r["nodes"] == 200
+        # unmoved slices never relisted through the migration
+        assert r["unmoved_relists"] == 0
+        assert r["rv_regressions"] == []
+        # the split moved real keyspace under a bounded freeze
+        assert r["moved_objects"] > 0
+        assert 0 < r["frozen_ms"] < 5000
+        assert r["epoch"] >= 3

@@ -29,6 +29,14 @@ Three suites:
   with seeded heavy-tailed arrivals and lifetime churn; invariants:
   zero lost pods, gang atomicity (never a partially-placed gang), no
   priority inversion at quiesce.
+- ``reshard`` — live partition resharding: slice migrations under a
+  seeded write storm (``midstorm``), a REAL partition server
+  SIGKILLed at a seeded phase of a live migration — must roll back or
+  complete, never half-routed — then WAL-restored and re-pointed
+  (``sigkill``), and the load-aware rebalancer under a hot-namespace
+  storm (``rebalance``); invariants: zero lost pods, no
+  double-delivered watch events, cache ≡ store at quiesce, one
+  topology epoch fleet-wide.
 
 Usage::
 
@@ -40,6 +48,7 @@ Usage::
     python tools/chaos_matrix.py --suite overload \
         --overload liststorm,saturation --seeds 11,23
     python tools/chaos_matrix.py --suite replay --families storm,gangs
+    python tools/chaos_matrix.py --suite reshard --seeds 11,23,37
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -82,7 +91,8 @@ def main() -> int:
         description="seeded chaos matrices (wire faults + node churn)")
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
-                                 "partition", "replay", "both", "all"))
+                                 "partition", "replay", "reshard",
+                                 "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -97,6 +107,9 @@ def main() -> int:
     parser.add_argument("--families", default="storm,gangs,tenancy",
                         help="replay-suite scenario families "
                              "(storm,gangs,tenancy)")
+    parser.add_argument("--reshard", default="midstorm,sigkill,rebalance",
+                        help="reshard-suite scenarios "
+                             "(midstorm,sigkill,rebalance)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -136,6 +149,12 @@ def main() -> int:
         if p and p not in REPLAY_FAMILIES:
             parser.error(f"unknown replay family {p!r} "
                          f"(have: {', '.join(sorted(REPLAY_FAMILIES))})")
+    from kubernetes_tpu.harness.chaos_reshard import RESHARD_SCENARIOS
+
+    for p in args.reshard.split(","):
+        if p and p not in RESHARD_SCENARIOS:
+            parser.error(f"unknown reshard scenario {p!r} "
+                         f"(have: {', '.join(sorted(RESHARD_SCENARIOS))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -168,6 +187,18 @@ def main() -> int:
         _run_suite(args, progress, rows, "replay", run_replay_cell,
                    "family",
                    [f for f in args.families.split(",") if f])
+    if args.suite in ("reshard", "all"):
+        # live-resharding cells: migrations mid-storm, partition
+        # SIGKILL mid-migration (rollback or completion, never a torn
+        # routing table), rebalancer-under-storm — the elastic control
+        # plane's invariants as pass/fail
+        from kubernetes_tpu.harness.chaos_reshard import (
+            run_chaos_reshard,
+        )
+
+        _run_suite(args, progress, rows, "reshard", run_chaos_reshard,
+                   "scenario",
+                   [s for s in args.reshard.split(",") if s])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

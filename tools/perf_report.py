@@ -542,6 +542,59 @@ def sustained_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def hotspot_flags(rounds: List[dict]) -> List[dict]:
+    """The ``hotspot_recovery`` family's own checks (ISSUE 15
+    satellite): the elastic-control-plane row is a RATIO row — its
+    trend says nothing unless the migrations underneath were clean.
+    Flag the round when:
+
+    - any watch event was lost across the migrations
+      (``lost_watches`` > 0 — the cursor-preserving handoff's hardest
+      bar: an informer's final state diverged from server truth);
+    - any hard invariant failed (``invariants_ok`` false: lost or
+      duplicated pods, relists of unmoved slices, RV regressions, or
+      the rebalancer never acting at all);
+    - the recovery ratio fell below 0.8 (the rebalanced arm failed to
+      claw back ≥80% of the balanced fleet's throughput — the row's
+      acceptance bar).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if not str(row.get("metric", "")).startswith(
+                    "hotspot_recovery") or "error" in row:
+                continue
+            problems = []
+            if row.get("lost_watches"):
+                problems.append(
+                    f"lost_watches={row['lost_watches']} (handoff "
+                    f"dropped or duplicated events)")
+            if row.get("invariants_ok") is False:
+                # count-valued invariants are bad when NONZERO;
+                # rebalancer_acted is the one boolean (bad when False)
+                bad = [k for k, v in
+                       (row.get("invariants") or {}).items()
+                       if (not v if k == "rebalancer_acted"
+                           else bool(v))]
+                problems.append(
+                    "invariants failed: " + (", ".join(bad) or "?"))
+            ratio = row.get("recovery_ratio", row.get("value"))
+            if ratio is not None and float(ratio) < 0.8:
+                problems.append(
+                    f"recovery_ratio {float(ratio):.3f} < 0.8 "
+                    f"(rebalancer failed to recover balanced "
+                    f"throughput)")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -620,6 +673,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     dev_flags = devscale_flags(rounds)
     rep_flags = replay_flags(rounds)
     sus_flags = sustained_flags(rounds)
+    hot_flags = hotspot_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -637,6 +691,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "devscale_flags": dev_flags,
             "replay_flags": rep_flags,
             "sustained_flags": sus_flags,
+            "hotspot_flags": hot_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -661,6 +716,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in sus_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if hot_flags:
+            print("\nhotspot recovery / handoff flags:")
+            for f in hot_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -671,7 +731,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
     return 1 if (args.strict
                  and (open_flags or scale_flags or dev_flags
-                      or rep_flags or sus_flags)) else 0
+                      or rep_flags or sus_flags or hot_flags)) else 0
 
 
 if __name__ == "__main__":
