@@ -663,8 +663,8 @@ def main() -> None:
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
                        "autoscale", "scale10x", "devscale", "sustained",
-                       "hotspot", "replay:storm", "replay:gangs",
-                       "replay:tenancy"])
+                       "hotspot", "upgrade", "replay:storm",
+                       "replay:gangs", "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
                     help="trace seed for the replay:<family> rows "
                          "(same seed + trace → identical arrivals)")
@@ -779,6 +779,28 @@ def main() -> None:
         else:
             row = run_hotspot_row(pods=24_000, partitions=3,
                                   wait_timeout=900, progress=log)
+        print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "upgrade":
+        # the rolling-upgrade row (ISSUE 16): the WHOLE fleet — three
+        # spawned partition servers + two scheduler replicas —
+        # restarted exactly once each under sustained open-loop
+        # arrivals; per partition: freeze → drain → verify → promote a
+        # prespawned standby → reroute (abort-and-rollback on a blown
+        # drain budget). Headline is p99 arrival→bind THROUGH the
+        # roll; the verdict is the invariant set (zero lost pods, zero
+        # lost/duplicated watch events, zero relists of unmoved
+        # slices, exactly-once restarts, one epoch, mixed-version wire
+        # guard clean), gated by perf_report's upgrade_flags
+        from kubernetes_tpu.harness.upgrade import run_upgrade_row
+
+        if args.quick:
+            row = run_upgrade_row(pods=800, qps=100.0, partitions=2,
+                                  replicas=1, node_cpu=16,
+                                  wait_timeout=300, progress=log)
+        else:
+            row = run_upgrade_row(progress=log)
         print(json.dumps(row), flush=True)
         return
 

@@ -29,6 +29,41 @@ from typing import Any
 # the negotiated media type (reference: application/vnd.kubernetes.protobuf)
 BINARY_CONTENT_TYPE = "application/vnd.ktpu.binary"
 
+# -- wire-version negotiation (mixed-version skew guard) ---------------
+# A rolling upgrade has old and new processes on the wire at once
+# (upstream's N/N-1 skew contract). The codec's one observable schema
+# change so far is the watch-event frame: v1 streamed ``(type, obj,
+# old)`` 3-tuples, v2 streams ``(type, obj, old, commit_ts)`` 4-tuples.
+# Decoders were written to accept both, but that is an accident of this
+# particular change — the next one may not be shape-sniffable. So the
+# contract is made EXPLICIT: the client stamps the highest version it
+# speaks on every request (VERSION_HEADER), the server pins the
+# connection to ``min(server, client)`` and echoes the pinned stamp
+# back; an out-of-range stamp is a 400, never a silent decode skew.
+# Absent header → v2 (every current in-tree client already speaks it;
+# the stamp exists for the NEXT skew, and for v1-pinned laggards).
+CODEC_VERSION = 2
+MIN_CODEC_VERSION = 1
+VERSION_HEADER = "X-Ktpu-Codec-Version"
+
+
+def negotiate(client_stamp) -> int:
+    """Pin the wire version for one request: ``min(server, client)``.
+
+    ``client_stamp`` is the raw header value (or None when absent).
+    Raises ValueError when the stamp is malformed or outside
+    [MIN_CODEC_VERSION, ∞) — a client OLDER than the server's floor
+    cannot be served and must be told so explicitly (the server no
+    longer encodes that schema), and garbage must not default-through
+    to a guess."""
+    if client_stamp is None:
+        return CODEC_VERSION
+    v = int(client_stamp)  # ValueError on garbage propagates
+    if v < MIN_CODEC_VERSION:
+        raise ValueError(
+            f"codec version {v} below server floor {MIN_CODEC_VERSION}")
+    return min(CODEC_VERSION, v)
+
 # watch streams prefix each frame with a 4-byte big-endian length (the
 # reference streams length-delimited protobuf frames the same way:
 # runtime/serializer/streaming). A frame's payload is a pickled LIST

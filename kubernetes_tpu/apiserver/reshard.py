@@ -107,8 +107,12 @@ class ReshardCoordinator:
         last_err: Optional[Exception] = None
         for p in range(len(self.client.partition_urls)):
             try:
+                # probe, not a request: a dead endpoint (the failover
+                # this fetch serves) must cost one refused connect, not
+                # a full retry-backoff ladder inside the outage window
                 code, doc = self.client._request(
-                    "GET", "/api/v1/partitiontopology", partition=p)
+                    "GET", "/api/v1/partitiontopology", partition=p,
+                    retries=0)
             except Exception as e:  # noqa: BLE001 — dead endpoint
                 last_err = e
                 continue

@@ -136,17 +136,26 @@ def _encode_custom(obj, api_version: str) -> Dict:
     return d
 
 
-def _cached_event_bytes(event: Event) -> bytes:
+def _cached_event_bytes(event: Event, version: int = 2) -> bytes:
     """Pickle one watch event as ``(type, obj, old, commit_ts)``,
     memoized on the event so N binary watchers (and the replay path)
     pay ONE encode — the reference's cachingObject, applied to the
     binary wire. The commit timestamp rides along so the client can
     measure end-to-end watch delivery (freshness SLI); decoders accept
-    the legacy 3-tuple too. Benign race: two watch writers may both
+    the legacy 3-tuple too. A watcher pinned to codec v1 (mixed-version
+    roll: codec.negotiate) gets the legacy 3-tuple from its own memo
+    slot — the wire contract is the negotiated one, not whatever the
+    server happens to emit. Benign race: two watch writers may both
     encode the first time; both produce identical bytes and one
     assignment wins."""
     from kubernetes_tpu.apiserver import codec
 
+    if version < 2:
+        b = event.__dict__.get("_bin_frame_v1")
+        if b is None:
+            b = codec.encode((event.type, event.obj, event.old_obj))
+            event.__dict__["_bin_frame_v1"] = b
+        return b
     b = event.__dict__.get("_bin_frame")
     if b is None:
         b = codec.encode(
@@ -535,6 +544,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_gated(self, inner) -> None:
         self._body_consumed = False   # per-request: see _send_429 drain
+        # pin the wire version FIRST: every response (including the
+        # fault-injected and 429 paths below) carries the echoed stamp,
+        # so a mid-roll client always learns what contract it got
+        from kubernetes_tpu.apiserver import codec
+
+        try:
+            self._codec_version = codec.negotiate(
+                self.headers.get(codec.VERSION_HEADER))
+        except ValueError as e:
+            # unsatisfiable stamp: explicit refusal, never a silent
+            # decode skew. Drop keep-alive — the body framing of a
+            # client this confused is not worth trusting.
+            self.close_connection = True
+            self._send_error(400, "UnsupportedCodecVersion", str(e))
+            return
         if self._inject_fault():
             return
         tracer = self.server.tracer
@@ -706,8 +730,19 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self._send_codec_header()
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_codec_header(self) -> None:
+        """Echo the pinned wire version (codec.negotiate) on every
+        response so the client records/renegotiates across restart
+        seams; call between send_response and end_headers."""
+        from kubernetes_tpu.apiserver import codec
+
+        self.send_header(
+            codec.VERSION_HEADER,
+            str(getattr(self, "_codec_version", codec.CODEC_VERSION)))
 
     def _send_error(self, code: int, reason: str, message: str) -> None:
         # reference metav1.Status error envelope
@@ -768,6 +803,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        self._send_codec_header()
         self.end_headers()
         self.wfile.write(body)
 
@@ -2459,7 +2495,13 @@ class _Handler(BaseHTTPRequestHandler):
             "Content-Type",
             codec.BINARY_CONTENT_TYPE if binary else "application/json")
         self.send_header("Transfer-Encoding", "chunked")
+        self._send_codec_header()
         self.end_headers()
+        # the stream's wire contract is pinned for its whole life: a
+        # v1-pinned watcher gets legacy 3-tuple frames even though the
+        # server's native frame is the 4-tuple (mixed-version roll)
+        codec_version = getattr(self, "_codec_version",
+                                codec.CODEC_VERSION)
         gate = self.server.fault_gate
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
         try:
@@ -2521,7 +2563,8 @@ class _Handler(BaseHTTPRequestHandler):
                             break
                         batch.append(nxt)
                     frame = codec.frame(
-                        [_cached_event_bytes(e) for e in batch])
+                        [_cached_event_bytes(e, codec_version)
+                         for e in batch])
                 else:
                     # JSON coalescing: several newline-delimited frames
                     # ride one chunk write (readline-based clients parse

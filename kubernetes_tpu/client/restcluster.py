@@ -225,6 +225,7 @@ class RestClusterClient:
         retry_seed: Optional[int] = None,
         flow_id: str = "",
         partition_urls: Optional[List[str]] = None,
+        codec_version: int = codec.CODEC_VERSION,
     ):
         # partition-aware mode (apiserver/partition.py): one apiserver
         # endpoint per store partition. Single-object calls route by the
@@ -333,6 +334,19 @@ class RestClusterClient:
         # NO known map — only a reconcile fetch of the gaining
         # partition can recover it
         self._pending_gained: set = set()
+        # -- wire-version pin (mixed-version skew guard) ---------------
+        # the highest codec version this client speaks, stamped on every
+        # request; the server echoes the pinned min(server, client).
+        # ``negotiated_codec[p]`` records each partition's echo — a
+        # restart seam that changes it counts as a re-negotiation, an
+        # echo ABOVE our stamp (a server that ignored the pin) counts
+        # as a failure. Both feed the upgrade harness's invariants.
+        self.codec_version = int(codec_version)
+        self._codec_lock = threading.Lock()
+        self.negotiated_codec: Dict[int, int] = {}
+        self._codec_pending_reneg: set = set()
+        self.codec_renegotiations = 0
+        self.codec_failures = 0
 
     def set_degraded_listener(
             self, listener: Callable[[bool], None]) -> None:
@@ -358,7 +372,41 @@ class RestClusterClient:
             h["Authorization"] = f"Bearer {self.token}"
         if self.flow_id:
             h["X-Flow-Id"] = self.flow_id
+        h[codec.VERSION_HEADER] = str(self.codec_version)
         return h
+
+    def _record_negotiated(self, partition: int, resp) -> None:
+        """Record the server's echoed wire-version pin for one
+        partition. First echo just registers; a CHANGED echo is a
+        re-negotiation (the restart seam put a different-version server
+        behind the URL); an echo above our own stamp means the server
+        ignored the pin — a contract failure, counted, and the
+        connection keeps decoding at our own (lower) version, which the
+        decoders tolerate for the current schema pair."""
+        stamp = resp.headers.get(codec.VERSION_HEADER) if resp.headers \
+            else None
+        if stamp is None:
+            return
+        try:
+            v = int(stamp)
+        except ValueError:
+            with self._codec_lock:
+                self.codec_failures += 1
+            return
+        with self._codec_lock:
+            if v > self.codec_version:
+                self.codec_failures += 1
+            prev = self.negotiated_codec.get(partition)
+            if prev is not None and prev != v:
+                # the server behind this URL changed its answer with no
+                # routing seam in between — still a re-negotiation (an
+                # in-place restart at the same port)
+                self.codec_renegotiations += 1
+            elif partition in self._codec_pending_reneg:
+                # first echo across a restart seam: re-pinned
+                self.codec_renegotiations += 1
+            self._codec_pending_reneg.discard(partition)
+            self.negotiated_codec[partition] = v
 
     @staticmethod
     def _note_retry(verb: str, reason: str) -> None:
@@ -392,7 +440,8 @@ class RestClusterClient:
                  charge: float = 1.0, body_binary: Optional[bool] = None,
                  partition: int = 0,
                  route: Optional[Callable[[], int]] = None,
-                 raise_on_stale: bool = False) -> Tuple[int, Any]:
+                 raise_on_stale: bool = False,
+                 retries: Optional[int] = None) -> Tuple[int, Any]:
         if self.limiter is not None:
             self.limiter.charge(charge)
         body_binary = self.binary if body_binary is None else body_binary
@@ -412,6 +461,12 @@ class RestClusterClient:
             # launder concurrency server-side either
             headers["X-Kubernetes-Request-Items"] = str(int(charge))
         conn: Optional[http.client.HTTPConnection] = None
+        # per-call retry ceiling: topology PROBES pass retries=0 — the
+        # poller's endpoint round-robin is their retry policy, and a
+        # probe stuck in backoff against a dead endpoint would hold the
+        # whole client's routing hostage during exactly the failover
+        # window it exists to detect
+        max_r = self.max_retries if retries is None else int(retries)
         attempt = 0
         while True:
             try:
@@ -432,13 +487,24 @@ class RestClusterClient:
                 _ConnPool.discard(conn)
                 conn = None
                 self.breaker.record_failure()
-                if attempt >= self.max_retries \
+                if attempt >= max_r \
                         or not self._retry_budget.try_spend():
                     raise
                 self._note_retry(method, "transport")
-                pool.prewarm(1)
                 time.sleep(self._backoff.delay(attempt))
                 attempt += 1
+                # re-resolve the route AND the pool before retrying: a
+                # transport error during a restart seam means the
+                # endpoint may be GONE — a replumb (topology push/poll)
+                # lands mid-backoff and swaps self._pools to the
+                # successor URL, and a retry pinned to the pre-seam
+                # pool object would dial the dead port until the budget
+                # ran out (a rolling upgrade turns that into a lost
+                # write)
+                if route is not None:
+                    partition = route()
+                pool = self._pools[(partition, lane)]
+                pool.prewarm(1)
                 continue
             if resp.status == 429 \
                     and resp.headers.get("X-Partition-Epoch"):
@@ -473,7 +539,7 @@ class RestClusterClient:
                     # correctly when this split predated the flip
                     raise StaleRouteError(
                         f"topology epoch {new_epoch}: re-split needed")
-                if attempt >= self.max_retries \
+                if attempt >= max_r \
                         or not self._retry_budget.try_spend():
                     ctype = resp.headers.get("Content-Type") or ""
                     if ctype.startswith(codec.BINARY_CONTENT_TYPE):
@@ -493,7 +559,7 @@ class RestClusterClient:
                     partition = route()
                 pool = self._pools[(partition, lane)]
                 continue
-            if resp.status in (429, 503) and attempt < self.max_retries \
+            if resp.status in (429, 503) and attempt < max_r \
                     and self._retry_budget.try_spend():
                 # overload pushback: honor Retry-After, CAPPED — a
                 # misbehaving server advertising an hour must not stall
@@ -542,6 +608,7 @@ class RestClusterClient:
                 _ConnPool.discard(conn)
             else:
                 pool.release(conn)
+            self._record_negotiated(partition, resp)
             ctype = resp.headers.get("Content-Type") or ""
             if ctype.startswith(codec.BINARY_CONTENT_TYPE):
                 return resp.status, codec.decode(raw)
@@ -651,7 +718,7 @@ class RestClusterClient:
         (the 429-retry path runs on arbitrary threads — watch-stream
         surgery belongs to the poller)."""
         code, doc = self._request("GET", "/api/v1/partitiontopology",
-                                  partition=partition)
+                                  partition=partition, retries=0)
         if code != 200 or not isinstance(doc, dict) \
                 or "owner" not in doc:
             return False
@@ -743,6 +810,14 @@ class RestClusterClient:
         with self._rv_lock:
             for key in [k for k in self._last_rv if k[1] in changed]:
                 del self._last_rv[key]
+        # a changed index is a restart seam: the recorded wire-version
+        # pin belonged to the OLD server behind this URL. Drop it so
+        # the first echo from the new server re-registers — and counts
+        # as a re-negotiation (the client re-pinned across the seam).
+        with self._codec_lock:
+            for p in changed:
+                if self.negotiated_codec.pop(p, None) is not None:
+                    self._codec_pending_reneg.add(p)
 
     def _list(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
         parts = self._pset(kind, namespace)
@@ -1464,9 +1539,25 @@ class RestClusterClient:
         from kubernetes_tpu.client.informers import replace_diff
 
         first = True
+        # the endpoint this stream was plumbed against: if it changes,
+        # the partition's process was replaced (rolling restart /
+        # failover) and the seam belongs to _replumb_streams — it stops
+        # this loop and opens a fresh HANDOFF stream. Without this
+        # guard the loop's own reconnect can race the replumb onto the
+        # successor's pool and mislabel the restart as a relist of an
+        # unmoved slice (and briefly double-stream the partition).
+        ep0 = self._endpoints[p] if p < len(self._endpoints) else None
         while not self._stopping.is_set() and not stop.is_set():
+            if (self._endpoints[p]
+                    if p < len(self._endpoints) else None) != ep0:
+                return
             try:
                 objs, rv = self._list_with_rv(kind, partition=p)
+                if stop.is_set() or self._stopping.is_set():
+                    return
+                if (self._endpoints[p]
+                        if p < len(self._endpoints) else None) != ep0:
+                    return
                 live = {_key_of(o): o for o in objs}
                 if first and not handoff:
                     # boot-time stream: Scheduler.start() replays the
@@ -1710,11 +1801,17 @@ class RestClusterClient:
             headers["Authorization"] = f"Bearer {self.token}"
         if self.flow_id:
             headers["X-Flow-Id"] = self.flow_id
+        headers[codec.VERSION_HEADER] = str(self.codec_version)
         try:
             conn.request(
                 "GET", f"/api/v1/{plural}?watch=1&resourceVersion={rv}",
                 headers=headers)
             resp = conn.getresponse()
+            # the stream's wire contract is pinned for its whole life
+            # (server-side too); record it so a restart seam that puts
+            # a different-version server behind this partition shows up
+            # as a re-negotiation
+            self._record_negotiated(partition, resp)
             if resp.status != 200:
                 resp.read()
                 if resp.status == 410:

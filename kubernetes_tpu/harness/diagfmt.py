@@ -230,6 +230,32 @@ def format_reshard(info: Optional[Dict]) -> str:
     return "reshard[" + " ".join(parts) + "]"
 
 
+def format_upgrade(info: Optional[Dict]) -> str:
+    """The rolling-upgrade segment: how many processes the roll cycled
+    (``rolled`` — partitions plus scheduler replicas, each exactly
+    once), the widest per-partition write-freeze window
+    (``frozen_ms_max`` — the bounded unavailability any one slice paid
+    for its restart), ``reneg`` (codec re-negotiations observed by
+    clients riding the seams — proof the mixed-version wire guard was
+    exercised, not bypassed), and the two MUST-be-zero counters:
+    ``lost`` (lost pods plus lost/duplicated watch events) and
+    ``relists`` (relists of slices whose partition did not move).
+    Emitted by the upgrade row and the upgrade chaos cells; parsed by
+    the generic bracket scan in ``parse_diag`` (key ``upgrade``) —
+    tools/perf_report.py reads it to gate the ``upgrade_flags``
+    family."""
+    if not info:
+        return ""
+    parts = [
+        f"rolled={int(info.get('rolled', 0))}",
+        f"frozen_ms_max={float(info.get('frozen_ms_max', 0.0)):.1f}",
+        f"reneg={int(info.get('reneg', 0))}",
+        f"lost={int(info.get('lost', 0))}",
+        f"relists={int(info.get('relists', 0))}",
+    ]
+    return "upgrade[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy

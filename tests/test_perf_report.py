@@ -344,6 +344,86 @@ class TestSustainedFlags:
         assert "degenerated" in flag["problems"][0]
 
 
+class TestUpgradeFlags:
+    _METRIC = ("upgrade_roll[open-loop 5000/s 3part+2sched rolling "
+               "restart, 30000pods seed=16, REST fabric]")
+
+    def _row(self, tmp_path, n, **extra):
+        base = {"p99_arrival_to_bind_ms": 120, "lost_pods": 0,
+                "lost_watch_events": 0, "duplicated_events": 0,
+                "unmoved_relists": 0, "frozen_ms_max": 330.0,
+                "freeze_budget_ms": 2000.0, "codec_failures": 0,
+                "codec_renegotiations": 8,
+                "rolled_exactly_once": True, "invariants_ok": True,
+                "slo_verdicts_ok": True}
+        base.update(extra)
+        _artifact(tmp_path, n, 4100.0, metric=self._METRIC,
+                  extra=base)
+
+    def test_green_roll_passes(self, tmp_path):
+        from tools.perf_report import main, upgrade_flags
+
+        self._row(tmp_path, 1)
+        assert upgrade_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_lost_pod_gates_strict(self, tmp_path):
+        from tools.perf_report import main, upgrade_flags
+
+        self._row(tmp_path, 1, lost_pods=2)
+        (flag,) = upgrade_flags(load_rounds(str(tmp_path)))
+        assert "lost_pods=2" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_lost_and_duplicated_events_flagged(self, tmp_path):
+        from tools.perf_report import upgrade_flags
+
+        self._row(tmp_path, 1, lost_watch_events=1,
+                  duplicated_events=3)
+        (flag,) = upgrade_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "lost_watch_events=1" in probs
+        assert "duplicated_events=3" in probs
+
+    def test_freeze_budget_overrun_flagged(self, tmp_path):
+        from tools.perf_report import upgrade_flags
+
+        self._row(tmp_path, 1, frozen_ms_max=2750.0)
+        (flag,) = upgrade_flags(load_rounds(str(tmp_path)))
+        assert "frozen_ms_max 2750.0 > budget 2000ms" \
+            in flag["problems"][0]
+
+    def test_red_slo_and_p99_flagged(self, tmp_path):
+        from tools.perf_report import main, upgrade_flags
+
+        self._row(tmp_path, 1, slo_verdicts_ok=False,
+                  p99_arrival_to_bind_ms=812)
+        (flag,) = upgrade_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "812ms > 500ms" in probs
+        assert "SLO went red" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_codec_failure_and_double_roll_flagged(self, tmp_path):
+        from tools.perf_report import upgrade_flags
+
+        self._row(tmp_path, 1, codec_failures=1,
+                  rolled_exactly_once=False, unmoved_relists=2)
+        (flag,) = upgrade_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "codec_failures=1" in probs
+        assert "not exactly-once" in probs
+        assert "unmoved_relists=2" in probs
+
+    def test_flags_survive_json_mode(self, tmp_path, capsys):
+        from tools.perf_report import main
+
+        self._row(tmp_path, 1, lost_pods=1)
+        main(["--dir", str(tmp_path), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["upgrade_flags"]) == 1
+
+
 # ---------------------------------------------------------------------------
 # committed artifacts: the tier-1 smoke over the real trajectory
 

@@ -1,0 +1,184 @@
+"""Rolling-upgrade orchestrator (harness/upgrade.py): the fleet never
+stops serving.
+
+The tier-1 surface of PR 16 — one REAL compressed roll plus the cheap
+contracts around it:
+
+- ``TestRollingMiniCell`` — a 2-partition fleet (in-process apiservers
+  with the real wire stack) + 1 scheduler replica rolled one process
+  at a time while a writer streams pods in over REST: informer ≡
+  server truth at quiesce, zero lost/duplicated watch events, zero
+  relists of unmoved slices, no resourceVersion regressions, and the
+  mixed-version guard exercised by one client pinned to
+  ``codec_version=1`` that must stay pinned (and re-negotiate) across
+  every restart seam.
+- ``TestUpgradeDiag`` — ``diagfmt.format_upgrade`` round-trips through
+  the shared bracket parser and honours the quiet convention.
+- ``TestUpgradeContracts`` — scenario validation and the
+  ``_upgrade_ok`` verdict surface on synthetic results (every checked
+  invariant flips the verdict).
+
+The full-fleet spawned-process roll (3 partitions + 2 replicas at
+open-loop 5k QPS) is the committed bench row (``upgrade_rows.log``)
+and the ``--suite upgrade`` chaos cells — too heavy for tier-1; this
+mini-cell walks the same seams at CI scale.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.harness import diagfmt
+from kubernetes_tpu.harness.upgrade import (
+    UPGRADE_SCENARIOS,
+    _upgrade_ok,
+    run_chaos_upgrade,
+    run_upgrade_mini_cell,
+)
+
+
+# ---------------------------------------------------------------------------
+# the real roll, compressed
+
+
+@pytest.fixture(scope="module")
+def mini_cell():
+    """One rolled fleet shared by every invariant assertion: the roll
+    is the expensive part; the checks are reads of its result."""
+    return run_upgrade_mini_cell(nodes=200, pods=160, partitions=2)
+
+
+class TestRollingMiniCell:
+    def test_no_errors_and_all_pods_survive(self, mini_cell):
+        assert mini_cell["errors"] == []
+        assert mini_cell["confirmed"] == 160
+        assert mini_cell["server_pods"] == 160
+        assert mini_cell["duplicates"] == 0
+
+    def test_every_pod_bound_through_the_roll(self, mini_cell):
+        # the scheduler replica was itself restarted mid-stream; every
+        # confirmed pod must still end bound on the servers
+        assert mini_cell["server_bound"] == mini_cell["server_pods"]
+        assert mini_cell["scheduled"] >= mini_cell["confirmed"]
+
+    def test_whole_fleet_rolled_exactly_once(self, mini_cell):
+        assert mini_cell["rolled_partitions"] == 2
+        assert mini_cell["rolled_replicas"] == 1
+        assert all(r["rolled"] for r in mini_cell["partition_records"])
+
+    def test_informer_equals_server_truth_at_quiesce(self, mini_cell):
+        # the CompositeCursor contract across BOTH partition seams and
+        # the replica seam: nothing missing, nothing extra, nothing
+        # stale — summed into lost_watches which MUST be zero
+        assert mini_cell["missing"] == []
+        assert mini_cell["extra"] == []
+        assert mini_cell["stale"] == []
+        assert mini_cell["lost_watches"] == 0
+        assert mini_cell["informer_pods"] == mini_cell["server_pods"]
+
+    def test_no_relists_of_unmoved_slices(self, mini_cell):
+        # a restart seam is a handoff, not a relist: the replumb owns
+        # the seam and carries cursors over; an in-loop reconnect that
+        # relisted would show up here
+        assert mini_cell["unmoved_relists"] == 0
+
+    def test_no_resource_version_regressions(self, mini_cell):
+        assert mini_cell["rv_regressions"] == []
+
+    def test_one_topology_epoch_fleet_wide(self, mini_cell):
+        # bootstrap epoch 1 + one reroute per rolled partition
+        assert mini_cell["epoch"] == 3
+
+    def test_mixed_version_guard_holds_across_seams(self, mini_cell):
+        # the v1-pinned client negotiated v1 on every partition, was
+        # forced to RE-negotiate across each restart seam (>= one per
+        # rolled partition), and was never refused
+        assert mini_cell["v1_pin_ok"]
+        assert all(v == 1
+                   for v in mini_cell["v1_negotiated"].values())
+        assert mini_cell["v1_renegotiations"] >= 2
+        assert mini_cell["codec_failures"] == 0
+
+    def test_freeze_windows_stayed_bounded(self, mini_cell):
+        # in-proc rolls carry no process spawn; the write-freeze
+        # window must stay well under the 2 s drain budget
+        assert 0.0 < mini_cell["frozen_ms_max"] < 2000.0
+
+
+# ---------------------------------------------------------------------------
+# diag segment: one writer, one parser
+
+
+class TestUpgradeDiag:
+    def test_round_trips_through_shared_parser(self):
+        seg = diagfmt.format_upgrade({
+            "rolled": 5, "frozen_ms_max": 326.71, "reneg": 8,
+            "lost": 0, "relists": 0})
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert parsed["upgrade"]["rolled"] == 5
+        assert parsed["upgrade"]["frozen_ms_max"] == pytest.approx(
+            326.7)
+        assert parsed["upgrade"]["reneg"] == 8
+        assert parsed["upgrade"]["lost"] == 0
+        assert parsed["upgrade"]["relists"] == 0
+
+    def test_quiet_convention(self):
+        assert diagfmt.format_upgrade(None) == ""
+        assert diagfmt.format_upgrade({}) == ""
+
+
+# ---------------------------------------------------------------------------
+# cheap contracts: scenario surface + verdict function
+
+
+def _green_result() -> dict:
+    return {
+        "lost_pods": 0, "injected": 200, "ever_bound": 200,
+        "send_errors": [], "duplicates": 0, "doubles": 0,
+        "lost_watches": 0, "unmoved_relists": 0, "rv_regressions": 0,
+        "rolled_exactly_once": True, "epochs": [3],
+        "frozen_ms_max": 326.7, "freeze_budget_ms": 2000.0,
+        "codec_failures": 0, "v1_pin_ok": True,
+        "slo_verdicts_ok": True,
+    }
+
+
+class TestUpgradeContracts:
+    def test_scenario_names_are_the_matrix_axes(self):
+        # roll order × SIGKILL-mid-roll: the four cells the chaos
+        # suite crosses
+        assert UPGRADE_SCENARIOS == (
+            "partitions-first", "schedulers-first",
+            "sigkill-partitions-first", "sigkill-schedulers-first")
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="scenario"):
+            run_chaos_upgrade(1, scenario="upside-down")
+
+    def test_green_result_passes(self):
+        ok, why = _upgrade_ok(_green_result())
+        assert ok, why
+        assert why == ""
+
+    @pytest.mark.parametrize("mutation,needle", [
+        ({"lost_pods": 1}, "lost_pods"),
+        ({"ever_bound": 150}, "all_bound"),
+        ({"send_errors": ["boom"]}, "send_errors"),
+        ({"duplicates": 2}, "duplicates"),
+        ({"doubles": 1}, "doubles"),
+        ({"lost_watches": 1}, "lost_watches"),
+        ({"unmoved_relists": 1}, "unmoved_relists"),
+        ({"rv_regressions": 1}, "rv_regressions"),
+        ({"rolled_exactly_once": False}, "rolled_exactly_once"),
+        ({"epochs": [2, 3]}, "one_epoch"),
+        ({"frozen_ms_max": 2500.0}, "freeze_budget"),
+        ({"codec_failures": 1}, "codec_failures"),
+        ({"v1_pin_ok": False}, "v1_pin"),
+        ({"slo_verdicts_ok": False}, "slo"),
+    ])
+    def test_each_invariant_flips_the_verdict(self, mutation, needle):
+        res = _green_result()
+        res.update(mutation)
+        ok, why = _upgrade_ok(res)
+        assert not ok
+        assert needle in why

@@ -2,7 +2,7 @@
 """Run the seeded chaos matrices and print a pass/fail table (the CI
 face of ``kubernetes_tpu.harness.chaos_rest`` and ``chaos_nodes``).
 
-Three suites:
+The suites:
 
 - ``rest`` — wire-level: a seeded fault profile armed through
   /debug/faults, an apiserver SIGKILL + WAL-restore restart
@@ -37,6 +37,16 @@ Three suites:
   storm (``rebalance``); invariants: zero lost pods, no
   double-delivered watch events, cache ≡ store at quiesce, one
   topology epoch fleet-wide.
+- ``upgrade`` — rolling upgrades: the WHOLE fleet (spawned partition
+  servers + scheduler replicas) restarted exactly once each under
+  sustained open-loop load, crossing roll order (``partitions-first``
+  / ``schedulers-first``) × SIGKILL mid-roll on the draining process
+  (``sigkill-*``); per partition: freeze → drain → verify → promote a
+  prespawned standby → reroute, abort-and-rollback if the drain blows
+  its budget; invariants: every roll complete-or-rolled-back, zero
+  lost pods, zero lost/duplicated watch events, zero relists of
+  unmoved slices, one epoch, and a v1-pinned client held at codec v1
+  across every seam (mixed-version wire guard).
 
 Usage::
 
@@ -49,6 +59,8 @@ Usage::
         --overload liststorm,saturation --seeds 11,23
     python tools/chaos_matrix.py --suite replay --families storm,gangs
     python tools/chaos_matrix.py --suite reshard --seeds 11,23,37
+    python tools/chaos_matrix.py --suite upgrade --seeds 3,5 \
+        --upgrade partitions-first,sigkill-schedulers-first
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -92,7 +104,7 @@ def main() -> int:
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
                                  "partition", "replay", "reshard",
-                                 "both", "all"))
+                                 "upgrade", "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -110,6 +122,14 @@ def main() -> int:
     parser.add_argument("--reshard", default="midstorm,sigkill,rebalance",
                         help="reshard-suite scenarios "
                              "(midstorm,sigkill,rebalance)")
+    parser.add_argument("--upgrade",
+                        default="partitions-first,schedulers-first,"
+                                "sigkill-partitions-first,"
+                                "sigkill-schedulers-first",
+                        help="upgrade-suite roll scenarios: roll order "
+                             "(partitions-first,schedulers-first) × "
+                             "SIGKILL mid-roll on a draining process "
+                             "(sigkill-* variants)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -155,6 +175,12 @@ def main() -> int:
         if p and p not in RESHARD_SCENARIOS:
             parser.error(f"unknown reshard scenario {p!r} "
                          f"(have: {', '.join(sorted(RESHARD_SCENARIOS))})")
+    from kubernetes_tpu.harness.upgrade import UPGRADE_SCENARIOS
+
+    for p in args.upgrade.split(","):
+        if p and p not in UPGRADE_SCENARIOS:
+            parser.error(f"unknown upgrade scenario {p!r} "
+                         f"(have: {', '.join(sorted(UPGRADE_SCENARIOS))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -199,6 +225,18 @@ def main() -> int:
         _run_suite(args, progress, rows, "reshard", run_chaos_reshard,
                    "scenario",
                    [s for s in args.reshard.split(",") if s])
+    if args.suite in ("upgrade", "all"):
+        # rolling-upgrade cells: the whole fleet (spawned partition
+        # servers + scheduler replicas) restarted one process at a
+        # time under load, crossing roll order × SIGKILL mid-roll on
+        # the draining process — every roll must complete-or-rollback
+        # with zero lost pods/events and the mixed-version wire guard
+        # holding a v1-pinned client across every seam
+        from kubernetes_tpu.harness.upgrade import run_chaos_upgrade
+
+        _run_suite(args, progress, rows, "upgrade", run_chaos_upgrade,
+                   "scenario",
+                   [s for s in args.upgrade.split(",") if s])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

@@ -595,6 +595,96 @@ def hotspot_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def upgrade_flags(rounds: List[dict]) -> List[dict]:
+    """The ``upgrade_roll`` family's own checks (ISSUE 16 satellite):
+    the rolling-upgrade row is a THROUGHPUT-UNDER-SURGERY row — its
+    trend says nothing unless the fleet actually kept serving while
+    every process restarted. Flag the round when:
+
+    - any pod was lost across the roll (``lost_pods`` > 0 — injected,
+      acked, then absent from both server truth and the bind stream);
+    - any watch event was lost or duplicated (``lost_watch_events`` /
+      ``duplicated_events`` > 0 — a CompositeCursor failed to carry a
+      client across a restart seam exactly-once);
+    - any slice whose partition did NOT move was relisted
+      (``unmoved_relists`` > 0 — the seam leaked beyond the restarted
+      process);
+    - a partition's write-freeze window blew its drain budget
+      (``frozen_ms_max`` > ``freeze_budget_ms`` — the roll should have
+      aborted and rolled back instead);
+    - p99 arrival→bind exceeded 500 ms during the roll (the row's
+      latency acceptance bar under open-loop load);
+    - any freshness SLO went red during the roll
+      (``slo_verdicts_ok`` false);
+    - the mixed-version wire guard broke (``codec_failures`` > 0 — a
+      client's pinned codec version was refused or mis-negotiated
+      across a seam);
+    - the roll was not exactly-once (``rolled_exactly_once`` false:
+      a process restarted twice or never) or any other hard invariant
+      failed (``invariants_ok`` false).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            if not str(row.get("metric", "")).startswith(
+                    "upgrade_roll") or "error" in row:
+                continue
+            problems = []
+            if row.get("lost_pods"):
+                problems.append(
+                    f"lost_pods={row['lost_pods']} (injected pods "
+                    f"vanished across the roll)")
+            if row.get("lost_watch_events"):
+                problems.append(
+                    f"lost_watch_events={row['lost_watch_events']} "
+                    f"(informer diverged from server truth)")
+            if row.get("duplicated_events"):
+                problems.append(
+                    f"duplicated_events={row['duplicated_events']} "
+                    f"(a seam replayed events already delivered)")
+            if row.get("unmoved_relists"):
+                problems.append(
+                    f"unmoved_relists={row['unmoved_relists']} "
+                    f"(restart seam relisted a slice that never "
+                    f"moved)")
+            frozen = row.get("frozen_ms_max")
+            budget = row.get("freeze_budget_ms")
+            if (frozen is not None and budget is not None
+                    and float(frozen) > float(budget)):
+                problems.append(
+                    f"frozen_ms_max {float(frozen):.1f} > budget "
+                    f"{float(budget):.0f}ms (drain overran; should "
+                    f"have aborted and rolled back)")
+            p99 = row.get("p99_arrival_to_bind_ms")
+            if p99 is not None and float(p99) > 500.0:
+                problems.append(
+                    f"p99_arrival_to_bind {float(p99):.0f}ms > 500ms "
+                    f"under open-loop load during the roll")
+            if row.get("slo_verdicts_ok") is False:
+                problems.append(
+                    "freshness SLO went red during the roll")
+            if row.get("codec_failures"):
+                problems.append(
+                    f"codec_failures={row['codec_failures']} "
+                    f"(mixed-version wire guard refused a client)")
+            if row.get("rolled_exactly_once") is False:
+                problems.append(
+                    "roll not exactly-once (a process restarted "
+                    "twice or never)")
+            if row.get("invariants_ok") is False:
+                why = (row.get("invariants") or {}).get("failed", "?")
+                problems.append(f"invariants failed: {why}")
+            if problems:
+                flags.append({
+                    "metric": row["metric"],
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -674,6 +764,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep_flags = replay_flags(rounds)
     sus_flags = sustained_flags(rounds)
     hot_flags = hotspot_flags(rounds)
+    upg_flags = upgrade_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -692,6 +783,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "replay_flags": rep_flags,
             "sustained_flags": sus_flags,
             "hotspot_flags": hot_flags,
+            "upgrade_flags": upg_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -721,6 +813,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in hot_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if upg_flags:
+            print("\nrolling-upgrade / version-skew flags:")
+            for f in upg_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -731,7 +828,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"pad waste {telemetry['pad_waste_pct']:.1f}%")
     return 1 if (args.strict
                  and (open_flags or scale_flags or dev_flags
-                      or rep_flags or sus_flags or hot_flags)) else 0
+                      or rep_flags or sus_flags or hot_flags
+                      or upg_flags)) else 0
 
 
 if __name__ == "__main__":
