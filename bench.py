@@ -288,10 +288,20 @@ def _diagnose(sched, bs) -> None:
         engine = get_slo_engine()
         if engine.enabled:
             slo_seg = diagfmt.format_slo(engine.evaluate())
+        # fleet critical-path segment from THIS process's ring (the
+        # full cross-process merge rides the row JSON; the diag line
+        # carries the scheduler-side attribution so a blown p99 names
+        # its phase from the log alone)
+        crit_seg = ""
+        if tracer.enabled:
+            from kubernetes_tpu.harness.perf import collect_critical_path
+
+            cp, _doc = collect_critical_path()
+            crit_seg = diagfmt.format_critpath(cp)
         log(diagfmt.format_diag(
             segs + [sess.strip(), devprof_seg.strip(), pipe_seg.strip(),
                     mesh_seg.strip(), churn.strip(), autoscale.strip(),
-                    apf.strip(), slo_seg] + buckets))
+                    apf.strip(), slo_seg, crit_seg] + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -352,6 +362,11 @@ def run_one(key: str, name: str, nodes: int, init_pods: int,
         # tools/slo_report.py renders the per-row verdict table from
         # exactly this sub-object
         row["freshness"] = median.freshness
+    if median.critical_path:
+        # fleet critical-path attribution (phase shares of the sampled
+        # pods' end-to-end latency) — tools/trace_report.py --fleet and
+        # perf_report's critpath_flags read exactly this sub-object
+        row["critical_path"] = median.critical_path
     if key == "headline":
         # provenance for the trace-overhead tracking (--config traceab):
         # which sampling config this headline number was measured under
@@ -440,6 +455,8 @@ def run_rest_one(nodes: int, measure_pods: int, serial_rate: float,
         # row (≥2 = the cross-process path measured real children)
         row["federation_instances"] = \
             median.metrics.get("federation_instances", [])
+    if median.critical_path:
+        row["critical_path"] = median.critical_path
     return row
 
 

@@ -84,6 +84,21 @@ class ReshardCoordinator:
                 f"partition {partition} admin GET failed: HTTP {code}")
         return resp
 
+    @staticmethod
+    def _seam(name: str, t0: float, epoch: int, **attrs) -> None:
+        """Record a first-class seam span into the fleet timeline
+        (trace id ``seam:<epoch>``): the critical-path pass folds these
+        windows into any sampled pod whose in-flight time overlaps
+        them, so a queue.wait that straddles a freeze names the freeze
+        instead of showing up as unattributed stall."""
+        try:
+            from kubernetes_tpu.observability import get_tracer
+
+            get_tracer().record(name, t0, trace=f"seam:{epoch}",
+                                **attrs)
+        except Exception:  # noqa: BLE001 — tracing must not fail a flip
+            pass
+
     def stats(self) -> List[dict]:
         """Best-effort per-partition admin stats (the rebalancer's
         load feed over REST). Dead partitions report ``alive: False``
@@ -258,10 +273,15 @@ class ReshardCoordinator:
             if kill_hook is not None:
                 kill_hook("pre_flip")   # chaos seam: crash before flip
             self._verify_frozen(by_src)
+            t_flip = time.monotonic()
             self.install_topology(new_topo, order=dests + srcs + rest)
+            self._seam("reshard.flip", t_flip, new_topo.epoch,
+                       reason=reason)
         except ReshardError as e:
             if not getattr(e, "committed", False):
                 self._rollback(by_src, adopted)
+                self._seam("reshard.rollback", t0, new_topo.epoch,
+                           reason=reason)
                 raise
             # flip partially landed: the new epoch exists somewhere —
             # the migration IS committed; finish via resolve()
@@ -269,9 +289,13 @@ class ReshardCoordinator:
             raise
         except Exception:
             self._rollback(by_src, adopted)
+            self._seam("reshard.rollback", t0, new_topo.epoch,
+                       reason=reason)
             raise
         frozen_ms = (time.monotonic() - t0) * 1000.0
         self._unfreeze(by_src)   # install already dropped non-owned
+        self._seam("reshard.freeze", t0, new_topo.epoch, reason=reason,
+                   frozen_ms=round(frozen_ms, 3))
         if self.evict_grace_s > 0 and evict:
             time.sleep(self.evict_grace_s)
         evict_failures = {}
@@ -379,18 +403,27 @@ class ReshardCoordinator:
             if kill_hook is not None:
                 kill_hook("pre_flip")
             self._verify_frozen(by_src)
+            t_flip = time.monotonic()
             self.install_topology(new_topo, order=dests + [src] + rest)
+            self._seam("reshard.flip", t_flip, new_topo.epoch,
+                       reason="split")
         except ReshardError as e:
             if not getattr(e, "committed", False):
                 self._rollback(by_src, adopted)
+                self._seam("reshard.rollback", t0, new_topo.epoch,
+                           reason="split")
                 raise
             self.resolve(new_topo)
             raise
         except Exception:
             self._rollback(by_src, adopted)
+            self._seam("reshard.rollback", t0, new_topo.epoch,
+                       reason="split")
             raise
         frozen_ms = (time.monotonic() - t0) * 1000.0
         self._unfreeze(by_src)
+        self._seam("reshard.freeze", t0, new_topo.epoch,
+                   reason="split", frozen_ms=round(frozen_ms, 3))
         if self.evict_grace_s > 0 and evict:
             time.sleep(self.evict_grace_s)
         evict_failed = ""
@@ -513,12 +546,15 @@ class ReshardCoordinator:
         urls = list(topo.urls or self.client.partition_urls)
         urls[index] = new_url.rstrip("/")
         new_topo = topo.evolve(urls=urls)
+        t0 = time.monotonic()
         # re-point the coordinator's OWN routing first (routing-only):
         # the install below reaches the restarted server through its
         # new endpoint instead of the corpse's
         self.client.apply_topology(new_topo, replumb=False)
         self.install_topology(new_topo, strict=False)
         got = self.resolve(new_topo)
+        self._seam("reshard.reroute", t0, new_topo.epoch,
+                   reason="failover", partition=index)
         report = {"reason": "failover", "partition": index,
                   "epoch": new_topo.epoch, "resolve": got}
         self.reports.append(report)

@@ -158,8 +158,16 @@ def _cached_event_bytes(event: Event, version: int = 2) -> bytes:
         return b
     b = event.__dict__.get("_bin_frame")
     if b is None:
+        # the commit-time origin trace context (fleet tracing) rides
+        # INSIDE the ts slot — ``(ts, origin)`` instead of a bare float
+        # — so the 4-tuple wire contract is unchanged for untraced
+        # events and v2 decoders distinguish the shapes by type
+        ts = event.ts
+        origin = getattr(event, "origin", None)
+        if origin is not None:
+            ts = (ts, origin)
         b = codec.encode(
-            (event.type, event.obj, event.old_obj, event.ts))
+            (event.type, event.obj, event.old_obj, ts))
         event.__dict__["_bin_frame"] = b
     return b
 
@@ -561,23 +569,46 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self._inject_fault():
             return
+        from kubernetes_tpu.observability.tracer import (
+            TRACE_HEADER, parse_trace_header, set_request_context)
+
+        ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
+        if ctx is not None:
+            self.server.trace_headers_seen += 1
         tracer = self.server.tracer
         span = None
         if tracer is not None and tracer.enabled \
                 and "watch=" not in self.path:
             # watches are long-running: a span per watch would never
             # close while the stream lives (upstream's longRunning
-            # exemption, applied to tracing too). Request spans are
-            # 1-in-N sampled at the tracer's rate — an unsampled span
-            # per request would wrap the ring in seconds at bench
-            # request rates and evict the sampled pod traces the
-            # recorder exists to keep.
-            rate = tracer.sample_rate
-            if rate >= 1.0 or (rate > 0.0 and
-                               next(self.server._req_seq)
-                               % max(1, round(1.0 / rate)) == 0):
-                span = tracer.span(f"rest.{self.command}",
-                                   path=self.path.split("?", 1)[0])
+            # exemption, applied to tracing too). A propagated context
+            # carries the CLIENT's sampling decision and it wins both
+            # ways: sampled=1 always opens the server-side child span
+            # (bypassing the 1-in-N fallback — the sampled pod's trace
+            # must stitch across every hop), sampled=0 never does.
+            # Context-free requests keep the 1-in-N fallback — an
+            # unsampled span per request would wrap the ring in seconds
+            # at bench request rates and evict the sampled pod traces
+            # the recorder exists to keep.
+            if ctx is not None:
+                if ctx.sampled:
+                    # the wire parent span id is a DIFFERENT process's
+                    # counter (span ids are per-process and collide
+                    # across the fleet), so it rides as an attribute
+                    # and the server span is a local root; the merged
+                    # timeline stitches hops by trace id + ctx_parent.
+                    span = tracer.span(
+                        f"rest.{self.command}", trace=ctx.trace,
+                        path=self.path.split("?", 1)[0],
+                        ctx_parent=ctx.parent)
+            else:
+                rate = tracer.sample_rate
+                if rate >= 1.0 or (rate > 0.0 and
+                                   next(self.server._req_seq)
+                                   % max(1, round(1.0 / rate)) == 0):
+                    span = tracer.span(f"rest.{self.command}",
+                                       path=self.path.split("?", 1)[0])
+        set_request_context(ctx)
         try:
             if span is not None:
                 with span:
@@ -585,6 +616,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._dispatch_gated(inner)
         finally:
+            set_request_context(None)
             wfile = self.wfile
             if isinstance(wfile, _TruncatingWriter):
                 wfile.finish_request()
@@ -1098,7 +1130,19 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_error(400, "BadRequest",
                                      f"invalid window {q['window']!r}")
                     return
-            self._send_json(200, tracer.export_perfetto(window))
+            doc = tracer.export_perfetto(window)
+            # half-RTT clock-offset echo (TraceFederation): the scraper
+            # sends its monotonic send-time as ?echo_mono=; we echo it
+            # beside OUR monotonic clock at export so the scraper can
+            # place this process's spans on its own timeline with a
+            # bounded-skew correction (bound = rtt/2).
+            if q.get("echo_mono"):
+                try:
+                    doc["otherData"]["echo_mono"] = float(q["echo_mono"])
+                except ValueError:
+                    pass
+            doc["otherData"]["server_mono"] = time.monotonic()
+            self._send_json(200, doc)
             return
         if verb == "DELETE":
             tracer.clear()
@@ -1822,15 +1866,29 @@ class _Handler(BaseHTTPRequestHandler):
     def _trace_ingest(self, pods) -> None:
         """Stamp a ``rest.ingest`` instant event for each SAMPLED pod:
         the first hop of a pod's causal trace (REST → queue → solve →
-        bind), keyed by pod uid so the scheduler-side spans stitch."""
+        bind), keyed by pod uid so the scheduler-side spans stitch.
+        A bulk request carries ONE propagated context (trace id = the
+        batch's elected uid); that explicit inbound decision overrides
+        local crc32 for exactly that pod — the rest of the batch keeps
+        the deterministic local decision, which the sender made
+        identically."""
         tracer = self.server.tracer
         if tracer is None or not tracer.enabled:
             return
+        from kubernetes_tpu.observability.tracer import (
+            current_request_context)
+
+        ctx = current_request_context()
+        parent = tracer.current_span_id()
         for p in pods:
             uid = p.metadata.uid
-            if uid and tracer.sampled(uid):
+            if not uid:
+                continue
+            inbound = ctx.sampled if ctx is not None \
+                and ctx.trace == uid else None
+            if tracer.sampled(uid, inbound=inbound):
                 tracer.event(
-                    "rest.ingest", trace=uid,
+                    "rest.ingest", trace=uid, parent_id=parent,
                     pod=f"{p.metadata.namespace}/{p.metadata.name}")
 
     def _bulk_create(self, kind: str, ns: Optional[str], body: dict,
@@ -2667,6 +2725,10 @@ class APIServer(ThreadingHTTPServer):
         import itertools
 
         self._req_seq = itertools.count()   # 1-in-N request-span sampling
+        # propagated-context observability: how many requests arrived
+        # with an X-Ktpu-Trace header (the KTPU_TRACE=off acceptance
+        # asserts this stays 0 — the whole layer sheds on the wire)
+        self.trace_headers_seen = 0
         # self-protection lanes (reference filters/maxinflight.go
         # defaults: --max-requests-inflight 400,
         # --max-mutating-requests-inflight 200); None = unlimited.

@@ -76,6 +76,26 @@ class Event:
     # staleness are both measured against it). 0.0 = synthetic event
     # (informer initial-sync replay, relist diff) — not measured.
     ts: float = 0.0
+    # commit-time origin trace context (the X-Ktpu-Trace header value
+    # of the request whose commit produced this event, when that
+    # request carried a SAMPLED context) — rides the cached binary
+    # watch frame so a watcher can stitch delivery back to the
+    # originating trace across the process boundary. None = untraced.
+    origin: Any = None
+
+
+def _commit_origin():
+    """The sampled inbound trace context of the request committing on
+    this thread (rest.py sets it per request), serialized to its wire
+    form — or None. Read once per dispatch batch."""
+    from kubernetes_tpu.observability.tracer import (
+        current_request_context,
+    )
+
+    ctx = current_request_context()
+    if ctx is not None and ctx.sampled:
+        return ctx.header_value()
+    return None
 
 
 class WatchHandle:
@@ -207,6 +227,8 @@ class ClusterStore:
         self._bump_kind(event.kind)
         if not event.ts and self._freshness.enabled:
             event.ts = time.time()
+        if event.origin is None:
+            event.origin = _commit_origin()
         for w in list(self._watches):
             w.fn(event)
 
@@ -217,16 +239,22 @@ class ClusterStore:
         watchers see the same events one by one."""
         if not events:
             return
-        # commit-time stamp, once per batch (the freshness SLI anchor)
+        # commit-time stamp, once per batch (the freshness SLI anchor
+        # + the origin trace context of the committing request)
+        origin = _commit_origin()
         if self._freshness.enabled:
             now = time.time()
             for e in events:
                 self._bump_kind(e.kind)
                 if not e.ts:
                     e.ts = now
+                if e.origin is None:
+                    e.origin = origin
         else:
             for e in events:
                 self._bump_kind(e.kind)
+                if e.origin is None:
+                    e.origin = origin
         for w in list(self._watches):
             if w.batch_fn is not None:
                 w.batch_fn(events)

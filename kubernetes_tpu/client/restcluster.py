@@ -47,6 +47,12 @@ from kubernetes_tpu.apiserver import codec
 from kubernetes_tpu.apiserver.rest import KIND_TO_PLURAL
 from kubernetes_tpu.apiserver.store import ADDED, DELETED, MODIFIED, Event
 from kubernetes_tpu.client.backoff import Backoff, CircuitBreaker, RetryBudget
+from kubernetes_tpu.observability.tracer import (
+    TRACE_HEADER,
+    format_trace_header,
+    get_tracer,
+    parse_trace_header,
+)
 
 # kinds the scheduler's event handlers consume
 # (eventhandlers.py handle(); reference addAllEventHandlers)
@@ -415,6 +421,33 @@ class RestClusterClient:
 
         fabric_metrics().client_retries_total.inc(verb, reason)
 
+    # -- fleet trace propagation ---------------------------------------
+    @staticmethod
+    def _trace_ctx_for(uids) -> Optional[str]:
+        """Outgoing ``X-Ktpu-Trace`` value for a request touching these
+        trace-id candidates (pod uids where they exist, ns/name keys
+        otherwise), or None when tracing is off / nothing is sampled.
+
+        Bulk discipline: ONE context per object batch — the elected
+        trace id is the first locally-sampled uid (deterministic crc32,
+        so every client elects identically), carrying the EXPLICIT
+        sampled bit; the full sampled-uid list rides as a span
+        attribute on the innermost open span (or one ``client.batch``
+        instant when none is open), never as N headers."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return None
+        uids = list(uids)
+        sampled = [u for u in uids if u and tracer.sampled(u)]
+        if not sampled:
+            return None
+        parent = tracer.current_span_id()
+        if len(uids) > 1:
+            if not tracer.annotate_current(trace_uids=sampled):
+                tracer.event("client.batch", trace=sampled[0],
+                             uids=sampled, n=len(uids))
+        return format_trace_header(sampled[0], parent, True)
+
     @staticmethod
     def _observe_delivery(kind: str, events: List[Event]) -> None:
         """Freshness SLI: commit → decode latency for a decoded watch
@@ -436,12 +469,41 @@ class RestClusterClient:
         except Exception:  # noqa: BLE001 — SLIs must never break watches
             pass
 
+    @staticmethod
+    def _trace_watch_delivery(events: List[Event]) -> None:
+        """Stamp a ``watch.deliver`` span for each event carrying a
+        SAMPLED commit-time origin context: commit → client decode, the
+        cross-process hop of the pod's causal trace. The span's start
+        back-dates by the freshness lag (client wall − commit wall —
+        the processes share a host, so wall clock is the common
+        reference); the explicit inbound bit overrides local crc32."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        now_m = now_w = None
+        for e in events:
+            origin = getattr(e, "origin", None)
+            if not origin:
+                continue
+            ctx = parse_trace_header(origin)
+            if ctx is None \
+                    or not tracer.sampled(ctx.trace,
+                                          inbound=ctx.sampled):
+                continue
+            if now_m is None:
+                now_m, now_w = time.monotonic(), time.time()
+            start = now_m - max(0.0, now_w - e.ts) if e.ts else now_m
+            tracer.record("watch.deliver", start, now_m,
+                          trace=ctx.trace, ctx_parent=ctx.parent,
+                          kind=e.kind)
+
     def _request(self, method: str, path: str, payload: Any = None,
                  charge: float = 1.0, body_binary: Optional[bool] = None,
                  partition: int = 0,
                  route: Optional[Callable[[], int]] = None,
                  raise_on_stale: bool = False,
-                 retries: Optional[int] = None) -> Tuple[int, Any]:
+                 retries: Optional[int] = None,
+                 trace_ctx: Optional[str] = None) -> Tuple[int, Any]:
         if self.limiter is not None:
             self.limiter.charge(charge)
         body_binary = self.binary if body_binary is None else body_binary
@@ -454,6 +516,11 @@ class RestClusterClient:
         lane = "ro" if method in ("GET", "HEAD") else "rw"
         pool = self._pools[(partition, lane)]
         headers = self._headers(body_binary)
+        if trace_ctx:
+            # fleet tracing: propagated context (trace id + parent span
+            # + the explicit sampling decision) — retries re-send the
+            # SAME context, so a retried hop stays one trace
+            headers[TRACE_HEADER] = trace_ctx
         if charge > 1:
             # declare the per-object count so the server's APF width
             # estimation charges proportional seats — the wire half of
@@ -943,7 +1010,8 @@ class RestClusterClient:
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
             {"status": status}, body_binary=False,
-            route=lambda: self._pk("Pod", namespace, name))
+            route=lambda: self._pk("Pod", namespace, name),
+            trace_ctx=self._trace_ctx_for([f"{namespace}/{name}"]))
         if code == 404:
             return False
         self._raise_for(code, payload)
@@ -1188,7 +1256,9 @@ class RestClusterClient:
                                    charge=len(bindings),
                                    partition=partition,
                                    raise_on_stale=self._topology
-                                   is not None)
+                                   is not None,
+                                   trace_ctx=self._trace_ctx_for(
+                                       [b[2] for b in bindings]))
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -1213,7 +1283,8 @@ class RestClusterClient:
         code, payload = self._request(
             "PUT", self._path("Pod", namespace, name, "status"),
             {"status": status}, body_binary=False,
-            route=lambda: self._pk("Pod", namespace, name))
+            route=lambda: self._pk("Pod", namespace, name),
+            trace_ctx=self._trace_ctx_for([f"{namespace}/{name}"]))
         if code == 404:
             return   # pod deleted under us: store semantics are no-op
         self._raise_for(code, payload)
@@ -1248,7 +1319,12 @@ class RestClusterClient:
             "POST", "/api/v1/statuses",
             {"kind": "PodStatusList", "items": updates},
             charge=len(updates), body_binary=False, partition=partition,
-            raise_on_stale=self._topology is not None)
+            raise_on_stale=self._topology is not None,
+            # status items carry no uid: ns/name keys are the trace-id
+            # candidates (deterministic crc32 either way)
+            trace_ctx=self._trace_ctx_for(
+                [f"{u.get('namespace')}/{u.get('name')}"
+                 for u in updates]))
         if code >= 400:
             err = RuntimeError(
                 resp.get("message", f"HTTP {code}")
@@ -1311,7 +1387,8 @@ class RestClusterClient:
     def delete_pod(self, namespace: str, name: str) -> None:
         code, payload = self._request(
             "DELETE", self._path("Pod", namespace, name),
-            route=lambda: self._pk("Pod", namespace, name))
+            route=lambda: self._pk("Pod", namespace, name),
+            trace_ctx=self._trace_ctx_for([f"{namespace}/{name}"]))
         if code >= 400 and code != 404:
             self._raise_for(code, payload)
 
@@ -1347,7 +1424,10 @@ class RestClusterClient:
         code, payload = self._request(
             "POST", self._path(kind, ns),
             obj if self.binary else to_wire(obj),
-            route=lambda: self._pk(kind, ns, obj.metadata.name))
+            route=lambda: self._pk(kind, ns, obj.metadata.name),
+            trace_ctx=self._trace_ctx_for(
+                [getattr(obj.metadata, "uid", "")
+                 or f"{ns}/{obj.metadata.name}"]))
         self._raise_for(code, payload)
         return obj
 
@@ -1392,7 +1472,10 @@ class RestClusterClient:
         code, resp = self._request("POST", self._path(kind, ns), payload,
                                    charge=len(objs), partition=partition,
                                    raise_on_stale=self._topology
-                                   is not None)
+                                   is not None,
+                                   trace_ctx=self._trace_ctx_for(
+                                       [getattr(o.metadata, "uid", "")
+                                        for o in objs]))
         self._raise_for(code, resp)
         return resp.get("created", 0)
 
@@ -1802,6 +1885,15 @@ class RestClusterClient:
         if self.flow_id:
             headers["X-Flow-Id"] = self.flow_id
         headers[codec.VERSION_HEADER] = str(self.codec_version)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # watch handoff carries an explicitly-UNSAMPLED context (a
+            # control-plane call, not a pod trace): the server must
+            # honor the bit and never open a request span for it, and
+            # the KTPU_TRACE=off arm must shed even this header
+            headers[TRACE_HEADER] = format_trace_header(
+                f"watch:{kind}/p{partition}",
+                tracer.current_span_id(), False)
         try:
             conn.request(
                 "GET", f"/api/v1/{plural}?watch=1&resourceVersion={rv}",
@@ -1845,11 +1937,18 @@ class RestClusterClient:
                         for item in batch:
                             if isinstance(item, (bytes, bytearray)):
                                 item = codec.decode(item)
+                            origin = None
                             if len(item) == 4:
                                 t, obj, old, ts = item
+                                if isinstance(ts, tuple):
+                                    # fleet tracing: the commit-time
+                                    # origin context rides inside the
+                                    # ts slot as (ts, origin)
+                                    ts, origin = ts
                             else:
                                 (t, obj, old), ts = item, 0.0
-                            events.append(Event(t, kind, obj, old, ts))
+                            events.append(
+                                Event(t, kind, obj, old, ts, origin))
                     except Exception:  # noqa: BLE001 — torn event
                         return
                 else:
@@ -1868,6 +1967,7 @@ class RestClusterClient:
                     events = [Event(msg["type"], kind, obj,
                                     ts=float(msg.get("commitTs") or 0.0))]
                 self._observe_delivery(kind, events)
+                self._trace_watch_delivery(events)
                 deliver(events)
         finally:
             if stream_key is not None \

@@ -256,6 +256,30 @@ def format_upgrade(info: Optional[Dict]) -> str:
     return "upgrade[" + " ".join(parts) + "]"
 
 
+def format_critpath(info: Optional[Dict]) -> str:
+    """The fleet critical-path segment: which phase owns the sampled
+    pods' end-to-end latency (``top``/``share``), how much of the
+    summed in-flight windows no phase span covers (``unattributed`` —
+    the tracing gap, not a scheduling cost), and the worst clock-skew
+    bound the cross-process merge carried (``skew_ms`` — how far two
+    processes' spans may really be apart). Emitted by bench rows
+    whenever the row collected a fleet trace (the ``critical_path``
+    sub-object); parsed by the generic bracket scan in ``parse_diag``
+    (key ``critpath``) — tools/perf_report.py reads it to gate the
+    ``critpath_flags`` family."""
+    if not info or not info.get("pods"):
+        return ""
+    parts = [
+        f"top={info.get('top') or 'none'}",
+        f"share={float(info.get('top_share', 0.0)):.2f}",
+        f"unattributed={float(info.get('unattributed_share', 0.0)):.2f}",
+        f"skew_ms={float(info.get('max_skew_ms', 0.0)):.1f}",
+    ]
+    if info.get("seam_windows"):
+        parts.append(f"seams={int(info['seam_windows'])}")
+    return "critpath[" + " ".join(parts) + "]"
+
+
 def format_e2e(hist, label: str = "scheduled") -> List[str]:
     """E2e latency segments rendered from the metrics-registry
     histogram itself: interpolated p99 (``quantile``) plus the legacy

@@ -96,6 +96,10 @@ class BenchmarkResult:
     # SLO verdicts) — bench.py attaches this to the row JSON as the
     # ``freshness`` sub-object
     freshness: Dict[str, object] = field(default_factory=dict)
+    # fleet critical-path attribution (per-phase shares of sampled pods'
+    # end-to-end latency, unattributed share, max clock skew) — bench.py
+    # attaches this to the row JSON as the ``critical_path`` sub-object
+    critical_path: Dict[str, object] = field(default_factory=dict)
 
     def data_items(self) -> dict:
         """DataItems JSON shape (util.go:101-129)."""
@@ -171,6 +175,33 @@ def collect_freshness(devprof_summary=None) -> dict:
         return freshness_row_summary(devprof_summary, slos)
     except Exception:  # noqa: BLE001
         return {}
+
+
+def collect_critical_path(remote=(), token: str = "", max_pods: int = 25):
+    """The row's ``critical_path`` sub-object plus the merged fleet
+    trace doc. Always absorbs this process's tracer ring under the
+    ``scheduler`` instance; ``remote`` adds (instance, url) apiserver
+    children to scrape with skew correction. Returns ``({}, None)``
+    when tracing is off or nothing was sampled — attribution must
+    never fail a row."""
+    try:
+        from kubernetes_tpu.observability.fleettrace import (
+            collect_fleet_trace,
+        )
+        from kubernetes_tpu.observability.tracer import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return {}, None
+        doc, cp = collect_fleet_trace(
+            remote=remote, local=[("scheduler", tracer)],
+            token=token, max_pods=max_pods)
+        if not cp.get("pods"):
+            return {}, None
+        row_cp = {k: v for k, v in cp.items() if k != "per_pod"}
+        return row_cp, doc
+    except Exception:  # noqa: BLE001 — attribution must never fail a row
+        return {}, None
 
 
 def run_workload(
@@ -351,6 +382,9 @@ def run_workload(
     }
     dp = get_devprof()
     telemetry = dp.summary() if dp.enabled else {}
+    # single-process rows: every span already lives in this tracer, so
+    # the fleet merge degenerates to one skew-free "scheduler" track
+    critpath, _ = collect_critical_path()
     return BenchmarkResult(
         name=name,
         total_pods=created_pods,
@@ -361,6 +395,7 @@ def run_workload(
         metrics=metrics,
         telemetry=telemetry,
         freshness=collect_freshness(telemetry),
+        critical_path=critpath,
     )
 
 

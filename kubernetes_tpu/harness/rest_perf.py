@@ -34,6 +34,7 @@ store-truth, not client-side optimism.
 
 from __future__ import annotations
 
+import json
 import multiprocessing as mp
 import os
 import tempfile
@@ -314,6 +315,7 @@ def run_workload_rest(
     get_tracer().clear()
     from kubernetes_tpu.harness.perf import (
         attach_slo_baseline,
+        collect_critical_path,
         collect_freshness,
         reset_sli_window,
     )
@@ -525,6 +527,19 @@ def run_workload_rest(
                 apfm.last_snapshot = snap
         except Exception:  # noqa: BLE001 — introspection is best-effort
             pass
+        # fleet trace: scrape the child's /debug/trace ring (with the
+        # half-RTT clock-offset handshake) while it is still alive,
+        # merge with this process's ring, and attribute the sampled
+        # pods' critical path — best-effort like the metrics scrape
+        critpath, fleet_doc = collect_critical_path(
+            remote=[("apiserver", url)], token=SCHEDULER_TOKEN)
+        trace_out = os.environ.get("KTPU_FLEET_TRACE_OUT")
+        if trace_out and fleet_doc is not None:
+            try:
+                with open(trace_out, "w") as f:
+                    json.dump(fleet_doc, f)
+            except Exception:  # noqa: BLE001
+                pass
         if result_hook is not None:
             result_hook(sched, bs)
     except BaseException:
@@ -577,4 +592,5 @@ def run_workload_rest(
         metrics=metrics,
         telemetry=telemetry,
         freshness=collect_freshness(telemetry),
+        critical_path=critpath,
     )

@@ -50,6 +50,7 @@ process that did not restart exactly once all fail ``--strict``.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import shutil
@@ -494,11 +495,24 @@ def _roll_one_partition(fleet: _SpawnedFleet, coordinator, i: int,
         else:
             fleet.quiesce(i)
         old, new_url = fleet.promote(i)
-        coordinator.reroute_after_restart(i, new_url)
+        reroute = coordinator.reroute_after_restart(i, new_url)
         # the write-frozen window ends here: the new process serves
         # unfrozen and every client has been re-pointed
         rec["frozen_ms"] = round((time.monotonic() - t0) * 1000.0, 1)
         rec["rolled"] = True
+        # first-class seam span into the fleet timeline: a sampled pod
+        # whose queue.wait overlaps this roll window names the roll in
+        # its critical path instead of unattributed stall
+        try:
+            from kubernetes_tpu.observability import get_tracer
+
+            get_tracer().record(
+                "upgrade.roll", t0,
+                trace=f"seam:{reroute.get('epoch', 0)}",
+                partition=i, killed=bool(kill),
+                frozen_ms=rec["frozen_ms"])
+        except Exception:  # noqa: BLE001 — tracing must not fail a roll
+            pass
         if not kill:
             # grace before retiring the read-only incumbent: let every
             # client's topology poll observe the new epoch and replumb
@@ -782,6 +796,25 @@ def run_upgrade_roll(
                                 if slo else None),
         }
         result.update(counters)
+        # ---- fleet trace: scrape every partition's /debug/trace with
+        # half-RTT skew correction, absorb the in-parent ring (replica
+        # schedulers + coordinator + replay engine all record there),
+        # merge, and attribute the per-pod cross-process critical path
+        try:
+            from kubernetes_tpu.observability import get_tracer
+            from kubernetes_tpu.observability.fleettrace import (
+                collect_fleet_trace,
+            )
+
+            doc, cp = collect_fleet_trace(
+                remote=[(f"apiserver-{i}", u)
+                        for i, u in enumerate(fleet.urls)],
+                local=[("scheduler", get_tracer())],
+                token=SCHEDULER_TOKEN, max_pods=25)
+            result["fleet_trace_doc"] = doc
+            result["critical_path"] = cp
+        except Exception:  # noqa: BLE001 — tracing must not fail a row
+            pass
         return result
     finally:
         if engine is not None:
@@ -896,6 +929,23 @@ def run_upgrade_row(
         slo = fresh.get("slo") or {}
         row["slo_verdicts_ok"] = res["slo_verdicts_ok"]
         row["slo_gated"] = sorted(slo)
+    cp = res.get("critical_path")
+    if cp:
+        # phase shares / unattributed_share / max_skew_ms ride the row
+        # (perf_report's critpath_flags gates them); the merged Perfetto
+        # doc is written aside when the caller names a destination —
+        # megabytes of spans don't belong in a bench row
+        row["critical_path"] = {k: v for k, v in cp.items()
+                                if k != "per_pod"}
+        out = os.environ.get("KTPU_FLEET_TRACE_OUT")
+        doc = res.get("fleet_trace_doc")
+        if out and doc:
+            try:
+                with open(out, "w") as f:
+                    json.dump(doc, f)
+                row["fleet_trace"] = os.path.basename(out)
+            except OSError:
+                pass
     _upgrade_diag(res)
     if progress:
         progress(f"[upgrade] rolled {res['rolled_partitions']}p+"

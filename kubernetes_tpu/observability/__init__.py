@@ -8,13 +8,19 @@ bench diagnostics (``bench.py``'s ``diag:`` line), and Chrome/Perfetto
 """
 
 from kubernetes_tpu.observability.tracer import (
+    TRACE_HEADER,
     Span,
+    TraceContext,
     Tracer,
+    format_trace_header,
     get_tracer,
+    parse_trace_header,
     set_tracer,
 )
 
 __all__ = ["Span", "Tracer", "get_tracer", "set_tracer",
+           "TRACE_HEADER", "TraceContext", "format_trace_header",
+           "parse_trace_header",
            "get_slo_engine", "set_slo_engine"]
 
 

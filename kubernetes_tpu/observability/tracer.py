@@ -35,7 +35,70 @@ import threading
 import time
 import zlib
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+# Fleet trace propagation (PR 17): every REST request may carry this
+# header so a pod sampled at the ingesting client is sampled in every
+# process it touches. Format: ``<trace>;<parent_span_id>;<0|1>`` —
+# trace id (pod uid where one exists), the sender's span id (kept as a
+# span ATTRIBUTE by the receiver, since span-id counters are
+# per-process and collide across the fleet), and the explicit sampling
+# decision (crc32 head sampling re-derived per-process agrees for pod
+# uids, but bulk verbs and control-plane calls need the bit).
+TRACE_HEADER = "X-Ktpu-Trace"
+
+
+class TraceContext(NamedTuple):
+    """A parsed ``X-Ktpu-Trace`` header: the wire form of one hop of a
+    fleet trace."""
+
+    trace: str
+    parent: int
+    sampled: bool
+
+    def header_value(self) -> str:
+        return format_trace_header(self.trace, self.parent, self.sampled)
+
+
+def format_trace_header(trace: str, parent: int = 0,
+                        sampled: bool = True) -> str:
+    """Serialize a trace context for the ``X-Ktpu-Trace`` header.
+    Semicolons in the trace id would corrupt the frame; uids never
+    contain them, but defend anyway."""
+    return (f"{str(trace).replace(';', '_')};{int(parent)};"
+            f"{1 if sampled else 0}")
+
+
+def parse_trace_header(value: Optional[str]) -> Optional[TraceContext]:
+    """Parse an ``X-Ktpu-Trace`` header value; returns None on any
+    malformed input (propagation is best-effort by contract — a bad
+    header must never fail the request that carried it)."""
+    if not value:
+        return None
+    try:
+        trace, parent, sampled = value.split(";", 2)
+        if not trace or sampled.strip() not in ("0", "1"):
+            return None
+        return TraceContext(trace, int(parent), sampled.strip() == "1")
+    except (ValueError, AttributeError):
+        return None
+
+
+# Thread-local inbound request context: rest.py sets it for the
+# duration of a request handler so commit-time machinery deeper in the
+# stack (store watch dispatch stamping origin context onto events) can
+# read the propagated context without threading a parameter through
+# every store verb. Request handlers run one request per thread, so a
+# plain thread-local is exact.
+_request_ctx = threading.local()
+
+
+def set_request_context(ctx: Optional[TraceContext]) -> None:
+    _request_ctx.ctx = ctx
+
+
+def current_request_context() -> Optional[TraceContext]:
+    return getattr(_request_ctx, "ctx", None)
 
 # record layout (tuples, not objects: ~3x cheaper to build and they
 # never need mutation once finished)
@@ -119,14 +182,23 @@ class Tracer:
         self._phase_hist = _phase_histogram(registry)
 
     # -- sampling ------------------------------------------------------
-    def sampled(self, uid: str) -> bool:
+    def sampled(self, uid: str, inbound: Optional[bool] = None) -> bool:
         """Deterministic head-based sampling decision for a trace id
         (pod uid): every component agrees on the same pods without
         shared state, so sampled traces are complete end-to-end. Runs
         once or twice per scheduled pod on the hot paths — one crc32
-        over a short byte string, no allocation beyond the encode."""
+        over a short byte string, no allocation beyond the encode.
+
+        ``inbound`` is an explicit decision propagated on the wire
+        (``X-Ktpu-Trace``); when present it WINS over local crc32
+        re-derivation both ways — a pod sampled at the ingesting
+        client stays sampled in every process it touches even if
+        seeds/rates disagree, and an unsampled one stays out. A
+        disabled tracer still records nothing."""
         if not self.enabled:
             return False
+        if inbound is not None:
+            return bool(inbound)
         rate = self.sample_rate
         if rate >= 1.0:
             return True
@@ -181,7 +253,8 @@ class Tracer:
                      trace, next(self._ids), parent_id, attrs or None)
 
     def event(self, name: str, trace: str = "",
-              at_mono: Optional[float] = None, **attrs) -> None:
+              at_mono: Optional[float] = None, parent_id: int = 0,
+              **attrs) -> None:
         """Record an instant event (a point in time, no duration).
         ``at_mono`` back-dates the event to an already-captured
         monotonic timestamp (e.g. a Trace step stamped earlier)."""
@@ -189,7 +262,24 @@ class Tracer:
             return
         self._append(name, _PH_INSTANT,
                      time.monotonic() if at_mono is None else at_mono,
-                     0.0, trace, next(self._ids), 0, attrs or None)
+                     0.0, trace, next(self._ids), parent_id,
+                     attrs or None)
+
+    def current_span_id(self) -> int:
+        """Span id of the innermost open span on this thread (0 when
+        none) — what an outgoing request stamps as the wire parent."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1].span_id if stack else 0
+
+    def annotate_current(self, **attrs) -> bool:
+        """Attach attributes to the innermost open span on this thread
+        (e.g. the per-object uid list of a bulk request — ONE attribute
+        on one span, not N headers). False when no span is open."""
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return False
+        stack[-1].set(**attrs)
+        return True
 
     def _append(self, name: str, ph: str, end: float, dur: float,
                 trace: str, span_id: int, parent_id: int,

@@ -360,11 +360,33 @@ class TPUBatchScheduler:
         return pad
 
     # -- the pipelined loop ---------------------------------------------
+    def _trace_cycle(self, start: float, processed: int,
+                     committed: int) -> None:
+        """Batch-level ``queue.cycle`` span covering one drain → solve →
+        commit pass. Carries no pod trace, so critical-path attribution
+        overlays it at the LOWEST priority: it soaks up the per-pod
+        assembly gaps no specific span covers (pop → solve dispatch,
+        solve handle → pending stamp, guard re-probes between commit
+        chunks) without ever masking encode/solve/commit/bind time.
+        Idle passes (nothing drained, nothing committed) stay silent."""
+        if processed == 0 and committed == 0:
+            return
+        try:
+            from kubernetes_tpu.observability import get_tracer
+
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.record("queue.cycle", start, time.monotonic(),
+                              pods=processed, committed=committed)
+        except Exception:   # noqa: BLE001 — tracing must not break cycles
+            pass
+
     def _run_batch_pipelined(self, pop_timeout: Optional[float]) -> int:
         sched = self.sched
         if sched.is_degraded():
             self._degraded_pause(pop_timeout)
             return 0
+        t_cycle = time.monotonic()
         prev = self._pending
         self._pending = None
         self._service_warm_pad()
@@ -498,6 +520,7 @@ class TPUBatchScheduler:
         # failures, or external events show up as extra mutations and
         # invalidate the mirror.
         self.session.note_committed(self._cycle_mutations, seq_anchor)
+        self._trace_cycle(t_cycle, processed, committed)
         return processed
 
     # -- the serialized (kill-switch) loop ------------------------------
@@ -513,6 +536,7 @@ class TPUBatchScheduler:
         if sched.is_degraded():
             self._degraded_pause(pop_timeout)
             return 0
+        t_cycle = time.monotonic()
         self._service_warm_pad()
         qpis = self._drain(pop_timeout)
         processed = len(qpis)
@@ -552,6 +576,7 @@ class TPUBatchScheduler:
                 serial.extend(q for q, _ in batchable)
         self._run_serial(serial)
         self.session.note_committed(self._cycle_mutations, seq_anchor)
+        self._trace_cycle(t_cycle, processed, committed)
         return processed
 
     def pipeline_info(self, telemetry: Optional[Dict] = None

@@ -685,6 +685,74 @@ def upgrade_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def critpath_flags(rounds: List[dict]) -> List[dict]:
+    """The fleet-tracing family's own checks (ISSUE 17 satellite): a
+    bench row that carries a ``critical_path`` sub-object claims its
+    latency is ATTRIBUTED — phase shares over the sampled pods'
+    stitched cross-process span trees. Flag the round when:
+
+    - ``unattributed_share`` > 0.05 (more than 5% of the summed
+      in-flight windows has no covering phase span — the trace has a
+      hole, so the phase shares cannot be trusted);
+    - ``fully_attributed`` < 0.95 (fewer than 95% of sampled pods are
+      individually ≤5% unattributed — the aggregate hides broken pods);
+    - ``max_skew_ms`` exceeds ``skew_bound_ms`` (a scrape's half-RTT
+      clock-offset bound was worse than the merge contract allows —
+      cross-process orderings in the trace are not trustworthy);
+    - a row that should carry a fleet trace lacks one: within rounds
+      where at least one row DOES carry ``critical_path`` (tracing-era
+      rounds — earlier committed artifacts predate the layer and stay
+      green), a headline row measured with the tracer on
+      (``trace_sample_rate`` > 0) or an ``upgrade_roll`` row without
+      the sub-object means the collection silently broke.
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        if not any("critical_path" in row for row in rnd["rows"]):
+            continue
+        for row in rnd["rows"]:
+            if "error" in row:
+                continue
+            metric = str(row.get("metric", ""))
+            cp = row.get("critical_path")
+            problems = []
+            if cp is None:
+                should_carry = (
+                    float(row.get("trace_sample_rate", 0.0) or 0.0) > 0
+                    or metric.startswith("upgrade_roll"))
+                if should_carry:
+                    problems.append(
+                        "row ran with tracing on but carries no "
+                        "critical_path (fleet-trace collection "
+                        "silently broke)")
+            else:
+                unatt = float(cp.get("unattributed_share", 0.0))
+                if unatt > 0.05:
+                    problems.append(
+                        f"unattributed_share {unatt:.3f} > 0.05 "
+                        f"(trace hole — phase shares untrustworthy)")
+                fully = cp.get("fully_attributed")
+                if fully is not None and float(fully) < 0.95:
+                    problems.append(
+                        f"fully_attributed {float(fully):.3f} < 0.95 "
+                        f"(aggregate hides per-pod trace holes)")
+                skew = float(cp.get("max_skew_ms", 0.0))
+                bound = float(cp.get("skew_bound_ms", 50.0))
+                if skew > bound:
+                    problems.append(
+                        f"max_skew_ms {skew:.3f} > bound {bound:.1f} "
+                        f"(cross-process ordering not trustworthy)")
+            if problems:
+                flags.append({
+                    "metric": metric,
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0) or 0.0),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -765,6 +833,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sus_flags = sustained_flags(rounds)
     hot_flags = hotspot_flags(rounds)
     upg_flags = upgrade_flags(rounds)
+    crit_flags = critpath_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -784,6 +853,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "sustained_flags": sus_flags,
             "hotspot_flags": hot_flags,
             "upgrade_flags": upg_flags,
+            "critpath_flags": crit_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -818,6 +888,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in upg_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if crit_flags:
+            print("\nfleet-trace critical-path flags:")
+            for f in crit_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -829,7 +904,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 1 if (args.strict
                  and (open_flags or scale_flags or dev_flags
                       or rep_flags or sus_flags or hot_flags
-                      or upg_flags)) else 0
+                      or upg_flags or crit_flags)) else 0
 
 
 if __name__ == "__main__":

@@ -119,6 +119,80 @@ def slowest_pods(events: List[dict], top: int = 5) -> str:
     return "\n".join(lines) if lines else "(no pod-level traces in dump)"
 
 
+def fleet_report(path: str, top: int = 5) -> str:
+    """Cross-process view of a MERGED fleet dump (``TraceFederation.
+    merged()``): per-process tracks with their clock-offset/skew
+    corrections, then the critical-path attribution table — which
+    phase owns the sampled pods' end-to-end latency, fleet-wide."""
+    import os
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from kubernetes_tpu.observability.fleettrace import critical_path
+
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a trace_event dump "
+                         "(no traceEvents array)")
+    instances = (doc.get("otherData") or {}).get("instances") or {}
+    if not instances:
+        raise ValueError(
+            f"{path}: not a merged fleet dump (no otherData.instances) "
+            "— use the plain single-process report instead")
+    lines = [f"fleet trace: {path}",
+             f"{len(events)} events across {len(instances)} processes",
+             "",
+             "== per-process tracks ==",
+             f"{'instance':<20}{'events':>8}{'offset_ms':>12}"
+             f"{'skew_ms':>10}"]
+    for name in sorted(instances):
+        meta = instances[name]
+        n = sum(1 for e in events
+                if (e.get("args") or {}).get("instance") == name)
+        lines.append(
+            f"{name:<20}{n:>8}"
+            f"{meta.get('offset_s', 0.0) * 1000.0:>12.3f}"
+            f"{meta.get('skew_ms', 0.0):>10.3f}")
+    errors = (doc.get("otherData") or {}).get("scrape_errors") or []
+    for err in errors:
+        lines.append(f"  scrape error: {err}")
+    cp = critical_path(doc, max_pods=top)
+    lines += ["",
+              "== critical-path attribution "
+              f"({cp['pods']} sampled pods, "
+              f"{cp['fully_attributed']:.0%} fully attributed) ==",
+              f"{'phase':<12}{'share':>10}"]
+    for phase, share in sorted(cp["phase_shares"].items(),
+                               key=lambda kv: -kv[1]):
+        lines.append(f"{phase:<12}{share:>10.1%}")
+    lines.append(f"{'(unattrib.)':<12}"
+                 f"{cp['unattributed_share']:>10.1%}")
+    lines += ["",
+              f"top phase: {cp['top'] or '(none)'} "
+              f"({cp['top_share']:.1%}); "
+              f"max skew {cp['max_skew_ms']:.3f}ms "
+              f"(bound {cp['skew_bound_ms']:.1f}ms)"]
+    if cp.get("seam_windows"):
+        lines.append(f"seam windows overlapped: {cp['seam_windows']}")
+    if cp.get("per_pod"):
+        lines += ["", f"== top-{top} pods by in-flight window =="]
+        shown = sorted(cp["per_pod"],
+                       key=lambda p: -p.get("window_ms", 0.0))[:top]
+        for p in shown:
+            phases = " ".join(
+                f"{k}={v:.1f}ms" for k, v in sorted(
+                    p.get("phases_ms", {}).items(),
+                    key=lambda kv: -kv[1]))
+            inst = ",".join(p.get("instances", []))
+            lines.append(
+                f"pod {p['trace']}  window {p['window_ms']:.2f}ms  "
+                f"top {p['top'] or '(none)'}  "
+                f"unattributed {p['unattributed_share']:.1%}  "
+                f"[{inst}]  {phases}")
+    return "\n".join(lines)
+
+
 def report(path: str, top: int = 5) -> str:
     events = load_events(path)
     spans = sum(1 for e in events if e["ph"] == "X")
@@ -140,9 +214,16 @@ def main(argv=None) -> int:
     ap.add_argument("dump", help="path to a flight-recorder JSON dump")
     ap.add_argument("--top", type=int, default=5,
                     help="how many slowest pods to show")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat the dump as a merged fleet trace "
+                         "(TraceFederation.merged()) and render the "
+                         "cross-process critical-path table")
     args = ap.parse_args(argv)
     try:
-        print(report(args.dump, top=args.top))
+        if args.fleet:
+            print(fleet_report(args.dump, top=args.top))
+        else:
+            print(report(args.dump, top=args.top))
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
