@@ -680,8 +680,9 @@ def main() -> None:
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
                        "autoscale", "scale10x", "devscale", "sustained",
-                       "hotspot", "upgrade", "replay:storm",
-                       "replay:gangs", "replay:tenancy"])
+                       "hotspot", "upgrade", "federation",
+                       "replay:storm", "replay:gangs",
+                       "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
                     help="trace seed for the replay:<family> rows "
                          "(same seed + trace → identical arrivals)")
@@ -819,6 +820,31 @@ def main() -> None:
         else:
             row = run_upgrade_row(progress=log)
         print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "federation":
+        # the federated multi-cluster rows (ISSUE 18): three spawned
+        # clusters (each its own apiserver + scheduler) behind the
+        # federation tier, one open-loop storm each across two cells —
+        # saturation spillover (cluster 0 pinned past capacity;
+        # overflow must land remotely with the saturated cell's own
+        # SLOs green) and cluster-loss (a whole cluster SIGKILLed
+        # mid-storm; every orphan re-placed onto survivors within the
+        # recovery budget). Verdict surface = zero lost pods
+        # fleet-wide, gang atomicity across clusters, relists confined
+        # to the dead cell, recovery ratio ≥ 0.8 — gated by
+        # perf_report's federation_flags
+        from kubernetes_tpu.harness.federation import run_federation_row
+
+        for mode in ("spill", "loss"):
+            if args.quick:
+                row = run_federation_row(pods=400, qps=100.0,
+                                         mode=mode, max_batch=128,
+                                         wait_timeout=300,
+                                         progress=log)
+            else:
+                row = run_federation_row(mode=mode, progress=log)
+            print(json.dumps(row), flush=True)
         return
 
     if args.config == "traceab":

@@ -124,6 +124,41 @@ def _sever(conn) -> None:
         pass
 
 
+def elect_trace_uid(uids) -> Optional[str]:
+    """The trace-id election every client performs identically: the
+    first locally-sampled uid (deterministic crc32 sampling), or None
+    when tracing is off / nothing sampled. Shared with the federation
+    tier so a cross-cluster hop elects the SAME trace id the
+    per-cluster client will stamp on the wire."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return None
+    for u in uids:
+        if u and tracer.sampled(u):
+            return u
+    return None
+
+
+def elect_trace_context(uids) -> Optional[str]:
+    """Outgoing ``X-Ktpu-Trace`` value for a request touching these
+    trace-id candidates (the bulk discipline: ONE context per batch,
+    parented to the innermost open span). See
+    ``RestClusterClient._trace_ctx_for`` for the contract text."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return None
+    uids = list(uids)
+    sampled = [u for u in uids if u and tracer.sampled(u)]
+    if not sampled:
+        return None
+    parent = tracer.current_span_id()
+    if len(uids) > 1:
+        if not tracer.annotate_current(trace_uids=sampled):
+            tracer.event("client.batch", trace=sampled[0],
+                         uids=sampled, n=len(uids))
+    return format_trace_header(sampled[0], parent, True)
+
+
 def _key_of(obj) -> tuple:
     return (getattr(obj.metadata, "namespace", ""), obj.metadata.name)
 
@@ -434,19 +469,7 @@ class RestClusterClient:
         sampled bit; the full sampled-uid list rides as a span
         attribute on the innermost open span (or one ``client.batch``
         instant when none is open), never as N headers."""
-        tracer = get_tracer()
-        if not tracer.enabled:
-            return None
-        uids = list(uids)
-        sampled = [u for u in uids if u and tracer.sampled(u)]
-        if not sampled:
-            return None
-        parent = tracer.current_span_id()
-        if len(uids) > 1:
-            if not tracer.annotate_current(trace_uids=sampled):
-                tracer.event("client.batch", trace=sampled[0],
-                             uids=sampled, n=len(uids))
-        return format_trace_header(sampled[0], parent, True)
+        return elect_trace_context(uids)
 
     @staticmethod
     def _observe_delivery(kind: str, events: List[Event]) -> None:

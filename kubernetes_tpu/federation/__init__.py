@@ -1,0 +1,43 @@
+"""Federated multi-cluster tier: K independent partitioned clusters
+behind a cross-cluster client, a what-if federation scheduler, and a
+cluster-granularity rebalancer. Federation is an optimizer, never a
+single point of failure — every cell keeps scheduling locally when
+this layer is down."""
+
+from kubernetes_tpu.federation.client import (
+    FederatedClusterClient,
+    HomeMap,
+)
+from kubernetes_tpu.federation.ledger import (
+    CapacityLedger,
+    ClusterCapacity,
+)
+from kubernetes_tpu.federation.rebalancer import ClusterRebalancer
+from kubernetes_tpu.federation.scheduler import (
+    GANG_NAME_LABEL,
+    REMOTE_CLUSTER_PENALTY,
+    SATURATION_PENALTY,
+    FederationPolicy,
+    FederationScheduler,
+    FederationUnavailable,
+    Placement,
+    PlacementUnit,
+    group_units,
+)
+
+__all__ = [
+    "CapacityLedger",
+    "ClusterCapacity",
+    "ClusterRebalancer",
+    "FederatedClusterClient",
+    "FederationPolicy",
+    "FederationScheduler",
+    "FederationUnavailable",
+    "GANG_NAME_LABEL",
+    "HomeMap",
+    "Placement",
+    "PlacementUnit",
+    "REMOTE_CLUSTER_PENALTY",
+    "SATURATION_PENALTY",
+    "group_units",
+]

@@ -1,0 +1,397 @@
+"""Cross-cluster client: route / fan-in / merge at cluster granularity.
+
+``RestClusterClient`` routes each object to the partition that owns
+its hash slot, fans list/watch over every partition, and merges the
+results behind one store-shaped surface. This module is the same shape
+one level up: a :class:`FederatedClusterClient` routes each CREATE to
+the cluster the federation scheduler chose, fans list/watch over every
+live cluster, and remembers the route so deletes and failover find the
+object again. The replay engine (and anything else speaking the store
+surface) drives a whole federation exactly like one cluster.
+
+Robustness contracts:
+
+- **never lost**: a unit no live cluster fits falls back to its home
+  cluster and PENDS there (its own scheduler binds it when capacity
+  frees) — routing never drops a pod;
+- **gang continuity**: the first chunk carrying a gang member decides
+  the gang's cluster; later chunks route to the recorded home, so a
+  gang can never straddle clusters across chunk boundaries;
+- **failover**: ``failover_cluster(cid)`` re-creates the dead cell's
+  registered pods (unbound copies, same NAMES — the chaos suites'
+  lost-pod invariant is name-based) on survivors and stops only the
+  dead cell's watch, so relists stay confined to the dead cluster;
+- **degradation**: when the federation scheduler is down (or raises),
+  routing falls back to home-cluster hashing — each cell keeps
+  scheduling locally; federation is an optimizer, never a SPOF.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import shallow_copy
+from kubernetes_tpu.client.restcluster import elect_trace_uid
+from kubernetes_tpu.federation.ledger import CapacityLedger
+from kubernetes_tpu.federation.scheduler import (
+    GANG_NAME_LABEL,
+    FederationScheduler,
+    FederationUnavailable,
+)
+from kubernetes_tpu.harness.burst import create_chunk
+
+
+class HomeMap:
+    """Namespace → home-cluster affinity, deterministic by default
+    (crc32 hash over the registered clusters) and rewritable by the
+    ``ClusterRebalancer``: ``split`` releases a namespace to free
+    placement (the spread set), ``move`` pins it to a new home."""
+
+    def __init__(self, clusters: Sequence[int],
+                 pin: Optional[Dict[str, int]] = None):
+        self._clusters = sorted(clusters)
+        self.pin = dict(pin or {})
+        self.spread: set = set()
+        self.overrides: Dict[str, int] = {}
+
+    def home_of(self, namespace: str) -> Optional[int]:
+        ns = namespace or "default"
+        if ns in self.spread:
+            return None
+        if ns in self.overrides:
+            return self.overrides[ns]
+        if ns in self.pin:
+            return self.pin[ns]
+        if not self._clusters:
+            return None
+        return self._clusters[
+            zlib.crc32(ns.encode()) % len(self._clusters)]
+
+
+def _unbound_copy(pod):
+    """A re-creatable copy with the bind cleared (the simulator's
+    scale-down discipline): shallow copy + fresh spec so the original
+    object is never mutated."""
+    p = shallow_copy(pod)
+    p.spec = copy.copy(pod.spec)
+    p.spec.node_name = ""
+    return p
+
+
+class _FederatedWatchHandle:
+    """One stop() over the per-cluster watch handles."""
+
+    def __init__(self, client: "FederatedClusterClient", key: int):
+        self._client = client
+        self._key = key
+
+    def stop(self) -> None:
+        self._client._stop_watch_group(self._key)
+
+
+class FederatedClusterClient:
+    """Store-shaped client over K clusters. ``clusters`` maps cluster
+    id → any store-surface client (``ClusterStore`` in-process,
+    ``RestClusterClient`` against a spawned cell)."""
+
+    def __init__(self, clusters: Dict[int, object],
+                 scheduler: FederationScheduler,
+                 ledger: CapacityLedger,
+                 home_map: Optional[HomeMap] = None):
+        self.clusters = dict(clusters)
+        self.scheduler = scheduler
+        self.ledger = ledger
+        self.home_map = home_map or HomeMap(sorted(self.clusters))
+        for cid in self.clusters:
+            ledger.register(cid)
+        self._lock = threading.Lock()
+        # (namespace, name) → cluster id, the route registry
+        self._route: Dict[Tuple[str, str], int] = {}
+        # (namespace, name) → unbound copy, the failover inventory
+        self._inventory: Dict[Tuple[str, str], object] = {}
+        self._gang_home: Dict[str, int] = {}
+        # watch fan-out bookkeeping: group key → {cid: handle}
+        self._watch_groups: Dict[int, Dict[int, object]] = {}
+        self._watch_seq = 0
+        # counters (the diag/bench surface)
+        self.placements = 0
+        self.spilled = 0
+        self.fallback_placements = 0
+        self.failovers = 0
+        self.failover_replaced = 0
+
+    # ------------------------------------------------------------------
+    # routing helpers
+
+    def _fallback_home(self, namespace: str) -> int:
+        """Degradation-mode routing: the namespace's home if alive,
+        else a deterministic hash over the live clusters — every
+        client elects the same survivor without coordination."""
+        live = self.ledger.live_clusters() or sorted(self.clusters)
+        home = self.home_map.home_of(namespace)
+        if home is not None and home in live:
+            return home
+        ns = namespace or "default"
+        return live[zlib.crc32(b"fed:" + ns.encode()) % len(live)]
+
+    def route_of(self, namespace: str, name: str) -> Optional[int]:
+        with self._lock:
+            return self._route.get((namespace or "default", name))
+
+    # ------------------------------------------------------------------
+    # store surface: create
+
+    def create_pods(self, pods: Sequence) -> List:
+        """Route one create chunk across the federation. Gangs whose
+        home is already recorded ride straight there (continuity);
+        the rest go through the federation scheduler, falling back to
+        home hashing when the layer is down or errors."""
+        pods = list(pods)
+        routed: Dict[int, List] = {}
+        to_place: List = []
+        live = set(self.ledger.live_clusters())
+        with self._lock:
+            for pod in pods:
+                gang = (pod.metadata.labels or {}).get(
+                    GANG_NAME_LABEL, "")
+                cid = self._gang_home.get(gang) if gang else None
+                if cid is not None and cid in live:
+                    routed.setdefault(cid, []).append(pod)
+                else:
+                    to_place.append(pod)
+        # gang-continuity routes bypass the scheduler, so reserve their
+        # capacity here (scheduler/fallback paths reserve their own)
+        for cid, group in routed.items():
+            self.ledger.note_admitted(cid, group)
+        if to_place:
+            for cid, placed in self._place(to_place).items():
+                routed.setdefault(cid, []).extend(placed)
+        created: List = []
+        stranded: List = []
+        for cid, group in sorted(routed.items()):
+            for acid, sent in self._send(cid, group).items():
+                created.extend(sent)
+                with self._lock:
+                    # liveness re-checked INSIDE the registry lock:
+                    # ``failover_cluster`` marks dead strictly before
+                    # its sweep takes this lock, so a route recorded
+                    # after the sweep must observe the death here —
+                    # the create-vs-failover race cannot strand a pod
+                    # on a dead cell unnoticed
+                    alive = self.ledger.alive(acid)
+                    for pod in sent:
+                        key = (pod.metadata.namespace or "default",
+                               pod.metadata.name)
+                        if not alive:
+                            stranded.append(pod)
+                            continue
+                        self._route[key] = acid
+                        self._inventory[key] = _unbound_copy(pod)
+                        gang = (pod.metadata.labels or {}).get(
+                            GANG_NAME_LABEL, "")
+                        if gang:
+                            self._gang_home[gang] = acid
+                    self.placements += len(sent)
+        if stranded:
+            # the cell died between routing and registration (its
+            # failover sweep predates these routes): rescue now —
+            # re-place unbound copies on the survivors
+            self.create_pods([_unbound_copy(p) for p in stranded])
+        return created
+
+    def _send(self, cid: int, group: List) -> Dict[int, List]:
+        """Deliver one routed group, surviving a cell that dies
+        between routing and send: mark it dead and re-route the group
+        onto survivors (a second failure propagates — the engine's
+        send_errors surface owns it). Returns {actual cid: pods}."""
+        try:
+            create_chunk(self.clusters[cid], group)
+            return {cid: group}
+        except Exception:  # noqa: BLE001 — the cell died mid-send
+            self.ledger.mark_dead(cid)
+            rerouted: Dict[int, List] = {}
+            for pod in group:
+                alt = self._fallback_home(
+                    pod.metadata.namespace or "default")
+                rerouted.setdefault(alt, []).append(pod)
+            for alt, g in rerouted.items():
+                create_chunk(self.clusters[alt], g)
+                self.ledger.note_admitted(alt, g)
+            with self._lock:
+                self.fallback_placements += len(group)
+            return rerouted
+
+    def _place(self, pods: List) -> Dict[int, List]:
+        """Scheduler placement with the degradation fallback; opens a
+        ``fed.route`` span around the cross-cluster decision so the
+        downstream per-cluster client's ``X-Ktpu-Trace`` parents under
+        it (attribution across the hop)."""
+        from kubernetes_tpu.observability import get_tracer
+
+        uid = elect_trace_uid(
+            p.metadata.uid or f"{p.metadata.namespace}/{p.metadata.name}"
+            for p in pods)
+        routed: Dict[int, List] = {}
+        try:
+            with get_tracer().span("fed.route", trace=uid or "",
+                                   pods=len(pods)):
+                placements = self.scheduler.place(
+                    pods, trace_uid=uid or "")
+            for pl in placements:
+                cid = pl.cluster
+                if cid is None:
+                    # no live cluster fits: park at home, where the
+                    # unit pends until capacity frees — never dropped
+                    cid = pl.home if pl.home is not None \
+                        else self._fallback_home(pl.unit.namespace)
+                if pl.spilled:
+                    self.spilled += len(pl.unit.pods)
+                routed.setdefault(cid, []).extend(pl.unit.pods)
+            return routed
+        except Exception as e:  # noqa: BLE001 — ANY scheduler failure
+            # degrades to home routing; federation is never a SPOF
+            if not isinstance(e, FederationUnavailable):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "federation place failed (%s); home fallback", e)
+            by_home: Dict[int, List] = {}
+            for pod in pods:
+                cid = self._fallback_home(
+                    pod.metadata.namespace or "default")
+                by_home.setdefault(cid, []).append(pod)
+            with self._lock:
+                self.fallback_placements += len(pods)
+            for cid, group in by_home.items():
+                self.ledger.note_admitted(cid, group)
+            return by_home
+
+    # ------------------------------------------------------------------
+    # store surface: delete / read / watch
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = (namespace or "default", name)
+        with self._lock:
+            cid = self._route.get(key)
+            self._inventory.pop(key, None)
+        if cid is None:
+            return
+        self.clusters[cid].delete_pod(namespace, name)
+
+    def delete_pods(self, keys: Sequence[Tuple[str, str]]) -> None:
+        by_cid: Dict[int, List[Tuple[str, str]]] = {}
+        with self._lock:
+            for ns, name in keys:
+                key = (ns or "default", name)
+                cid = self._route.get(key)
+                self._inventory.pop(key, None)
+                if cid is not None:
+                    by_cid.setdefault(cid, []).append((ns, name))
+        for cid, group in by_cid.items():
+            self.clusters[cid].delete_pods(group)
+
+    def list_pods(self) -> List:
+        out: List = []
+        for cid in self.ledger.live_clusters():
+            try:
+                out.extend(self.clusters[cid].list_pods())
+            except Exception:  # noqa: BLE001 — a cell dying mid-list
+                pass           # is the chaos family's normal weather
+        return out
+
+    def list_nodes(self) -> List:
+        out: List = []
+        for cid in self.ledger.live_clusters():
+            try:
+                out.extend(self.clusters[cid].list_nodes())
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+    def watch(self, fn: Callable, batch_fn: Optional[Callable] = None):
+        """Fan the watch over every live cluster; the returned handle
+        stops them all. Per-cluster handles stay addressable so
+        ``failover_cluster`` can stop ONLY the dead cell's stream
+        (relists confined to the dead cluster)."""
+        with self._lock:
+            self._watch_seq += 1
+            key = self._watch_seq
+            group: Dict[int, object] = {}
+            self._watch_groups[key] = group
+        for cid in self.ledger.live_clusters():
+            group[cid] = self.clusters[cid].watch(fn, batch_fn=batch_fn)
+        return _FederatedWatchHandle(self, key)
+
+    def _stop_watch_group(self, key: int) -> None:
+        with self._lock:
+            group = self._watch_groups.pop(key, {})
+        for handle in group.values():
+            try:
+                handle.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # failover (the cluster-loss chaos path)
+
+    def failover_cluster(self, cid: int,
+                         progress: Optional[Callable] = None) -> int:
+        """Re-place every pod registered to a dead cluster onto the
+        survivors: unbound copies, SAME names (name-keyed lost
+        accounting counts the rescue), routed through the federation
+        scheduler with the dead column disabled. Stops the dead cell's
+        watch streams first so surviving streams never relist. Returns
+        the number of pods re-created."""
+        import time
+
+        from kubernetes_tpu.observability import get_tracer
+
+        t0 = time.monotonic()
+        self.ledger.mark_dead(cid)
+        with self._lock:
+            for group in self._watch_groups.values():
+                handle = group.pop(cid, None)
+                if handle is not None:
+                    try:
+                        handle.stop()
+                    except Exception:  # noqa: BLE001 — the cell is
+                        pass           # dead; its stream may be too
+            orphans = [
+                self._inventory[key]
+                for key, owner in self._route.items()
+                if owner == cid and key in self._inventory
+            ]
+            # drop the dead routes; create_pods re-records survivors
+            for key, owner in list(self._route.items()):
+                if owner == cid:
+                    del self._route[key]
+            for gang, owner in list(self._gang_home.items()):
+                if owner == cid:
+                    del self._gang_home[gang]
+        if progress:
+            progress(f"federation: failover cluster {cid}, "
+                     f"{len(orphans)} pods to re-place")
+        replaced = 0
+        if orphans:
+            replaced = len(self.create_pods(orphans))
+        with self._lock:
+            self.failovers += 1
+            self.failover_replaced += replaced
+        get_tracer().record(
+            "fed.failover", t0, trace=f"seam:fed-{cid}",
+            cluster=cid, replaced=replaced)
+        return replaced
+
+    # ------------------------------------------------------------------
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "placements": self.placements,
+                "spilled": self.spilled,
+                "fallback_placements": self.fallback_placements,
+                "failovers": self.failovers,
+                "failover_replaced": self.failover_replaced,
+            }

@@ -1,0 +1,240 @@
+"""Per-cluster capacity + write ledgers (the federation tier's facts).
+
+The partitioned control plane already keeps per-slot write ledgers
+(``PartitionRebalancer`` reads them as rate deltas); the federated
+tier needs the same discipline one level up — per-CLUSTER ledgers that
+answer the two questions the federation layer asks:
+
+- **placement**: how much capacity does each cluster have left right
+  now (the what-if solver's synthetic cluster-node allocatable), and
+  is it alive at all;
+- **rebalancing**: which cluster / which tenant is taking the writes
+  (``ClusterRebalancer`` feeds these counters to ``plan_rebalance``
+  exactly like slot ledgers).
+
+Capacity is observed (``refresh_from`` over a cluster's node/pod
+lists) plus reserved (``note_admitted`` for placements the federation
+layer has routed but the cell's own scheduler hasn't bound yet).
+Reservations are pod-keyed: a refresh drops exactly the reservations
+its observed pod list accounts for — a reservation noted AFTER the
+list snapshot was read survives the refresh, so a placement landing
+mid-refresh can never be double-spent (blanket-clearing here once let
+the spill storm overcommit the saturated cell by one pod). jax-free
+by design — the harness's liveness-probe thread and the REST children
+import this.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.scheduler.types import (
+    Resource,
+    compute_pod_resource_request,
+)
+
+
+@dataclass
+class ClusterCapacity:
+    """One cluster's observed capacity snapshot + liveness."""
+
+    cluster: int
+    alive: bool = True
+    nodes: int = 0
+    allocatable_milli: int = 0
+    allocatable_mem: int = 0
+    used_milli: int = 0
+    used_mem: int = 0
+    bound: int = 0
+    pending: int = 0
+    # in-flight admissions the observed pod list does not account for
+    # yet; aggregates of the ledger's pod-keyed reservation map, and
+    # decayed per-pod as refreshes observe each routed pod
+    admitted_milli: int = 0
+    admitted_mem: int = 0
+    admitted_pods: int = 0
+
+    def remaining(self) -> Tuple[int, int]:
+        """(milli-cpu, memory bytes) still uncommitted — observed usage
+        AND in-flight reservations both subtract, so two placement
+        rounds between refreshes cannot both spend the same capacity."""
+        milli = self.allocatable_milli - self.used_milli \
+            - self.admitted_milli
+        mem = self.allocatable_mem - self.used_mem - self.admitted_mem
+        return max(milli, 0), max(mem, 0)
+
+    def utilization(self) -> float:
+        """Committed share of cpu capacity (reservations included); a
+        cluster with no observed capacity reads fully utilized — the
+        saturation penalty then steers placements away until a refresh
+        says otherwise."""
+        if self.allocatable_milli <= 0:
+            return 1.0
+        return (self.used_milli + self.admitted_milli) \
+            / self.allocatable_milli
+
+
+class CapacityLedger:
+    """Thread-safe per-cluster ledgers: capacity for the federation
+    scheduler, cumulative write counters (per cluster and per
+    namespace) for the ``ClusterRebalancer``'s rate deltas, and
+    liveness flags fed by the harness's probe loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._caps: Dict[int, ClusterCapacity] = {}
+        # cluster → pod key → (milli, mem): the in-flight reservations
+        # backing the admitted_* aggregates, so a refresh can release
+        # exactly the pods its observed list covers
+        self._admitted: Dict[int, Dict[str, Tuple[int, int]]] = {}
+        self._writes: Dict[int, float] = {}
+        self._ns_writes: Dict[str, float] = {}
+
+    @staticmethod
+    def _pod_key(pod) -> str:
+        return pod.metadata.uid or (
+            f"{pod.metadata.namespace or 'default'}/{pod.metadata.name}")
+
+    # -- membership / liveness -----------------------------------------
+    def register(self, cluster: int) -> None:
+        with self._lock:
+            if cluster not in self._caps:
+                self._caps[cluster] = ClusterCapacity(cluster=cluster)
+                self._writes[cluster] = 0.0
+
+    def clusters(self) -> List[int]:
+        with self._lock:
+            return sorted(self._caps)
+
+    def live_clusters(self) -> List[int]:
+        with self._lock:
+            return sorted(c for c, cap in self._caps.items()
+                          if cap.alive)
+
+    def dead_clusters(self) -> List[int]:
+        with self._lock:
+            return sorted(c for c, cap in self._caps.items()
+                          if not cap.alive)
+
+    def alive(self, cluster: int) -> bool:
+        with self._lock:
+            cap = self._caps.get(cluster)
+            return cap is not None and cap.alive
+
+    def mark_dead(self, cluster: int) -> None:
+        with self._lock:
+            if cluster in self._caps:
+                self._caps[cluster].alive = False
+
+    def mark_alive(self, cluster: int) -> None:
+        with self._lock:
+            if cluster in self._caps:
+                self._caps[cluster].alive = True
+
+    # -- capacity -------------------------------------------------------
+    def refresh_from(self, cluster: int, nodes, pods) -> ClusterCapacity:
+        """Recompute a cluster's capacity from its live node/pod lists
+        (one poll tick of the harness's ledger thread, or the
+        in-process cells' direct store reads). Releases the in-flight
+        reservations the observed pod list accounts for — and ONLY
+        those: a pod routed after the caller read its list is not in
+        ``pods`` yet, and clearing its reservation anyway would let the
+        next placement spend the same capacity twice."""
+        alloc_milli = alloc_mem = 0
+        for node in nodes:
+            r = Resource.from_resource_list(node.status.allocatable)
+            alloc_milli += r.milli_cpu
+            alloc_mem += r.memory
+        used_milli = used_mem = bound = pending = 0
+        observed = set()
+        for pod in pods:
+            req = compute_pod_resource_request(pod)
+            observed.add(self._pod_key(pod))
+            if pod.spec.node_name:
+                bound += 1
+                used_milli += req.milli_cpu
+                used_mem += req.memory
+            else:
+                pending += 1
+                # a pending pod is capacity already spoken for on this
+                # cluster — its own scheduler will bind it
+                used_milli += req.milli_cpu
+                used_mem += req.memory
+        with self._lock:
+            cap = self._caps.setdefault(
+                cluster, ClusterCapacity(cluster=cluster))
+            cap.nodes = len(list(nodes)) if not hasattr(nodes, "__len__") \
+                else len(nodes)
+            cap.allocatable_milli = alloc_milli
+            cap.allocatable_mem = alloc_mem
+            cap.used_milli = used_milli
+            cap.used_mem = used_mem
+            cap.bound = bound
+            cap.pending = pending
+            slot = self._admitted.get(cluster)
+            if slot:
+                for key in [k for k in slot if k in observed]:
+                    del slot[key]
+            ents = self._admitted.get(cluster) or {}
+            cap.admitted_milli = sum(m for m, _ in ents.values())
+            cap.admitted_mem = sum(me for _, me in ents.values())
+            cap.admitted_pods = len(ents)
+            return ClusterCapacity(**vars(cap))
+
+    def note_admitted(self, cluster: int, pods) -> None:
+        """Reserve capacity for pods the federation layer just routed
+        to ``cluster`` (and count the writes for the rebalancer).
+        Reservations are pod-keyed; re-reserving the same pod replaces
+        its entry rather than double-counting it."""
+        entries: List[Tuple[str, int, int]] = []
+        ns_counts: Dict[str, int] = {}
+        for pod in pods:
+            req = compute_pod_resource_request(pod)
+            entries.append(
+                (self._pod_key(pod), req.milli_cpu, req.memory))
+            ns = pod.metadata.namespace or "default"
+            ns_counts[ns] = ns_counts.get(ns, 0) + 1
+        with self._lock:
+            cap = self._caps.setdefault(
+                cluster, ClusterCapacity(cluster=cluster))
+            slot = self._admitted.setdefault(cluster, {})
+            for key, milli, mem in entries:
+                old = slot.get(key)
+                if old is not None:
+                    cap.admitted_milli -= old[0]
+                    cap.admitted_mem -= old[1]
+                    cap.admitted_pods -= 1
+                slot[key] = (milli, mem)
+                cap.admitted_milli += milli
+                cap.admitted_mem += mem
+                cap.admitted_pods += 1
+            self._writes[cluster] = \
+                self._writes.get(cluster, 0.0) + len(entries)
+            for ns, c in ns_counts.items():
+                self._ns_writes[ns] = self._ns_writes.get(ns, 0.0) + c
+
+    def capacity(self, cluster: int) -> Optional[ClusterCapacity]:
+        with self._lock:
+            cap = self._caps.get(cluster)
+            return ClusterCapacity(**vars(cap)) if cap is not None \
+                else None
+
+    def remaining(self, cluster: int) -> Tuple[int, int]:
+        with self._lock:
+            cap = self._caps.get(cluster)
+            return cap.remaining() if cap is not None else (0, 0)
+
+    def utilization(self, cluster: int) -> float:
+        with self._lock:
+            cap = self._caps.get(cluster)
+            return cap.utilization() if cap is not None else 1.0
+
+    # -- write ledgers (the rebalancer's observation surface) -----------
+    def write_counts(self) -> Tuple[Dict[int, float], Dict[str, float]]:
+        """CUMULATIVE (cluster → writes, namespace → writes) counters;
+        the rebalancer differences consecutive ticks into rates, the
+        same contract the per-slot ledgers honor."""
+        with self._lock:
+            return dict(self._writes), dict(self._ns_writes)

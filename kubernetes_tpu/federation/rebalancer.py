@@ -1,0 +1,133 @@
+"""ClusterRebalancer: ``plan_rebalance`` one level up.
+
+The partition rebalancer's planner is a pure function over (slot
+rates, namespace rates, topology, liveness) — nothing in it knows a
+slot is an apiserver partition. At the federation tier the same
+decision shapes recur with clusters in the slot role:
+
+- a dead CLUSTER → **failover** (re-place its pods onto survivors;
+  beats everything, exactly like a silent shard);
+- one tenant dominating the fleet's writes → **split** (release the
+  namespace from home-cluster affinity so placement spreads it);
+- one hot cluster, siblings cold → **move** (re-home the hot
+  cluster's hottest namespace onto the coldest sibling);
+- buy/retire → recorded no-ops here (the fleet of clusters is fixed
+  capital; the per-cluster NODE autoscalers own elasticity).
+
+:class:`ClusterRebalancer` is a genuine subclass of
+``PartitionRebalancer`` — same tick/differencing/sustain/cooldown
+loop, same pure planner — fed by a driver that adapts the federation
+surfaces (``CapacityLedger`` write counters, ``HomeMap``,
+``FederatedClusterClient.failover_cluster``) to the driver contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu.autoscaler.partitions import (
+    PartitionGroup,
+    PartitionRebalancer,
+    RebalancePolicy,
+)
+from kubernetes_tpu.federation.client import FederatedClusterClient
+
+
+class _ClusterTopologyView:
+    """The planner's topology protocol with clusters as slots: K
+    one-slot partitions, slot i owned by partition i. ``spread`` is
+    the HomeMap's spread set (namespaces already released)."""
+
+    def __init__(self, clusters: List[int], dead: List[int],
+                 spread: set):
+        self.partitions = (max(clusters) + 1) if clusters else 0
+        self.retired = {p for p in range(self.partitions)
+                        if p not in clusters}
+        # owner[slot] = slot: a cluster IS its own slot
+        self.owner = list(range(self.partitions))
+        self.spread = set(spread)
+        self._dead = set(dead)
+
+    def slots_of_partition(self, p: int) -> List[int]:
+        if p in self.retired or p in self._dead:
+            return []
+        return [p]
+
+
+class _FederationDriver:
+    """Adapts the federation tier to the rebalancer driver contract."""
+
+    def __init__(self, client: FederatedClusterClient):
+        self.client = client
+        self.ledger = client.ledger
+        self.home_map = client.home_map
+        # a dead CLUSTER stays dead (unlike a partition, which failover
+        # restarts) — report it dead exactly once or the planner would
+        # re-fire failover every tick forever
+        self._failed_over: set = set()
+
+    def observe(self) -> dict:
+        cluster_writes, ns_writes = self.ledger.write_counts()
+        all_dead = self.ledger.dead_clusters()
+        dead = [c for c in all_dead if c not in self._failed_over]
+        # the topology keeps EVERY dead cluster slotless (a failed-over
+        # cell must never look like a cold move target); only the
+        # planner's failover trigger sees each death once
+        topo = _ClusterTopologyView(
+            self.ledger.clusters(), all_dead, self.home_map.spread)
+        return {"epoch": 0, "topology": topo,
+                "slot_writes": dict(cluster_writes),
+                "ns_writes": dict(ns_writes), "dead": dead}
+
+    def federate(self) -> None:
+        """No metrics federation hop: the ledger is already the
+        merged view."""
+
+    def apply(self, action: Dict[str, Any]) -> dict:
+        op = action["op"]
+        if op == "failover":
+            cid = action["partition"]
+            self._failed_over.add(cid)
+            replaced = self.client.failover_cluster(cid)
+            return {"cluster": cid, "replaced": replaced}
+        if op == "split":
+            ns = action["namespace"]
+            self.home_map.spread.add(ns)
+            return {"namespace": ns, "spread": True}
+        if op == "move":
+            # assignments = {hot cluster: coldest cluster}; re-home the
+            # hot cluster's dominant namespace onto the target
+            moved: Dict[str, int] = {}
+            for src, dst in action["assignments"].items():
+                ns = self._hottest_ns_homed_on(src)
+                if ns is not None:
+                    self.home_map.overrides[ns] = dst
+                    moved[ns] = dst
+            return {"moved": moved}
+        # buy/retire: the cluster fleet is fixed capital — record the
+        # pressure signal, change nothing
+        return {"noop": op}
+
+    def _hottest_ns_homed_on(self, cid: int) -> Optional[str]:
+        _, ns_writes = self.ledger.write_counts()
+        best, best_rate = None, 0.0
+        for ns, rate in ns_writes.items():
+            if self.home_map.home_of(ns) == cid and rate > best_rate:
+                best, best_rate = ns, rate
+        return best
+
+
+class ClusterRebalancer(PartitionRebalancer):
+    """The partition rebalancer's loop pointed at clusters."""
+
+    def __init__(self, client: FederatedClusterClient,
+                 group: Optional[PartitionGroup] = None,
+                 policy: Optional[RebalancePolicy] = None,
+                 interval_s: float = 0.5):
+        driver = _FederationDriver(client)
+        super().__init__(
+            driver,
+            group=group or PartitionGroup(name="federation",
+                                          max_partitions=64),
+            policy=policy,
+            interval_s=interval_s)

@@ -1,0 +1,283 @@
+"""Federation scheduler: clusters are what-if solver columns.
+
+The autoscaler already asks "would a new node help?" by appending
+virtual node COLUMNS to the encoded planes and score-penalizing them
+(``ops/solver.py`` ``solve_whatif``); the federation tier asks the
+same question at cluster granularity — "which CLUSTER should take this
+workload?" — with one synthetic node per cluster whose allocatable is
+the cluster's remaining capacity (``CapacityLedger``). The penalty
+tiers order placement preference exactly like the autoscaler's
+real > upcoming > virtual ladder:
+
+    home cluster (0) < remote cluster (REMOTE_CLUSTER_PENALTY)
+        < saturated cluster (SATURATION_PENALTY) < dead (disabled)
+
+so a workload lands at home while home has room, spills to a sibling
+when home saturates (the spillover headline), and never routes to a
+dead cell at all. Gangs fold into ONE synthetic unit pod (summed
+request), so a gang is atomic by construction — the solver cannot
+split what it sees as a single pod.
+
+``place`` raises :class:`FederationUnavailable` when the layer is
+marked down; callers (``FederatedClusterClient``) then fall back to
+home routing — federation is an optimizer, never a single point of
+failure (the degradation invariant).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kubernetes_tpu.federation.ledger import CapacityLedger
+
+# the gang labels trace.pod_dict stamps (the coscheduling contract)
+GANG_NAME_LABEL = "pod-group.scheduling.k8s.io/name"
+
+# Penalty tiers (same float32-safe magnitudes as the autoscaler's:
+# real scores are O(hundreds), VIRTUAL_NODE_PENALTY is 1e6). Remote
+# must stay far below saturation so a saturated home loses to a
+# healthy sibling, and saturation must stay below the virtual tier so
+# differential tests can still stack both without overflow.
+REMOTE_CLUSTER_PENALTY = 1.0e4
+SATURATION_PENALTY = 5.0e5
+
+
+class FederationUnavailable(RuntimeError):
+    """The federation layer is down; each cell schedules locally."""
+
+
+@dataclass(frozen=True)
+class FederationPolicy:
+    """Placement knobs for the cluster-granularity solve."""
+
+    remote_penalty: float = REMOTE_CLUSTER_PENALTY
+    saturation_penalty: float = SATURATION_PENALTY
+    saturation_threshold: float = 0.85   # utilization → penalized
+    # the numpy per-unit oracle by default: a placement decision is a
+    # K-column solve over a handful of units, where jit dispatch would
+    # dominate; serial=False routes the identical question through the
+    # device solve_whatif (the differential tests hold them equal)
+    serial: bool = True
+    pad_pods: int = 64
+
+
+@dataclass
+class PlacementUnit:
+    """One atomically-placed workload: a single pod, or a whole gang
+    folded into one summed request."""
+
+    pods: List = field(default_factory=list)
+    gang: str = ""
+    milli: int = 0
+    mem: int = 0
+    namespace: str = "default"
+
+
+@dataclass
+class Placement:
+    """One unit's verdict: the chosen cluster (None = no live cluster
+    fits; the caller parks it at home, where it pends — never lost)."""
+
+    unit: PlacementUnit
+    cluster: Optional[int]
+    home: Optional[int]
+
+    @property
+    def spilled(self) -> bool:
+        return (self.cluster is not None and self.home is not None
+                and self.cluster != self.home)
+
+
+def group_units(pods: Sequence) -> List[PlacementUnit]:
+    """Fold a pod batch into placement units: gang members (the
+    ``pod-group.scheduling.k8s.io/name`` label) merge into one unit
+    with the summed resource request; everything else is a singleton.
+    Order-preserving for determinism."""
+    from kubernetes_tpu.scheduler.types import (
+        compute_pod_resource_request,
+    )
+
+    units: List[PlacementUnit] = []
+    by_gang: Dict[str, PlacementUnit] = {}
+    for pod in pods:
+        req = compute_pod_resource_request(pod)
+        gang = (pod.metadata.labels or {}).get(GANG_NAME_LABEL, "")
+        if gang:
+            unit = by_gang.get(gang)
+            if unit is None:
+                unit = PlacementUnit(
+                    gang=gang,
+                    namespace=pod.metadata.namespace or "default")
+                by_gang[gang] = unit
+                units.append(unit)
+        else:
+            unit = PlacementUnit(
+                namespace=pod.metadata.namespace or "default")
+            units.append(unit)
+        unit.pods.append(pod)
+        unit.milli += req.milli_cpu
+        unit.mem += req.memory
+    return units
+
+
+class FederationScheduler:
+    """Scores candidate clusters with the existing what-if machinery
+    and places units atomically. One instance serves one federation."""
+
+    def __init__(self, ledger: CapacityLedger,
+                 policy: Optional[FederationPolicy] = None,
+                 home_of: Optional[Callable[[str], Optional[int]]] = None):
+        self.ledger = ledger
+        self.policy = policy or FederationPolicy()
+        # namespace → home cluster (None = no affinity, place freely);
+        # the ClusterRebalancer's split/move actions rewrite this map
+        self.home_of = home_of or (lambda ns: None)
+        self._down = False
+        self.solves = 0
+        self.placed_units = 0
+        self.unplaced_units = 0
+
+    # -- degradation switch (the chaos family kills the layer) ---------
+    def set_down(self, down: bool) -> None:
+        self._down = bool(down)
+
+    @property
+    def down(self) -> bool:
+        return self._down
+
+    # -- the placement decision ----------------------------------------
+    def place(self, pods: Sequence,
+              trace_uid: str = "") -> List[Placement]:
+        """Place a pod batch across the federation. Returns one
+        :class:`Placement` per unit (gangs fold; see ``group_units``).
+        Emits a ``fed.place`` span so placement cost attributes to the
+        sampled pod's critical path (the seam-phase contract)."""
+        if self._down:
+            raise FederationUnavailable("federation layer is down")
+        from kubernetes_tpu.observability import get_tracer
+
+        t0 = time.monotonic()
+        units = group_units(pods)
+        by_home: Dict[Optional[int], List[PlacementUnit]] = {}
+        for u in units:
+            home = self.home_of(u.namespace)
+            if home is not None and not self.ledger.alive(home):
+                home = None
+            by_home.setdefault(home, []).append(u)
+        out: List[Placement] = []
+        for home, group in by_home.items():
+            out.extend(self._place_group(group, home))
+        spilled = sum(1 for p in out if p.spilled)
+        get_tracer().record(
+            "fed.place", t0, trace=trace_uid,
+            units=len(units), pods=len(list(pods)),
+            clusters=len(self.ledger.clusters()), spilled=spilled,
+            unplaced=sum(1 for p in out if p.cluster is None))
+        return out
+
+    def _place_group(self, units: List[PlacementUnit],
+                     home: Optional[int]) -> List[Placement]:
+        """One solve for all units sharing a home cluster (penalties
+        are per-COLUMN, so a solve can express only one home)."""
+        clusters = self.ledger.clusters()
+        live = set(self.ledger.live_clusters())
+        if not live:
+            self.unplaced_units += len(units)
+            return [Placement(unit=u, cluster=None, home=home)
+                    for u in units]
+        cluster, batch, col_cluster = self._encode(clusters, units)
+        penalties: Dict[int, float] = {}
+        disabled: List[int] = []
+        for col, cid in enumerate(col_cluster):
+            if cid not in live:
+                disabled.append(col)
+                continue
+            pen = 0.0
+            if home is not None and cid != home:
+                pen += self.policy.remote_penalty
+            if self.ledger.utilization(cid) \
+                    >= self.policy.saturation_threshold:
+                pen += self.policy.saturation_penalty
+            if pen:
+                penalties[col] = pen
+        assignments = self._solve(cluster, batch, penalties, disabled)
+        self.solves += 1
+        out: List[Placement] = []
+        for i, u in enumerate(units):
+            col = int(assignments[i])
+            cid = col_cluster[col] if 0 <= col < len(col_cluster) \
+                else None
+            if cid is not None:
+                self.ledger.note_admitted(cid, u.pods)
+                self.placed_units += 1
+            else:
+                self.unplaced_units += 1
+            out.append(Placement(unit=u, cluster=cid, home=home))
+        return out
+
+    # -- encode clusters-as-nodes, units-as-pods ------------------------
+    def _encode(self, clusters: List[int],
+                units: List[PlacementUnit]):
+        from kubernetes_tpu.api.types import Node, Pod
+        from kubernetes_tpu.ops.encode import BatchEncoder
+        from kubernetes_tpu.scheduler.snapshot import new_snapshot
+
+        nodes = []
+        for cid in clusters:
+            milli, mem = self.ledger.remaining(cid)
+            nodes.append(Node.from_dict({
+                "metadata": {
+                    "name": f"cluster-{cid}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"cluster-{cid}"},
+                },
+                "status": {"capacity": {
+                    "cpu": f"{max(milli, 0)}m",
+                    "memory": str(max(mem, 0)),
+                    # a cluster-node holds thousands of pods; the
+                    # per-node 110 cap is a kubelet property, not a
+                    # cluster one
+                    "pods": "1000000"}},
+            }))
+        unit_pods = []
+        for j, u in enumerate(units):
+            pod = Pod.from_dict({
+                "metadata": {"name": f"unit-{j}",
+                             "namespace": u.namespace},
+                "spec": {"containers": [
+                    {"name": "c", "image": "registry/fake:1",
+                     "resources": {"requests": {
+                         "cpu": f"{u.milli}m",
+                         "memory": str(u.mem)}}}]},
+            })
+            pod.metadata.uid = f"fu-{j}"
+            unit_pods.append(pod)
+        enc = BatchEncoder(new_snapshot([], nodes))
+        cluster, batch = enc.encode(unit_pods,
+                                    pad_pods=self.policy.pad_pods)
+        # column → cluster id by name (the encoder preserves order,
+        # but mapping by name keeps this correct under any reorder)
+        by_name = {f"cluster-{cid}": cid for cid in clusters}
+        col_cluster = [by_name.get(n) for n in cluster.node_names]
+        return cluster, batch, col_cluster
+
+    def _solve(self, cluster, batch, penalties: Dict[int, float],
+               disabled: List[int]):
+        from kubernetes_tpu.ops.solver import SolverParams
+
+        if self.policy.serial:
+            from kubernetes_tpu.autoscaler.simulator import (
+                _serial_whatif,
+            )
+
+            solver = _serial_whatif
+        else:
+            from kubernetes_tpu.ops.solver import solve_whatif
+
+            solver = solve_whatif
+        assignments, _counts = solver(
+            cluster, batch, SolverParams(),
+            deprioritized_cols=penalties, disabled_cols=disabled)
+        return assignments

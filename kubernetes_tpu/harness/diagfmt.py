@@ -256,6 +256,29 @@ def format_upgrade(info: Optional[Dict]) -> str:
     return "upgrade[" + " ".join(parts) + "]"
 
 
+def format_federation(info: Optional[Dict]) -> str:
+    """The federation segment: fleet width (``clusters``), how many
+    pods the saturation path steered off their home cluster
+    (``spilled``), how many whole-cluster failovers fired
+    (``failovers``), the MUST-be-zero fleet-wide ``lost`` counter, and
+    the failover ``recovery`` ratio (share of the dead cell's unbound
+    pods re-bound on survivors inside the recovery budget; 1.0 when no
+    cluster died). Emitted by the federation rows and chaos cells;
+    parsed by the generic bracket scan in ``parse_diag`` (key
+    ``federation``) — tools/perf_report.py reads it to gate the
+    ``federation_flags`` family."""
+    if not info:
+        return ""
+    parts = [
+        f"clusters={int(info.get('clusters', 0))}",
+        f"spilled={int(info.get('spilled', 0))}",
+        f"failovers={int(info.get('failovers', 0))}",
+        f"lost={int(info.get('lost', 0))}",
+        f"recovery={float(info.get('recovery', 0.0)):.2f}",
+    ]
+    return "federation[" + " ".join(parts) + "]"
+
+
 def format_critpath(info: Optional[Dict]) -> str:
     """The fleet critical-path segment: which phase owns the sampled
     pods' end-to-end latency (``top``/``share``), how much of the

@@ -74,7 +74,7 @@ def phase_of(name: str) -> Optional[str]:
     if name.startswith("watch"):
         return "watch"
     if (name.startswith("reshard") or name.startswith("upgrade")
-            or name.startswith("seam")):
+            or name.startswith("fed") or name.startswith("seam")):
         return "seam"
     return None
 

@@ -1,0 +1,502 @@
+"""Federated multi-cluster tier (federation/): the cross-cluster
+placement, failover, and degradation contracts at CI scale.
+
+The tier-1 surface of the federation PR — the cheap unit contracts
+plus two REAL compressed cells:
+
+- ``TestHomeMap`` / ``TestCapacityLedger`` — the routing affinity and
+  per-cluster capacity/write facts everything above builds on.
+- ``TestFederationScheduler`` — clusters-as-solver-columns placement:
+  home affinity, saturation spillover, dead-cluster exclusion, gang
+  atomicity by construction, and the serial-oracle ≡ device-solver
+  differential.
+- ``TestFederationDriver`` — the ``plan_rebalance`` action shapes
+  translated to cluster granularity (failover fires exactly once;
+  split releases a namespace; move re-homes the hottest tenant).
+- ``TestFailoverClient`` — ``failover_cluster`` re-places a dead
+  cell's pods on survivors under the same names, and routing survives
+  a cell dying mid-send.
+- ``TestLossMiniCell`` / ``TestSpillMiniCell`` — in-process 3-cluster
+  cells under the open loop: cluster loss mid-storm (zero lost
+  fleet-wide, orphans re-bound within the recovery budget, gangs
+  never split) and saturation spillover (overflow lands remotely).
+- ``TestDegradationDifferential`` — federation down ≡ federation up
+  at single-cluster scope: bit-identical bound sets.
+- ``TestFederationDiag`` — ``diagfmt.format_federation`` round-trips
+  through the shared bracket parser.
+
+The spawned-process storm (real apiserver children, real SIGKILL) is
+the committed bench row (``bench.py --config federation``) and the
+``--suite federation`` chaos cells — too heavy for tier-1; these
+cells walk the same seams in-process.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.federation import (
+    CapacityLedger,
+    FederatedClusterClient,
+    FederationPolicy,
+    FederationScheduler,
+    FederationUnavailable,
+    GANG_NAME_LABEL,
+    HomeMap,
+    group_units,
+)
+from kubernetes_tpu.harness import diagfmt
+from kubernetes_tpu.harness.federation import (
+    FEDERATION_SCENARIOS,
+    _federation_ok,
+    run_chaos_federation,
+    run_degradation_differential,
+    run_federation_mini_cell,
+)
+
+
+def _node(name: str, cpu_milli: int) -> Node:
+    return Node.from_dict({
+        "metadata": {"name": name,
+                     "labels": {"kubernetes.io/hostname": name}},
+        "status": {"capacity": {"cpu": f"{cpu_milli}m",
+                                "memory": "68719476736",
+                                "pods": "110"}},
+    })
+
+
+def _pod(name: str, ns: str = "default", milli: int = 500,
+         gang: str = "") -> Pod:
+    labels = {GANG_NAME_LABEL: gang} if gang else {}
+    pod = Pod.from_dict({
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {"containers": [
+            {"name": "c", "image": "registry/fake:1",
+             "resources": {"requests": {"cpu": f"{milli}m",
+                                        "memory": "1048576"}}}]},
+    })
+    pod.metadata.uid = f"uid-{ns}-{name}"
+    return pod
+
+
+def _ledger(capacities: dict) -> CapacityLedger:
+    """cluster id → total milli-cpu, observed as one node each."""
+    ledger = CapacityLedger()
+    for cid, milli in capacities.items():
+        ledger.register(cid)
+        ledger.refresh_from(cid, [_node(f"c{cid}-node-0", milli)], [])
+    return ledger
+
+
+# ---------------------------------------------------------------------------
+# routing affinity + capacity facts
+
+
+class TestHomeMap:
+    def test_hash_fallback_is_deterministic_and_in_range(self):
+        hm = HomeMap([0, 1, 2])
+        homes = {hm.home_of(f"ns-{i}") for i in range(40)}
+        assert homes <= {0, 1, 2}
+        assert hm.home_of("ns-7") == hm.home_of("ns-7")
+
+    def test_pin_beats_hash(self):
+        hm = HomeMap([0, 1, 2], pin={"tenant-a": 2})
+        assert hm.home_of("tenant-a") == 2
+
+    def test_override_beats_pin(self):
+        # the rebalancer's move action re-homes a pinned tenant
+        hm = HomeMap([0, 1, 2], pin={"tenant-a": 2})
+        hm.overrides["tenant-a"] = 1
+        assert hm.home_of("tenant-a") == 1
+
+    def test_spread_releases_affinity_entirely(self):
+        # the rebalancer's split action: no home at all → place freely
+        hm = HomeMap([0, 1, 2], pin={"tenant-a": 2})
+        hm.overrides["tenant-a"] = 1
+        hm.spread.add("tenant-a")
+        assert hm.home_of("tenant-a") is None
+
+
+class TestCapacityLedger:
+    def test_refresh_computes_capacity_and_usage(self):
+        ledger = CapacityLedger()
+        bound = _pod("p-0", milli=1000)
+        bound.spec.node_name = "c0-node-0"
+        pending = _pod("p-1", milli=500)
+        cap = ledger.refresh_from(
+            0, [_node("c0-node-0", 16000)], [bound, pending])
+        assert cap.allocatable_milli == 16000
+        # a pending pod is capacity already spoken for on its cluster
+        assert cap.used_milli == 1500
+        assert (cap.bound, cap.pending) == (1, 1)
+        assert ledger.remaining(0) == (14500, cap.remaining()[1])
+
+    def test_admissions_reserve_until_a_refresh_observes_them(self):
+        ledger = _ledger({0: 16000})
+        routed = _pod("p-0", milli=4000)
+        ledger.note_admitted(0, [routed])
+        assert ledger.remaining(0)[0] == 12000
+        assert ledger.utilization(0) == pytest.approx(0.25)
+        # once a refresh OBSERVES the routed pod, the reservation is
+        # released (the pod now counts as used — pending or bound)
+        ledger.refresh_from(0, [_node("c0-node-0", 16000)], [routed])
+        cap = ledger.capacity(0)
+        assert cap.admitted_pods == 0
+        assert ledger.remaining(0)[0] == 12000
+
+    def test_refresh_never_drops_an_unobserved_reservation(self):
+        # the overcommit race: a placement lands AFTER the refresher
+        # read the cluster's pod list but BEFORE the refresh commits.
+        # The stale list cannot account for the new pod, so its
+        # reservation must survive — blanket-clearing here once let
+        # the spill storm route one pod more than the cell could bind.
+        ledger = _ledger({0: 16000})
+        stale_pod_list = []          # read before the placement landed
+        ledger.note_admitted(0, [_pod("p-0", milli=4000)])
+        ledger.refresh_from(
+            0, [_node("c0-node-0", 16000)], stale_pod_list)
+        assert ledger.remaining(0)[0] == 12000
+        assert ledger.capacity(0).admitted_pods == 1
+
+    def test_re_reserving_a_pod_replaces_not_double_counts(self):
+        ledger = _ledger({0: 16000})
+        pod = _pod("p-0", milli=4000)
+        ledger.note_admitted(0, [pod])
+        ledger.note_admitted(0, [pod])
+        assert ledger.remaining(0)[0] == 12000
+        assert ledger.capacity(0).admitted_pods == 1
+
+    def test_write_counts_are_cumulative_per_cluster_and_tenant(self):
+        ledger = _ledger({0: 16000, 1: 16000})
+        ledger.note_admitted(0, [_pod("p-0", ns="a"),
+                                 _pod("p-1", ns="a")])
+        ledger.note_admitted(1, [_pod("p-2", ns="b")])
+        writes, ns_writes = ledger.write_counts()
+        assert writes == {0: 2.0, 1: 1.0}
+        assert ns_writes == {"a": 2.0, "b": 1.0}
+
+    def test_liveness_flags(self):
+        ledger = _ledger({0: 1000, 1: 1000})
+        ledger.mark_dead(0)
+        assert ledger.live_clusters() == [1]
+        assert ledger.dead_clusters() == [0]
+        assert not ledger.alive(0)
+        ledger.mark_alive(0)
+        assert ledger.live_clusters() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# clusters as solver columns
+
+
+class TestFederationScheduler:
+    def test_gangs_fold_into_one_unit(self):
+        pods = [_pod("g-0", gang="fg-0", milli=700),
+                _pod("s-0", milli=500),
+                _pod("g-1", gang="fg-0", milli=700)]
+        units = group_units(pods)
+        assert [u.gang for u in units] == ["fg-0", ""]
+        assert units[0].milli == 1400
+        assert len(units[0].pods) == 2
+
+    def test_home_cluster_wins_while_it_has_room(self):
+        ledger = _ledger({0: 16000, 1: 16000})
+        sched = FederationScheduler(ledger, home_of=lambda ns: 1)
+        (pl,) = sched.place([_pod("p-0")])
+        assert pl.cluster == 1
+        assert not pl.spilled
+
+    def test_saturated_home_spills_to_a_sibling(self):
+        ledger = _ledger({0: 16000, 1: 16000})
+        # pin home 0 past the 0.85 saturation threshold
+        ledger.note_admitted(0, [_pod("fat", milli=14000)])
+        sched = FederationScheduler(ledger, home_of=lambda ns: 0)
+        (pl,) = sched.place([_pod("p-0")])
+        assert pl.cluster == 1
+        assert pl.spilled
+
+    def test_dead_cluster_is_never_chosen(self):
+        ledger = _ledger({0: 16000, 1: 16000})
+        ledger.mark_dead(0)
+        sched = FederationScheduler(ledger, home_of=lambda ns: 0)
+        (pl,) = sched.place([_pod("p-0")])
+        assert pl.cluster == 1
+
+    def test_gang_places_atomically_on_one_cluster(self):
+        ledger = _ledger({0: 16000, 1: 16000})
+        pods = [_pod(f"g-{i}", gang="fg-0", milli=800)
+                for i in range(4)]
+        (pl,) = FederationScheduler(ledger).place(pods)
+        assert pl.cluster in (0, 1)
+        assert len(pl.unit.pods) == 4
+
+    def test_no_live_cluster_leaves_units_unplaced_not_lost(self):
+        ledger = _ledger({0: 16000})
+        ledger.mark_dead(0)
+        sched = FederationScheduler(ledger, home_of=lambda ns: 0)
+        (pl,) = sched.place([_pod("p-0")])
+        assert pl.cluster is None
+        assert sched.unplaced_units == 1
+
+    def test_down_layer_raises_federation_unavailable(self):
+        sched = FederationScheduler(_ledger({0: 16000}))
+        sched.set_down(True)
+        with pytest.raises(FederationUnavailable):
+            sched.place([_pod("p-0")])
+
+    def test_serial_oracle_matches_device_solver(self):
+        # the same K-column question through the numpy per-unit oracle
+        # and the jitted what-if solver must place identically
+        def run(serial: bool):
+            ledger = _ledger({0: 16000, 1: 16000, 2: 16000})
+            ledger.note_admitted(1, [_pod("fat", milli=14000)])
+            sched = FederationScheduler(
+                ledger, policy=FederationPolicy(serial=serial),
+                home_of=lambda ns: {"a": 0, "b": 1, "c": 2}.get(ns))
+            pods = [_pod("p-0", ns="a"), _pod("p-1", ns="b"),
+                    _pod("g-0", ns="c", gang="fg-0"),
+                    _pod("g-1", ns="c", gang="fg-0")]
+            return [(pl.unit.namespace, pl.cluster)
+                    for pl in sched.place(pods)]
+
+        assert run(serial=True) == run(serial=False)
+
+
+# ---------------------------------------------------------------------------
+# the rebalancer's action translation
+
+
+class _StubFedClient:
+    def __init__(self):
+        self.ledger = CapacityLedger()
+        self.home_map = HomeMap([0, 1, 2], pin={"fed-0": 0})
+        self.failed: list = []
+
+    def failover_cluster(self, cid: int) -> int:
+        self.failed.append(cid)
+        return 7
+
+
+class TestFederationDriver:
+    def _driver(self):
+        from kubernetes_tpu.federation.rebalancer import (
+            _FederationDriver,
+        )
+
+        client = _StubFedClient()
+        for cid in (0, 1, 2):
+            client.ledger.register(cid)
+        return client, _FederationDriver(client)
+
+    def test_observe_speaks_the_driver_contract(self):
+        client, driver = self._driver()
+        obs = driver.observe()
+        assert set(obs) == {"epoch", "topology", "slot_writes",
+                            "ns_writes", "dead"}
+        topo = obs["topology"]
+        assert topo.partitions == 3
+        assert topo.slots_of_partition(1) == [1]
+
+    def test_failover_fires_exactly_once_per_dead_cluster(self):
+        client, driver = self._driver()
+        client.ledger.mark_dead(1)
+        assert driver.observe()["dead"] == [1]
+        report = driver.apply({"op": "failover", "partition": 1})
+        assert report == {"cluster": 1, "replaced": 7}
+        assert client.failed == [1]
+        # a dead CLUSTER stays dead — it must not be re-reported or
+        # the planner would re-fire failover every tick forever
+        assert driver.observe()["dead"] == []
+        assert driver.observe()["topology"].slots_of_partition(1) == []
+
+    def test_split_releases_the_namespace(self):
+        client, driver = self._driver()
+        driver.apply({"op": "split", "namespace": "fed-0"})
+        assert client.home_map.home_of("fed-0") is None
+
+    def test_move_rehomes_the_hottest_tenant(self):
+        client, driver = self._driver()
+        client.ledger.note_admitted(0, [_pod("p-0", ns="fed-0"),
+                                        _pod("p-1", ns="fed-0")])
+        report = driver.apply({"op": "move", "assignments": {0: 2}})
+        assert report == {"moved": {"fed-0": 2}}
+        assert client.home_map.home_of("fed-0") == 2
+
+    def test_buy_and_retire_are_recorded_noops(self):
+        _, driver = self._driver()
+        assert driver.apply({"op": "buy"}) == {"noop": "buy"}
+        assert driver.apply({"op": "retire", "partition": 2}) \
+            == {"noop": "retire"}
+
+
+# ---------------------------------------------------------------------------
+# the cross-cluster client's failover path
+
+
+class TestFailoverClient:
+    def _federation(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        stores = {cid: ClusterStore() for cid in (0, 1)}
+        for cid, store in stores.items():
+            store.add_node(Node.from_dict({
+                "metadata": {"name": f"c{cid}-node-0"},
+                "status": {"capacity": {"cpu": "16",
+                                        "memory": "68719476736",
+                                        "pods": "110"}},
+            }))
+        ledger = CapacityLedger()
+        home_map = HomeMap([0, 1], pin={"a": 0, "b": 1})
+        sched = FederationScheduler(ledger, home_of=home_map.home_of)
+        client = FederatedClusterClient(stores, sched, ledger,
+                                        home_map=home_map)
+        for cid, store in stores.items():
+            ledger.refresh_from(cid, store.list_nodes(),
+                                store.list_pods())
+        return stores, ledger, client
+
+    def test_failover_replaces_dead_cell_pods_by_name(self):
+        stores, ledger, client = self._federation()
+        client.create_pods([_pod(f"a-{i}", ns="a") for i in range(4)]
+                           + [_pod("b-0", ns="b")])
+        assert {p.metadata.name for p in stores[0].list_pods()} \
+            == {f"a-{i}" for i in range(4)}
+        replaced = client.failover_cluster(0)
+        assert replaced == 4
+        # the lost-pod invariant is NAME-keyed: the survivors now hold
+        # every name the dead cell held
+        assert {p.metadata.name for p in stores[1].list_pods()} \
+            == {f"a-{i}" for i in range(4)} | {"b-0"}
+        assert client.route_of("a", "a-0") == 1
+        assert client.counters()["failovers"] == 1
+        assert client.counters()["failover_replaced"] == 4
+
+    def test_gang_continuity_pins_later_chunks(self):
+        stores, ledger, client = self._federation()
+        client.create_pods([_pod("g-0", ns="a", gang="fg-0")])
+        first = client.route_of("a", "g-0")
+        client.create_pods([_pod("g-1", ns="a", gang="fg-0")])
+        assert client.route_of("a", "g-1") == first
+
+    def test_scheduler_failure_degrades_to_home_routing(self):
+        stores, ledger, client = self._federation()
+        client.scheduler.set_down(True)
+        client.create_pods([_pod("a-0", ns="a"), _pod("b-0", ns="b")])
+        assert client.route_of("a", "a-0") == 0
+        assert client.route_of("b", "b-0") == 1
+        assert client.counters()["fallback_placements"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the real cells, compressed
+
+
+@pytest.fixture(scope="module")
+def loss_cell():
+    """One cluster-loss mini-cell shared by every invariant assertion:
+    the storm is the expensive part; the checks are reads."""
+    return run_federation_mini_cell(scenario="loss-mid", seed=18)
+
+
+class TestLossMiniCell:
+    def test_zero_lost_fleet_wide(self, loss_cell):
+        assert loss_cell["lost"] == 0
+        assert loss_cell["ever_bound"] == loss_cell["injected"] > 0
+
+    def test_a_cluster_actually_died_and_failed_over(self, loss_cell):
+        assert loss_cell["victim"] is not None
+        assert loss_cell["failovers"] >= 1
+        assert "failover" in loss_cell["rebalancer_actions"]
+
+    def test_orphans_recovered_within_budget(self, loss_cell):
+        assert loss_cell["recovery_ratio"] >= 0.8
+
+    def test_gangs_never_split_across_clusters(self, loss_cell):
+        assert loss_cell["gang_splits"] == 0
+
+
+@pytest.fixture(scope="module")
+def spill_cell():
+    return run_federation_mini_cell(scenario="spill", seed=18)
+
+
+class TestSpillMiniCell:
+    def test_overflow_lands_remotely_with_nothing_lost(self,
+                                                       spill_cell):
+        assert spill_cell["victim"] is None
+        assert spill_cell["spilled"] > 0
+        assert spill_cell["lost"] == 0
+        assert spill_cell["ever_bound"] == spill_cell["injected"]
+
+    def test_every_cluster_carried_load(self, spill_cell):
+        bound = {k: v["bound"]
+                 for k, v in spill_cell["per_cluster"].items()}
+        assert all(v > 0 for v in bound.values()), bound
+
+
+class TestDegradationDifferential:
+    def test_fed_down_binds_the_identical_set(self):
+        res = run_degradation_differential(pods=120, qps=400, seed=18)
+        assert res["identical"], (
+            f"on={len(res['bound_on'])} down={len(res['bound_down'])}")
+        assert res["on"]["lost"] == 0
+        assert res["down"]["lost"] == 0
+        # the down arm really exercised the fallback path; the up arm
+        # never needed it
+        assert res["down"]["fallbacks"] > 0
+        assert res["on"]["fallbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# contracts around the chaos/bench surfaces
+
+
+class TestFederationContracts:
+    def test_scenario_catalog(self):
+        assert set(FEDERATION_SCENARIOS) == {
+            "spill", "loss-early", "loss-mid", "loss-late",
+            "spill-loss"}
+
+    def test_chaos_cell_rejects_unknown_scenarios(self):
+        with pytest.raises(ValueError, match="unknown federation"):
+            run_chaos_federation(18, scenario="bogus")
+
+    def test_verdict_surface_flips_on_every_invariant(self):
+        base = {"scenario": "spill-loss", "lost_pods": 0,
+                "injected": 10, "ever_bound": 10, "send_errors": [],
+                "gang_splits": 0, "survivor_relists": 0,
+                "per_cluster_slo_ok": True, "recovery_ratio": 1.0,
+                "victim": 1, "slo_verdicts_ok": True, "spilled": 3,
+                "failovers": 1}
+        ok, why = _federation_ok(dict(base))
+        assert ok and why == ""
+        for key, bad in [("lost_pods", 2), ("ever_bound", 9),
+                         ("send_errors", ["boom"]), ("gang_splits", 1),
+                         ("survivor_relists", 4),
+                         ("per_cluster_slo_ok", False),
+                         ("recovery_ratio", 0.5),
+                         ("slo_verdicts_ok", False), ("spilled", 0),
+                         ("failovers", 0)]:
+            res = dict(base)
+            res[key] = bad
+            ok, why = _federation_ok(res)
+            assert not ok, key
+            assert why, key
+
+
+class TestFederationDiag:
+    def test_round_trips_through_the_bracket_parser(self):
+        seg = diagfmt.format_federation({
+            "clusters": 3, "spilled": 47, "failovers": 1,
+            "lost": 0, "recovery": 1.0})
+        assert seg == ("federation[clusters=3 spilled=47 failovers=1 "
+                       "lost=0 recovery=1.00]")
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert parsed["federation"] == {
+            "clusters": 3, "spilled": 47, "failovers": 1,
+            "lost": 0, "recovery": 1.0}
+
+    def test_quiet_when_empty(self):
+        assert diagfmt.format_federation(None) == ""
+        assert diagfmt.format_federation({}) == ""

@@ -424,6 +424,96 @@ class TestUpgradeFlags:
         assert len(doc["upgrade_flags"]) == 1
 
 
+class TestFederationFlags:
+    _SPILL = ("federation_spill[open-loop 300/s 3clusters saturation "
+              "spillover, 900pods seed=18, REST fabric]")
+    _LOSS = ("federation_loss[open-loop 300/s 3clusters cluster-loss "
+             "SIGKILL, 900pods seed=18, REST fabric]")
+
+    def _row(self, tmp_path, n, metric=None, **extra):
+        base = {"lost_pods": 0, "gang_splits": 0,
+                "survivor_relists": 0, "per_cluster_slo_ok": True,
+                "spilled": 31, "failovers": 0, "recovery_ratio": 1.0,
+                "slo_verdicts_ok": True, "invariants_ok": True}
+        base.update(extra)
+        _artifact(tmp_path, n, 280.0,
+                  metric=metric or self._SPILL, extra=base)
+
+    def test_green_row_passes(self, tmp_path):
+        from tools.perf_report import federation_flags, main
+
+        self._row(tmp_path, 1)
+        assert federation_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_lost_pod_gates_strict(self, tmp_path):
+        from tools.perf_report import federation_flags, main
+
+        self._row(tmp_path, 1, lost_pods=2)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        assert "lost_pods=2" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_gang_split_and_survivor_relist_flagged(self, tmp_path):
+        from tools.perf_report import federation_flags
+
+        self._row(tmp_path, 1, gang_splits=1, survivor_relists=2)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "gang_splits=1" in probs
+        assert "survivor_relists=2" in probs
+
+    def test_red_per_cluster_slo_gates_strict(self, tmp_path):
+        from tools.perf_report import federation_flags, main
+
+        self._row(tmp_path, 1, per_cluster_slo_ok=False)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        assert "per-cluster SLO went red" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_low_recovery_ratio_needs_a_failover(self, tmp_path):
+        from tools.perf_report import federation_flags
+
+        # no failover happened: a low ratio is vacuous, not a flag
+        self._row(tmp_path, 1, recovery_ratio=0.0, failovers=0)
+        assert federation_flags(load_rounds(str(tmp_path))) == []
+        self._row(tmp_path, 2, metric=self._LOSS,
+                  recovery_ratio=0.5, failovers=1)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        assert "recovery_ratio 0.50 < 0.8" in flag["problems"][0]
+
+    def test_dry_spill_row_flagged(self, tmp_path):
+        from tools.perf_report import federation_flags
+
+        self._row(tmp_path, 1, spilled=0)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        assert "spilled=0" in flag["problems"][0]
+        # a LOSS row with spilled=0 is fine — spill is not its job
+        self._row(tmp_path, 2, metric=self._LOSS, spilled=0,
+                  failovers=1)
+        flags = federation_flags(load_rounds(str(tmp_path)))
+        assert [f["round"] for f in flags] == [1]
+
+    def test_invariant_failure_carries_reason(self, tmp_path):
+        from tools.perf_report import federation_flags
+
+        self._row(tmp_path, 1, invariants_ok=False,
+                  invariants={"failed": "gang fg-3 split 2 clusters"},
+                  slo_verdicts_ok=False)
+        (flag,) = federation_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "gang fg-3 split 2 clusters" in probs
+        assert "fleet freshness SLO went red" in probs
+
+    def test_flags_survive_json_mode(self, tmp_path, capsys):
+        from tools.perf_report import main
+
+        self._row(tmp_path, 1, lost_pods=1)
+        main(["--dir", str(tmp_path), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["federation_flags"]) == 1
+
+
 # ---------------------------------------------------------------------------
 # committed artifacts: the tier-1 smoke over the real trajectory
 

@@ -47,6 +47,16 @@ The suites:
   lost pods, zero lost/duplicated watch events, zero relists of
   unmoved slices, one epoch, and a v1-pinned client held at codec v1
   across every seam (mixed-version wire guard).
+- ``federation`` — federated multi-cluster cells: K independent
+  spawned clusters (each its own apiserver + scheduler) behind the
+  federation tier, crossing saturation spillover (``spill`` — one
+  cell pinned past capacity, overflow must land remotely with the
+  saturated cell's own SLOs green) × whole-cluster SIGKILL at
+  25/50/75% of the storm (``loss-early``/``loss-mid``/``loss-late``,
+  or ``spill-loss`` for both at once); invariants: zero lost pods
+  fleet-wide, every orphan re-placed onto survivors within the
+  recovery budget, relists confined to the dead cell, gangs never
+  split across clusters.
 
 Usage::
 
@@ -61,6 +71,8 @@ Usage::
     python tools/chaos_matrix.py --suite reshard --seeds 11,23,37
     python tools/chaos_matrix.py --suite upgrade --seeds 3,5 \
         --upgrade partitions-first,sigkill-schedulers-first
+    python tools/chaos_matrix.py --suite federation --seeds 18 \
+        --federation spill,loss-mid
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -104,7 +116,8 @@ def main() -> int:
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
                                  "partition", "replay", "reshard",
-                                 "upgrade", "both", "all"))
+                                 "upgrade", "federation", "both",
+                                 "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -130,6 +143,13 @@ def main() -> int:
                              "(partitions-first,schedulers-first) × "
                              "SIGKILL mid-roll on a draining process "
                              "(sigkill-* variants)")
+    parser.add_argument("--federation",
+                        default="spill,loss-mid",
+                        help="federation-suite scenarios: saturation "
+                             "spillover (spill), whole-cluster SIGKILL "
+                             "at 25/50/75%% of the storm "
+                             "(loss-early,loss-mid,loss-late), or both "
+                             "at once (spill-loss)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -181,6 +201,13 @@ def main() -> int:
         if p and p not in UPGRADE_SCENARIOS:
             parser.error(f"unknown upgrade scenario {p!r} "
                          f"(have: {', '.join(sorted(UPGRADE_SCENARIOS))})")
+    from kubernetes_tpu.harness.federation import FEDERATION_SCENARIOS
+
+    for p in args.federation.split(","):
+        if p and p not in FEDERATION_SCENARIOS:
+            parser.error(
+                f"unknown federation scenario {p!r} "
+                f"(have: {', '.join(sorted(FEDERATION_SCENARIOS))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -237,6 +264,21 @@ def main() -> int:
         _run_suite(args, progress, rows, "upgrade", run_chaos_upgrade,
                    "scenario",
                    [s for s in args.upgrade.split(",") if s])
+    if args.suite in ("federation", "all"):
+        # federated multi-cluster cells: K independent spawned
+        # clusters behind the federation tier, crossing saturation
+        # spillover (one cell pinned past capacity, overflow must land
+        # remotely with the saturated cell's SLOs green) × whole-
+        # cluster SIGKILL mid-storm (every orphan re-placed onto
+        # survivors, zero lost fleet-wide, relists confined to the
+        # dead cell, gangs never split across clusters)
+        from kubernetes_tpu.harness.federation import (
+            run_chaos_federation,
+        )
+
+        _run_suite(args, progress, rows, "federation",
+                   run_chaos_federation, "scenario",
+                   [s for s in args.federation.split(",") if s])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

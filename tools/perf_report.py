@@ -753,6 +753,86 @@ def critpath_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def federation_flags(rounds: List[dict]) -> List[dict]:
+    """The ``federation_*`` family's own checks (ISSUE 18 satellite):
+    the federation rows are ROBUSTNESS-UNDER-PARTITION rows — a
+    cross-cluster placement tier only earns its keep if losing a whole
+    cluster loses zero pods and saturating one cluster stays invisible
+    to its tenants. Flag the round when:
+
+    - any pod was lost fleet-wide (``lost_pods`` > 0 — injected, acked
+      by some cell, then absent from every survivor's truth);
+    - a gang was split across clusters (``gang_splits`` > 0 — gangs
+      place atomically or not at all; a cross-cluster split deadlocks
+      the workload);
+    - a SURVIVOR cell relisted (``survivor_relists`` > 0 — the
+      cluster-loss seam leaked beyond the dead cell);
+    - any per-cluster freshness/latency SLO went red
+      (``per_cluster_slo_ok`` false — spillover must keep the
+      saturated cell's own tenants green);
+    - a cluster was failed over but fewer than 80% of its orphaned
+      pods re-bound within the recovery budget (``recovery_ratio``
+      < 0.8 with ``failovers`` > 0);
+    - a spillover row spilled nothing (``spilled`` == 0 on a
+      ``federation_spill`` row — the saturation penalty never fired,
+      so the row measured a plain single-cluster run);
+    - any fleet freshness SLO went red (``slo_verdicts_ok`` false) or
+      any other hard invariant failed (``invariants_ok`` false).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            metric = str(row.get("metric", ""))
+            if not metric.startswith("federation_") or "error" in row:
+                continue
+            problems = []
+            if row.get("lost_pods"):
+                problems.append(
+                    f"lost_pods={row['lost_pods']} (pods vanished "
+                    f"fleet-wide across the cluster loss)")
+            if row.get("gang_splits"):
+                problems.append(
+                    f"gang_splits={row['gang_splits']} (a gang was "
+                    f"split across clusters — placement must be "
+                    f"atomic)")
+            if row.get("survivor_relists"):
+                problems.append(
+                    f"survivor_relists={row['survivor_relists']} "
+                    f"(cluster-loss seam leaked a relist into a "
+                    f"surviving cell)")
+            if row.get("per_cluster_slo_ok") is False:
+                problems.append(
+                    "a per-cluster SLO went red (spillover leaked "
+                    "onto the saturated cell's own tenants)")
+            ratio = row.get("recovery_ratio")
+            if (row.get("failovers") and ratio is not None
+                    and float(ratio) < 0.8):
+                problems.append(
+                    f"recovery_ratio {float(ratio):.2f} < 0.8 "
+                    f"(failover re-placed too few orphans within "
+                    f"the recovery budget)")
+            if (metric.startswith("federation_spill")
+                    and row.get("spilled") == 0):
+                problems.append(
+                    "spilled=0 on a spillover row (saturation "
+                    "penalty never fired — row measured nothing)")
+            if row.get("slo_verdicts_ok") is False:
+                problems.append(
+                    "fleet freshness SLO went red during the storm")
+            if row.get("invariants_ok") is False:
+                why = (row.get("invariants") or {}).get("failed", "?")
+                problems.append(f"invariants failed: {why}")
+            if problems:
+                flags.append({
+                    "metric": metric,
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -833,6 +913,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sus_flags = sustained_flags(rounds)
     hot_flags = hotspot_flags(rounds)
     upg_flags = upgrade_flags(rounds)
+    fed_flags = federation_flags(rounds)
     crit_flags = critpath_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
@@ -853,6 +934,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "sustained_flags": sus_flags,
             "hotspot_flags": hot_flags,
             "upgrade_flags": upg_flags,
+            "federation_flags": fed_flags,
             "critpath_flags": crit_flags,
             "telemetry": telemetry,
         }, indent=1))
@@ -888,6 +970,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in upg_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if fed_flags:
+            print("\nfederation placement / cluster-loss flags:")
+            for f in fed_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if crit_flags:
             print("\nfleet-trace critical-path flags:")
             for f in crit_flags:
@@ -904,7 +991,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 1 if (args.strict
                  and (open_flags or scale_flags or dev_flags
                       or rep_flags or sus_flags or hot_flags
-                      or upg_flags or crit_flags)) else 0
+                      or upg_flags or fed_flags
+                      or crit_flags)) else 0
 
 
 if __name__ == "__main__":
