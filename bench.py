@@ -680,7 +680,7 @@ def main() -> None:
                     choices=sorted(CONFIGS) + sorted(EXTRA_MATRIX)
                     + ["rest", "qos", "traceab", "profab", "freshab",
                        "autoscale", "scale10x", "devscale", "sustained",
-                       "hotspot", "upgrade", "federation",
+                       "hotspot", "upgrade", "federation", "watchherd",
                        "replay:storm", "replay:gangs",
                        "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
@@ -844,6 +844,34 @@ def main() -> None:
                                          progress=log)
             else:
                 row = run_federation_row(mode=mode, progress=log)
+            print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "watchherd":
+        # the read-tier watch-herd rows (ISSUE 19): one arm per
+        # replica count (0 / 1 / 4 spawned ReadReplica processes
+        # tailing the owner's commit stream) with the SAME seeded
+        # create/delete sequence — the replicas-off arm is the
+        # differential control and every arm must land the identical
+        # truth hash. 320 informers (≥10× any earlier row's stream
+        # count) list+watch through the replicas while writes stay on
+        # the owner; the scaling row judges fan-out per OWNER
+        # cpu-second (the host time-shares every process, so
+        # wall-clock aggregate measures the host, not the tier) and
+        # the replica-kill cell closes the loop: zero lost events,
+        # relists confined to the killed replica. Gated by
+        # perf_report's readtier_flags
+        from kubernetes_tpu.harness.watchherd import run_watchherd_row
+
+        if args.quick:
+            rows = run_watchherd_row(informers=64, creates=120,
+                                     qps=20.0, herd_children=2,
+                                     nodes=20, replica_arms=(0, 4),
+                                     wait_timeout=300, progress=log)
+        else:
+            rows = run_watchherd_row(progress=log)
+        for row in rows:
+            row.pop("replica_stats", None)
             print(json.dumps(row), flush=True)
         return
 

@@ -155,11 +155,12 @@ class PartitionTopology:
     a single integer to know whether they are stale."""
 
     __slots__ = ("partitions", "slots", "owner", "epoch", "spread",
-                 "urls", "retired")
+                 "urls", "retired", "replicas")
 
     def __init__(self, partitions: int, owner: List[int], epoch: int = 1,
                  spread=frozenset(), urls: Optional[List[str]] = None,
-                 retired=frozenset()):
+                 retired=frozenset(),
+                 replicas: Optional[Dict[int, List[str]]] = None):
         self.partitions = int(partitions)
         self.owner: Tuple[int, ...] = tuple(int(o) for o in owner)
         self.slots = len(self.owner)
@@ -167,6 +168,14 @@ class PartitionTopology:
         self.spread = frozenset(spread)
         self.urls = list(urls) if urls is not None else None
         self.retired = frozenset(retired)
+        # read-tier advertisement: partition index -> read-replica URLs
+        # (apiserver/readtier.py). Replicas serve lists and watches for
+        # their partition's keyspace; writes always route to the owner.
+        # Empty dict = no read tier (every read hits the owner).
+        self.replicas: Dict[int, Tuple[str, ...]] = {
+            int(p): tuple(u.rstrip("/") for u in us)
+            for p, us in (replicas or {}).items() if us
+        }
 
     @classmethod
     def default(cls, partitions: int, slots: int = NUM_SLOTS,
@@ -178,14 +187,18 @@ class PartitionTopology:
     def evolve(self, owner: Optional[List[int]] = None, spread=None,
                partitions: Optional[int] = None,
                urls: Optional[List[str]] = None,
-               retired=None) -> "PartitionTopology":
+               retired=None, replicas=None) -> "PartitionTopology":
         return PartitionTopology(
             partitions if partitions is not None else self.partitions,
             owner if owner is not None else self.owner,
             epoch=self.epoch + 1,
             spread=self.spread if spread is None else spread,
             urls=self.urls if urls is None else urls,
-            retired=self.retired if retired is None else retired)
+            retired=self.retired if retired is None else retired,
+            replicas=self.replicas if replicas is None else replicas)
+
+    def replicas_for(self, partition: int) -> Tuple[str, ...]:
+        return self.replicas.get(int(partition), ())
 
     # -- routing -------------------------------------------------------
     def slot_of(self, kind: str, namespace: Optional[str],
@@ -223,6 +236,12 @@ class PartitionTopology:
         }
         if self.urls is not None:
             doc["urls"] = list(self.urls)
+        if self.replicas:
+            # JSON object keys are strings on the wire; from_dict
+            # restores the integer partition indices
+            doc["replicas"] = {
+                str(p): list(us) for p, us in sorted(self.replicas.items())
+            }
         return doc
 
     @classmethod
@@ -231,7 +250,9 @@ class PartitionTopology:
                    epoch=int(doc.get("epoch", 1)),
                    spread=frozenset(doc.get("spread") or ()),
                    urls=doc.get("urls"),
-                   retired=frozenset(doc.get("retired") or ()))
+                   retired=frozenset(doc.get("retired") or ()),
+                   replicas={int(p): list(us) for p, us in
+                             (doc.get("replicas") or {}).items()})
 
     def __repr__(self) -> str:
         return (f"PartitionTopology(epoch={self.epoch}, "

@@ -1,0 +1,470 @@
+"""Read-tier subsystem: horizontally-scalable watch replicas per
+partition.
+
+"Millions of users" is read-dominated — every kubelet, controller and
+dashboard is a list+watch client, yet one partition process serves both
+its authoritative writes AND its whole watch fan-out, so read load and
+write load contend for the same dispatch threads (ROADMAP item 3; the
+reference apiserver's watch-cache + reflector hierarchy is the
+blueprint, and Pathways makes the same argument one layer down:
+throughput is won by decoupling the serving fan-out from the
+authoritative coordinator so neither waits on the other).
+
+This module is the serving side of that split:
+
+- ``ReplicationClient`` — subscribes to the owner's commit stream
+  (``/api/v1/subscription``, rest.py): seeds from ``?snapshot=1`` via
+  the silent ``adopt_objects`` channel (RVs preserved, no phantom
+  events), then applies the live stream through
+  ``ClusterStore.apply_replicated`` — the RV-preserving, per-object
+  monotonic ingest whose equal-rv guard collapses resume overlap. The
+  cursor is the max applied rv; a dropped connection resumes from it,
+  and only a 410 (owner's cache AND WAL both compacted past the
+  cursor) forces a reseed.
+- ``FenceStateMachine`` — the staleness contract (PR 8 freshness SLI
+  layer): replication lag per applied batch feeds a per-replica
+  ``replication_lag_seconds`` histogram and this hysteresis machine. A
+  replica past its lag budget for ``trip_after`` consecutive batches
+  self-fences (server answers reads 503 + X-Replica-Fenced, sheds live
+  watch streams; clients re-route, relist confined to THIS replica);
+  ``clear_after`` consecutive batches under half the budget unfence it.
+- ``ReadReplica`` — mirror ``ClusterStore`` + a ``read_only``
+  ``APIServer`` serving lists from its own pre-encoded caches and
+  watches from its own dispatch threads, fed by a ReplicationClient
+  wired into the server's fence flag. Replicas are advertised in the
+  ``PartitionTopology`` doc (``replicas`` field) so
+  ``RestClusterClient`` routes reads to them while writes still hit
+  the owner.
+
+Loss model: replica loss costs a relist on that replica's clients
+only; owner restart replays the missed window from the owner's WAL
+(``attach_wal(..., preserve_log=True)``) so live replicas resubscribe
+from their cursor with no reseed. Fleet-wide zero lost events is the
+acceptance bar (harness/watchherd.py proves it with a differential
+replicas-off arm held event-identical).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.serialization import from_wire
+from kubernetes_tpu.apiserver.rest import APIServer
+from kubernetes_tpu.apiserver.store import (
+    DELETED,
+    MODIFIED,
+    ClusterStore,
+    Event,
+)
+
+__all__ = [
+    "FenceStateMachine",
+    "ReplicationClient",
+    "ReadReplica",
+]
+
+# staleness contract defaults: a replica more than half a second behind
+# its owner for 3 consecutive batches is serving history, not state —
+# fence it. Unfencing needs sustained headroom (half the budget) so a
+# replica oscillating at the budget edge doesn't flap client routing.
+DEFAULT_LAG_BUDGET_S = 0.5
+FENCE_TRIP_AFTER = 3
+FENCE_CLEAR_AFTER = 5
+
+
+class FenceStateMachine:
+    """Pure hysteresis over replication-lag samples.
+
+    ``observe(lag_s)`` returns ``True`` on the fence transition,
+    ``False`` on the unfence transition, ``None`` otherwise — the
+    caller (ReplicationClient) maps transitions onto the server's
+    ``fenced`` event. Tripping takes ``trip_after`` CONSECUTIVE
+    over-budget samples (one slow batch is a scheduling hiccup, not
+    staleness); clearing takes ``clear_after`` consecutive samples
+    under ``budget/2`` (recovering to just-under-budget still means
+    one bad batch re-fences — demand real headroom before taking
+    client traffic back)."""
+
+    def __init__(self, lag_budget_s: float = DEFAULT_LAG_BUDGET_S,
+                 trip_after: int = FENCE_TRIP_AFTER,
+                 clear_after: int = FENCE_CLEAR_AFTER):
+        self.lag_budget_s = float(lag_budget_s)
+        self.trip_after = max(1, int(trip_after))
+        self.clear_after = max(1, int(clear_after))
+        self.fenced = False
+        self.fences = 0          # lifetime fence transitions
+        self._over = 0
+        self._under = 0
+
+    def observe(self, lag_s: float) -> Optional[bool]:
+        if not self.fenced:
+            if lag_s > self.lag_budget_s:
+                self._over += 1
+                if self._over >= self.trip_after:
+                    self.fenced = True
+                    self.fences += 1
+                    self._under = 0
+                    return True
+            else:
+                self._over = 0
+            return None
+        if lag_s <= self.lag_budget_s / 2.0:
+            self._under += 1
+            if self._under >= self.clear_after:
+                self.fenced = False
+                self._over = 0
+                return False
+        else:
+            self._under = 0
+        return None
+
+
+def _parse_frame(line: bytes, known_kinds) -> Optional[Event]:
+    """One subscription NDJSON line -> an Event for apply_replicated.
+    Live frames carry the full object; WAL-replayed deletes carry a
+    key-only stub — synthesize metadata so the mirror can pop and
+    re-announce the stored body at the delete's revision."""
+    frame = json.loads(line)
+    kind = frame.get("kind")
+    rv = int(frame.get("rv") or 0)
+    etype = frame.get("type") or MODIFIED
+    ts = float(frame.get("commitTs") or 0.0)
+    if frame.get("object") is not None:
+        obj = from_wire(frame["object"], kind)
+    elif frame.get("key") is not None:
+        ns, name = frame["key"]
+        obj = from_wire({"kind": kind, "metadata": {
+            "namespace": ns or "", "name": name,
+            "resourceVersion": str(rv)}}, kind)
+    else:
+        return None
+    if known_kinds is not None and kind not in known_kinds:
+        return None
+    return Event(etype, kind, obj, ts=ts, origin="owner")
+
+
+class ReplicationClient:
+    """Owner commit stream -> mirror store, with cursor resume.
+
+    Seed: ``GET /api/v1/subscription?snapshot=1`` — a leading
+    ``{"rv": R}`` line (captured before any kind is listed), then
+    per-kind object batches adopted silently (``adopt_objects``: RVs
+    preserved, no watch events — replica clients list first, they must
+    not see a phantom ADDED storm). Cursor starts at R.
+
+    Stream: ``GET /api/v1/subscription?resourceVersion=cursor`` —
+    NDJSON frames applied via ``apply_replicated`` (RV-preserving,
+    per-object monotonic, DISPATCHED: replica watch clients see the
+    owner's history verbatim, commit stamps included). Cursor advances
+    to the max applied rv, so a dropped connection resumes exactly
+    where the mirror left off (counted in ``resumes``); a 410 means
+    the owner compacted past the cursor and the mirror reseeds
+    (counted in ``reseeds`` — this is the only path that costs the
+    replica's clients a relist).
+
+    Lag: ``now - commitTs`` per applied frame feeds the per-replica
+    ``replication_lag_seconds`` histogram and the fence machine;
+    fence transitions invoke ``fence_cb(fenced_bool)``.
+    ``apply_delay`` is the chaos hook (tools/chaos_matrix.py lag-fence
+    cell): sleeping before each apply manufactures real lag without
+    touching the wire."""
+
+    def __init__(self, owner_url: str, store: ClusterStore,
+                 replica_id: str = "r0",
+                 lag_budget_s: float = DEFAULT_LAG_BUDGET_S,
+                 fence_cb: Optional[Callable[[bool], None]] = None,
+                 apply_delay: float = 0.0,
+                 token: str = ""):
+        host_port = owner_url.rstrip("/").split("//", 1)[-1]
+        host, _, port = host_port.partition(":")
+        self._host, self._port = host, int(port or 80)
+        self.store = store
+        self.replica_id = replica_id
+        self.fence = FenceStateMachine(lag_budget_s)
+        self.fence_cb = fence_cb
+        self.apply_delay = float(apply_delay)
+        self.token = token
+        self.cursor: Optional[int] = None
+        self.events_applied = 0
+        self.events_seen = 0
+        self.resumes = 0
+        self.reseeds = 0
+        self.last_lag_s = 0.0
+        self.max_lag_s = 0.0
+        self.seeded = threading.Event()
+        self._stop = threading.Event()
+        self._conn: Optional[HTTPConnection] = None
+        self._thread: Optional[threading.Thread] = None
+        from kubernetes_tpu.metrics.freshness_metrics import (
+            freshness_metrics,
+        )
+
+        self._lag_hist = freshness_metrics().replication_lag_seconds
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "ReplicationClient":
+        self._thread = threading.Thread(
+            target=self._run, name=f"replication-{self.replica_id}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        conn = self._conn
+        if conn is not None:
+            # force the blocked readline() home (the _sa_watch rule:
+            # shutdown, not close — close() wants the reader's lock)
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+
+    # -- wire ---------------------------------------------------------
+    def _open(self, path: str):
+        conn = HTTPConnection(self._host, self._port, timeout=30)
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        conn.request("GET", path, headers=headers)
+        self._conn = conn
+        return conn, conn.getresponse()
+
+    def _seed(self) -> bool:
+        try:
+            conn, resp = self._open("/api/v1/subscription?snapshot=1")
+        except OSError:
+            return False
+        try:
+            if resp.status != 200:
+                resp.read()
+                return False
+            head = resp.readline()
+            if not head:
+                return False
+            rv0 = int(json.loads(head)["rv"])
+            while True:
+                line = resp.readline()
+                if not line or line.strip() == b"":
+                    break
+                batch = json.loads(line)
+                objs = [from_wire(w, batch["kind"])
+                        for w in batch.get("objects") or ()]
+                if objs:
+                    self.store.adopt_objects(batch["kind"], objs)
+        except (OSError, ValueError, KeyError, AttributeError):
+            return False
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._conn = None
+        self.cursor = rv0
+        self.seeded.set()
+        return True
+
+    def _observe_lag(self, ts: float) -> None:
+        if ts <= 0:
+            return
+        lag = max(0.0, time.time() - ts)
+        self.last_lag_s = lag
+        self.max_lag_s = max(self.max_lag_s, lag)
+        self._lag_hist.observe(lag, self.replica_id)
+        flip = self.fence.observe(lag)
+        if flip is not None and self.fence_cb is not None:
+            self.fence_cb(flip)
+
+    def _stream_once(self) -> str:
+        """One subscription attempt. Returns 'gone' (410 -> reseed),
+        'retry' (transport drop -> resume from cursor) or 'stop'."""
+        try:
+            conn, resp = self._open(
+                f"/api/v1/subscription?resourceVersion={self.cursor}")
+        except OSError:
+            return "retry"
+        try:
+            if resp.status == 410:
+                resp.read()
+                return "gone"
+            if resp.status != 200:
+                resp.read()
+                return "retry"
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    return "retry"
+                line = line.strip()
+                if not line:
+                    continue
+                self.events_seen += 1
+                try:
+                    e = _parse_frame(line, None)
+                except (ValueError, KeyError, TypeError):
+                    continue
+                if e is None:
+                    continue
+                if self.apply_delay > 0:
+                    time.sleep(self.apply_delay)
+                applied = self.store.apply_replicated([e])
+                self.events_applied += len(applied)
+                rv = int(e.obj.metadata.resource_version or 0)
+                if self.cursor is None or rv > self.cursor:
+                    self.cursor = rv
+                self._observe_lag(e.ts)
+        except (OSError, ValueError, AttributeError):
+            # a socket shut down mid-readline surfaces as ValueError /
+            # AttributeError from http.client's chunk decoder, not
+            # OSError — all of them mean "stream gone, resume"
+            return "retry"
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._conn = None
+        return "stop"
+
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            if self.cursor is None:
+                if not self._seed():
+                    self._stop.wait(backoff)
+                    backoff = min(backoff * 2, 1.0)
+                    continue
+                backoff = 0.05
+            outcome = self._stream_once()
+            if outcome == "stop" or self._stop.is_set():
+                return
+            if outcome == "gone":
+                # owner compacted past the cursor: full reseed — the
+                # only path that costs this replica's clients a relist
+                self.reseeds += 1
+                self.cursor = None
+            else:
+                self.resumes += 1
+            self._stop.wait(backoff)
+            backoff = min(backoff * 2, 1.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replica": self.replica_id,
+            "cursor": self.cursor,
+            "events_seen": self.events_seen,
+            "events_applied": self.events_applied,
+            "resumes": self.resumes,
+            "reseeds": self.reseeds,
+            "fences": self.fence.fences,
+            "fenced": self.fence.fenced,
+            "last_lag_s": round(self.last_lag_s, 6),
+            "max_lag_s": round(self.max_lag_s, 6),
+        }
+
+
+class ReadReplica:
+    """One read replica: mirror store + read-only APIServer + the
+    replication client that feeds it. Serves the owner's partition
+    index (lists from its own pre-encoded caches, watches from its own
+    dispatch threads); every mutating verb answers 503
+    X-Replica-ReadOnly. The fence machine's transitions set/clear the
+    server's ``fenced`` event — a fenced replica 503s reads
+    (X-Replica-Fenced) and sheds live watch streams so clients
+    re-route to a sibling or the owner."""
+
+    def __init__(self, owner_url: str,
+                 partition: Tuple[int, int] = (0, 1),
+                 replica_id: str = "r0",
+                 host: str = "127.0.0.1", port: int = 0,
+                 lag_budget_s: float = DEFAULT_LAG_BUDGET_S,
+                 apply_delay: float = 0.0,
+                 tokens: Optional[Dict[str, str]] = None,
+                 authorizer: Any = None,
+                 token: str = ""):
+        self.replica_id = replica_id
+        self.store = ClusterStore()
+        kwargs: Dict[str, Any] = dict(
+            store=self.store, host=host, port=port,
+            partition=tuple(partition), read_only=True,
+            # replicas exist to absorb fan-out: no APF, no lane caps —
+            # back-pressure belongs on the owner's write path
+            flow_control=None, max_readonly_inflight=None,
+            max_mutating_inflight=None,
+        )
+        if tokens is not None:
+            kwargs["tokens"] = tokens
+        if authorizer is not None:
+            kwargs["authorizer"] = authorizer
+        self.server = APIServer(**kwargs)
+        self.repl = ReplicationClient(
+            owner_url, self.store, replica_id=replica_id,
+            lag_budget_s=lag_budget_s, apply_delay=apply_delay,
+            fence_cb=self._on_fence, token=token)
+
+    def _on_fence(self, fenced: bool) -> None:
+        if fenced:
+            self.server.fenced.set()
+        else:
+            self.server.fenced.clear()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self, seed_timeout: float = 10.0) -> "ReadReplica":
+        self.server.start()
+        self.repl.start()
+        # serve no reads before the first seed: an empty mirror would
+        # answer lists with rv=0 and every informer would relist
+        self.repl.seeded.wait(seed_timeout)
+        return self
+
+    def stop(self) -> None:
+        self.repl.stop()
+        self.server.shutdown_server()
+
+    def kill(self) -> None:
+        """Hard kill (in-proc chaos): stop serving AND sever every
+        live client connection, like a SIGKILLed process dropping its
+        sockets — pooled keep-alive clients must see the failure, not
+        keep being served by surviving handler threads."""
+        self.repl.stop()
+        self.server.shutdown_server()
+        self.server.sever_connections()
+        try:
+            self.server.server_close()
+        except OSError:
+            pass
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def stats(self) -> Dict[str, Any]:
+        s = self.repl.stats()
+        s["url"] = self.url
+        s["store_rv"] = self.store.current_rv()
+        return s
+
+
+def advertise_replicas(topology, partition: int,
+                       urls: List[str]):
+    """Evolve a PartitionTopology with this partition's replica URLs
+    (epoch bump — clients refresh and start routing reads)."""
+    replicas = dict(topology.replicas)
+    if urls:
+        replicas[int(partition)] = tuple(u.rstrip("/") for u in urls)
+    else:
+        replicas.pop(int(partition), None)
+    return topology.evolve(replicas=replicas)

@@ -449,7 +449,8 @@ class _Handler(BaseHTTPRequestHandler):
     # the debug surfaces mid-overload.
     _EXEMPT_PATHS = ("/healthz", "/livez", "/readyz",
                      "/metrics", "/metrics/resources",
-                     "/api/v1/partitiontopology")
+                     "/api/v1/partitiontopology",
+                     "/api/v1/subscription")
 
     def _admission_exempt(self, path: str) -> bool:
         return path in self.ADMIN_ROUTES or path in self._EXEMPT_PATHS
@@ -1542,6 +1543,13 @@ class _Handler(BaseHTTPRequestHandler):
                 doc.update(topo.to_dict())
             self._send_json(200, doc)
             return
+        if u.path == "/api/v1/subscription":
+            # read-tier commit stream (apiserver/readtier.py): the
+            # owner's whole event history as one all-kind feed, resumed
+            # by resourceVersion — a control-plane internal surface in
+            # the same trust envelope as the topology doc
+            self._serve_subscription(u)
+            return
         if u.path in ("/api", "/apis") or self._is_discovery_path(u.path):
             self._serve_discovery(u.path)
             return
@@ -1552,6 +1560,27 @@ class _Handler(BaseHTTPRequestHandler):
             body = resources_metrics_text(self.server.store).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.server.fenced.is_set():
+            # self-fenced read replica: past its replication-lag budget,
+            # so serving this read would violate the staleness contract.
+            # A distinguishable 503 (X-Replica-Fenced) tells the client
+            # to re-route the read to a sibling replica or the owner;
+            # health probes, metrics, and the topology doc above stay
+            # reachable so the fence itself remains observable.
+            body = json.dumps({
+                "kind": "Status", "status": "Failure",
+                "reason": "ReplicaFenced", "code": 503,
+                "message": "read replica fenced: replication lag over "
+                           "budget — re-route to a sibling or the owner",
+            }).encode()
+            self.send_response(503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("X-Replica-Fenced", "1")
+            self.send_header("Retry-After", "0.5")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -1970,6 +1999,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_POST(self) -> None:
         if self._dispatch_admin("POST"):
             return
+        if self._reject_if_read_only():
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             if sub == "acquire" and name is not None:
@@ -2240,6 +2271,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_PUT(self) -> None:
         if self._dispatch_admin("PUT"):
             return
+        if self._reject_if_read_only():
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -2341,6 +2374,8 @@ class _Handler(BaseHTTPRequestHandler):
         v1beta1 route patches the nested v1beta1 document."""
         if self._dispatch_admin("PATCH"):
             return
+        if self._reject_if_read_only():
+            return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
             self._send_error(405, "MethodNotAllowed",
@@ -2430,6 +2465,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_DELETE(self) -> None:
         if self._dispatch_admin("DELETE"):
+            return
+        if self._reject_if_read_only():
             return
         kind, ns, name, sub, q = self._route()
         if kind == "Lease":
@@ -2570,6 +2607,12 @@ class _Handler(BaseHTTPRequestHandler):
                     # never raise, so exit explicitly or this thread
                     # would drain a dead subscription forever
                     break
+                if self.server.fenced.is_set():
+                    # a read replica that fenced mid-stream must shed
+                    # its watchers too: the clean close makes the
+                    # client relist — which the fence gate answers with
+                    # the re-route 503, landing the stream on a sibling
+                    break
                 try:
                     frame = frames.get(timeout=0.5)
                 except queue.Empty:
@@ -2651,12 +2694,231 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
+    # -- read-tier subscription (apiserver/readtier.py) ----------------
+    def _serve_subscription(self, u) -> None:
+        """The owner's commit stream for read replicas: every watch
+        event of every kind, as newline-delimited JSON lines carrying
+        {type, kind, rv, object, commitTs}, resumed from
+        ``resourceVersion``. Resume sources, in order: the in-memory
+        watch cache (replay + live attach under one lock, no seam),
+        then the WAL on disk — a restarted owner has an empty cache,
+        but its log still holds the window between a replica's cursor
+        and the crash, so replicas resubscribe without a full reseed.
+        Only when BOTH are compacted past the cursor does the stream
+        410 and the replica reseed from ``?snapshot=1``."""
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        if q.get("snapshot") in ("1", "true"):
+            self._serve_subscription_snapshot()
+            return
+        try:
+            rv = int(q.get("resourceVersion") or 0)
+        except ValueError:
+            self._send_error(
+                400, "BadRequest",
+                f"invalid resourceVersion {q.get('resourceVersion')!r}")
+            return
+        frames: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=50_000)
+
+        def sink(event_rv: int, event: Event) -> None:
+            # one encode per event, shared across every subscribed
+            # replica (the same cachingObject discipline _serve_watch
+            # uses for its JSON frames)
+            frame = event.__dict__.get("_sub_frame")
+            if frame is None:
+                doc = {"type": event.type, "kind": event.kind,
+                       "rv": event_rv, "object": to_wire(event.obj)}
+                if event.ts:
+                    doc["commitTs"] = event.ts
+                frame = json.dumps(doc).encode() + b"\n"
+                event.__dict__["_sub_frame"] = frame
+            try:
+                frames.put_nowait(frame)
+            except queue.Full:
+                # a replica that cannot keep up is cut (it resumes from
+                # its cursor — or reseeds — instead of stalling the
+                # owner's dispatch)
+                try:
+                    frames.get_nowait()
+                    frames.put_nowait(None)
+                except (queue.Empty, queue.Full):
+                    pass
+
+        replayed: List[bytes] = []
+        handle = None
+        try:
+            try:
+                handle = self.server.watch_cache.watch_from(rv, sink)
+            except TooOldResourceVersion:
+                handle = self._attach_via_wal(rv, sink, replayed)
+            if handle is None:
+                self._send_error(
+                    410, "Expired",
+                    f"resourceVersion {rv} is compacted out of both the "
+                    "watch cache and the WAL — reseed from ?snapshot=1")
+                return
+        finally:
+            ticket = self._apf_ticket
+            if ticket is not None:
+                ticket.release()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            if replayed:
+                body = b"".join(replayed)
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(body), body))
+                self.wfile.flush()
+            while not self.server.stopping.is_set():
+                if self._sock_aborted:
+                    break
+                try:
+                    frame = frames.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if frame is None:
+                    break
+                parts = [frame]
+                closing = False
+                while len(parts) < 512:
+                    try:
+                        nxt = frames.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        closing = True
+                        break
+                    parts.append(nxt)
+                buf = b"".join(parts)
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(buf), buf))
+                self.wfile.flush()
+                if closing:
+                    break
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            handle.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+    def _attach_via_wal(self, rv: int, sink, replayed: List[bytes]):
+        """WAL fallback for a subscription resume the watch cache can't
+        cover: encode the on-disk window (rv, wal-end] into ``replayed``
+        frames, then attach the live sink at the replay horizon — any
+        event committed while the log was read is newer than the
+        horizon and replays from the cache. Returns the live handle, or
+        None when the WAL can't prove coverage either (→ 410)."""
+        wal_dir = getattr(self.server, "wal_dir", None)
+        if not wal_dir:
+            return None
+        from kubernetes_tpu.apiserver.wal import wal_events_since
+
+        try:
+            covered, entries = wal_events_since(wal_dir, rv)
+        except OSError:
+            return None
+        if not covered:
+            return None
+        top = rv
+        for line in entries:
+            line_rv = int(line.get("rv") or 0)
+            doc: Dict[str, Any] = {"rv": line_rv, "kind": line["k"]}
+            if line["t"] == "DEL":
+                # key-only delete (the log stores no body): the replica
+                # pops its mirrored object and re-announces it at this rv
+                doc["type"] = "DELETED"
+                doc["key"] = [line.get("ns", ""), line["n"]]
+            else:
+                doc["type"] = "MODIFIED"
+                doc["object"] = line["o"]
+            replayed.append(json.dumps(doc).encode() + b"\n")
+            top = max(top, line_rv)
+        try:
+            return self.server.watch_cache.watch_from(top, sink)
+        except TooOldResourceVersion:
+            return None
+
+    def _serve_subscription_snapshot(self) -> None:
+        """Full-state seed for a new (or 410'd) replica: a leading
+        {"rv": R} line with R captured BEFORE any kind is listed, then
+        per-kind object batches. Events between R and each list are
+        delivered again by the subsequent subscription from R — the
+        replica's per-object rv guard collapses the overlap, which is
+        exactly the adopt_objects idempotency the silent placement
+        channel already relies on."""
+        store = self.server.store
+        rv0 = store.current_rv()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_line(doc: dict) -> None:
+            body = json.dumps(doc).encode() + b"\n"
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(body), body))
+
+        try:
+            write_line({"rv": rv0})
+            for kind in store.known_kinds():
+                if kind == "Lease":
+                    # synthesized objects with no watch events — a
+                    # mirror of them would never be maintained
+                    continue
+                try:
+                    objs, krv = store.list_objects_with_rv(kind)
+                except KeyError:
+                    continue
+                if not objs:
+                    continue
+                for i in range(0, len(objs), 500):
+                    write_line({
+                        "kind": kind, "rv": krv,
+                        "objects": [to_wire(o)
+                                    for o in objs[i:i + 500]],
+                    })
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+    def _reject_if_read_only(self) -> bool:
+        """True when this server is a read replica and the mutating
+        request was answered 503: writes belong to the partition owner
+        (the client routes them there; this gate catches strays). The
+        body is drained first so keep-alive framing survives."""
+        if not getattr(self.server, "read_only", False):
+            return False
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            self.rfile.read(length)
+        body = json.dumps({
+            "kind": "Status", "status": "Failure",
+            "reason": "ReadOnlyReplica", "code": 503,
+            "message": "read replica serves no writes — "
+                       "route mutations to the partition owner",
+        }).encode()
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("X-Replica-ReadOnly", "1")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return True
+
 
 class APIServer(ThreadingHTTPServer):
     """In-process kube-apiserver equivalent. Serves a ClusterStore over
     REST; start with .start(), stop with .shutdown_server()."""
 
     daemon_threads = True
+    # an informer herd (re)connects in bursts of hundreds when a
+    # replica dies or a topology epoch bumps; socketserver's default
+    # backlog of 5 turns that thundering herd into connection-refused
+    # churn instead of a queue
+    request_queue_size = 512
 
     def __init__(
         self,
@@ -2674,8 +2936,21 @@ class APIServer(ThreadingHTTPServer):
         watch_flush_window: float = 0.002,
         flow_control: Any = "default",
         partition: Optional[Tuple[int, int]] = None,
+        read_only: bool = False,
     ):
         super().__init__((host, port), _Handler)
+        # read-tier identity (apiserver/readtier.py): a read replica
+        # serves lists/watches from its mirror store and answers every
+        # mutating verb 503 — writes belong to the partition owner.
+        # ``fenced`` is the replica's staleness circuit breaker: set
+        # when replication lag blows the budget, it turns reads into
+        # re-route 503s (X-Replica-Fenced) and sheds live watch
+        # streams; cleared when the replica catches back up. ``wal_dir``
+        # (set by harnesses that attach a WAL) lets the subscription
+        # endpoint replay resume windows its in-memory cache lost.
+        self.read_only = bool(read_only)
+        self.fenced = threading.Event()
+        self.wal_dir: Optional[str] = None
         # partitioned-control-plane identity: (index, count) when this
         # server is one shard of a partitioned fabric (its store holds
         # ONLY partition ``index`` of the keyspace — one server process
@@ -2839,6 +3114,13 @@ class APIServer(ThreadingHTTPServer):
         self._sa_watch = self.store.watch(_maybe_invalidate)
         self.stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # live client sockets, for hard-kill fidelity in in-proc
+        # harnesses: shutdown() only stops the accept loop — pooled
+        # keep-alive connections keep being served by their handler
+        # threads, so a "killed" in-proc server would stay silently
+        # alive to every client that already had a connection
+        self._conn_lock = threading.Lock()
+        self._live_conns: set = set()
         self._metrics_text_fn = metrics_text_fn
         from kubernetes_tpu.proxy.ipallocator import IPAllocator
 
@@ -3079,6 +3361,47 @@ class APIServer(ThreadingHTTPServer):
         )
         self._thread.start()
         return self
+
+    def handle_error(self, request, client_address):
+        # a dropped client connection is normal fabric weather (pool
+        # churn, chaos kills, severed keep-alives) — not worth a
+        # stderr traceback; anything else keeps the default report
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, BrokenPipeError)) \
+                or self.stopping.is_set():
+            return
+        super().handle_error(request, client_address)
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._live_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._live_conns.discard(request)
+        super().shutdown_request(request)
+
+    def sever_connections(self) -> None:
+        """Close every live client connection — the in-proc equivalent
+        of a SIGKILLed process dropping its sockets. Without this an
+        in-proc 'kill' leaves keep-alive clients being served by the
+        dead server's surviving handler threads, and chaos cells that
+        assert re-route behavior would pass against a zombie."""
+        with self._conn_lock:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def shutdown_server(self) -> None:
         self.stopping.set()

@@ -341,6 +341,60 @@ class ClusterStore:
             self._dispatch_silent(events)
         return len(events)
 
+    def apply_replicated(self, events: List[Event]) -> List[Event]:
+        """RV-preserving apply of a replicated event batch — the read
+        tier's mirror ingest (apiserver/readtier.py). Like
+        ``adopt_objects`` it never re-stamps resourceVersions (the
+        owner committed them); unlike it, applied events ARE dispatched
+        to this store's watchers — a replica's watch clients must see
+        the owner's history verbatim, commit stamps included. The
+        per-object equal-rv/newer guard collapses subscription resume
+        overlap (a replayed event the mirror already holds is dropped,
+        never re-announced), so the replica's watch log stays exactly
+        as duplicate-free as the owner's. Returns the applied events.
+
+        DELETED events may carry a key-only stub (a WAL-replayed
+        delete has no object body); the mirrored object is popped and
+        re-announced at the event's rv so the replica's watch history
+        stays rv-monotonic like the owner's."""
+        applied: List[Event] = []
+        with self._lock:
+            for e in events:
+                try:
+                    table, key = self._table_key(
+                        e.kind, getattr(e.obj.metadata, "namespace", ""),
+                        e.obj.metadata.name)
+                except KeyError:
+                    continue   # kind this mirror doesn't know
+                try:
+                    rv = int(e.obj.metadata.resource_version or 0)
+                except (TypeError, ValueError):
+                    rv = 0
+                cur = table.get(key)
+                cur_rv = 0
+                if cur is not None:
+                    try:
+                        cur_rv = int(cur.metadata.resource_version or 0)
+                    except (TypeError, ValueError):
+                        cur_rv = 0
+                if e.type == DELETED:
+                    if cur is None or cur_rv > rv:
+                        continue
+                    table.pop(key, None)
+                    # announce the STORED object (a key-only WAL stub
+                    # has no body), stamped at the delete's revision
+                    cur.metadata.resource_version = str(rv)
+                    e = Event(DELETED, e.kind, cur, ts=e.ts,
+                              origin=e.origin)
+                else:
+                    if cur is not None and cur_rv >= rv:
+                        continue
+                    table[key] = e.obj
+                self._rv = max(self._rv, rv)
+                applied.append(e)
+            self._dispatch_many(applied)
+        return applied
+
     def evict_objects(self, kind: str,
                       keys: List[Tuple[str, str]]) -> List[Any]:
         """Remove objects silently — the source half of a live slice
@@ -468,8 +522,8 @@ class ClusterStore:
                     self._delete(self._pods, "Pod", key)
                     continue
                 self._pods.pop(key)
-                old.metadata.resource_version = self._next_rv()
-                events.append(Event(DELETED, "Pod", old))
+                events.append(Event(DELETED, "Pod",
+                                    self._deletion_copy(old)))
             self._dispatch_many(events)
 
     def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
@@ -548,6 +602,21 @@ class ClusterStore:
             table[key] = obj
             self._dispatch(Event(MODIFIED if old is not None else ADDED, kind, obj, old))
 
+    def _deletion_copy(self, obj):
+        """Deletion stamps a new revision (etcd semantics) — on a COPY.
+        The stored instance is still referenced by every earlier
+        ADDED/MODIFIED event sitting in watch caches and subscription
+        replay windows; stamping it in place rewrites that committed
+        history the moment a resumed stream lazily re-encodes it (a
+        replayed create would claim the delete's revision, and the
+        delete that follows gets collapsed as a duplicate by any
+        rv-monotonic consumer — a lost deletion). Callers hold the
+        store lock (``_next_rv``)."""
+        final = shallow_copy(obj)
+        final.metadata = shallow_copy(obj.metadata)
+        final.metadata.resource_version = self._next_rv()
+        return final
+
     def _delete(self, table: Dict, kind: str, key: str) -> None:
         """Finalizer-aware (apimachinery deletion semantics — shared by
         EVERY delete path, typed or generic): objects carrying
@@ -566,14 +635,12 @@ class ClusterStore:
                     self._dispatch(Event(MODIFIED, kind, marked, old))
                 return
             table.pop(key)
-            # a delete creates a new revision (etcd semantics); stamp it
-            # on the final object so watch logs stay monotonic
-            old.metadata.resource_version = self._next_rv()
-            self._dispatch(Event(DELETED, kind, old))
+            final = self._deletion_copy(old)
+            self._dispatch(Event(DELETED, kind, final))
             if kind == "CustomResourceDefinition":
                 # definition gone -> kind unregistered, instances
                 # cascade-deleted (apiextensions finalizer semantics)
-                self._unregister_crd_locked(old)
+                self._unregister_crd_locked(final)
 
     def add_node(self, node: Node) -> None:
         self._upsert(self._nodes, "Node", node.name, node)
@@ -986,8 +1053,8 @@ class ClusterStore:
             ]
             for key in stale:
                 old = self._api_events.pop(key)
-                old.metadata.resource_version = self._next_rv()
-                self._dispatch(Event(DELETED, "Event", old))
+                self._dispatch(Event(DELETED, "Event",
+                                     self._deletion_copy(old)))
                 removed += 1
         return removed
 
@@ -1098,9 +1165,7 @@ class ClusterStore:
         # cascade: instances die with their definition (the reference
         # apiextensions finalizer deletes all CRs before the CRD goes)
         table, _ = got
-        for obj in list(table.values()):
-            obj.metadata.resource_version = self._next_rv()
-        doomed = list(table.values())
+        doomed = [self._deletion_copy(obj) for obj in table.values()]
         table.clear()
         for obj in doomed:
             self._dispatch(Event(DELETED, kind, obj))
@@ -1252,9 +1317,9 @@ class ClusterStore:
                          if f != finalizer]
             if not remaining and obj.metadata.deletion_timestamp is not None:
                 table.pop(key)
-                obj.metadata.finalizers = remaining
-                obj.metadata.resource_version = self._next_rv()
-                self._dispatch(Event(DELETED, kind, obj))
+                final = self._deletion_copy(obj)
+                final.metadata.finalizers = remaining
+                self._dispatch(Event(DELETED, kind, final))
                 return True
             updated = shallow_copy(obj)
             updated.metadata = shallow_copy(obj.metadata)

@@ -208,13 +208,82 @@ class WalHandle:
 
 def attach_wal(store: ClusterStore, directory: str,
                snapshot_every: int = 20000, fsync: bool = False,
-               async_serialize: bool = False) -> WalHandle:
+               async_serialize: bool = False,
+               preserve_log: bool = False) -> WalHandle:
     """Make ``store`` durable: all subsequent mutations are logged.
-    Cuts an initial snapshot so pre-existing state is captured too."""
+    Cuts an initial snapshot so pre-existing state is captured too.
+
+    ``preserve_log=True`` (restart-after-restore): skip the initial
+    snapshot — which would TRUNCATE the log — and append to the
+    existing one instead (after repairing a torn tail from the crash).
+    The read tier depends on this: a replica resuming its subscription
+    across an owner restart replays the missed window from this log
+    (``wal_events_since``); a truncating attach would swallow exactly
+    the events between the replica's cursor and the crash and force a
+    full reseed."""
+    if preserve_log:
+        _repair_log_tail(os.path.join(directory, LOG_NAME))
     handle = WalHandle(store, directory, snapshot_every=snapshot_every,
                        fsync=fsync, async_serialize=async_serialize)
-    handle.snapshot()
+    if not preserve_log:
+        handle.snapshot()
     return handle
+
+
+def _repair_log_tail(path: str) -> None:
+    """Truncate a torn (crash-interrupted) final line so appends start
+    on a clean line boundary. Restore already tolerates the torn tail
+    by stopping replay; appending AFTER it would glue the next record
+    onto the fragment and lose it too."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data or data.endswith(b"\n"):
+        return
+    cut = data.rfind(b"\n")
+    os.truncate(path, cut + 1 if cut >= 0 else 0)
+
+
+def wal_events_since(directory: str, cursor: int):
+    """Parsed WAL entries with rv > ``cursor`` — the subscription
+    endpoint's resume source when its in-memory watch cache cannot
+    cover the window (a restarted owner starts with an empty cache).
+    Returns ``(covered, entries)``: ``covered`` is False when
+    compaction may have swallowed part of the window (a snapshot newer
+    than the cursor with no log line at-or-below it) — the caller must
+    answer 410 and the replica reseeds. Entries keep the on-disk shape
+    ({"t": "PUT"/"DEL", "k": kind, "rv": rv, ...}); duplicates below
+    the replica's per-object guard are harmless by contract."""
+    snap_rv = 0
+    snap_path = os.path.join(directory, SNAP_NAME)
+    if os.path.exists(snap_path):
+        try:
+            with open(snap_path, encoding="utf-8") as f:
+                snap_rv = int((json.load(f) or {}).get("rv") or 0)
+        except (json.JSONDecodeError, OSError, ValueError):
+            return False, []
+    entries = []
+    min_rv = None
+    log_path = os.path.join(directory, LOG_NAME)
+    if os.path.exists(log_path):
+        with open(log_path, encoding="utf-8") as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    line = json.loads(raw)
+                except json.JSONDecodeError:
+                    break   # torn tail write from a crash: stop here
+                line_rv = int(line.get("rv") or 0)
+                if min_rv is None or line_rv < min_rv:
+                    min_rv = line_rv
+                if line_rv > cursor:
+                    entries.append(line)
+    covered = cursor >= snap_rv \
+        or (min_rv is not None and min_rv <= cursor + 1)
+    return covered, entries
 
 
 def restore_store(directory: str,

@@ -388,6 +388,22 @@ class RestClusterClient:
         self._codec_pending_reneg: set = set()
         self.codec_renegotiations = 0
         self.codec_failures = 0
+        # -- read tier (apiserver/readtier.py) -------------------------
+        # per-partition replica endpoints from the topology doc's
+        # ``replicas`` field: resource reads route to a STICKY healthy
+        # replica — sticky, not per-request round-robin, because the
+        # RV watchdog is per (kind, partition) and replicas trail the
+        # owner by independent lags, so alternating replicas would
+        # read as false RV regressions. The pick advances only when
+        # the current replica fails or fences (TTL'd down-mark), and
+        # the watchdog baseline resets at exactly that seam.
+        self._replica_lock = threading.Lock()
+        self._read_replicas: Dict[int, List[Tuple[str, int]]] = {}
+        self._replica_pools: Dict[Tuple[int, int], _ConnPool] = {}
+        self._replica_pick: Dict[int, int] = {}
+        self._replica_down: Dict[Tuple[int, int], float] = {}
+        self.replica_reads = 0
+        self.replica_reroutes = 0
 
     def set_degraded_listener(
             self, listener: Callable[[bool], None]) -> None:
@@ -402,6 +418,143 @@ class RestClusterClient:
         chaos harness sever live transports after a server kill)."""
         for pool in self._pools.values():
             pool.close_all()
+        with self._replica_lock:
+            pools = list(self._replica_pools.values())
+        for pool in pools:
+            pool.close_all()
+
+    # -- read-tier routing ---------------------------------------------
+    _REPLICA_DOWN_TTL = 2.0
+
+    @staticmethod
+    def _replica_eligible(method: str, path: str) -> bool:
+        """Reads of resource paths ride replicas; control/meta paths
+        always hit the owner — the topology document especially (a
+        stale replica's doc could wedge routing), and the subscription
+        stream by definition (it IS the owner's commit log)."""
+        if method not in ("GET", "HEAD"):
+            return False
+        if not path.startswith("/api/v1/"):
+            return False
+        return not path.startswith(("/api/v1/partitiontopology",
+                                    "/api/v1/subscription"))
+
+    def set_read_replicas(self, replicas) -> None:
+        """Install per-partition read-replica URLs directly
+        ({partition: [url, ...]}) — harness wiring without a topology
+        doc; the topology path lands here too via
+        ``_install_routing_locked``."""
+        self._set_read_replicas({
+            int(p): tuple(us) for p, us in (replicas or {}).items()})
+
+    def _set_read_replicas(self, replicas) -> None:
+        with self._replica_lock:
+            new: Dict[int, List[Tuple[str, int]]] = {}
+            for p, urls in (replicas or {}).items():
+                eps = []
+                for u in urls:
+                    rest = u.split("://", 1)[1]
+                    host, _, port = rest.partition(":")
+                    eps.append((host, int(port or 80)))
+                if eps:
+                    new[int(p)] = eps
+            for p in set(self._read_replicas) | set(new):
+                if self._read_replicas.get(p) == new.get(p):
+                    continue
+                # the set changed for this partition: rebuild its pools
+                # and forget its down-marks/pick (indices renumbered)
+                for idx in range(len(self._read_replicas.get(p) or ())):
+                    pool = self._replica_pools.pop((p, idx), None)
+                    if pool is not None:
+                        pool.close_all()
+                    self._replica_down.pop((p, idx), None)
+                for idx, (host, port) in enumerate(new.get(p) or ()):
+                    self._replica_pools[(p, idx)] = _ConnPool(host, port)
+                self._replica_pick.pop(p, None)
+            self._read_replicas = new
+
+    def _reset_rv_baseline(self, partition: int) -> None:
+        # replica switch seam: the successor may trail the predecessor,
+        # so its list RVs are BEHIND — that is staleness (bounded by
+        # the fence), not the regression the watchdog hunts
+        with self._rv_lock:
+            for key in [k for k in self._last_rv if k[1] == partition]:
+                del self._last_rv[key]
+
+    def _pick_replica(self, partition: int) -> Optional[int]:
+        """Sticky healthy replica index for a partition, or None (no
+        replicas advertised / all down → owner serves the read)."""
+        switched = False
+        with self._replica_lock:
+            reps = self._read_replicas.get(partition)
+            if not reps:
+                return None
+            n = len(reps)
+            start = self._replica_pick.get(partition)
+            if start is None:
+                # first pick: spread distinct client instances across
+                # the replica set instead of herding onto replica 0
+                start = (id(self) >> 6) % n
+                self._replica_pick[partition] = start
+            start %= n
+            now = time.monotonic()
+            pick = None
+            for k in range(n):
+                idx = (start + k) % n
+                if self._replica_down.get((partition, idx), 0.0) > now:
+                    continue
+                pick = idx
+                break
+            if pick is None:
+                return None
+            if pick != start:
+                self._replica_pick[partition] = pick
+                switched = True
+        if switched:
+            self._reset_rv_baseline(partition)
+        return pick
+
+    def _mark_replica_down(self, partition: int, idx: int) -> None:
+        """TTL'd down-mark after a transport failure or fence 503: the
+        next pick skips this replica (and the owner absorbs the reads
+        if every sibling is down too)."""
+        with self._replica_lock:
+            self._replica_down[(partition, idx)] = \
+                time.monotonic() + self._REPLICA_DOWN_TTL
+            reps = self._read_replicas.get(partition) or []
+            if reps and self._replica_pick.get(partition) == idx:
+                self._replica_pick[partition] = (idx + 1) % len(reps)
+            self.replica_reroutes += 1
+        self._reset_rv_baseline(partition)
+
+    def _read_pool(self, partition: int,
+                   lane: str) -> Tuple["_ConnPool", Optional[int]]:
+        """Connection pool for a replica-eligible read: the sticky
+        healthy replica's pool, else the owner's ro pool."""
+        idx = self._pick_replica(partition)
+        if idx is not None:
+            with self._replica_lock:
+                pool = self._replica_pools.get((partition, idx))
+            if pool is not None:
+                self.replica_reads += 1
+                return pool, idx
+        return self._pools[(partition, lane)], None
+
+    def _read_endpoint(self, partition: int
+                       ) -> Tuple[str, int, Optional[int]]:
+        """(host, port, replica_idx|None) for a watch stream — watch
+        fan-out is the read tier's whole reason to exist, so streams
+        ride replicas exactly like lists do."""
+        idx = self._pick_replica(partition)
+        if idx is not None:
+            with self._replica_lock:
+                reps = self._read_replicas.get(partition) or []
+                if idx < len(reps):
+                    host, port = reps[idx]
+                    self.replica_reads += 1
+                    return host, port, idx
+        host, port = self._endpoints[partition]
+        return host, port, None
 
     def _headers(self, body_binary: bool) -> Dict[str, str]:
         h: Dict[str, str] = {}
@@ -537,7 +690,12 @@ class RestClusterClient:
         if route is not None:
             partition = route()
         lane = "ro" if method in ("GET", "HEAD") else "rw"
-        pool = self._pools[(partition, lane)]
+        use_replica = self._replica_eligible(method, path)
+        replica_idx: Optional[int] = None
+        if use_replica:
+            pool, replica_idx = self._read_pool(partition, lane)
+        else:
+            pool = self._pools[(partition, lane)]
         headers = self._headers(body_binary)
         if trace_ctx:
             # fleet tracing: propagated context (trace id + parent span
@@ -590,10 +748,19 @@ class RestClusterClient:
                 # successor URL, and a retry pinned to the pre-seam
                 # pool object would dial the dead port until the budget
                 # ran out (a rolling upgrade turns that into a lost
-                # write)
+                # write). Same rule for the read tier: a read that died
+                # against a replica down-marks it FIRST, so this
+                # re-resolve — and every sibling caller's — redirects
+                # to a healthy replica or the owner instead of burning
+                # the whole retry budget on a dead replica.
+                if replica_idx is not None:
+                    self._mark_replica_down(partition, replica_idx)
                 if route is not None:
                     partition = route()
-                pool = self._pools[(partition, lane)]
+                if use_replica:
+                    pool, replica_idx = self._read_pool(partition, lane)
+                else:
+                    pool = self._pools[(partition, lane)]
                 pool.prewarm(1)
                 continue
             if resp.status == 429 \
@@ -647,7 +814,30 @@ class RestClusterClient:
                 attempt += 1
                 if route is not None:
                     partition = route()
-                pool = self._pools[(partition, lane)]
+                if use_replica:
+                    pool, replica_idx = self._read_pool(partition, lane)
+                else:
+                    pool = self._pools[(partition, lane)]
+                continue
+            if resp.status == 503 and replica_idx is not None \
+                    and resp.headers.get("X-Replica-Fenced") \
+                    and attempt < max_r \
+                    and self._retry_budget.try_spend():
+                # fenced replica: its OWN staleness verdict, not
+                # overload — no Retry-After wait. Down-mark it and
+                # re-route this very attempt to a sibling (or the
+                # owner); the relist cost stays confined to clients
+                # that were pinned to the fenced replica.
+                if resp.will_close:
+                    _ConnPool.discard(conn)
+                else:
+                    pool.release(conn)
+                conn = None
+                self.breaker.record_success()
+                self._mark_replica_down(partition, replica_idx)
+                self._note_retry(method, "replica_fenced")
+                pool, replica_idx = self._read_pool(partition, lane)
+                attempt += 1
                 continue
             if resp.status in (429, 503) and attempt < max_r \
                     and self._retry_budget.try_spend():
@@ -698,7 +888,12 @@ class RestClusterClient:
                 _ConnPool.discard(conn)
             else:
                 pool.release(conn)
-            self._record_negotiated(partition, resp)
+            if replica_idx is None:
+                # replica echoes don't feed the per-partition codec pin
+                # ledger — that contract is with the OWNER process, and
+                # a same-version replica answering between two owner
+                # echoes would read as a phantom re-negotiation
+                self._record_negotiated(partition, resp)
             ctype = resp.headers.get("Content-Type") or ""
             if ctype.startswith(codec.BINARY_CONTENT_TYPE):
                 return resp.status, codec.decode(raw)
@@ -908,6 +1103,10 @@ class RestClusterClient:
             for p in changed:
                 if self.negotiated_codec.pop(p, None) is not None:
                     self._codec_pending_reneg.add(p)
+        # read-tier advertisement: (re)build replica routing from the
+        # doc — an epoch that adds/removes replicas reaches every
+        # client through the same poll/429 channels as ownership moves
+        self._set_read_replicas(getattr(topo, "replicas", None) or {})
 
     def _list(self, kind: str, namespace: Optional[str] = None) -> List[Any]:
         parts = self._pset(kind, namespace)
@@ -1891,7 +2090,7 @@ class RestClusterClient:
                       partition: int = 0, stream_key=None,
                       stop: Optional[threading.Event] = None) -> None:
         plural = KIND_TO_PLURAL.get(kind, kind.lower() + "s")
-        host, port = self._endpoints[partition]
+        host, port, w_replica = self._read_endpoint(partition)
         conn = http.client.HTTPConnection(host, port, timeout=300)
         conn.connect()
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -1925,8 +2124,10 @@ class RestClusterClient:
             # the stream's wire contract is pinned for its whole life
             # (server-side too); record it so a restart seam that puts
             # a different-version server behind this partition shows up
-            # as a re-negotiation
-            self._record_negotiated(partition, resp)
+            # as a re-negotiation (owner streams only — replica echoes
+            # stay out of the owner's pin ledger, as in _request)
+            if w_replica is None:
+                self._record_negotiated(partition, resp)
             if resp.status != 200:
                 resp.read()
                 if resp.status == 410:

@@ -279,6 +279,30 @@ def format_federation(info: Optional[Dict]) -> str:
     return "federation[" + " ".join(parts) + "]"
 
 
+def format_readtier(info: Optional[Dict]) -> str:
+    """The read-tier segment: how wide the tier ran (``replicas``),
+    how many list+watch streams rode it (``streams``), the worst
+    replica's replication-lag p99 (``lag_p99_ms`` — the staleness the
+    fence state machine judges against the lag budget), how many fence
+    trips fired (``fenced`` — a replica past budget self-severing its
+    readers), and ``relists`` (MUST be zero outside a killed or fenced
+    process — the watch contract's confinement counter). Emitted by
+    the watch-herd rows and the readtier chaos cells; parsed by the
+    generic bracket scan in ``parse_diag`` (key ``readtier``) —
+    tools/perf_report.py reads it to gate the ``readtier_flags``
+    family."""
+    if not info:
+        return ""
+    parts = [
+        f"replicas={int(info.get('replicas', 0))}",
+        f"streams={int(info.get('streams', 0))}",
+        f"lag_p99_ms={float(info.get('lag_p99_ms', 0.0)):.1f}",
+        f"fenced={int(info.get('fenced', 0))}",
+        f"relists={int(info.get('relists', 0))}",
+    ]
+    return "readtier[" + " ".join(parts) + "]"
+
+
 def format_critpath(info: Optional[Dict]) -> str:
     """The fleet critical-path segment: which phase owns the sampled
     pods' end-to-end latency (``top``/``share``), how much of the

@@ -81,6 +81,13 @@ class FreshnessMetrics:
             "in the encoded planes the solver ran against",
             buckets=_STALENESS_BUCKETS,
         )
+        self.replication_lag_seconds = _histogram(
+            registry, "replication_lag_seconds",
+            "Read-tier replication lag, owner commit to replica apply, "
+            "by replica id (the staleness the fence state machine "
+            "evaluates against the lag budget)",
+            ("replica",), buckets=_STALENESS_BUCKETS,
+        )
 
     def configure(self, enabled: Optional[bool] = None) -> None:
         if enabled is not None:
@@ -93,6 +100,7 @@ class FreshnessMetrics:
         self.watch_delivery_seconds.clear()
         self.informer_lag_seconds.clear()
         self.snapshot_staleness_seconds.clear()
+        self.replication_lag_seconds.clear()
 
 
 _default: Optional[FreshnessMetrics] = None

@@ -514,6 +514,148 @@ class TestFederationFlags:
         assert len(doc["federation_flags"]) == 1
 
 
+class TestReadtierFlags:
+    _ARM = ("watchherd[320 informers R=4, 289 events open-loop 12/s "
+            "seed=16, REST fabric]")
+    _SCALING = ("watchherd_scaling[R=4 vs R=0, 320 informers seed=16, "
+                "per owner-cpu-second]")
+    _CELL = "watchherd_cell[replica_kill seed=16]"
+
+    def _arm(self, tmp_path, n, **extra):
+        base = {"replicas": 4, "lost_events": 0,
+                "unconverged_informers": 0, "dup_suppressed": 0,
+                "relists": 0, "replica_reads": 12,
+                "replication_lag_p99_ms": 80.0, "lag_budget_ms": 500.0,
+                "invariants_ok": True,
+                "freshness": {"slo": {"replication_lag": "ok"}}}
+        base.update(extra)
+        _artifact(tmp_path, n, 5000.0, metric=self._ARM, extra=base)
+
+    def _scaling(self, tmp_path, n, **extra):
+        base = {"read_scaling_x": 10.4, "read_scaling_floor_x": 1.5,
+                "write_flat_ok": True, "write_ratio": 1.0,
+                "differential_match": True, "invariants_ok": True}
+        base.update(extra)
+        _artifact(tmp_path, n, 10.4, metric=self._SCALING, extra=base)
+
+    def _cell(self, tmp_path, n, **extra):
+        base = {"ok": True, "lost_events": 0,
+                "relists_beyond_faulted": 0}
+        base.update(extra)
+        _artifact(tmp_path, n, 1.0, metric=self._CELL, extra=base)
+
+    def test_green_rows_pass(self, tmp_path):
+        from tools.perf_report import main, readtier_flags
+
+        self._arm(tmp_path, 1)
+        self._scaling(tmp_path, 2)
+        self._cell(tmp_path, 3)
+        assert readtier_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_lost_events_gate_strict(self, tmp_path):
+        from tools.perf_report import main, readtier_flags
+
+        self._arm(tmp_path, 1, lost_events=3, unconverged_informers=3)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "lost_events=3" in probs
+        assert "unconverged_informers=3" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_dups_and_relists_flagged(self, tmp_path):
+        from tools.perf_report import readtier_flags
+
+        self._arm(tmp_path, 1, dup_suppressed=2, relists=5)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "dup_suppressed=2" in probs
+        assert "relists=5" in probs
+
+    def test_unused_replicas_flagged(self, tmp_path):
+        from tools.perf_report import readtier_flags
+
+        # reads never touched a replica while replicas were advertised
+        self._arm(tmp_path, 1, replica_reads=0)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        assert "replica_reads=0" in flag["problems"][0]
+        # vacuous on the replicas-off differential arm
+        self._arm(tmp_path, 2, replicas=0, replica_reads=0)
+        flags = readtier_flags(load_rounds(str(tmp_path)))
+        assert [f["round"] for f in flags] == [1]
+
+    def test_lag_over_budget_and_red_slo_gate_strict(self, tmp_path):
+        from tools.perf_report import main, readtier_flags
+
+        self._arm(tmp_path, 1, replication_lag_p99_ms=740.0,
+                  freshness={"slo": {"replication_lag": "violated"}})
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "lag p99 740.0ms over the 500ms budget" in probs
+        assert "freshness SLO red: replication_lag" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_scaling_below_floor_gates_strict(self, tmp_path):
+        from tools.perf_report import main, readtier_flags
+
+        self._scaling(tmp_path, 1, read_scaling_x=1.2,
+                      invariants_ok=False)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        assert "read scaling 1.20x < 1.5x floor" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_write_regression_and_differential_flagged(self, tmp_path):
+        from tools.perf_report import readtier_flags
+
+        self._scaling(tmp_path, 1, write_flat_ok=False,
+                      write_ratio=0.7, differential_match=False,
+                      invariants_ok=False)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "write throughput regressed" in probs
+        assert "differential arms disagree" in probs
+
+    def test_failed_cell_gates_strict(self, tmp_path):
+        from tools.perf_report import main, readtier_flags
+
+        self._cell(tmp_path, 1, ok=False,
+                   failure="2 relists beyond the killed replica",
+                   lost_events=1, relists_beyond_faulted=2)
+        (flag,) = readtier_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "2 relists beyond the killed replica" in probs
+        assert "lost_events=1" in probs
+        assert "relists_beyond_faulted=2" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_flags_survive_json_mode(self, tmp_path, capsys):
+        from tools.perf_report import main
+
+        self._arm(tmp_path, 1, lost_events=1)
+        main(["--dir", str(tmp_path), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["readtier_flags"]) == 1
+
+    def test_committed_watchherd_log_is_strict_clean(self):
+        from tools.perf_report import readtier_flags
+
+        path = os.path.join(_REPO_ROOT, "watchherd_rows.log")
+        with open(path) as f:
+            rows = _rows_from_tail(f.read())
+        assert any(r["metric"].startswith("watchherd[") for r in rows)
+        assert any(r["metric"].startswith("watchherd_scaling[")
+                   for r in rows)
+        assert any(r["metric"].startswith("watchherd_cell[")
+                   for r in rows)
+        fake_round = [{"round": 0, "rows": rows}]
+        assert readtier_flags(fake_round) == []
+        # the committed scaling row proves the headline claims
+        (srow,) = [r for r in rows
+                   if r["metric"].startswith("watchherd_scaling[")]
+        assert srow["read_scaling_x"] >= srow["read_scaling_floor_x"]
+        assert srow["write_flat_ok"] and srow["differential_match"]
+
+
 # ---------------------------------------------------------------------------
 # committed artifacts: the tier-1 smoke over the real trajectory
 

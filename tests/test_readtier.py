@@ -1,0 +1,359 @@
+"""Read-tier subsystem (apiserver/readtier.py + harness/watchherd.py).
+
+Tier-1 coverage for the watch-replica tier:
+
+- the in-process mini-cell: one owner apiserver, two ``ReadReplica``
+  mirrors, a 10-informer herd through a live writer, and one replica
+  hard-killed mid-stream — every informer converges to the owner's
+  truth with zero lost and zero double-applied events, relists stay
+  confined to the killed replica's informers, and the surviving
+  replica's store is identical to the owner's;
+- ``FenceStateMachine`` hysteresis: consecutive-sample trip and clear
+  thresholds, the half-budget clear bar, counter semantics;
+- subscription resume-from-RV: a severed ``ReplicationClient`` resumes
+  from its cursor and converges — INCLUDING when a create+delete pair
+  landed entirely inside the outage window (the lazily re-encoded
+  replay must not stamp the create at the delete's revision; the store
+  stamps deletion RVs on a copy for exactly this reason);
+- the store's deletion-copy contract directly: committed watch events
+  are immutable history, a delete must never rewrite them in place;
+- ``RestClusterClient`` read-route re-resolution per transport-retry
+  attempt: a read that dies against a dead or fenced replica
+  down-marks it and the SAME call re-routes to the owner instead of
+  burning its retry budget on the dead pool;
+- the ``readtier[...]`` diag segment round-trips through the one
+  writer (``diagfmt.format_readtier``) and the one parser
+  (``diagfmt.parse_diag``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver.readtier import (
+    FenceStateMachine,
+    ReadReplica,
+    ReplicationClient,
+)
+from kubernetes_tpu.apiserver.rest import APIServer
+from kubernetes_tpu.apiserver.store import ADDED, DELETED, ClusterStore
+from kubernetes_tpu.client.restcluster import RestClusterClient
+from kubernetes_tpu.harness import diagfmt
+from kubernetes_tpu.harness.burst import make_burst_pods
+from kubernetes_tpu.harness.watchherd import run_readtier_mini_cell
+
+
+# ---------------------------------------------------------------------------
+# the mini-cell: run once, assert many invariants
+
+
+@pytest.fixture(scope="module")
+def mini_cell():
+    return run_readtier_mini_cell()
+
+
+class TestReadTierMiniCell:
+    def test_every_informer_converged_to_owner_truth(self, mini_cell):
+        assert mini_cell["unconverged"] == 0
+        assert mini_cell["lost_events"] == 0
+        assert mini_cell["truth_objects"] > 0
+
+    def test_replica_kill_relists_are_confined(self, mini_cell):
+        # the killed replica's informers must relist (their streams
+        # died mid-watch) — and NOBODY else may
+        assert mini_cell["relists_on_killed"] >= 1
+        assert mini_cell["relists_beyond_killed"] == 0
+        assert mini_cell["killed_informers"] > 0
+
+    def test_cursor_handoff_never_double_applies(self, mini_cell):
+        # dup_suppressed counts frames the informers' per-key
+        # high-water filter caught across the relist handoff — they
+        # were suppressed, never re-applied, so convergence (asserted
+        # above) plus zero lost events IS the no-double-apply proof
+        assert mini_cell["delivered_total"] > 0
+
+    def test_surviving_replica_store_matches_owner(self, mini_cell):
+        assert mini_cell["replica_truth_match"] is True
+
+    def test_survivor_never_reseeded(self, mini_cell):
+        # the owner stayed up: the survivor's subscription must have
+        # held (or resumed from its cursor) — a reseed here would mean
+        # the cursor resume path is broken
+        assert mini_cell["survivor_stats"]["reseeds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fence hysteresis
+
+
+class TestFenceStateMachine:
+    def test_trips_after_consecutive_over_budget_samples(self):
+        f = FenceStateMachine(lag_budget_s=0.1, trip_after=3)
+        assert f.observe(0.2) is None
+        assert f.observe(0.2) is None
+        assert f.observe(0.2) is True
+        assert f.fenced and f.fences == 1
+
+    def test_one_good_sample_resets_the_trip_counter(self):
+        f = FenceStateMachine(lag_budget_s=0.1, trip_after=3)
+        f.observe(0.2)
+        f.observe(0.2)
+        assert f.observe(0.05) is None      # hiccup over, streak broken
+        f.observe(0.2)
+        f.observe(0.2)
+        assert not f.fenced                 # needs 3 consecutive again
+        assert f.observe(0.2) is True
+
+    def test_clears_only_after_sustained_half_budget_headroom(self):
+        f = FenceStateMachine(lag_budget_s=0.1, trip_after=1,
+                              clear_after=3)
+        assert f.observe(0.5) is True
+        assert f.observe(0.04) is None
+        assert f.observe(0.04) is None
+        # just-under-budget is NOT headroom: the streak resets
+        assert f.observe(0.09) is None
+        assert f.fenced
+        assert f.observe(0.04) is None
+        assert f.observe(0.04) is None
+        # third consecutive half-budget sample: unfence transition
+        assert f.observe(0.04) is False
+        assert not f.fenced
+
+    def test_unfence_returns_false_and_refence_counts(self):
+        f = FenceStateMachine(lag_budget_s=0.1, trip_after=1,
+                              clear_after=2)
+        assert f.observe(0.5) is True
+        assert f.observe(0.01) is None
+        assert f.observe(0.01) is False
+        assert not f.fenced
+        assert f.observe(0.5) is True
+        assert f.fences == 2
+
+
+# ---------------------------------------------------------------------------
+# the store's deletion-copy contract (the read tier's correctness rests
+# on committed watch history being immutable)
+
+
+class TestDeletionCopy:
+    def test_delete_does_not_mutate_the_committed_added_event(self):
+        store = ClusterStore()
+        events = []
+        handle = store.watch(events.append)
+        try:
+            (pod,) = make_burst_pods(1, name_prefix="dc-",
+                                     uid_prefix="dcu-")
+            store.create_pod(pod)
+            added = next(e for e in events if e.type == ADDED)
+            create_rv = int(added.obj.metadata.resource_version)
+            store.delete_pod(pod.namespace, pod.metadata.name)
+            deleted = next(e for e in events if e.type == DELETED)
+            # the delete got its own, newer revision — stamped on a
+            # COPY, never on the instance the ADDED event references
+            assert int(deleted.obj.metadata.resource_version) > create_rv
+            assert deleted.obj is not added.obj
+            assert int(added.obj.metadata.resource_version) == create_rv
+        finally:
+            handle.stop()
+
+    def test_bulk_delete_keeps_committed_history_immutable(self):
+        store = ClusterStore()
+        events = []
+        handle = store.watch(events.append)
+        try:
+            pods = make_burst_pods(3, name_prefix="dcb-",
+                                   uid_prefix="dcbu-")
+            for p in pods:
+                store.create_pod(p)
+            created = {e.obj.metadata.name:
+                       int(e.obj.metadata.resource_version)
+                       for e in events if e.type == ADDED}
+            store.delete_pods([(p.namespace, p.metadata.name)
+                               for p in pods])
+            for e in events:
+                if e.type != ADDED:
+                    continue
+                assert int(e.obj.metadata.resource_version) == \
+                    created[e.obj.metadata.name]
+        finally:
+            handle.stop()
+
+
+# ---------------------------------------------------------------------------
+# subscription resume-from-RV
+
+
+class TestSubscriptionResume:
+    def _truth(self, store):
+        return sorted((p.namespace, p.metadata.name,
+                       int(p.metadata.resource_version))
+                      for p in store.list_pods())
+
+    def _wait_match(self, mirror, store, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._truth(mirror) == self._truth(store):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_severed_stream_resumes_from_cursor(self):
+        store = ClusterStore()
+        owner = APIServer(store=store).start()
+        mirror = ClusterStore()
+        repl = ReplicationClient(owner.url, mirror, replica_id="tr0")
+        try:
+            repl.start()
+            assert repl.seeded.wait(10.0)
+            for p in make_burst_pods(5, name_prefix="sr-",
+                                     uid_prefix="sru-"):
+                store.create_pod(p)
+            assert self._wait_match(mirror, store)
+            cursor_before = repl.cursor
+            owner.sever_connections()
+            for p in make_burst_pods(5, name_prefix="sr2-",
+                                     uid_prefix="sr2u-"):
+                store.create_pod(p)
+            assert self._wait_match(mirror, store)
+            assert repl.resumes >= 1
+            assert repl.reseeds == 0
+            assert repl.cursor > cursor_before
+        finally:
+            repl.stop()
+            owner.shutdown_server()
+
+    def test_delete_inside_the_outage_window_is_not_resurrected(self):
+        # the regression the deletion-copy fix closes: a pod created
+        # AND deleted while the subscription was down used to replay
+        # its create lazily re-encoded at the delete's revision, so
+        # the delete that followed was collapsed as a duplicate and
+        # the mirror kept the pod forever
+        store = ClusterStore()
+        owner = APIServer(store=store).start()
+        mirror = ClusterStore()
+        repl = ReplicationClient(owner.url, mirror, replica_id="tr1")
+        try:
+            repl.start()
+            assert repl.seeded.wait(10.0)
+            for p in make_burst_pods(3, name_prefix="dw-",
+                                     uid_prefix="dwu-"):
+                store.create_pod(p)
+            assert self._wait_match(mirror, store)
+            owner.sever_connections()
+            (ghost,) = make_burst_pods(1, name_prefix="ghost-",
+                                       uid_prefix="ghostu-")
+            store.create_pod(ghost)
+            store.delete_pod(ghost.namespace, ghost.metadata.name)
+            (keeper,) = make_burst_pods(1, name_prefix="keep-",
+                                        uid_prefix="keepu-",
+                                        offset=1)
+            store.create_pod(keeper)
+            assert self._wait_match(mirror, store)
+            names = {p.metadata.name for p in mirror.list_pods()}
+            assert ghost.metadata.name not in names
+            assert keeper.metadata.name in names
+            assert repl.reseeds == 0
+        finally:
+            repl.stop()
+            owner.shutdown_server()
+
+
+# ---------------------------------------------------------------------------
+# client read routing: per-attempt re-resolution
+
+
+class TestClientReadRouting:
+    def _seed(self, store, n, prefix):
+        for p in make_burst_pods(n, name_prefix=prefix,
+                                 uid_prefix=prefix + "u"):
+            store.create_pod(p)
+
+    def test_reads_ride_the_advertised_replica(self):
+        store = ClusterStore()
+        owner = APIServer(store=store).start()
+        rep = ReadReplica(owner.url, replica_id="rt0")
+        client = None
+        try:
+            self._seed(store, 4, "rr-")
+            rep.start(seed_timeout=10.0)
+            client = RestClusterClient(owner.url)
+            client.set_read_replicas({0: [rep.url]})
+            pods = client.list_pods()
+            assert len(pods) == 4
+            assert client.replica_reads >= 1
+        finally:
+            if client is not None:
+                client._drop_conn()
+            rep.stop()
+            owner.shutdown_server()
+
+    def test_dead_replica_reroutes_within_one_call(self):
+        store = ClusterStore()
+        owner = APIServer(store=store).start()
+        rep = ReadReplica(owner.url, replica_id="rt1")
+        client = None
+        try:
+            self._seed(store, 3, "dr-")
+            rep.start(seed_timeout=10.0)
+            client = RestClusterClient(owner.url)
+            client.set_read_replicas({0: [rep.url]})
+            assert len(client.list_pods()) == 3
+            rep.kill()
+            # the SAME call must down-mark the dead replica on its
+            # transport error and re-resolve to the owner — not dial
+            # the dead pool until the retry budget runs out
+            pods = client.list_pods()
+            assert len(pods) == 3
+            assert client.replica_reroutes >= 1
+        finally:
+            if client is not None:
+                client._drop_conn()
+            owner.shutdown_server()
+
+    def test_fenced_replica_503_redirects_to_owner(self):
+        store = ClusterStore()
+        owner = APIServer(store=store).start()
+        rep = ReadReplica(owner.url, replica_id="rt2")
+        client = None
+        try:
+            self._seed(store, 2, "fr-")
+            rep.start(seed_timeout=10.0)
+            client = RestClusterClient(owner.url)
+            client.set_read_replicas({0: [rep.url]})
+            assert len(client.list_pods()) == 2
+            rep.server.fenced.set()
+            pods = client.list_pods()
+            assert len(pods) == 2
+            assert client.replica_reroutes >= 1
+        finally:
+            if client is not None:
+                client._drop_conn()
+            rep.stop()
+            owner.shutdown_server()
+
+
+# ---------------------------------------------------------------------------
+# diag round-trip
+
+
+class TestReadtierDiag:
+    def test_round_trips_through_parse_diag(self):
+        seg = diagfmt.format_readtier({
+            "replicas": 4, "streams": 320, "lag_p99_ms": 379.58,
+            "fenced": 1, "relists": 0,
+        })
+        parsed = diagfmt.parse_diag(f"    diag: {seg}")
+        assert parsed is not None
+        rt = parsed["readtier"]
+        assert rt["replicas"] == 4
+        assert rt["streams"] == 320
+        assert rt["lag_p99_ms"] == pytest.approx(379.6, abs=0.05)
+        assert rt["fenced"] == 1
+        assert rt["relists"] == 0
+
+    def test_empty_info_emits_nothing(self):
+        assert diagfmt.format_readtier(None) == ""
+        assert diagfmt.format_readtier({}) == ""  # falsy info: no segment

@@ -116,8 +116,8 @@ def main() -> int:
     parser.add_argument("--suite", default="both",
                         choices=("rest", "nodes", "scale", "overload",
                                  "partition", "replay", "reshard",
-                                 "upgrade", "federation", "both",
-                                 "all"))
+                                 "upgrade", "federation", "readtier",
+                                 "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -150,6 +150,14 @@ def main() -> int:
                              "at 25/50/75%% of the storm "
                              "(loss-early,loss-mid,loss-late), or both "
                              "at once (spill-loss)")
+    parser.add_argument("--readtier",
+                        default="replica_kill,owner_restart,lag_fence",
+                        help="readtier-suite scenarios: read-replica "
+                             "SIGKILL mid-herd (replica_kill), owner "
+                             "SIGKILL + same-port WAL restart with "
+                             "replicas live (owner_restart), or a "
+                             "slow replica blowing its lag budget "
+                             "(lag_fence)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -208,6 +216,13 @@ def main() -> int:
             parser.error(
                 f"unknown federation scenario {p!r} "
                 f"(have: {', '.join(sorted(FEDERATION_SCENARIOS))})")
+    from kubernetes_tpu.harness.watchherd import READTIER_SCENARIOS
+
+    for p in args.readtier.split(","):
+        if p and p not in READTIER_SCENARIOS:
+            parser.error(
+                f"unknown readtier scenario {p!r} "
+                f"(have: {', '.join(sorted(READTIER_SCENARIOS))})")
 
     from kubernetes_tpu.harness.chaos_nodes import run_chaos_nodes
     from kubernetes_tpu.harness.chaos_rest import run_chaos_rest
@@ -279,6 +294,20 @@ def main() -> int:
         _run_suite(args, progress, rows, "federation",
                    run_chaos_federation, "scenario",
                    [s for s in args.federation.split(",") if s])
+    if args.suite in ("readtier", "all"):
+        # read-tier cells: a spawned owner + read replicas serving an
+        # informer herd through a live writer, crossing replica
+        # SIGKILL mid-herd (relists confined to the dead replica,
+        # zero lost fleet-wide) × owner SIGKILL + same-port WAL
+        # restart (replicas resubscribe from their cursor — no full
+        # reseed, replica-served streams never break) × a slow
+        # replica blowing its lag budget (self-fence, clients
+        # re-route, relists confined)
+        from kubernetes_tpu.harness.watchherd import run_chaos_readtier
+
+        _run_suite(args, progress, rows, "readtier",
+                   run_chaos_readtier, "scenario",
+                   [s for s in args.readtier.split(",") if s])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

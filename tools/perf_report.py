@@ -833,6 +833,123 @@ def federation_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def readtier_flags(rounds: List[dict]) -> List[dict]:
+    """The ``watchherd*`` family's own checks (ISSUE 19 satellite):
+    read-tier rows are LOSS-AND-STALENESS rows — replica-served
+    watches only earn their keep if every informer converges to the
+    owner's truth with zero lost or duplicated events, replicas stay
+    inside their lag budget, and the replicated fan-out actually
+    scales. Flag the round when:
+
+    - an arm row (``watchherd[...]``) lost events (``lost_events`` or
+      ``unconverged_informers`` > 0 — an informer's steady state
+      diverged from the owner's truth at quiesce), re-applied a
+      duplicate (``dup_suppressed`` > 0 on the happy path), relisted
+      (``relists`` > 0 — a healthy tier never breaks a watch), never
+      routed a single read through a replica while replicas were
+      advertised (``replica_reads`` < 1 with ``replicas`` > 0),
+      blew the replication-lag budget
+      (``replication_lag_p99_ms`` > ``lag_budget_ms``), went red on
+      the freshness SLO, or failed any hard invariant;
+    - the scaling row (``watchherd_scaling[...]``) shows fan-out per
+      owner CPU-second below the committed floor (``read_scaling_x``
+      < ``read_scaling_floor_x``), the write path regressing against
+      the replicas-off arm (``write_flat_ok`` false), or the
+      differential arms disagreeing on final state
+      (``differential_match`` false — replicas changed WHAT was
+      stored, not just who served it);
+    - a chaos cell row (``watchherd_cell[...]``) failed its scenario
+      judgement (``ok``/``invariants_ok`` false), lost events, or
+      leaked relists beyond the faulted replica
+      (``relists_beyond_faulted`` > 0).
+
+    All gate ``--strict``."""
+    flags: List[dict] = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            metric = str(row.get("metric", ""))
+            if not metric.startswith(("watchherd[", "watchherd_scaling[",
+                                      "watchherd_cell[")) \
+                    or "error" in row:
+                continue
+            problems = []
+            if metric.startswith("watchherd["):
+                if row.get("lost_events"):
+                    problems.append(
+                        f"lost_events={row['lost_events']} (informer "
+                        f"steady state diverged from owner truth)")
+                if row.get("unconverged_informers"):
+                    problems.append(
+                        f"unconverged_informers="
+                        f"{row['unconverged_informers']} (herd never "
+                        f"reached the owner's state hash)")
+                if row.get("dup_suppressed"):
+                    problems.append(
+                        f"dup_suppressed={row['dup_suppressed']} "
+                        f"(duplicate frames on the happy path)")
+                if row.get("relists"):
+                    problems.append(
+                        f"relists={row['relists']} (a healthy read "
+                        f"tier never breaks a watch)")
+                if (row.get("replicas") and
+                        not row.get("replica_reads")):
+                    problems.append(
+                        "replica_reads=0 with replicas advertised "
+                        "(reads never routed through the read tier)")
+                lag = row.get("replication_lag_p99_ms")
+                budget = row.get("lag_budget_ms")
+                if (lag is not None and budget
+                        and float(lag) > float(budget)):
+                    problems.append(
+                        f"replication lag p99 {float(lag):.1f}ms over "
+                        f"the {float(budget):.0f}ms budget")
+                slo = (row.get("freshness") or {}).get("slo") or {}
+                if any(v == "violated" for v in slo.values()):
+                    red = [k for k, v in slo.items() if v == "violated"]
+                    problems.append(
+                        f"freshness SLO red: {', '.join(red)}")
+            elif metric.startswith("watchherd_scaling["):
+                floor = float(row.get("read_scaling_floor_x") or 1.5)
+                sx = row.get("read_scaling_x")
+                if sx is not None and float(sx) < floor:
+                    problems.append(
+                        f"read scaling {float(sx):.2f}x < {floor:.1f}x "
+                        f"floor (fan-out per owner CPU-second)")
+                if row.get("write_flat_ok") is False:
+                    problems.append(
+                        f"write throughput regressed vs the "
+                        f"replicas-off arm "
+                        f"(ratio {row.get('write_ratio')})")
+                if row.get("differential_match") is False:
+                    problems.append(
+                        "differential arms disagree on final state "
+                        "(replicas changed what was stored)")
+            else:  # watchherd_cell[...]
+                if row.get("ok") is False:
+                    problems.append(
+                        f"cell failed: {row.get('failure') or '?'}")
+                if row.get("lost_events"):
+                    problems.append(
+                        f"lost_events={row['lost_events']} across the "
+                        f"fault")
+                if row.get("relists_beyond_faulted"):
+                    problems.append(
+                        f"relists_beyond_faulted="
+                        f"{row['relists_beyond_faulted']} (fault seam "
+                        f"leaked relists past the faulted replica)")
+            if row.get("invariants_ok") is False:
+                why = row.get("invariants") or row.get("failure") or "?"
+                problems.append(f"invariants failed: {why}")
+            if problems:
+                flags.append({
+                    "metric": metric,
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -915,6 +1032,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     upg_flags = upgrade_flags(rounds)
     fed_flags = federation_flags(rounds)
     crit_flags = critpath_flags(rounds)
+    rt_flags = readtier_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -936,6 +1054,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "upgrade_flags": upg_flags,
             "federation_flags": fed_flags,
             "critpath_flags": crit_flags,
+            "readtier_flags": rt_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -980,6 +1099,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in crit_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if rt_flags:
+            print("\nread-tier watch-herd flags:")
+            for f in rt_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -992,7 +1116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                  and (open_flags or scale_flags or dev_flags
                       or rep_flags or sus_flags or hot_flags
                       or upg_flags or fed_flags
-                      or crit_flags)) else 0
+                      or crit_flags or rt_flags)) else 0
 
 
 if __name__ == "__main__":
