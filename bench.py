@@ -166,14 +166,15 @@ def _diagnose(sched, bs) -> None:
         devprof_seg = ""
         mesh_seg = ""
         pipe_seg = ""
+        mirror_seg = ""
         if bs is not None:
             sess = " " + diagfmt.format_session(
                 bs.session, bs._chunk, bs.max_cycle_s, bs.pad_warms)
             from kubernetes_tpu.observability.devprof import get_devprof
 
             dp = get_devprof()
+            summary = dp.summary() if dp.enabled else None
             if dp.enabled:
-                summary = dp.summary()
                 if summary["cycles"] or summary["warm_compiles"]:
                     devprof_seg = " " + diagfmt.format_devprof(summary)
                 # streaming-pipeline segment: stage depth + how much of
@@ -181,6 +182,13 @@ def _diagnose(sched, bs) -> None:
                 # the pipeline is on — the off arm prints nothing)
                 pipe_seg = " " + diagfmt.format_pipeline(
                     bs.pipeline_info(summary))
+            # device-mirror segment: watch deltas scattered into the
+            # resident planes, their link cost, and the surviving
+            # encode share (only when the session carries a mirror —
+            # KTPU_MIRROR=off rows print nothing)
+            if hasattr(bs, "mirror_info"):
+                mirror_seg = " " + diagfmt.format_mirror(
+                    bs.mirror_info(summary))
             # mesh segment, only when the row actually solved on the
             # sharded tier: mesh width, shard count, donation — the
             # provenance a devscale (or sharded-default REST) row's
@@ -300,8 +308,9 @@ def _diagnose(sched, bs) -> None:
             crit_seg = diagfmt.format_critpath(cp)
         log(diagfmt.format_diag(
             segs + [sess.strip(), devprof_seg.strip(), pipe_seg.strip(),
-                    mesh_seg.strip(), churn.strip(), autoscale.strip(),
-                    apf.strip(), slo_seg, crit_seg] + buckets))
+                    mirror_seg.strip(), mesh_seg.strip(), churn.strip(),
+                    autoscale.strip(), apf.strip(), slo_seg, crit_seg]
+            + buckets))
     except Exception as e:  # noqa: BLE001 — diagnostics must never fail a row
         log(f"    diag failed: {e}")
 
@@ -661,6 +670,91 @@ def run_freshness_ab(nodes: int, measure_pods: int,
     }
 
 
+def run_mirror_ab(quick: bool = False) -> list:
+    """Device-mirror on/off A/B riding the sustained harness
+    (``--config mirrorab``): interleaved arms over the SAME seeded
+    open-loop trace — the on arm is the committed mirror row (encode
+    share near zero, per-cycle h2d strictly below the committed
+    donation row), the off arm is the PR 12 delta-encode differential
+    reference. The summary row adds a seeded in-process differential
+    cell (node killed inside the scatter window; placements must be
+    bit-identical across arms). Gated by perf_report's mirror_flags
+    under ``--strict``."""
+    import os
+
+    from kubernetes_tpu.harness.chaos_mirror import run_chaos_mirror
+    from kubernetes_tpu.harness.sustained import run_sustained_row
+
+    # the committed PR 10 donated-buffer baseline this row must beat:
+    # devscale_scaling.log donation_ab.on h2d_bytes_per_cycle
+    h2d_budget = 618_497
+    pods, qps, node_cpu, max_batch, timeout = (
+        (2000, 1000.0, 16, 512, 300) if quick
+        else (30_000, 5000.0, 32, 4096, 900))
+    rows = []
+    arms = {}
+    prev = os.environ.get("KTPU_MIRROR")
+    try:
+        for arm in ("on", "off"):
+            os.environ["KTPU_MIRROR"] = arm
+            log(f"[mirror-ab] sustained arm mirror={arm}: {pods} pods "
+                f"@ {qps:.0f}/s")
+            row = run_sustained_row(pods=pods, qps=qps,
+                                    node_cpu=node_cpu,
+                                    max_batch=max_batch,
+                                    wait_timeout=timeout,
+                                    progress=log)
+            row["metric"] = (f"mirror_sustained[arm={arm}, "
+                             + row["metric"].split("[", 1)[1])
+            row["mirror_arm"] = arm
+            t = row.get("telemetry") or {}
+            row["encode_share"] = t.get("encode_share")
+            row["p99_budget_ms"] = 500
+            cycles = int(t.get("cycles") or 0)
+            if cycles:
+                row["h2d_per_cycle_bytes"] = round(
+                    float(t.get("h2d_bytes", 0)) / cycles)
+            if arm == "on":
+                row["encode_share_budget"] = 0.05
+                row["h2d_per_cycle_budget_bytes"] = h2d_budget
+                # exactly one re-seed is structural: the warmup
+                # session rebuilds when the live trace starts; any
+                # further reseed means journal gaps or inexpressible
+                # deltas mid-run
+                row["reseeds_allowed"] = 1
+            arms[arm] = row
+            rows.append(row)
+    finally:
+        if prev is None:
+            os.environ.pop("KTPU_MIRROR", None)
+        else:
+            os.environ["KTPU_MIRROR"] = prev
+    log("[mirror-ab] seeded differential cell (node_kill)")
+    cell = run_chaos_mirror(14, scenario="node_kill", progress=log)
+    on, off = arms["on"], arms["off"]
+    overhead_pct = 0.0
+    if off["value"] > 0:
+        overhead_pct = 100.0 * (1.0 - on["value"] / off["value"])
+    rows.append({
+        "metric": (f"mirror_ab[sustained {pods}pods @ {qps:.0f}/s "
+                   f"on/off + seeded node_kill differential]"),
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "mirror_on_pods_per_sec": on["value"],
+        "mirror_off_pods_per_sec": off["value"],
+        "h2d_per_cycle_on_bytes": on.get("h2d_per_cycle_bytes"),
+        "h2d_per_cycle_off_bytes": off.get("h2d_per_cycle_bytes"),
+        "encode_share_on": on.get("encode_share"),
+        "encode_share_off": off.get("encode_share"),
+        "differential_match": cell["differential_match"],
+        "differential_lost_pods": cell["lost_pods"],
+        "invariants_ok": bool(cell["ok"]
+                              and on.get("invariants_ok")
+                              and off.get("invariants_ok")),
+    })
+    return rows
+
+
 def measure_serial(name: str, nodes: int, measure_pods: int,
                    serial_pods: int) -> float:
     serial_pods = min(serial_pods, measure_pods)
@@ -681,7 +775,7 @@ def main() -> None:
                     + ["rest", "qos", "traceab", "profab", "freshab",
                        "autoscale", "scale10x", "devscale", "sustained",
                        "hotspot", "upgrade", "federation", "watchherd",
-                       "replay:storm", "replay:gangs",
+                       "mirrorab", "replay:storm", "replay:gangs",
                        "replay:tenancy"])
     ap.add_argument("--replay-seed", type=int, default=11,
                     help="trace seed for the replay:<family> rows "
@@ -872,6 +966,19 @@ def main() -> None:
             rows = run_watchherd_row(progress=log)
         for row in rows:
             row.pop("replica_stats", None)
+            print(json.dumps(row), flush=True)
+        return
+
+    if args.config == "mirrorab":
+        # the device-mirror rows (ISSUE 20): mirror on/off interleaved
+        # over the same seeded sustained trace — the on arm commits
+        # the tentpole's claim (encode share near zero, per-cycle h2d
+        # strictly below the committed donation row), the off arm is
+        # the delta-encode differential reference, and the summary row
+        # carries the seeded in-process differential (bit-identical
+        # placements through a node killed inside the scatter window).
+        # Gated by perf_report's mirror_flags
+        for row in run_mirror_ab(quick=args.quick):
             print(json.dumps(row), flush=True)
         return
 

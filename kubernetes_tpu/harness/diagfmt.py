@@ -303,6 +303,32 @@ def format_readtier(info: Optional[Dict]) -> str:
     return "readtier[" + " ".join(parts) + "]"
 
 
+def format_mirror(info: Optional[Dict]) -> str:
+    """The device-resident cluster-state segment: how many watch-event
+    deltas the mirror scattered into the donated planes (``events``),
+    the link cost of those index/value triples (``scatter_mb`` — the
+    only per-event h2d the mirror path pays), the surviving per-cycle
+    encode share (``encode_share`` — host encode + pack over the phase
+    total; the tentpole target is near-zero on sustained rows), and
+    ``reseeds`` (journal gaps, inexpressible deltas, or topology churn
+    forcing a full host rebuild — a sustained row should show none
+    after warmup). Emitted by bench rows whenever the session carries a
+    mirror (``KTPU_MIRROR`` on AND a backend with scatter hooks);
+    parsed by the generic bracket scan in ``parse_diag`` (key
+    ``mirror``) — tools/perf_report.py reads it to gate the
+    ``mirror_flags`` family."""
+    if not info:
+        return ""
+    parts = [
+        f"events={int(info.get('events', 0))}",
+        f"scatter_mb={float(info.get('scatter_mb', 0.0)):.3f}",
+    ]
+    if info.get("encode_share") is not None:
+        parts.append(f"encode_share={float(info['encode_share']):.4f}")
+    parts.append(f"reseeds={int(info.get('reseeds', 0))}")
+    return "mirror[" + " ".join(parts) + "]"
+
+
 def format_critpath(info: Optional[Dict]) -> str:
     """The fleet critical-path segment: which phase owns the sampled
     pods' end-to-end latency (``top``/``share``), how much of the

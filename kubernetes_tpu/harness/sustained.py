@@ -168,6 +168,7 @@ def run_sustained_once(
             "telemetry": telemetry,
             "freshness": collect_freshness(telemetry),
             "pipeline": bs.pipeline_info(telemetry),
+            "mirror": bs.mirror_info(telemetry),
             "session": {
                 "incremental_hits": bs.session.incremental_hits,
                 "rebuilds": bs.session.rebuilds,
@@ -243,6 +244,7 @@ def run_sustained_row(
         "invariants": {"zero_lost_pods": zero_lost},
         "invariants_ok": zero_lost,
         "pipeline": extras.get("pipeline"),
+        "mirror": extras.get("mirror"),
         "session": extras.get("session"),
     }
     if extras.get("telemetry"):
@@ -272,9 +274,11 @@ def _sustained_diag(extras: Dict) -> None:
 
     from kubernetes_tpu.harness import diagfmt
 
-    seg = diagfmt.format_pipeline(extras.get("pipeline"))
-    if seg:
-        print(diagfmt.format_diag([seg]), file=sys.stderr, flush=True)
+    segs = [diagfmt.format_pipeline(extras.get("pipeline")),
+            diagfmt.format_mirror(extras.get("mirror"))]
+    segs = [s for s in segs if s]
+    if segs:
+        print(diagfmt.format_diag(segs), file=sys.stderr, flush=True)
 
 
 def run_sustained_cell(
@@ -310,6 +314,8 @@ def run_sustained_cell(
         "overlapped_cycles": telemetry.get("overlapped_cycles", 0),
         "staleness_verdict": slo.get("snapshot_staleness"),
         "max_staleness_s": telemetry.get("max_staleness_s"),
+        "encode_share": telemetry.get("encode_share", 0.0),
         "pipeline": extras.get("pipeline"),
+        "mirror": extras.get("mirror"),
         "session": extras.get("session"),
     }

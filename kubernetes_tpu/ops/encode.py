@@ -1,5 +1,11 @@
 """Host-side encoding: Snapshot + pod batch → dense device arrays.
 
+With the device mirror attached (``ops/mirror.py``), the full
+cluster-plane build below runs only on cold start and on reseed
+(journal gaps / inexpressible deltas) — steady state scatters watch
+deltas into the resident planes and the per-batch work reduces to the
+pod-row delta encode, which is the drained pods' own h2d prep.
+
 The reference's PreFilter phase builds per-pod maps over all nodes
 (``interpodaffinity/filtering.go:162-235``, ``podtopologyspread/
 filtering.go:198-273``); this encoder materializes the same information

@@ -49,6 +49,22 @@ class CppBackend:
     def materialize(handle):
         return handle
 
+    # -------- device-resident mirror scatter hooks (ops.mirror): the
+    # planes are host numpy mutated in place, so a "scatter" is a fancy
+    # index update and zero bytes cross any link
+    @staticmethod
+    def scatter_state_add(pstate, rows, cols, vals):
+        planes = pstate.planes
+        flat = planes.reshape(planes.shape[0], -1)
+        np.add.at(flat, (rows, cols), vals)
+        return pstate, 0
+
+    @staticmethod
+    def scatter_static_set(pstatic, rows, cols, vals):
+        flat = pstatic.ints.reshape(pstatic.ints.shape[0], -1)
+        flat[rows, cols] = vals
+        return pstatic, 0
+
     def solve(self, params: SolverParams, pstatic, pstate, pod_ints,
               pod_floats):
         planes = pstate.planes  # [CD, NB, 128] int32, C-contiguous

@@ -921,7 +921,44 @@ def _xla_planes_solve_sparse(params: SolverParams, r: int, sc: int, t: int,
     return final_planes, assignments
 
 
-class XlaPlanesBackend:
+def _scatter_flat_add(planes, rows, cols, vals):
+    """Donated scatter-add into [C, NB, 128] planes; ``cols`` are flat
+    node indices (the [C, N] view — the reshape is row-major, so flat
+    col == node index)."""
+    c, nb, lanes = planes.shape
+    flat = planes.reshape(c, nb * lanes)
+    return flat.at[rows, cols].add(vals).reshape(c, nb, lanes)
+
+
+def _scatter_flat_set(planes, rows, cols, vals):
+    c, nb, lanes = planes.shape
+    flat = planes.reshape(c, nb * lanes)
+    return flat.at[rows, cols].set(vals).reshape(c, nb, lanes)
+
+
+# device-resident mirror update kernels (ops.mirror): the plane stack
+# is donated so the update happens in place on device and only the
+# index/value triples cross the link
+_scatter_flat_add_jit = jax.jit(_scatter_flat_add, donate_argnums=(0,))
+_scatter_flat_set_jit = jax.jit(_scatter_flat_set, donate_argnums=(0,))
+
+
+class _PlanesScatterHooks:
+    """Mirror scatter hooks shared by the device planes backends
+    (XlaPlanes + Pallas — both carry PState/PStatic device arrays)."""
+
+    def scatter_state_add(self, pstate, rows, cols, vals):
+        planes = _scatter_flat_add_jit(pstate.planes, rows, cols, vals)
+        return (PState(planes=planes),
+                int(rows.nbytes + cols.nbytes + vals.nbytes))
+
+    def scatter_static_set(self, pstatic, rows, cols, vals):
+        ints = _scatter_flat_set_jit(pstatic.ints, rows, cols, vals)
+        return (pstatic._replace(ints=ints),
+                int(rows.nbytes + cols.nbytes + vals.nbytes))
+
+
+class XlaPlanesBackend(_PlanesScatterHooks):
     """Gather-free scan backend on the planes layout — the fallback for
     constraint spaces too wide for the unrolled pallas kernel. Wide term
     axes (T ≥ SPARSE_MIN_T) with few per-pod references ride the sparse
@@ -976,7 +1013,7 @@ class XlaPlanesBackend:
         return self.materialize(h), state
 
 
-class PallasBackend:
+class PallasBackend(_PlanesScatterHooks):
     """Drop-in solve backend for SolverSession (see session.py)."""
 
     name = "pallas"

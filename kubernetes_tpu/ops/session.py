@@ -34,6 +34,7 @@ import numpy as np
 from kubernetes_tpu.observability import get_tracer
 from kubernetes_tpu.observability.devprof import get_devprof
 from kubernetes_tpu.ops.encode import BatchEncoder, EncodedCluster
+from kubernetes_tpu.ops.mirror import mirror_enabled
 from kubernetes_tpu.ops.solver import (
     SolverParams,
     _solve_packed,
@@ -287,6 +288,28 @@ class SolverSession:
         if self._profile_left <= 0:
             self._profile_dir = None
         self._profiling = False
+        # device-resident cluster mirror (KTPU_MIRROR, default on):
+        # watch deltas journaled by the cache are SCATTERED into the
+        # donated planes at the next solve instead of forcing a full
+        # host encode. Constructed only when the preferred backend
+        # exposes the scatter hooks (the legacy scan doesn't) and the
+        # scheduler carries a journal-capable cache.
+        self._mirror = None
+        self._journal = None
+        if (
+            mirror_enabled()
+            and hasattr(self.backend, "scatter_state_add")
+            and hasattr(getattr(self.sched, "cache", None),
+                        "attach_delta_journal")
+        ):
+            from kubernetes_tpu.ops.mirror import (
+                DeltaJournal,
+                DeviceClusterMirror,
+            )
+
+            self._journal = DeltaJournal()
+            self.sched.cache.attach_delta_journal(self._journal)
+            self._mirror = DeviceClusterMirror(self, self._journal)
 
     # ------------------------------------------------------------------
     def warm_pad(self, pods: List, pad: int) -> Optional[int]:
@@ -396,6 +419,18 @@ class SolverSession:
             and seq_now == seq_before + expected_mutations
         ):
             self._last_seq = seq_now
+        elif (
+            self._mirror is not None
+            and not self._poisoned
+            and self._last_seq >= 0
+        ):
+            # mirror arm: unexpected-but-journaled mutations (serial
+            # binds, external pod/node events, TTL expiry) no longer
+            # force a rebuild — the anchor stays where the device state
+            # is known-good and the next solve's catch-up scatters the
+            # journal window on top. Anything the journal can't express
+            # still reseeds there.
+            pass
         else:
             self._last_seq = -1
 
@@ -422,6 +457,23 @@ class SolverSession:
         self._profile_tick()
         pad = pad_to or self.max_batch
         seq_before = self.sched.cache.mutation_seq
+        # mirror catch-up: a journaled mutation window since the last
+        # validated seq is scattered into the resident planes, making
+        # the incremental gate below pass — external churn stops
+        # forcing rebuilds. Timed here, booked into the devprof cycle
+        # once it opens (the scatter belongs to THIS solve's cycle).
+        scatter_stash = None
+        if (
+            self._mirror is not None and self._state is not None
+            and not self._poisoned
+            and 0 <= self._last_seq != seq_before
+            and self._node_epoch == self.sched.cache.node_set_seq
+        ):
+            t_sc = time.monotonic()
+            applied = self._mirror.catch_up(self._last_seq, seq_before)
+            if applied is not None:
+                scatter_stash = (time.monotonic() - t_sc, applied)
+                self._last_seq = seq_before
         if self._state is not None and seq_before == self._last_seq \
                 and self._node_epoch == self.sched.cache.node_set_seq:
             dp = get_devprof()
@@ -430,6 +482,18 @@ class SolverSession:
                 warming=warming) if dp.enabled else None
             if not warming:
                 self._note_staleness(rec, dp)
+            if scatter_stash is not None:
+                sc_s, sc_bytes = scatter_stash
+                dp.phase("scatter", sc_s)
+                if sc_bytes:
+                    # the only remaining per-event h2d: index/value
+                    # triples. Counted in solver_transfer_bytes_total
+                    # (h2d) plus the scatter attribution ledger; never
+                    # in the donated ledger.
+                    dp.add_bytes("h2d", sc_bytes)
+                    dp.add_bytes("scatter", sc_bytes)
+                if not warming:
+                    self._observe("scatter", sc_s)
             try:
                 t0 = time.monotonic()
                 pb = self._encoder.encode_pods_only(pods, pad)
@@ -443,8 +507,12 @@ class SolverSession:
                     self._observe("encode", t_pack - t0, end_mono=t_pack)
                     self._observe("pack", t_done - t_pack,
                                   end_mono=t_done)
-                    dp.phase("encode", t_pack - t0)
-                    dp.phase("pack", t_done - t_pack)
+                    # devprof attribution: the pod-row delta encode is
+                    # the drained pods' h2d prep — inherent per-batch
+                    # work, booked under pack. The "encode" phase (and
+                    # so encode_share) is reserved for cluster-plane
+                    # builds, the stage the device mirror eliminates.
+                    dp.phase("pack", t_done - t0)
                     dp.add_bytes("h2d", ints.nbytes + floats.nbytes)
                     # stage handoff: with the previous lazy handle
                     # still in flight, this dispatch chains onto its
@@ -670,6 +738,10 @@ class SolverSession:
     def _rebuild_and_solve_inner(self, pods: List, seq_before: int,
                                  pad: Optional[int], dp, rec):
         t0 = time.monotonic()
+        # for the mirror's reseed accounting: a rebuild with resident
+        # state is a re-seed (the mirror failed to keep up); the cold
+        # start is just the seed
+        mirror_cold = self._state is None
         # captured BEFORE the snapshot refresh: a node-set change that
         # races the rebuild bumps mutation_seq too, so the next solve
         # re-validates either way
@@ -755,6 +827,8 @@ class SolverSession:
                 self._last_seq = seq_before
                 if not self._warming:
                     self.state_only_rebuilds += 1
+                if self._mirror is not None:
+                    self._mirror.note_seeded(mirror_cold, self._warming)
                 return out, cluster, seq_before
             except Exception:  # noqa: BLE001 — fall back to full rebuild
                 _logger.exception("state-only rebuild failed; full path")
@@ -834,6 +908,8 @@ class SolverSession:
         dp.end_cycle(rec)
         # valid-until-next-mutation; the sidecar's note_committed refines
         self._last_seq = seq_before
+        if self._mirror is not None:
+            self._mirror.note_seeded(mirror_cold, self._warming)
         return out, cluster, seq_before
 
     @property

@@ -483,6 +483,25 @@ def _tree_nbytes(tree) -> int:
                if hasattr(leaf, "nbytes"))
 
 
+@lru_cache(maxsize=None)
+def _scatter_jit(mesh: Mesh, op: str):
+    """Jitted donated row/column scatter into node-sharded [C, N]
+    planes (the device-resident cluster mirror's update kernel). The
+    input plane stack is DONATED — the update is in-place on device —
+    and ``out_shardings`` pins the result to the same node-sharded
+    placement, so GSPMD routes each (row, col) entry to the shard
+    owning that node column; the host ships only the index/value
+    triples. Cached per (mesh, op); jit caches per entry-count bucket."""
+    sharding = NamedSharding(mesh, P(None, "nodes"))
+
+    def run(planes, rows, cols, vals):
+        if op == "add":
+            return planes.at[rows, cols].add(vals)
+        return planes.at[rows, cols].set(vals)
+
+    return jax.jit(run, donate_argnums=(0,), out_shardings=sharding)
+
+
 class ShardedBackend:
     """SolverSession backend running the planes scan over a device mesh
     (drop-in next to PallasBackend / XlaPlanesBackend / CppBackend): the
@@ -609,6 +628,26 @@ class ShardedBackend:
         dispatch measurement into the block phase."""
         s, self._staging_s = self._staging_s, 0.0
         return s
+
+    # -------- device-resident mirror scatter hooks (ops.mirror)
+    def scatter_state_add(self, sstate: SState, rows, cols, vals):
+        """Add (row, col, val) deltas into the donated dynamic planes;
+        returns (new state, h2d bytes). Only the index/value triples
+        cross the link — the planes stay resident."""
+        fn = _scatter_jit(self.mesh, "add")
+        with self.mesh:
+            planes = fn(sstate.planes, rows, cols, vals)
+        return (SState(planes=planes, totals=sstate.totals),
+                int(rows.nbytes + cols.nbytes + vals.nbytes))
+
+    def scatter_static_set(self, sstatic: SStatic, rows, cols, vals):
+        """Set absolute values (node capacity updates) into the donated
+        static int planes; returns (new static, h2d bytes)."""
+        fn = _scatter_jit(self.mesh, "set")
+        with self.mesh:
+            ints = fn(sstatic.ints, rows, cols, vals)
+        return (sstatic._replace(ints=ints),
+                int(rows.nbytes + cols.nbytes + vals.nbytes))
 
     def solve_lazy(self, params, sstatic, sstate, pod_ints, pod_floats,
                    donate: Optional[bool] = None):

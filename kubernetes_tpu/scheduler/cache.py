@@ -123,6 +123,12 @@ class SchedulerCache:
         self._image_states: Dict[str, _ImageState] = {}
         self._stop = threading.Event()
         self._cleanup_thread: Optional[threading.Thread] = None
+        # device-mirror delta journal (ops.mirror.DeltaJournal): when
+        # attached, every mutation_seq bump is noted as a compact delta
+        # record so the solver session can SCATTER the window into the
+        # device-resident planes instead of rebuilding. None (default)
+        # costs one attribute test per mutation.
+        self._journal = None
 
     # ------------------------------------------------------------------
     # linked-list maintenance (cache.go moveNodeInfoToHead / removeNodeInfoFromList)
@@ -162,6 +168,22 @@ class SchedulerCache:
             item.next = self._head
             self._head = item
         return item
+
+    # ------------------------------------------------------------------
+    def attach_delta_journal(self, journal) -> None:
+        """Attach a mirror delta journal: every later ``mutation_seq``
+        bump emits one record (under the cache lock, so record order ==
+        seq order). Re-attaching replaces the journal — an earlier
+        session's journal simply stops receiving records and its next
+        window read reports a gap (→ reseed)."""
+        with self._lock:
+            self._journal = journal
+
+    def _note(self, kind: str, a=None, b=None) -> None:
+        """Journal the mutation just bumped (caller holds the lock and
+        has ALREADY incremented ``_mutation_seq``)."""
+        if self._journal is not None:
+            self._journal.note(self._mutation_seq, kind, a, b)
 
     # ------------------------------------------------------------------
     @property
@@ -245,6 +267,7 @@ class SchedulerCache:
         ``SolverSession.mirror_current``'s arithmetic fail."""
         with self._lock:
             self._mutation_seq += 1
+            self._note("external")
 
     def note_event_ts(self, ts: float) -> None:
         """Advance the newest-applied-event commit timestamp (called by
@@ -262,6 +285,9 @@ class SchedulerCache:
             if key in self._pod_states:
                 raise ValueError(f"pod {key} is in the cache, so can't be assumed")
             self._mutation_seq += 1
+            # serial-path bind: NOT device-applied (the solve carry
+            # never placed this pod) — the mirror scatters it
+            self._note("assume", pod)
             self._add_pod_locked(pod)
             self._pod_states[key] = _PodState(pod)
             self._assumed_pods.add(key)
@@ -281,6 +307,9 @@ class SchedulerCache:
                     )
                     continue
                 self._mutation_seq += 1
+                # bulk-commit assume: the solve carry already applied
+                # this pod on device — the mirror must NOT re-scatter
+                self._note("assume_bulk", pod)
                 self._add_pod_locked(pod)
                 self._pod_states[key] = _PodState(pod)
                 self._assumed_pods.add(key)
@@ -300,6 +329,7 @@ class SchedulerCache:
             if state.pod.spec.node_name != pod.spec.node_name:
                 # scheduler result differs from api truth: relocate
                 self._mutation_seq += 1
+                self._note("pod_move", state.pod, pod)
                 self._remove_pod_locked(state.pod)
                 self._add_pod_locked(pod)
             self._assumed_pods.discard(key)
@@ -307,10 +337,12 @@ class SchedulerCache:
         elif key in self._pod_states:
             # duplicate add: treat as update
             self._mutation_seq += 1
+            self._note("pod_update", self._pod_states[key].pod, pod)
             self._update_pod_locked(self._pod_states[key].pod, pod)
             self._pod_states[key] = _PodState(pod)
         else:
             self._mutation_seq += 1
+            self._note("pod_add", pod)
             self._add_pod_locked(pod)
             self._pod_states[key] = _PodState(pod)
 
@@ -341,6 +373,7 @@ class SchedulerCache:
             if key not in self._assumed_pods:
                 raise ValueError(f"pod {key} wasn't assumed, so can't be forgotten")
             self._mutation_seq += 1
+            self._note("pod_del", self._pod_states[key].pod)
             self._remove_pod_locked(self._pod_states[key].pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -357,6 +390,7 @@ class SchedulerCache:
                 raise ValueError(f"assumed pod {key} shouldn't be updated")
             if _pod_mirror_changed(old, new):
                 self._mutation_seq += 1
+                self._note("pod_update", old, new)
             self._update_pod_locked(old, new)
             self._pod_states[key] = _PodState(new)
 
@@ -367,6 +401,7 @@ class SchedulerCache:
             if state is None:
                 return
             self._mutation_seq += 1
+            self._note("pod_del", state.pod)
             self._remove_pod_locked(state.pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -410,6 +445,10 @@ class SchedulerCache:
             item = self._ensure_node(node.name)
             if item.info.node is None:
                 self._node_set_seq += 1
+                self._note("node_add", node)
+            else:
+                # re-add of a known node is an update in mirror terms
+                self._note("node_update", item.info.node, node)
             self._remove_node_image_states(item.info.node)
             item.info.set_node(node)
             self._add_node_image_states(node, item.info)
@@ -420,6 +459,7 @@ class SchedulerCache:
         with self._lock:
             if _node_mirror_changed(old, new):
                 self._mutation_seq += 1
+                self._note("node_update", old, new)
             item = self._ensure_node(new.name)
             self._remove_node_image_states(item.info.node)
             item.info.set_node(new)
@@ -433,6 +473,7 @@ class SchedulerCache:
             if item is None:
                 return
             self._mutation_seq += 1
+            self._note("node_del", node)
             if item.info.node is not None:
                 self._node_set_seq += 1
             item.info.remove_node()
@@ -588,6 +629,7 @@ class SchedulerCache:
                 if state.binding_finished and state.deadline is not None and now >= state.deadline:
                     # expire: the bind never became visible; undo the assume
                     self._mutation_seq += 1
+                    self._note("pod_del", state.pod)
                     self._remove_pod_locked(state.pod)
                     del self._pod_states[key]
                     self._assumed_pods.discard(key)

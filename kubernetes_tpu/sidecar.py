@@ -517,8 +517,10 @@ class TPUBatchScheduler:
         # be one this cycle's commits performed (assumes — including
         # gang pods parked at Permit — plus sync rejection forgets,
         # commit_assignments_bulk's ledger). Serial binds, async-bind
-        # failures, or external events show up as extra mutations and
-        # invalidate the mirror.
+        # failures, or external events show up as extra mutations; with
+        # the device mirror attached they land in the delta journal and
+        # the next solve scatters them into the resident planes, without
+        # it they invalidate the session for a full rebuild.
         self.session.note_committed(self._cycle_mutations, seq_anchor)
         self._trace_cycle(t_cycle, processed, committed)
         return processed
@@ -593,6 +595,23 @@ class TPUBatchScheduler:
         if telemetry:
             info["overlap"] = float(telemetry.get("overlap_share", 0.0))
             info["cycles"] = int(telemetry.get("overlapped_cycles", 0))
+        return info
+
+    def mirror_info(self, telemetry: Optional[Dict] = None
+                    ) -> Optional[Dict]:
+        """The ``mirror[...]`` diag segment's payload: delta-journal
+        events scattered into the device-resident planes, the bytes
+        those index/value triples cost on the link, how often the
+        mirror had to fall back to a full reseed, and (when a devprof
+        summary is supplied) the surviving encode share. None when the
+        mirror is off (``KTPU_MIRROR=off`` or a backend without scatter
+        hooks) — quiet-row convention, same as ``pipeline_info``."""
+        mirror = getattr(self.session, "_mirror", None)
+        if mirror is None:
+            return None
+        info = mirror.info()
+        if telemetry and "encode_share" in telemetry:
+            info["encode_share"] = float(telemetry["encode_share"])
         return info
 
     def flush(self, timeout: float = 60.0) -> int:

@@ -144,6 +144,28 @@ class TestDiagFmtRoundTrip:
         assert diagfmt.format_pipeline(None) == ""
         assert diagfmt.format_pipeline({}) == ""
 
+    def test_mirror_segment_round_trips(self):
+        """The device-mirror segment (ISSUE 20 satellite): scatter
+        counters + encode share through the one writer / one parser
+        (the generic bracket grammar — no parser change)."""
+        seg = diagfmt.format_mirror(
+            {"events": 42, "scatter_mb": 1.2345, "reseeds": 1,
+             "encode_share": 0.0037})
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert parsed["mirror"]["events"] == 42
+        assert parsed["mirror"]["scatter_mb"] == pytest.approx(1.234,
+                                                               abs=1e-3)
+        assert parsed["mirror"]["encode_share"] == pytest.approx(0.0037)
+        assert parsed["mirror"]["reseeds"] == 1
+        # quiet conventions: mirror off (None info) renders nothing,
+        # and a row without encode_share omits the key
+        assert diagfmt.format_mirror(None) == ""
+        assert diagfmt.format_mirror({}) == ""
+        seg = diagfmt.format_mirror({"events": 1, "scatter_mb": 0.0,
+                                     "reseeds": 0})
+        parsed = diagfmt.parse_diag(diagfmt.format_diag([seg]))
+        assert "encode_share" not in parsed["mirror"]
+
 
 # ---------------------------------------------------------------------------
 # synthetic trajectory: the flagging semantics
@@ -819,3 +841,158 @@ class TestBenchTailGuard:
         headline = rows[-1]
         assert "headline" in headline["metric"]
         assert headline["telemetry"] == tel
+
+
+# ---------------------------------------------------------------------------
+# device-mirror flags (ISSUE 20)
+
+
+class TestMirrorFlags:
+    _ON = ("mirror_sustained[arm=on, open-loop 5000/s 240nodes/"
+           "30000pods seed=14, store-direct replay engine]")
+    _AB = ("mirror_ab[sustained 30000pods @ 5000/s on/off + seeded "
+           "node_kill differential]")
+
+    def _on_row(self, tmp_path, n, **extra):
+        base = {"mirror_arm": "on", "encode_share": 0.004,
+                "encode_share_budget": 0.05,
+                "mirror": {"events": 12, "catch_ups": 9,
+                           "scatter_mb": 0.4, "reseeds": 0},
+                "reseeds_allowed": 0,
+                "h2d_per_cycle_bytes": 106_500,
+                "h2d_per_cycle_budget_bytes": 618_497,
+                "p99_arrival_to_bind_ms": 180, "p99_budget_ms": 500,
+                "lost_pods": 0, "invariants_ok": True}
+        base.update(extra)
+        _artifact(tmp_path, n, 4900.0, metric=self._ON, extra=base)
+
+    def _ab_row(self, tmp_path, n, **extra):
+        base = {"mirror_on_pods_per_sec": 4900.0,
+                "mirror_off_pods_per_sec": 4880.0,
+                "h2d_per_cycle_on_bytes": 106_500,
+                "h2d_per_cycle_off_bytes": 108_000,
+                "differential_match": True, "invariants_ok": True}
+        base.update(extra)
+        _artifact(tmp_path, n, 0.4, metric=self._AB, extra=base)
+
+    def test_green_rows_pass(self, tmp_path):
+        from tools.perf_report import main, mirror_flags
+
+        self._on_row(tmp_path, 1)
+        self._ab_row(tmp_path, 2)
+        assert mirror_flags(load_rounds(str(tmp_path))) == []
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_encode_share_over_budget_gates_strict(self, tmp_path):
+        from tools.perf_report import main, mirror_flags
+
+        self._on_row(tmp_path, 1, encode_share=0.31)
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        assert "encode share 0.3100 >= 0.05" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_unexpected_reseed_flagged(self, tmp_path):
+        from tools.perf_report import mirror_flags
+
+        self._on_row(tmp_path, 1,
+                     mirror={"events": 12, "catch_ups": 9,
+                             "scatter_mb": 0.4, "reseeds": 3})
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        assert "reseeds=3 > 0 allowed" in flag["problems"][0]
+
+    def test_h2d_over_committed_budget_gates_strict(self, tmp_path):
+        from tools.perf_report import main, mirror_flags
+
+        self._on_row(tmp_path, 1, h2d_per_cycle_bytes=700_000)
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        assert "700,000B >= the committed donation-row budget " \
+               "618,497B" in flag["problems"][0]
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_off_arm_not_held_to_mirror_budgets(self, tmp_path):
+        """The reference arm re-encodes node columns by design: its
+        encode share and reseeds are not defects."""
+        from tools.perf_report import mirror_flags
+
+        self._on_row(tmp_path, 1, mirror_arm="off", mirror={},
+                     encode_share=0.4)
+        assert mirror_flags(load_rounds(str(tmp_path))) == []
+
+    def test_lost_pods_and_p99_flag_either_arm(self, tmp_path):
+        from tools.perf_report import mirror_flags
+
+        self._on_row(tmp_path, 1, mirror_arm="off", mirror={},
+                     lost_pods=2, p99_arrival_to_bind_ms=812)
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "lost_pods=2" in probs
+        assert "812ms over the 500ms SLO" in probs
+
+    def test_differential_mismatch_gates_strict(self, tmp_path):
+        from tools.perf_report import main, mirror_flags
+
+        self._ab_row(tmp_path, 1, differential_match=False,
+                     invariants_ok=False)
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "differential arms disagree" in probs
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+
+    def test_scatter_h2d_regression_flagged_with_headroom(self,
+                                                          tmp_path):
+        from tools.perf_report import mirror_flags
+
+        # within the 10% jitter band: clean
+        self._ab_row(tmp_path, 1, h2d_per_cycle_on_bytes=115_000)
+        assert mirror_flags(load_rounds(str(tmp_path))) == []
+        # past it: the scatter triples cost more than the encode
+        self._ab_row(tmp_path, 1, h2d_per_cycle_on_bytes=160_000)
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        assert "above the off arm's" in flag["problems"][0]
+
+    def test_chaos_cell_row_flagged(self, tmp_path):
+        from tools.perf_report import mirror_flags
+
+        _artifact(tmp_path, 1, 0.0,
+                  metric="mirror_cell[node_kill seed=11]",
+                  extra={"ok": False, "differential_match": False,
+                         "lost_pods": 1,
+                         "failure": "differential mismatch"})
+        (flag,) = mirror_flags(load_rounds(str(tmp_path)))
+        probs = " ".join(flag["problems"])
+        assert "cell failed" in probs
+        assert "lost_pods=1" in probs
+
+    def test_flags_survive_json_mode(self, tmp_path, capsys):
+        from tools.perf_report import main
+
+        self._on_row(tmp_path, 1, encode_share=0.2)
+        main(["--dir", str(tmp_path), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["mirror_flags"]) == 1
+
+    def test_committed_mirror_rows_strict_clean(self, tmp_path):
+        """The committed artifact IS the acceptance criterion: the
+        checked-in mirror_rows.log rows must hold every mirror_flags
+        budget (encode share, per-cycle h2d vs the donation row, zero
+        lost, differential match) — a regression that sneaks into the
+        committed row fails tier-1, not just --strict CI."""
+        path = os.path.join(_REPO_ROOT, "mirror_rows.log")
+        assert os.path.exists(path), "mirror_rows.log not committed"
+        with open(path) as f:
+            tail = f.read()
+        doc = {"n": 1, "cmd": "python bench.py --config mirrorab",
+               "rc": 0, "tail": tail}
+        with open(os.path.join(tmp_path, "BENCH_r01.json"), "w") as fh:
+            json.dump(doc, fh)
+        from tools.perf_report import mirror_flags
+
+        rounds = load_rounds(str(tmp_path))
+        rows = _rows_from_tail(tail)
+        kinds = {str(r.get("metric", "")).split("[", 1)[0]
+                 for r in rows}
+        assert "mirror_sustained" in kinds and "mirror_ab" in kinds
+        on_rows = [r for r in rows if r.get("mirror_arm") == "on"]
+        assert on_rows and all(
+            float(r["encode_share"]) < 0.05 for r in on_rows)
+        assert mirror_flags(rounds) == []

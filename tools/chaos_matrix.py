@@ -47,6 +47,13 @@ The suites:
   lost pods, zero lost/duplicated watch events, zero relists of
   unmoved slices, one epoch, and a v1-pinned client held at codec v1
   across every seam (mixed-version wire guard).
+- ``mirror`` — device-resident cluster-state cells: the same seeded
+  event sequence run mirror-on (watch deltas scattered into the
+  donated resident planes) and ``KTPU_MIRROR=off`` (the delta-encode
+  reference), crossing a node killed inside the scatter window, a
+  mesh resize with pods in flight, and an event storm overflowing the
+  delta journal (which MUST surface as a reseed); invariants:
+  bit-identical placement sets across arms, zero lost pods.
 - ``federation`` — federated multi-cluster cells: K independent
   spawned clusters (each its own apiserver + scheduler) behind the
   federation tier, crossing saturation spillover (``spill`` — one
@@ -73,6 +80,8 @@ Usage::
         --upgrade partitions-first,sigkill-schedulers-first
     python tools/chaos_matrix.py --suite federation --seeds 18 \
         --federation spill,loss-mid
+    python tools/chaos_matrix.py --suite mirror --seeds 11,23 \
+        --mirror node_kill,event_storm
     python tools/chaos_matrix.py --pods 240 --nodes 40 -v
 
 Exit status is non-zero when any cell fails.
@@ -117,7 +126,7 @@ def main() -> int:
                         choices=("rest", "nodes", "scale", "overload",
                                  "partition", "replay", "reshard",
                                  "upgrade", "federation", "readtier",
-                                 "both", "all"))
+                                 "mirror", "both", "all"))
     parser.add_argument("--seeds", default="11,23,37,41,53",
                         help="comma-separated chaos seeds")
     parser.add_argument("--profiles", default="mixed",
@@ -158,6 +167,14 @@ def main() -> int:
                              "replicas live (owner_restart), or a "
                              "slow replica blowing its lag budget "
                              "(lag_fence)")
+    parser.add_argument("--mirror",
+                        default="node_kill,mesh_resize,event_storm",
+                        help="mirror-suite scenarios: a node killed "
+                             "inside the scatter window (node_kill), "
+                             "a mesh resize with pods in flight "
+                             "(mesh_resize), or an event storm "
+                             "overflowing the delta journal — must "
+                             "force a reseed (event_storm)")
     parser.add_argument("--nodes", type=int, default=20)
     parser.add_argument("--pods", type=int, default=120)
     parser.add_argument("--wait-timeout", type=float, default=120.0)
@@ -174,6 +191,12 @@ def main() -> int:
     # keep the scheduler on the CPU mesh: the matrix measures the
     # fabric and the churn, not the solver
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.suite in ("mirror", "all"):
+        # the mirror suite's mesh-resize cell wants a multi-device CPU
+        # mesh; the flag only lands if it is set before the first jax
+        # import (the cell degrades to the available width otherwise)
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
     from kubernetes_tpu.harness.chaos_rest import FAULT_PROFILES
     from kubernetes_tpu.harness.chaos_nodes import CHURN_PROFILES
@@ -216,6 +239,13 @@ def main() -> int:
             parser.error(
                 f"unknown federation scenario {p!r} "
                 f"(have: {', '.join(sorted(FEDERATION_SCENARIOS))})")
+    from kubernetes_tpu.harness.chaos_mirror import MIRROR_SCENARIOS
+
+    for p in args.mirror.split(","):
+        if p and p not in MIRROR_SCENARIOS:
+            parser.error(
+                f"unknown mirror scenario {p!r} "
+                f"(have: {', '.join(MIRROR_SCENARIOS)})")
     from kubernetes_tpu.harness.watchherd import READTIER_SCENARIOS
 
     for p in args.readtier.split(","):
@@ -308,6 +338,18 @@ def main() -> int:
         _run_suite(args, progress, rows, "readtier",
                    run_chaos_readtier, "scenario",
                    [s for s in args.readtier.split(",") if s])
+    if args.suite in ("mirror", "all"):
+        # device-mirror cells: the same seeded sequence run scatter-on
+        # vs delta-encode-off across the mirror's fault seams — a node
+        # killed inside the scatter window, a mesh resize with pods in
+        # flight, an event storm overflowing the delta journal (which
+        # must force a reseed, never silently drop deltas); verdict =
+        # bit-identical placements across arms + zero lost pods
+        from kubernetes_tpu.harness.chaos_mirror import run_chaos_mirror
+
+        _run_suite(args, progress, rows, "mirror", run_chaos_mirror,
+                   "scenario",
+                   [s for s in args.mirror.split(",") if s])
     if args.suite in ("partition", "all"):
         # partitioned-control-plane conflict cells: replica sets with
         # overlapping responsibility racing over a tight cluster — the

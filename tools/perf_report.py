@@ -950,6 +950,105 @@ def readtier_flags(rounds: List[dict]) -> List[dict]:
     return flags
 
 
+def mirror_flags(rounds: List[dict]) -> List[dict]:
+    """Device-mirror rows (``bench.py --config mirrorab`` and the
+    chaos-matrix mirror suite): the mirror-on sustained arm must keep
+    its encode share near zero (the stage the mirror exists to kill),
+    never reseed unexpectedly, and stay strictly below the committed
+    donation-row per-cycle h2d; the A/B row must show the on arm at or
+    below the off arm's per-cycle h2d with bit-identical placements;
+    chaos cells must hold the differential across faults."""
+    flags = []
+    for rnd in rounds:
+        for row in rnd["rows"]:
+            metric = str(row.get("metric", ""))
+            if not metric.startswith(("mirror_sustained[", "mirror_ab[",
+                                      "mirror_cell[")) \
+                    or "error" in row:
+                continue
+            problems = []
+            if metric.startswith("mirror_sustained["):
+                mirror = row.get("mirror") or {}
+                on_arm = row.get("mirror_arm") == "on"
+                if on_arm:
+                    share = row.get("encode_share")
+                    budget = float(
+                        row.get("encode_share_budget") or 0.05)
+                    if share is not None and float(share) >= budget:
+                        problems.append(
+                            f"encode share {float(share):.4f} >= "
+                            f"{budget:g} budget on a mirror-on row "
+                            f"(the resident planes should have killed "
+                            f"the encode stage)")
+                    allowed = int(row.get("reseeds_allowed") or 0)
+                    if int(mirror.get("reseeds") or 0) > allowed:
+                        problems.append(
+                            f"reseeds={mirror['reseeds']} > "
+                            f"{allowed} allowed (journal gaps or "
+                            f"inexpressible deltas forced full host "
+                            f"encodes mid-run)")
+                    h2d = row.get("h2d_per_cycle_bytes")
+                    h2d_budget = row.get("h2d_per_cycle_budget_bytes")
+                    if (h2d is not None and h2d_budget
+                            and float(h2d) >= float(h2d_budget)):
+                        problems.append(
+                            f"per-cycle h2d {float(h2d):,.0f}B >= the "
+                            f"committed donation-row budget "
+                            f"{float(h2d_budget):,.0f}B")
+                if row.get("lost_pods"):
+                    problems.append(
+                        f"lost_pods={row['lost_pods']} (arrivals "
+                        f"never bound)")
+                p99 = row.get("p99_arrival_to_bind_ms")
+                p99_budget = row.get("p99_budget_ms")
+                if (p99 is not None and p99_budget
+                        and float(p99) > float(p99_budget)):
+                    problems.append(
+                        f"arrival→bind p99 {float(p99):.0f}ms over "
+                        f"the {float(p99_budget):.0f}ms SLO")
+            elif metric.startswith("mirror_ab["):
+                if row.get("differential_match") is False:
+                    problems.append(
+                        "differential arms disagree on final "
+                        "placements (the mirror changed what was "
+                        "bound)")
+                on_h2d = row.get("h2d_per_cycle_on_bytes")
+                off_h2d = row.get("h2d_per_cycle_off_bytes")
+                # 10% headroom: per-cycle h2d jitters with batch
+                # splits even over identical traces — the flag is for
+                # scatter triples costing MORE than the encode they
+                # replaced, not for cycle-count noise
+                if (on_h2d is not None and off_h2d is not None
+                        and float(on_h2d) > 1.10 * float(off_h2d)):
+                    problems.append(
+                        f"mirror-on per-cycle h2d "
+                        f"{float(on_h2d):,.0f}B above the off arm's "
+                        f"{float(off_h2d):,.0f}B (scatter triples "
+                        f"cost more than the encode they replaced)")
+            else:  # mirror_cell[...]
+                if row.get("ok") is False:
+                    problems.append(
+                        f"cell failed: {row.get('failure') or '?'}")
+                if row.get("differential_match") is False:
+                    problems.append(
+                        "differential arms disagree across the fault")
+                if row.get("lost_pods"):
+                    problems.append(
+                        f"lost_pods={row['lost_pods']} across the "
+                        f"fault")
+            if row.get("invariants_ok") is False:
+                why = row.get("invariants") or row.get("failure") or "?"
+                problems.append(f"invariants failed: {why}")
+            if problems:
+                flags.append({
+                    "metric": metric,
+                    "round": rnd["round"],
+                    "value": float(row.get("value", 0.0)),
+                    "problems": problems,
+                })
+    return flags
+
+
 def _short_metric(metric: str) -> str:
     m = re.match(r"(\w+)\[([^\]]*)\]", metric)
     return m.group(2) if m else metric
@@ -1033,6 +1132,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     fed_flags = federation_flags(rounds)
     crit_flags = critpath_flags(rounds)
     rt_flags = readtier_flags(rounds)
+    mir_flags = mirror_flags(rounds)
     telemetry = summarize_telemetry(args.telemetry) \
         if args.telemetry else None
     if args.json:
@@ -1055,6 +1155,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "federation_flags": fed_flags,
             "critpath_flags": crit_flags,
             "readtier_flags": rt_flags,
+            "mirror_flags": mir_flags,
             "telemetry": telemetry,
         }, indent=1))
     else:
@@ -1104,6 +1205,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             for f in rt_flags:
                 print(f"  r{f['round']} {_short_metric(f['metric'])}: "
                       + "; ".join(f["problems"]))
+        if mir_flags:
+            print("\ndevice-mirror flags:")
+            for f in mir_flags:
+                print(f"  r{f['round']} {_short_metric(f['metric'])}: "
+                      + "; ".join(f["problems"]))
         if telemetry:
             print(f"\ntelemetry stream ({args.telemetry}): "
                   f"{telemetry['cycles']} cycles "
@@ -1116,7 +1222,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  and (open_flags or scale_flags or dev_flags
                       or rep_flags or sus_flags or hot_flags
                       or upg_flags or fed_flags
-                      or crit_flags or rt_flags)) else 0
+                      or crit_flags or rt_flags
+                      or mir_flags)) else 0
 
 
 if __name__ == "__main__":
